@@ -1,0 +1,29 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference framework.
+
+A ground-up re-design of the capabilities of NVIDIA Dynamo (the orchestration
+plane for high-throughput distributed LLM serving) for TPU hardware:
+
+- ``dynamo_tpu.runtime``  — distributed runtime: streaming engines, pipeline
+  graph, component/endpoint discovery, control plane (KV store with leases and
+  watches + message bus), TCP data plane.  (Reference: ``lib/runtime`` crate.)
+- ``dynamo_tpu.llm``      — LLM domain library: OpenAI protocol types, HTTP
+  frontend, preprocessor, detokenizing backend, KV-aware router, disaggregated
+  prefill/decode router, KV block manager, mocker engine.  (Reference:
+  ``lib/llm`` crate.)
+- ``dynamo_tpu.models``   — JAX model definitions (Llama/Qwen/Mixtral-class)
+  built for pjit/SPMD sharding over a ``jax.sharding.Mesh``.
+- ``dynamo_tpu.ops``      — TPU compute ops: paged attention, block
+  gather/scatter (Pallas), RoPE, rmsnorm, sampling.
+- ``dynamo_tpu.parallel`` — mesh construction, sharding specs, multi-host
+  bootstrap, cross-mesh KV transfer (ICI/DCN; replaces NIXL/RDMA).
+- ``dynamo_tpu.engine``   — the in-process JAX inference engine: paged KV
+  cache, continuous-batching scheduler, streaming generate loop.  (Replaces
+  the reference's vLLM/SGLang/TRT-LLM adapters with a native engine.)
+- ``dynamo_tpu.planner``  — load/SLA autoscaling planner.
+- ``dynamo_tpu.sdk``      — service-graph DSL + local serving.
+
+The compute path is JAX/XLA/Pallas; orchestration is asyncio Python with
+native (C++) components for hot data-plane paths under ``csrc/``.
+"""
+
+__version__ = "0.1.0"

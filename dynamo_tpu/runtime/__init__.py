@@ -1,0 +1,17 @@
+from dynamo_tpu.runtime.engine import (
+    AsyncEngine,
+    Context,
+    EngineContext,
+    Operator,
+    ResponseStream,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "EngineContext",
+    "Operator",
+    "ResponseStream",
+    "DistributedRuntime",
+]

@@ -1,0 +1,179 @@
+"""Network egress: endpoint clients and the push router.
+
+Mirrors the reference's client/egress stack (reference:
+lib/runtime/src/component/client.rs, pipeline/network/egress/push_router.rs):
+a ``Client`` tracks live instances (static list or dynamic KV watch); a
+``PushRouter`` picks an instance per request (random / round-robin / direct),
+registers a local TCP response stream, and pushes the request envelope to the
+instance's bus subject.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import random
+
+import msgpack
+
+from dynamo_tpu.runtime.component import Endpoint, Instance, instances_prefix
+from dynamo_tpu.runtime.controlplane.interface import WatchEventType
+from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.client")
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    KV = "kv"  # KV-cache-aware; scheduling provided by dynamo_tpu.llm.kv_router
+
+
+class Client:
+    """Tracks instances of one endpoint; generates requests against them."""
+
+    def __init__(
+        self,
+        runtime,
+        endpoint: Endpoint,
+        *,
+        static_instances: list[Instance] | None = None,
+    ):
+        self.runtime = runtime
+        self.endpoint = endpoint
+        self._static = static_instances is not None
+        self._instances: dict[int, Instance] = {
+            i.instance_id: i for i in (static_instances or [])
+        }
+        self._watch = None
+        self._watch_task: asyncio.Task | None = None
+        self._changed = asyncio.Event()
+
+    async def start(self) -> None:
+        if self._static:
+            return
+        prefix = instances_prefix(
+            self.endpoint.component.namespace.name,
+            self.endpoint.component.name,
+            self.endpoint.name,
+        )
+        self._watch = self.runtime.plane.kv.watch_prefix(prefix)
+        self._watch_task = asyncio.ensure_future(self._watch_loop())
+
+    async def _watch_loop(self) -> None:
+        assert self._watch is not None
+        async for event in self._watch:
+            try:
+                inst = Instance.from_json(event.entry.value)
+            except Exception:  # noqa: BLE001
+                continue
+            if event.type == WatchEventType.PUT:
+                self._instances[inst.instance_id] = inst
+            else:
+                self._instances.pop(inst.instance_id, None)
+            self._changed.set()
+            self._changed = asyncio.Event()
+
+    async def close(self) -> None:
+        if self._watch is not None:
+            self._watch.cancel()
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+
+    # -- instance views ----------------------------------------------------
+    @property
+    def instances(self) -> list[Instance]:
+        return list(self._instances.values())
+
+    @property
+    def instance_ids(self) -> list[int]:
+        return list(self._instances.keys())
+
+    async def wait_for_instances(self, n: int = 1, timeout: float = 30.0) -> list[Instance]:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while len(self._instances) < n:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{self.endpoint.path}: {len(self._instances)}/{n} instances after {timeout}s"
+                )
+            changed = self._changed
+            try:
+                await asyncio.wait_for(changed.wait(), min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass
+        return self.instances
+
+
+class PushRouter:
+    """Routes requests to instances and returns the response stream."""
+
+    def __init__(self, client: Client, mode: RouterMode = RouterMode.RANDOM):
+        self.client = client
+        self.mode = mode
+        self._rr = 0
+
+    @classmethod
+    async def from_endpoint(
+        cls, endpoint: Endpoint, mode: RouterMode = RouterMode.RANDOM
+    ) -> "PushRouter":
+        client = await endpoint.client()
+        return cls(client, mode)
+
+    def _pick(self, instance_id: int | None) -> Instance:
+        instances = self.client.instances
+        if instance_id is not None:
+            inst = self.client._instances.get(instance_id)
+            if inst is None:
+                raise RuntimeError(f"instance {instance_id:x} not found")
+            return inst
+        if not instances:
+            raise RuntimeError(f"no instances available for {self.client.endpoint.path}")
+        if self.mode == RouterMode.ROUND_ROBIN:
+            inst = instances[self._rr % len(instances)]
+            self._rr += 1
+            return inst
+        return random.choice(instances)
+
+    async def generate(
+        self, request: Context[dict], *, instance_id: int | None = None
+    ) -> ResponseStream[dict]:
+        """Push ``request`` (a wire-dict) to an instance, return its stream."""
+        runtime = self.client.runtime
+        server = await runtime.data_server()
+        ctx = request.ctx
+        pending = server.register(ctx.id, ctx)
+        envelope = msgpack.packb(
+            {
+                "c": {"id": ctx.id, "ci": server.connection_info(ctx.id).to_dict()},
+                "p": request.data,
+            },
+            use_bin_type=True,
+        )
+        inst = self._pick(instance_id)
+        try:
+            await runtime.plane.bus.publish(inst.subject, envelope)
+            # rendezvous: wait for the worker to connect back before
+            # returning the stream (the reference awaits the prologue)
+            await asyncio.wait_for(pending.connected.wait(), timeout=30.0)
+        except Exception:
+            server.unregister(ctx.id)
+            raise
+        return ResponseStream(pending, ctx)
+
+    async def generate_direct(self, request: Context[dict], instance_id: int) -> ResponseStream[dict]:
+        return await self.generate(request, instance_id=instance_id)
+
+
+class RemoteEngine:
+    """AsyncEngine facade over a PushRouter (so pipelines can ``.link`` a
+    remote endpoint transparently)."""
+
+    def __init__(self, router: PushRouter, *, instance_id: int | None = None):
+        self.router = router
+        self.instance_id = instance_id
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        return await self.router.generate(request, instance_id=self.instance_id)

@@ -1,0 +1,253 @@
+"""TCP client for the dynctl control-plane server.
+
+Implements the same ``KeyValueStore`` / ``MessageBus`` interfaces as the
+memory backend by msgpack-RPC over one multiplexed connection.  Leases are
+kept alive by a background task at ttl/3 cadence (reference: etcd lease
+keep-alive, lib/runtime/src/transports/etcd.rs:44-170).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+
+from dynamo_tpu.runtime.controlplane.interface import (
+    ControlPlane,
+    KVEntry,
+    KeyValueStore,
+    Lease,
+    Message,
+    MessageBus,
+    Subscription,
+    Watch,
+    WatchEvent,
+    WatchEventType,
+)
+from dynamo_tpu.runtime.controlplane.wire import kv_entry_from_wire, pack_frame, read_frame
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.controlplane.client")
+
+
+class RpcConnection:
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._req_ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, object] = {}  # stream_id -> Watch | Subscription
+        self._unrouted: dict[int, list[dict]] = {}  # pushes racing registration
+        self._read_task: asyncio.Task | None = None
+        self._write_lock = asyncio.Lock()
+        self._closed = False
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._read_task = asyncio.ensure_future(self._read_loop())
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        while True:
+            frame = await read_frame(self._reader)
+            if frame is None:
+                break
+            if "i" in frame:  # rpc response
+                fut = self._pending.pop(frame["i"], None)
+                if fut is not None and not fut.done():
+                    if frame["ok"]:
+                        fut.set_result(frame.get("r"))
+                    else:
+                        fut.set_exception(RuntimeError(frame.get("e", "rpc error")))
+            elif "s" in frame:  # stream push
+                self._route_push(frame)
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("control plane connection lost"))
+        self._pending.clear()
+        for target in self._streams.values():
+            if isinstance(target, Watch):
+                target.cancel()
+            elif isinstance(target, Subscription):
+                target._closed = True
+                target._queue.put_nowait(None)
+        self._streams.clear()
+
+    def register_stream(self, stream_id: int, target: object) -> None:
+        """Attach a local stream handle; flush any pushes that raced it."""
+        self._streams[stream_id] = target
+        for frame in self._unrouted.pop(stream_id, []):
+            self._route_push(frame)
+
+    def _route_push(self, frame: dict) -> None:
+        target = self._streams.get(frame["s"])
+        if target is None:
+            # push arrived before the caller registered the handle (the rpc
+            # response and the first events race through the read loop)
+            self._unrouted.setdefault(frame["s"], []).append(frame)
+            return
+        kind, data = frame["t"], frame["d"]
+        if kind == "close":
+            self._streams.pop(frame["s"], None)
+            if isinstance(target, Watch):
+                target._close()
+            elif isinstance(target, Subscription):
+                target._queue.put_nowait(None)
+        elif kind == "kv" and isinstance(target, Watch):
+            target._emit(
+                WatchEvent(WatchEventType(data["type"]), kv_entry_from_wire(data["entry"]))
+            )
+        elif kind == "bus" and isinstance(target, Subscription):
+            target._deliver(
+                Message(subject=data["subject"], payload=data["payload"], reply_to=data["reply_to"])
+            )
+
+    async def call(self, method: str, *args, timeout: float | None = 30.0):
+        if self._closed:
+            raise ConnectionError("control plane connection closed")
+        req_id = next(self._req_ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._write_lock:
+            assert self._writer is not None
+            self._writer.write(pack_frame({"i": req_id, "m": method, "a": list(args)}))
+            await self._writer.drain()
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+
+
+class RemoteKV(KeyValueStore):
+    def __init__(self, conn: RpcConnection):
+        self._conn = conn
+        self._keepalive_tasks: dict[int, asyncio.Task] = {}
+
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        return await self._conn.call("kv.put", key, value, lease_id)
+
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        return await self._conn.call("kv.create", key, value, lease_id)
+
+    async def get(self, key: str) -> KVEntry | None:
+        result = await self._conn.call("kv.get", key)
+        return kv_entry_from_wire(result) if result else None
+
+    async def get_prefix(self, prefix: str) -> list[KVEntry]:
+        return [kv_entry_from_wire(d) for d in await self._conn.call("kv.get_prefix", prefix)]
+
+    async def delete(self, key: str) -> bool:
+        return await self._conn.call("kv.delete", key)
+
+    async def delete_prefix(self, prefix: str) -> int:
+        return await self._conn.call("kv.delete_prefix", prefix)
+
+    async def grant_lease(self, ttl: float) -> Lease:
+        lease_id = await self._conn.call("kv.grant_lease", ttl)
+        lease = Lease(id=lease_id, ttl=ttl)
+        self._keepalive_tasks[lease_id] = asyncio.ensure_future(self._keepalive_loop(lease))
+        return lease
+
+    async def _keepalive_loop(self, lease: Lease) -> None:
+        """Auto keep-alive (the client owns the heartbeat, like etcd's
+        lease keep-alive stream)."""
+        try:
+            while not lease.revoked:
+                await asyncio.sleep(max(lease.ttl / 3.0, 0.1))
+                ok = await self._conn.call("kv.keep_alive", lease.id)
+                if not ok:
+                    lease._revoked.set()
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            lease._revoked.set()
+
+    async def keep_alive(self, lease: Lease) -> None:
+        await self._conn.call("kv.keep_alive", lease.id)
+
+    async def revoke_lease(self, lease: Lease) -> None:
+        task = self._keepalive_tasks.pop(lease.id, None)
+        if task is not None:
+            task.cancel()
+        lease._revoked.set()
+        await self._conn.call("kv.revoke_lease", lease.id)
+
+    def watch_prefix(self, prefix: str) -> Watch:
+        watch = Watch()
+
+        async def _start() -> None:
+            stream_id = await self._conn.call("kv.watch_prefix", prefix)
+            self._conn.register_stream(stream_id, watch)
+            watch._stream_id = stream_id  # type: ignore[attr-defined]
+
+        asyncio.ensure_future(_start())
+        return watch
+
+
+class RemoteBus(MessageBus):
+    def __init__(self, conn: RpcConnection):
+        self._conn = conn
+
+    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
+        await self._conn.call("bus.publish", subject, payload, reply_to)
+
+    async def subscribe(self, subject: str, queue_group: str | None = None) -> Subscription:
+        sub = Subscription(subject)
+        stream_id = await self._conn.call("bus.subscribe", subject, queue_group)
+        self._conn.register_stream(stream_id, sub)
+        original_unsub = sub.unsubscribe
+
+        async def _unsub() -> None:
+            self._conn._streams.pop(stream_id, None)
+            try:
+                await self._conn.call("bus.unsubscribe", stream_id)
+            except ConnectionError:
+                pass
+            await original_unsub()
+
+        sub.unsubscribe = _unsub  # type: ignore[method-assign]
+        return sub
+
+    async def request(self, subject: str, payload: bytes, timeout: float = 5.0) -> bytes:
+        return await self._conn.call("bus.request", subject, payload, timeout, timeout=timeout + 5)
+
+    async def queue_publish(self, queue: str, payload: bytes) -> None:
+        await self._conn.call("bus.queue_publish", queue, payload)
+
+    async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
+        rpc_timeout = None if timeout is None else timeout + 5
+        return await self._conn.call("bus.queue_pop", queue, timeout, timeout=rpc_timeout)
+
+    async def queue_len(self, queue: str) -> int:
+        return await self._conn.call("bus.queue_len", queue)
+
+    async def object_put(self, bucket: str, name: str, data: bytes) -> None:
+        await self._conn.call("bus.object_put", bucket, name, data, timeout=120)
+
+    async def object_get(self, bucket: str, name: str) -> bytes | None:
+        return await self._conn.call("bus.object_get", bucket, name, timeout=120)
+
+    async def object_delete(self, bucket: str, name: str) -> bool:
+        return await self._conn.call("bus.object_delete", bucket, name)
+
+
+class RemoteControlPlane(ControlPlane):
+    def __init__(self, host: str, port: int):
+        self._conn = RpcConnection(host, port)
+        self.kv = RemoteKV(self._conn)
+        self.bus = RemoteBus(self._conn)
+
+    async def connect(self) -> None:
+        await self._conn.connect()
+        await self._conn.call("ping")
+
+    async def close(self) -> None:
+        for task in self.kv._keepalive_tasks.values():
+            task.cancel()
+        await self._conn.close()

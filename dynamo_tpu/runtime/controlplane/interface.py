@@ -1,0 +1,237 @@
+"""Abstract control-plane interfaces (etcd-class KV + NATS-class bus)."""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import AsyncIterator
+
+
+# --------------------------------------------------------------------------
+# Key-value store (discovery, leases, config watch)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class KVEntry:
+    key: str
+    value: bytes
+    revision: int = 0
+    lease_id: int = 0
+
+
+class WatchEventType(enum.Enum):
+    PUT = "put"
+    DELETE = "delete"
+
+
+@dataclass
+class WatchEvent:
+    type: WatchEventType
+    entry: KVEntry
+
+
+@dataclass
+class Lease:
+    """A liveness lease; keys attached to it vanish when it expires.
+
+    (Reference: etcd leases, lib/runtime/src/transports/etcd.rs:51-88 — the
+    liveness primitive for failure detection.)
+    """
+
+    id: int
+    ttl: float
+    _revoked: asyncio.Event = field(default_factory=asyncio.Event)
+
+    @property
+    def revoked(self) -> bool:
+        return self._revoked.is_set()
+
+
+class KeyValueStore(ABC):
+    @abstractmethod
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        """Put; returns new revision."""
+
+    @abstractmethod
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        """Atomically create iff absent (etcd CAS kv_create). False if exists."""
+
+    @abstractmethod
+    async def get(self, key: str) -> KVEntry | None:
+        ...
+
+    @abstractmethod
+    async def get_prefix(self, prefix: str) -> list[KVEntry]:
+        ...
+
+    @abstractmethod
+    async def delete(self, key: str) -> bool:
+        ...
+
+    @abstractmethod
+    async def delete_prefix(self, prefix: str) -> int:
+        ...
+
+    @abstractmethod
+    async def grant_lease(self, ttl: float) -> Lease:
+        """Grant a lease; caller must keep it alive via ``keep_alive``."""
+
+    @abstractmethod
+    async def keep_alive(self, lease: Lease) -> None:
+        """Refresh lease TTL once."""
+
+    @abstractmethod
+    async def revoke_lease(self, lease: Lease) -> None:
+        ...
+
+    @abstractmethod
+    def watch_prefix(self, prefix: str) -> "Watch":
+        """Watch a prefix: yields initial snapshot as PUTs, then live events."""
+
+
+class Watch:
+    """Async stream of WatchEvents with a cancel handle."""
+
+    def __init__(self) -> None:
+        self._queue: asyncio.Queue[WatchEvent | None] = asyncio.Queue()
+        self._cancelled = False
+
+    def _emit(self, event: WatchEvent) -> None:
+        if not self._cancelled:
+            self._queue.put_nowait(event)
+
+    def _close(self) -> None:
+        self._queue.put_nowait(None)
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self._queue.put_nowait(None)
+
+    def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        event = await self._queue.get()
+        if event is None or self._cancelled:
+            raise StopAsyncIteration
+        return event
+
+
+# --------------------------------------------------------------------------
+# Message bus (request push, work queues, object store, stats)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Message:
+    subject: str
+    payload: bytes
+    reply_to: str | None = None
+
+
+class Subscription:
+    """Async stream of Messages for a subject (optionally queue-grouped)."""
+
+    def __init__(self, subject: str) -> None:
+        self.subject = subject
+        self._queue: asyncio.Queue[Message | None] = asyncio.Queue()
+        self._closed = False
+
+    def _deliver(self, msg: Message) -> None:
+        if not self._closed:
+            self._queue.put_nowait(msg)
+
+    async def unsubscribe(self) -> None:
+        self._closed = True
+        self._queue.put_nowait(None)
+
+    def pending(self) -> int:
+        return self._queue.qsize()
+
+    def __aiter__(self) -> AsyncIterator[Message]:
+        return self
+
+    async def __anext__(self) -> Message:
+        msg = await self._queue.get()
+        if msg is None or self._closed:
+            raise StopAsyncIteration
+        return msg
+
+
+@dataclass
+class Bucket:
+    """Object-store bucket handle (model artifacts; reference:
+    lib/runtime/src/transports/nats.rs:123-211)."""
+
+    name: str
+
+
+class MessageBus(ABC):
+    @abstractmethod
+    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
+        ...
+
+    @abstractmethod
+    async def subscribe(self, subject: str, queue_group: str | None = None) -> Subscription:
+        """Wildcard ``*`` (one token) and ``>`` (rest) are supported.
+
+        Within a queue group, each message goes to exactly one subscriber.
+        """
+
+    @abstractmethod
+    async def request(self, subject: str, payload: bytes, timeout: float = 5.0) -> bytes:
+        """Request/reply (service stats scraping)."""
+
+    # ---- durable work queue (JetStream work-queue analog; prefill queue) --
+    @abstractmethod
+    async def queue_publish(self, queue: str, payload: bytes) -> None:
+        ...
+
+    @abstractmethod
+    async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
+        """Pop one item; None on timeout. Exactly-one-consumer semantics."""
+
+    @abstractmethod
+    async def queue_len(self, queue: str) -> int:
+        ...
+
+    # ---- object store -----------------------------------------------------
+    @abstractmethod
+    async def object_put(self, bucket: str, name: str, data: bytes) -> None:
+        ...
+
+    @abstractmethod
+    async def object_get(self, bucket: str, name: str) -> bytes | None:
+        ...
+
+    @abstractmethod
+    async def object_delete(self, bucket: str, name: str) -> bool:
+        ...
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style subject matching: ``a.*.c`` and ``a.>``."""
+    p_tokens = pattern.split(".")
+    s_tokens = subject.split(".")
+    for i, tok in enumerate(p_tokens):
+        if tok == ">":
+            return True
+        if i >= len(s_tokens):
+            return False
+        if tok != "*" and tok != s_tokens[i]:
+            return False
+    return len(p_tokens) == len(s_tokens)
+
+
+class ControlPlane(ABC):
+    """A connected control plane: KV store + message bus + lifecycle."""
+
+    kv: KeyValueStore
+    bus: MessageBus
+
+    @abstractmethod
+    async def close(self) -> None:
+        ...

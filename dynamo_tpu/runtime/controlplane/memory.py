@@ -1,0 +1,221 @@
+"""In-process control plane (static/dev mode and tests).
+
+Implements full etcd/NATS-class semantics — revisions, CAS, leases with expiry
+reaping, prefix watches, queue groups, request/reply, durable queues, object
+store — entirely in process.  The ``dynctl`` TCP server wraps this same state
+machine; memory mode is the reference's "static mode without discovery"
+(reference: lib/runtime/src/distributed.rs:86) but with discovery working.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+import uuid
+from collections import defaultdict
+
+from dynamo_tpu.runtime.controlplane.interface import (
+    ControlPlane,
+    KVEntry,
+    KeyValueStore,
+    Lease,
+    Message,
+    MessageBus,
+    Subscription,
+    Watch,
+    WatchEvent,
+    WatchEventType,
+    subject_matches,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.controlplane.memory")
+
+
+class MemoryKV(KeyValueStore):
+    def __init__(self) -> None:
+        self._data: dict[str, KVEntry] = {}
+        self._revision = 0
+        self._leases: dict[int, tuple[Lease, float]] = {}  # id -> (lease, deadline)
+        self._lease_keys: dict[int, set[str]] = defaultdict(set)
+        self._watches: list[tuple[str, Watch]] = []
+        self._lease_counter = itertools.count(1)
+        self._reaper: asyncio.Task | None = None
+
+    # -- events ------------------------------------------------------------
+    def _notify(self, event: WatchEvent) -> None:
+        for prefix, watch in list(self._watches):
+            if event.entry.key.startswith(prefix):
+                watch._emit(event)
+
+    def _ensure_reaper(self) -> None:
+        if self._reaper is None or self._reaper.done():
+            self._reaper = asyncio.get_running_loop().create_task(self._reap_loop())
+
+    async def _reap_loop(self) -> None:
+        while self._leases:
+            await asyncio.sleep(0.2)
+            now = time.monotonic()
+            expired = [lid for lid, (_, deadline) in self._leases.items() if deadline < now]
+            for lid in expired:
+                await self._expire_lease(lid)
+        self._reaper = None
+
+    async def _expire_lease(self, lease_id: int) -> None:
+        entry = self._leases.pop(lease_id, None)
+        if entry is None:
+            return
+        lease, _ = entry
+        lease._revoked.set()
+        for key in self._lease_keys.pop(lease_id, set()):
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._notify(WatchEvent(WatchEventType.DELETE, old))
+
+    # -- KeyValueStore -----------------------------------------------------
+    async def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        self._revision += 1
+        entry = KVEntry(key=key, value=value, revision=self._revision, lease_id=lease_id)
+        self._data[key] = entry
+        if lease_id:
+            self._lease_keys[lease_id].add(key)
+        self._notify(WatchEvent(WatchEventType.PUT, entry))
+        return self._revision
+
+    async def create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        if key in self._data:
+            return False
+        await self.put(key, value, lease_id)
+        return True
+
+    async def get(self, key: str) -> KVEntry | None:
+        return self._data.get(key)
+
+    async def get_prefix(self, prefix: str) -> list[KVEntry]:
+        return [e for k, e in sorted(self._data.items()) if k.startswith(prefix)]
+
+    async def delete(self, key: str) -> bool:
+        old = self._data.pop(key, None)
+        if old is None:
+            return False
+        if old.lease_id:
+            self._lease_keys[old.lease_id].discard(key)
+        self._notify(WatchEvent(WatchEventType.DELETE, old))
+        return True
+
+    async def delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self._data if k.startswith(prefix)]
+        for k in keys:
+            await self.delete(k)
+        return len(keys)
+
+    async def grant_lease(self, ttl: float) -> Lease:
+        lease = Lease(id=next(self._lease_counter), ttl=ttl)
+        self._leases[lease.id] = (lease, time.monotonic() + ttl)
+        self._ensure_reaper()
+        return lease
+
+    async def keep_alive(self, lease: Lease) -> None:
+        if lease.id in self._leases:
+            self._leases[lease.id] = (lease, time.monotonic() + lease.ttl)
+
+    async def revoke_lease(self, lease: Lease) -> None:
+        await self._expire_lease(lease.id)
+
+    def watch_prefix(self, prefix: str) -> Watch:
+        watch = Watch()
+        for entry in list(self._data.values()):
+            if entry.key.startswith(prefix):
+                watch._emit(WatchEvent(WatchEventType.PUT, entry))
+        self._watches.append((prefix, watch))
+        return watch
+
+
+class MemoryBus(MessageBus):
+    def __init__(self) -> None:
+        # subject pattern -> {queue_group_or_None -> [subscriptions]}
+        self._subs: list[tuple[str, str | None, Subscription]] = []
+        self._rr: dict[tuple[str, str], int] = defaultdict(int)
+        self._queues: dict[str, asyncio.Queue[bytes]] = defaultdict(asyncio.Queue)
+        self._objects: dict[str, dict[str, bytes]] = defaultdict(dict)
+
+    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
+        msg = Message(subject=subject, payload=payload, reply_to=reply_to)
+        # group -> matching members; None-group members all get a copy
+        grouped: dict[str, list[Subscription]] = defaultdict(list)
+        for pattern, group, sub in list(self._subs):
+            if sub._closed or not subject_matches(pattern, subject):
+                continue
+            if group is None:
+                sub._deliver(msg)
+            else:
+                grouped[f"{pattern}|{group}"].append(sub)
+        for key, members in grouped.items():
+            idx = self._rr[(key, "")] % len(members)
+            self._rr[(key, "")] += 1
+            members[idx]._deliver(msg)
+
+    async def subscribe(self, subject: str, queue_group: str | None = None) -> Subscription:
+        sub = Subscription(subject)
+        self._subs.append((subject, queue_group, sub))
+        return sub
+
+    async def request(self, subject: str, payload: bytes, timeout: float = 5.0) -> bytes:
+        inbox = f"_inbox.{uuid.uuid4().hex}"
+        sub = await self.subscribe(inbox)
+        try:
+            await self.publish(subject, payload, reply_to=inbox)
+            msg = await asyncio.wait_for(sub.__anext__(), timeout)
+            return msg.payload
+        finally:
+            await sub.unsubscribe()
+
+    async def queue_publish(self, queue: str, payload: bytes) -> None:
+        self._queues[queue].put_nowait(payload)
+
+    async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
+        q = self._queues[queue]
+        try:
+            if timeout is None:
+                return await q.get()
+            return await asyncio.wait_for(q.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def queue_len(self, queue: str) -> int:
+        return self._queues[queue].qsize()
+
+    async def object_put(self, bucket: str, name: str, data: bytes) -> None:
+        self._objects[bucket][name] = data
+
+    async def object_get(self, bucket: str, name: str) -> bytes | None:
+        return self._objects[bucket].get(name)
+
+    async def object_delete(self, bucket: str, name: str) -> bool:
+        return self._objects[bucket].pop(name, None) is not None
+
+
+class MemoryControlPlane(ControlPlane):
+    """A fully in-process control plane instance."""
+
+    _named: dict[str, "MemoryControlPlane"] = {}
+
+    def __init__(self) -> None:
+        self.kv: MemoryKV = MemoryKV()
+        self.bus: MemoryBus = MemoryBus()
+
+    @classmethod
+    def named(cls, name: str) -> "MemoryControlPlane":
+        """Process-wide shared instance (so runtimes in one process discover
+        each other, like pointing at the same etcd)."""
+        if name not in cls._named:
+            cls._named[name] = cls()
+        return cls._named[name]
+
+    @classmethod
+    def reset_named(cls) -> None:
+        cls._named.clear()
+
+    async def close(self) -> None:
+        pass

@@ -1,0 +1,165 @@
+"""Network ingress: serving an endpoint instance.
+
+The worker-side push endpoint (reference:
+lib/runtime/src/pipeline/network/ingress/push_endpoint.rs:39-101): subscribes
+the instance's bus subject, spawns a handler task per request, connects back
+over TCP to stream responses, and tracks in-flight requests for graceful
+drain on shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import msgpack
+
+from dynamo_tpu.runtime.component import Instance, instance_key, stats_subject
+from dynamo_tpu.runtime.dataplane import ConnectionInfo, ResponseStreamSender
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineContext
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("runtime.ingress")
+
+
+class EndpointService:
+    """A live, registered instance serving one engine."""
+
+    def __init__(
+        self,
+        runtime,
+        instance: Instance,
+        engine: AsyncEngine,
+        *,
+        stats_handler=None,
+    ):
+        self.runtime = runtime
+        self.instance = instance
+        self.engine = engine
+        self.stats_handler = stats_handler
+        self._lease = None
+        self._sub = None
+        self._stats_sub = None
+        self._tasks: set[asyncio.Task] = set()
+        self._loop_task: asyncio.Task | None = None
+        self._stats_task: asyncio.Task | None = None
+        self._in_flight = 0
+        self._handled_total = 0
+        self._errors_total = 0
+        self._drained = asyncio.Event()
+        self._drained.set()
+        self._started_at = time.time()
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, lease_ttl: float = 3.0) -> None:
+        plane = self.runtime.plane
+        self._lease = await plane.kv.grant_lease(lease_ttl)
+        self._sub = await plane.bus.subscribe(self.instance.subject)
+        self._stats_sub = await plane.bus.subscribe(stats_subject(self.instance.subject))
+        self._loop_task = asyncio.ensure_future(self._serve_loop())
+        self._stats_task = asyncio.ensure_future(self._stats_loop())
+        self.runtime.register_keepalive(self._lease)
+        # register *after* subscribing so no request can race the subscription
+        await plane.kv.put(instance_key(self.instance), self.instance.to_json(), self._lease.id)
+        logger.info("serving %s (instance %x)", self.instance.subject, self.instance.instance_id)
+
+    async def shutdown(self, *, drain_timeout: float | None = None) -> None:
+        """Deregister, stop accepting, drain in-flight requests."""
+        plane = self.runtime.plane
+        await plane.kv.delete(instance_key(self.instance))
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self._stats_sub is not None:
+            await self._stats_sub.unsubscribe()
+        if drain_timeout is None:
+            drain_timeout = self.runtime.config.graceful_shutdown_timeout
+        try:
+            await asyncio.wait_for(self._drained.wait(), drain_timeout)
+        except asyncio.TimeoutError:
+            logger.warning(
+                "drain timeout: %d requests still in flight on %s",
+                self._in_flight,
+                self.instance.subject,
+            )
+        for task in (self._loop_task, self._stats_task):
+            if task is not None:
+                task.cancel()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._lease is not None:
+            await plane.kv.revoke_lease(self._lease)
+
+    # -- serving -----------------------------------------------------------
+    async def _serve_loop(self) -> None:
+        assert self._sub is not None
+        async for msg in self._sub:
+            try:
+                envelope = msgpack.unpackb(msg.payload, raw=False)
+            except Exception:  # noqa: BLE001
+                logger.warning("malformed request envelope on %s", self.instance.subject)
+                continue
+            task = asyncio.ensure_future(self._handle(envelope))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _handle(self, envelope: dict) -> None:
+        control = envelope["c"]
+        request = envelope["p"]
+        ctx = EngineContext(control["id"])
+        sender = ResponseStreamSender(ConnectionInfo.from_dict(control["ci"]), ctx)
+        self._in_flight += 1
+        self._drained.clear()
+        try:
+            await sender.connect()
+        except (ConnectionError, OSError) as exc:
+            logger.warning("connect-back failed for %s: %r", control["id"], exc)
+            self._request_done()
+            return
+        try:
+            stream = await self.engine.generate(Context(request, ctx))
+            async for item in stream:
+                if ctx.is_killed:
+                    break
+                await sender.send(item)
+            await sender.complete()
+            self._handled_total += 1
+        except asyncio.CancelledError:
+            await sender.error("worker shutting down")
+            raise
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("engine error on %s", self.instance.subject)
+            self._errors_total += 1
+            await sender.error(repr(exc))
+        finally:
+            self._request_done()
+
+    def _request_done(self) -> None:
+        self._in_flight -= 1
+        if self._in_flight == 0:
+            self._drained.set()
+
+    # -- stats -------------------------------------------------------------
+    def stats(self) -> dict:
+        data = {
+            "subject": self.instance.subject,
+            "instance_id": self.instance.instance_id,
+            "in_flight": self._in_flight,
+            "handled_total": self._handled_total,
+            "errors_total": self._errors_total,
+            "uptime_s": time.time() - self._started_at,
+        }
+        if self.stats_handler is not None:
+            try:
+                data["custom"] = self.stats_handler()
+            except Exception:  # noqa: BLE001
+                logger.exception("stats handler failed")
+        return data
+
+    async def _stats_loop(self) -> None:
+        assert self._stats_sub is not None
+        async for msg in self._stats_sub:
+            if msg.reply_to:
+                await self.runtime.plane.bus.publish(
+                    msg.reply_to, json.dumps(self.stats()).encode()
+                )

@@ -1,0 +1,4 @@
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+from dynamo_tpu.utils.tasks import CriticalTaskGroup
+
+__all__ = ["configure_logging", "get_logger", "CriticalTaskGroup"]

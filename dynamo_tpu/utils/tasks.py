@@ -1,0 +1,70 @@
+"""Supervised async task utilities.
+
+Mirrors the reference's ``CriticalTaskExecutionHandle`` (reference:
+lib/runtime/src/utils/task.rs): a critical task that fails or panics must take
+the whole runtime down rather than leave the process half-alive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections.abc import Coroutine
+from typing import Any, Callable
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("utils.tasks")
+
+
+class CriticalTaskGroup:
+    """Tracks supervised background tasks.
+
+    - ``spawn(coro)``: plain background task; exceptions are logged.
+    - ``spawn_critical(coro)``: if the task raises, ``on_failure`` is invoked
+      (typically ``runtime.shutdown``) so the process fails fast.
+    - ``cancel_all()``: cancel and await every tracked task.
+    """
+
+    def __init__(self, on_failure: Callable[[BaseException], Any] | None = None):
+        self._tasks: set[asyncio.Task] = set()
+        self._on_failure = on_failure
+
+    def spawn(self, coro: Coroutine, *, name: str | None = None) -> asyncio.Task:
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._tasks.add(task)
+        task.add_done_callback(self._done)
+        return task
+
+    def spawn_critical(self, coro: Coroutine, *, name: str | None = None) -> asyncio.Task:
+        task = self.spawn(coro, name=name)
+        task._dyn_critical = True  # type: ignore[attr-defined]
+        return task
+
+    def _done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None:
+            return
+        name = task.get_name()
+        if getattr(task, "_dyn_critical", False):
+            logger.error("critical task %s failed: %r", name, exc)
+            if self._on_failure is not None:
+                self._on_failure(exc)
+        else:
+            logger.warning("background task %s failed: %r", name, exc)
+
+    async def cancel_all(self) -> None:
+        tasks = list(self._tasks)
+        self._tasks.clear()
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    def __len__(self) -> int:
+        return len(self._tasks)

@@ -1,0 +1,43 @@
+"""Test harness configuration.
+
+- Forces JAX onto 8 virtual CPU devices (before any jax import) so all
+  sharding/mesh tests run without TPU hardware, mirroring the reference's
+  "every infra dependency has a mock twin" strategy (SURVEY.md §4).
+- Minimal asyncio support: ``async def`` test functions run under a fresh
+  event loop (no pytest-asyncio in the image).
+"""
+
+import asyncio
+import inspect
+import os
+
+# Must happen before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DYN_LOG", "warn")
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        loop = asyncio.new_event_loop()
+        try:
+            timeout = pyfuncitem.get_closest_marker("slow") and 300 or 60
+            loop.run_until_complete(asyncio.wait_for(fn(**kwargs), timeout=timeout))
+        finally:
+            loop.close()
+        return True
+    return None
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"

@@ -1,0 +1,189 @@
+"""Control-plane semantics: KV/CAS/lease/watch + bus subjects/queues.
+
+Covers the behaviors the reference gets from etcd + NATS (SURVEY.md §2.1
+etcd/NATS transports): CAS create, prefix watch with snapshot, lease expiry
+deleting keys and notifying watchers, queue-group load balancing,
+request/reply, durable work queue, object store — for both the memory backend
+and the dynctl TCP server.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.controlplane import MemoryControlPlane
+from dynamo_tpu.runtime.controlplane.client import RemoteControlPlane
+from dynamo_tpu.runtime.controlplane.interface import WatchEventType
+from dynamo_tpu.runtime.controlplane.server import ControlPlaneServer
+
+
+@pytest.fixture(params=["memory", "tcp"])
+def plane_factory(request):
+    return request.param
+
+
+async def make_plane(kind: str):
+    if kind == "memory":
+        return MemoryControlPlane(), None
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", server.port)
+    await plane.connect()
+    return plane, server
+
+
+async def teardown(plane, server):
+    await plane.close()
+    if server is not None:
+        await server.stop()
+
+
+async def test_kv_basic(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        rev1 = await plane.kv.put("a/b", b"1")
+        rev2 = await plane.kv.put("a/c", b"2")
+        assert rev2 > rev1
+        entry = await plane.kv.get("a/b")
+        assert entry is not None and entry.value == b"1"
+        assert await plane.kv.get("missing") is None
+        entries = await plane.kv.get_prefix("a/")
+        assert [e.key for e in entries] == ["a/b", "a/c"]
+        assert await plane.kv.delete("a/b") is True
+        assert await plane.kv.delete("a/b") is False
+        assert await plane.kv.delete_prefix("a/") == 1
+    finally:
+        await teardown(plane, server)
+
+
+async def test_kv_cas_create(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        assert await plane.kv.create("x", b"first") is True
+        assert await plane.kv.create("x", b"second") is False
+        entry = await plane.kv.get("x")
+        assert entry.value == b"first"
+    finally:
+        await teardown(plane, server)
+
+
+async def test_watch_snapshot_and_live(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        await plane.kv.put("w/a", b"1")
+        watch = plane.kv.watch_prefix("w/")
+        await asyncio.sleep(0.05)  # let remote watch register
+        await plane.kv.put("w/b", b"2")
+        await plane.kv.delete("w/a")
+
+        events = []
+        async for ev in watch:
+            events.append(ev)
+            if len(events) == 3:
+                watch.cancel()
+        assert events[0].type == WatchEventType.PUT and events[0].entry.key == "w/a"
+        kinds = [(e.type, e.entry.key) for e in events]
+        assert (WatchEventType.PUT, "w/b") in kinds
+        assert (WatchEventType.DELETE, "w/a") in kinds
+    finally:
+        await teardown(plane, server)
+
+
+async def test_lease_expiry_deletes_and_notifies(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        lease = await plane.kv.grant_lease(0.4)
+        await plane.kv.put("inst/1", b"alive", lease_id=lease.id)
+        watch = plane.kv.watch_prefix("inst/")
+        # swallow the snapshot PUT
+        first = await asyncio.wait_for(watch.__anext__(), 2)
+        assert first.type == WatchEventType.PUT
+        # stop keep-alive: revoke explicitly (remote auto-keepalive would
+        # otherwise keep it fresh forever)
+        await plane.kv.revoke_lease(lease)
+        ev = await asyncio.wait_for(watch.__anext__(), 2)
+        assert ev.type == WatchEventType.DELETE and ev.entry.key == "inst/1"
+        assert await plane.kv.get("inst/1") is None
+        watch.cancel()
+    finally:
+        await teardown(plane, server)
+
+
+async def test_lease_ttl_expiry_without_keepalive():
+    # memory backend: simulate a crashed client whose lease lapses
+    plane = MemoryControlPlane()
+    lease = await plane.kv.grant_lease(0.3)
+    await plane.kv.put("inst/2", b"alive", lease_id=lease.id)
+    await asyncio.sleep(0.8)
+    assert await plane.kv.get("inst/2") is None
+    assert lease.revoked
+
+
+async def test_bus_pubsub_and_queue_groups(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        plain = await plane.bus.subscribe("evt.>")
+        g1 = await plane.bus.subscribe("work.q", queue_group="g")
+        g2 = await plane.bus.subscribe("work.q", queue_group="g")
+        await asyncio.sleep(0.02)
+
+        await plane.bus.publish("evt.kv.stored", b"e1")
+        msg = await asyncio.wait_for(plain.__anext__(), 2)
+        assert msg.subject == "evt.kv.stored" and msg.payload == b"e1"
+
+        for i in range(4):
+            await plane.bus.publish("work.q", f"m{i}".encode())
+        await asyncio.sleep(0.05)
+        # queue group: each message to exactly one member, balanced
+        assert g1.pending() + g2.pending() == 4
+        assert g1.pending() == 2 and g2.pending() == 2
+        await plain.unsubscribe()
+        await g1.unsubscribe()
+        await g2.unsubscribe()
+    finally:
+        await teardown(plane, server)
+
+
+async def test_bus_request_reply(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        sub = await plane.bus.subscribe("svc.stats")
+        await asyncio.sleep(0.02)
+
+        async def responder():
+            msg = await sub.__anext__()
+            await plane.bus.publish(msg.reply_to, b"stats:" + msg.payload)
+
+        task = asyncio.ensure_future(responder())
+        reply = await plane.bus.request("svc.stats", b"hello", timeout=2)
+        assert reply == b"stats:hello"
+        await task
+        await sub.unsubscribe()
+    finally:
+        await teardown(plane, server)
+
+
+async def test_work_queue(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        await plane.bus.queue_publish("prefill", b"req1")
+        await plane.bus.queue_publish("prefill", b"req2")
+        assert await plane.bus.queue_len("prefill") == 2
+        assert await plane.bus.queue_pop("prefill", timeout=1) == b"req1"
+        assert await plane.bus.queue_pop("prefill", timeout=1) == b"req2"
+        assert await plane.bus.queue_pop("prefill", timeout=0.1) is None
+    finally:
+        await teardown(plane, server)
+
+
+async def test_object_store(plane_factory):
+    plane, server = await make_plane(plane_factory)
+    try:
+        blob = bytes(range(256)) * 100
+        await plane.bus.object_put("models", "card.json", blob)
+        assert await plane.bus.object_get("models", "card.json") == blob
+        assert await plane.bus.object_get("models", "absent") is None
+        assert await plane.bus.object_delete("models", "card.json") is True
+        assert await plane.bus.object_delete("models", "card.json") is False
+    finally:
+        await teardown(plane, server)

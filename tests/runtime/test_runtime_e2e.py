@@ -1,0 +1,218 @@
+"""Distributed runtime end-to-end: endpoint serving, discovery, push routing,
+TCP response streaming, cancellation propagation, graceful drain, failover.
+
+Exercises call stack SURVEY.md §3.2 minus the LLM layers: client →
+PushRouter → bus publish → PushEndpoint ingress → engine → TCP connect-back →
+ResponseStream.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime, ResponseStream
+from dynamo_tpu.runtime.client import PushRouter, RemoteEngine, RouterMode
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+class EchoEngine:
+    """Streams each input token back with a worker tag."""
+
+    def __init__(self, tag: str = "w"):
+        self.tag = tag
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        async def gen():
+            for tok in request.data["tokens"]:
+                yield {"token": tok, "worker": self.tag}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+class SlowEngine:
+    """Emits forever until stopped; used for cancellation tests."""
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        ctx = request.ctx
+
+        async def gen():
+            i = 0
+            while not ctx.is_stopped:
+                yield {"i": i}
+                i += 1
+                await asyncio.sleep(0.01)
+
+        return ResponseStream(gen(), ctx)
+
+
+@pytest.fixture
+async def runtime():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://test"))
+    yield rt
+    await rt.close()
+
+
+# fixture helper for non-async fixture injection under the custom asyncio runner
+@pytest.fixture
+def runtime_factory():
+    MemoryControlPlane.reset_named()
+
+    async def make():
+        return await DistributedRuntime.create(RuntimeConfig(control_plane="memory://test"))
+
+    return make
+
+
+async def test_serve_and_generate(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        service = await ep.serve(EchoEngine())
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+
+        stream = await router.generate(Context({"tokens": [1, 2, 3]}))
+        out = [item async for item in stream]
+        assert [o["token"] for o in out] == [1, 2, 3]
+        await service.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_round_robin_balances(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"))
+        s2 = await ep.serve(EchoEngine("w2"))
+        router = await PushRouter.from_endpoint(ep, RouterMode.ROUND_ROBIN)
+        await router.client.wait_for_instances(2, timeout=5)
+
+        seen = set()
+        for _ in range(4):
+            stream = await router.generate(Context({"tokens": [0]}))
+            out = await stream.collect()
+            seen.add(out[0]["worker"])
+        assert seen == {"w1", "w2"}
+        await s1.shutdown(drain_timeout=1)
+        await s2.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_direct_routing(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"), instance_id=111)
+        s2 = await ep.serve(EchoEngine("w2"), instance_id=222)
+        router = await PushRouter.from_endpoint(ep, RouterMode.DIRECT)
+        await router.client.wait_for_instances(2, timeout=5)
+
+        out = await (await router.generate_direct(Context({"tokens": [0]}), 222)).collect()
+        assert out[0]["worker"] == "w2"
+        out = await (await router.generate_direct(Context({"tokens": [0]}), 111)).collect()
+        assert out[0]["worker"] == "w1"
+        await s1.shutdown(drain_timeout=1)
+        await s2.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_cancellation_propagates_to_worker(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        service = await ep.serve(SlowEngine())
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+
+        req = Context({"tokens": []})
+        stream = await router.generate(req)
+        got = 0
+        async for _ in stream:
+            got += 1
+            if got == 3:
+                req.ctx.stop_generating()
+        assert got >= 3
+        # worker should drain to zero in-flight shortly after the stop
+        for _ in range(100):
+            if service._in_flight == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert service._in_flight == 0
+        await service.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_worker_death_removes_instance(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"))
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+        assert len(router.client.instances) == 1
+
+        await s1.shutdown(drain_timeout=1)
+        for _ in range(100):
+            if not router.client.instances:
+                break
+            await asyncio.sleep(0.02)
+        assert router.client.instances == []
+        with pytest.raises(RuntimeError, match="no instances"):
+            await router.generate(Context({"tokens": [1]}))
+    finally:
+        await rt.close()
+
+
+async def test_engine_error_surfaces_to_caller(runtime_factory):
+    rt = await runtime_factory()
+    try:
+
+        class FailingEngine:
+            async def generate(self, request):
+                raise ValueError("model exploded")
+
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        service = await ep.serve(FailingEngine())
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+
+        stream = await router.generate(Context({"tokens": [1]}))
+        with pytest.raises(RuntimeError, match="model exploded"):
+            await stream.collect()
+        await service.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_remote_engine_facade_and_stats(runtime_factory):
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        service = await ep.serve(EchoEngine(), stats_handler=lambda: {"kv_usage": 0.5})
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+
+        engine = RemoteEngine(router)
+        out = await (await engine.generate(Context({"tokens": [7]}))).collect()
+        assert out == [{"token": 7, "worker": "w"}]
+
+        # stats scrape over request/reply
+        import json
+
+        from dynamo_tpu.runtime.component import stats_subject
+
+        raw = await rt.plane.bus.request(
+            stats_subject(service.instance.subject), b"", timeout=2
+        )
+        stats = json.loads(raw)
+        assert stats["handled_total"] == 1
+        assert stats["custom"] == {"kv_usage": 0.5}
+        await service.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
