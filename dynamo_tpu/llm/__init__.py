@@ -1,0 +1,7 @@
+"""dynamo_tpu.llm — the LLM domain library.
+
+OpenAI-compatible protocol types + HTTP frontend, preprocessing (chat
+templates, tokenization), detokenizing backend, model cards and discovery,
+KV-aware routing, disaggregation, and the KV block manager.
+(Reference: the ``dynamo-llm`` crate, lib/llm/.)
+"""

@@ -1,0 +1,3 @@
+from dynamo_tpu.llm.http.service import HttpService, ModelManager
+
+__all__ = ["HttpService", "ModelManager"]

@@ -1,0 +1,111 @@
+"""Frontend Prometheus metrics.
+
+Per-model request/latency/token metrics under the ``dyn_llm`` prefix
+(reference: lib/llm/src/http/service/metrics.rs:94-260, prefix ``nv_llm``).
+``InflightGuard`` bumps the inflight gauge and records status + duration on
+drop, like the reference's RAII guard.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+PREFIX = "dyn_llm"
+
+
+class FrontendMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None):
+        self.registry = registry or CollectorRegistry()
+        self.requests_total = Counter(
+            f"{PREFIX}_http_service_requests_total",
+            "Total HTTP LLM requests",
+            ["model", "endpoint", "request_type", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{PREFIX}_http_service_inflight_requests",
+            "In-flight HTTP LLM requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            f"{PREFIX}_http_service_request_duration_seconds",
+            "Request duration",
+            ["model", "endpoint"],
+            registry=self.registry,
+            buckets=(0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0),
+        )
+        self.time_to_first_token = Histogram(
+            f"{PREFIX}_http_service_time_to_first_token_seconds",
+            "Time to first streamed token",
+            ["model"],
+            registry=self.registry,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0),
+        )
+        self.inter_token_latency = Histogram(
+            f"{PREFIX}_http_service_inter_token_latency_seconds",
+            "Latency between streamed tokens",
+            ["model"],
+            registry=self.registry,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+        )
+        self.input_tokens = Histogram(
+            f"{PREFIX}_http_service_input_sequence_tokens",
+            "Prompt token count",
+            ["model"],
+            registry=self.registry,
+            buckets=(16, 64, 256, 512, 1024, 2048, 4096, 8192, 16384, 32768, 131072),
+        )
+        self.output_tokens = Histogram(
+            f"{PREFIX}_http_service_output_sequence_tokens",
+            "Completion token count",
+            ["model"],
+            registry=self.registry,
+            buckets=(1, 4, 16, 64, 128, 256, 512, 1024, 2048, 8192),
+        )
+
+    def guard(self, model: str, endpoint: str, request_type: str) -> "InflightGuard":
+        return InflightGuard(self, model, endpoint, request_type)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class InflightGuard:
+    def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str, request_type: str):
+        self.metrics = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self.request_type = request_type
+        self.status = "error"
+        self._start = time.monotonic()
+        self._last_token: float | None = None
+        metrics.inflight.labels(model, endpoint).inc()
+
+    def mark_ok(self) -> None:
+        self.status = "success"
+
+    def token_observed(self) -> None:
+        now = time.monotonic()
+        if self._last_token is None:
+            self.metrics.time_to_first_token.labels(self.model).observe(now - self._start)
+        else:
+            self.metrics.inter_token_latency.labels(self.model).observe(now - self._last_token)
+        self._last_token = now
+
+    def done(self) -> None:
+        self.metrics.inflight.labels(self.model, self.endpoint).dec()
+        self.metrics.requests_total.labels(
+            self.model, self.endpoint, self.request_type, self.status
+        ).inc()
+        self.metrics.request_duration.labels(self.model, self.endpoint).observe(
+            time.monotonic() - self._start
+        )

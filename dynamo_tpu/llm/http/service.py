@@ -1,0 +1,263 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes (reference: lib/llm/src/http/service/openai.rs, service_v2.rs):
+- ``POST /v1/chat/completions``  (streaming SSE + unary)
+- ``POST /v1/completions``
+- ``POST /v1/embeddings``
+- ``GET  /v1/models``
+- ``GET  /health`` / ``GET /live``
+- ``GET  /metrics``              (Prometheus)
+
+``ModelManager`` holds per-model typed engines, added/removed dynamically by
+the discovery watcher (reference: lib/llm/src/discovery/model_manager.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from aiohttp import web
+
+from dynamo_tpu.llm.http.metrics import FrontendMetrics
+from dynamo_tpu.llm.protocols import sse
+from dynamo_tpu.llm.protocols.aggregator import (
+    aggregate_chat_stream,
+    aggregate_completion_stream,
+)
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    EmbeddingRequest,
+    ModelInfo,
+    ModelList,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.http")
+
+
+class ModelManager:
+    """Per-model engine registry, mutated live by discovery."""
+
+    def __init__(self) -> None:
+        self.chat_engines: dict[str, Any] = {}
+        self.completion_engines: dict[str, Any] = {}
+        self.embedding_engines: dict[str, Any] = {}
+
+    def add_chat_model(self, name: str, engine: Any) -> None:
+        self.chat_engines[name] = engine
+
+    def add_completion_model(self, name: str, engine: Any) -> None:
+        self.completion_engines[name] = engine
+
+    def add_embedding_model(self, name: str, engine: Any) -> None:
+        self.embedding_engines[name] = engine
+
+    def remove_model(self, name: str) -> None:
+        self.chat_engines.pop(name, None)
+        self.completion_engines.pop(name, None)
+        self.embedding_engines.pop(name, None)
+
+    def model_names(self) -> list[str]:
+        return sorted(
+            set(self.chat_engines) | set(self.completion_engines) | set(self.embedding_engines)
+        )
+
+
+def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}}, status=status
+    )
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager | None = None,
+        *,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics: FrontendMetrics | None = None,
+    ):
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = metrics or FrontendMetrics()
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.router.add_post("/v1/chat/completions", self.handle_chat)
+        self.app.router.add_post("/v1/completions", self.handle_completions)
+        self.app.router.add_post("/v1/embeddings", self.handle_embeddings)
+        self.app.router.add_get("/v1/models", self.handle_models)
+        self.app.router.add_get("/health", self.handle_health)
+        self.app.router.add_get("/live", self.handle_health)
+        self.app.router.add_get("/metrics", self.handle_metrics)
+        self._runner: web.AppRunner | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # resolve ephemeral port
+            self.port = s.getsockname()[1]
+            break
+        logger.info("HTTP frontend on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- handlers ----------------------------------------------------------
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy", "models": self.manager.model_names()})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        models = ModelList(data=[ModelInfo(id=name) for name in self.manager.model_names()])
+        return web.json_response(models.model_dump())
+
+    async def handle_chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            chat_request = ChatCompletionRequest.model_validate(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+        engine = self.manager.chat_engines.get(chat_request.model)
+        if engine is None:
+            return _error(404, f"model '{chat_request.model}' not found", "model_not_found")
+
+        guard = self.metrics.guard(chat_request.model, "chat_completions", "stream" if chat_request.stream else "unary")
+        try:
+            ctx = Context(chat_request)
+            try:
+                stream = await engine.generate(ctx)
+            except ValueError as exc:
+                return _error(400, str(exc))
+            if chat_request.stream:
+                return await self._stream_sse(request, stream, ctx, guard, chat_request.model)
+            chunks = _data_only(stream, guard)
+            response = await aggregate_chat_stream(chunks)
+            guard.mark_ok()
+            self._observe_usage(chat_request.model, response.usage)
+            return web.json_response(response.model_dump(exclude_none=True))
+        except asyncio.CancelledError:
+            ctx.ctx.kill()
+            raise
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("chat request failed")
+            return _error(500, repr(exc), "internal_error")
+        finally:
+            guard.done()
+
+    async def handle_completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            completion_request = CompletionRequest.model_validate(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+        engine = self.manager.completion_engines.get(completion_request.model)
+        if engine is None:
+            return _error(404, f"model '{completion_request.model}' not found", "model_not_found")
+
+        guard = self.metrics.guard(
+            completion_request.model, "completions", "stream" if completion_request.stream else "unary"
+        )
+        try:
+            ctx = Context(completion_request)
+            try:
+                stream = await engine.generate(ctx)
+            except ValueError as exc:
+                return _error(400, str(exc))
+            if completion_request.stream:
+                return await self._stream_sse(request, stream, ctx, guard, completion_request.model)
+            chunks = _data_only(stream, guard)
+            response = await aggregate_completion_stream(chunks)
+            guard.mark_ok()
+            self._observe_usage(completion_request.model, response.usage)
+            return web.json_response(response.model_dump(exclude_none=True))
+        except asyncio.CancelledError:
+            ctx.ctx.kill()
+            raise
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("completion request failed")
+            return _error(500, repr(exc), "internal_error")
+        finally:
+            guard.done()
+
+    async def handle_embeddings(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            embedding_request = EmbeddingRequest.model_validate(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request: {exc}")
+        engine = self.manager.embedding_engines.get(embedding_request.model)
+        if engine is None:
+            return _error(404, f"model '{embedding_request.model}' not found", "model_not_found")
+        guard = self.metrics.guard(embedding_request.model, "embeddings", "unary")
+        try:
+            response = await engine.embed(embedding_request)
+            guard.mark_ok()
+            return web.json_response(response.model_dump(exclude_none=True))
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("embedding request failed")
+            return _error(500, repr(exc), "internal_error")
+        finally:
+            guard.done()
+
+    # -- streaming ---------------------------------------------------------
+    async def _stream_sse(self, request, stream, ctx, guard, model: str) -> web.StreamResponse:
+        response = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await response.prepare(request)
+        completion_tokens = 0
+        try:
+            async for ann in stream:
+                if ann.is_annotation():
+                    await response.write(
+                        sse.encode_event(event=ann.event, comments=ann.comment).encode()
+                    )
+                    continue
+                guard.token_observed()
+                completion_tokens += 1
+                payload = json.dumps(ann.data.model_dump(exclude_none=True))
+                await response.write(sse.encode_event(data=payload).encode())
+            await response.write(sse.encode_done().encode())
+            guard.mark_ok()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: propagate kill upstream
+            ctx.ctx.kill()
+        finally:
+            self.metrics.output_tokens.labels(model).observe(completion_tokens)
+        await response.write_eof()
+        return response
+
+    def _observe_usage(self, model: str, usage) -> None:
+        if usage is None:
+            return
+        self.metrics.input_tokens.labels(model).observe(usage.prompt_tokens)
+        self.metrics.output_tokens.labels(model).observe(usage.completion_tokens)
+
+
+def _data_only(stream, guard):
+    """Strip annotations; count tokens for metrics."""
+
+    async def gen():
+        async for ann in stream:
+            if ann.is_annotation() or ann.data is None:
+                continue
+            guard.token_observed()
+            yield ann.data
+
+    return gen()

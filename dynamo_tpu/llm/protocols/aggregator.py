@@ -1,0 +1,99 @@
+"""Delta aggregation: fold a streamed response into a unary response for
+non-streaming clients (reference:
+lib/llm/src/protocols/openai/chat_completions/aggregator.rs,
+completions/aggregator.rs).
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator
+
+from dynamo_tpu.llm.protocols.openai import (
+    ChatChoice,
+    ChatCompletionChunk,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChoice,
+    CompletionResponse,
+    Usage,
+)
+
+
+async def aggregate_chat_stream(
+    chunks: AsyncIterator[ChatCompletionChunk],
+) -> ChatCompletionResponse:
+    response_id = ""
+    model = ""
+    created = 0
+    usage: Usage | None = None
+    # per-choice accumulation
+    contents: dict[int, list[str]] = {}
+    roles: dict[int, str] = {}
+    finish: dict[int, str | None] = {}
+    tool_calls: dict[int, list[dict]] = {}
+
+    async for chunk in chunks:
+        response_id = chunk.id or response_id
+        model = chunk.model or model
+        created = chunk.created or created
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for choice in chunk.choices:
+            idx = choice.index
+            contents.setdefault(idx, [])
+            if choice.delta.role:
+                roles[idx] = choice.delta.role
+            if choice.delta.content:
+                contents[idx].append(choice.delta.content)
+            if choice.delta.tool_calls:
+                tool_calls.setdefault(idx, []).extend(choice.delta.tool_calls)
+            if choice.finish_reason is not None:
+                finish[idx] = choice.finish_reason
+
+    choices = [
+        ChatChoice(
+            index=idx,
+            message=ChatMessage(
+                role=roles.get(idx, "assistant"),  # type: ignore[arg-type]
+                content="".join(parts),
+                tool_calls=tool_calls.get(idx) or None,
+            ),
+            finish_reason=finish.get(idx),
+        )
+        for idx, parts in sorted(contents.items())
+    ]
+    return ChatCompletionResponse(
+        id=response_id, model=model, created=created, choices=choices, usage=usage
+    )
+
+
+async def aggregate_completion_stream(
+    chunks: AsyncIterator[CompletionResponse],
+) -> CompletionResponse:
+    response_id = ""
+    model = ""
+    created = 0
+    usage: Usage | None = None
+    texts: dict[int, list[str]] = {}
+    finish: dict[int, str | None] = {}
+
+    async for chunk in chunks:
+        response_id = chunk.id or response_id
+        model = chunk.model or model
+        created = chunk.created or created
+        if chunk.usage is not None:
+            usage = chunk.usage
+        for choice in chunk.choices:
+            texts.setdefault(choice.index, [])
+            if choice.text:
+                texts[choice.index].append(choice.text)
+            if choice.finish_reason is not None:
+                finish[choice.index] = choice.finish_reason
+
+    choices = [
+        CompletionChoice(index=idx, text="".join(parts), finish_reason=finish.get(idx))
+        for idx, parts in sorted(texts.items())
+    ]
+    return CompletionResponse(
+        id=response_id, model=model, created=created, choices=choices, usage=usage
+    )

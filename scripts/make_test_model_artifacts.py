@@ -1,0 +1,104 @@
+"""Generate the self-contained test model artifacts in tests/data/tiny-chat-model/.
+
+Trains a tiny byte-level BPE tokenizer on a synthetic corpus and writes a
+llama-style chat template.  Run once; artifacts are committed so tests are
+deterministic and need no network (the reference bundles HF checkouts under
+lib/llm/tests/data/sample-models for the same reason; ours are generated, not
+copied).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from pathlib import Path
+
+from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+OUT = Path(__file__).parent.parent / "tests" / "data" / "tiny-chat-model"
+
+SPECIALS = ["<|bos|>", "<|eos|>", "<|sys|>", "<|user|>", "<|asst|>", "<|end|>", "<|pad|>"]
+
+CHAT_TEMPLATE = (
+    "{{ '<|bos|>' }}"
+    "{% for message in messages %}"
+    "{% if message.role == 'system' %}{{ '<|sys|>' + message.content + '<|end|>' }}"
+    "{% elif message.role == 'user' %}{{ '<|user|>' + message.content + '<|end|>' }}"
+    "{% elif message.role == 'assistant' %}{{ '<|asst|>' + message.content + '<|end|>' }}"
+    "{% endif %}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|asst|>' }}{% endif %}"
+)
+
+
+def synthetic_corpus() -> list[str]:
+    rng = random.Random(1337)
+    words = [
+        "the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog", "hello",
+        "world", "token", "stream", "model", "tensor", "shard", "mesh", "cache",
+        "block", "prefill", "decode", "route", "batch", "attention", "kernel",
+        "memory", "device", "python", "compile", "llama", "matrix", "vector",
+        "zero", "one", "two", "three", "four", "alpha", "beta", "gamma", "delta",
+    ]
+    lines = []
+    for _ in range(3000):
+        n = rng.randint(3, 14)
+        lines.append(" ".join(rng.choice(words) for _ in range(n)) + ".")
+    # unicode coverage so multi-byte decode paths are exercised
+    lines += ["héllo wörld 你好世界 🚀 émoji ñandú çava"] * 50
+    return lines
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    tokenizer = Tokenizer(models.BPE(unk_token=None))
+    tokenizer.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tokenizer.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=2048,
+        special_tokens=SPECIALS,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tokenizer.train_from_iterator(synthetic_corpus(), trainer)
+    tokenizer.save(str(OUT / "tokenizer.json"))
+
+    (OUT / "tokenizer_config.json").write_text(
+        json.dumps(
+            {
+                "model_type": "llama",
+                "bos_token": "<|bos|>",
+                "eos_token": "<|eos|>",
+                "pad_token": "<|pad|>",
+                "chat_template": CHAT_TEMPLATE,
+                "model_max_length": 2048,
+            },
+            indent=2,
+        )
+    )
+    # minimal config.json (tiny llama-class geometry for engine tests)
+    (OUT / "config.json").write_text(
+        json.dumps(
+            {
+                "model_type": "llama",
+                "vocab_size": tokenizer.get_vocab_size(),
+                "hidden_size": 64,
+                "intermediate_size": 128,
+                "num_hidden_layers": 2,
+                "num_attention_heads": 4,
+                "num_key_value_heads": 2,
+                "head_dim": 16,
+                "max_position_embeddings": 2048,
+                "rms_norm_eps": 1e-5,
+                "rope_theta": 10000.0,
+                "bos_token_id": 0,
+                "eos_token_id": 1,
+                "tie_word_embeddings": True,
+            },
+            indent=2,
+        )
+    )
+    print(f"wrote artifacts to {OUT}, vocab={tokenizer.get_vocab_size()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,271 @@
+"""Frontend slice e2e: OpenAI request → template → tokenize → engine →
+detokenize → SSE/unary response (SURVEY.md §3.1/§3.2 without the network hop).
+
+The echo-core engine streams prompt tokens back, so expected outputs are
+exactly computable.
+"""
+
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, StopSequenceJail
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.http import HttpService, ModelManager
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import ChatPreprocessor, CompletionPreprocessor
+from dynamo_tpu.llm.protocols.sse import SseDecoder
+from dynamo_tpu.llm.tokenizer import HfTokenizer
+from dynamo_tpu.runtime.engine import Context
+
+MODEL_DIR = Path(__file__).parent.parent / "data" / "tiny-chat-model"
+
+
+@pytest.fixture(scope="module")
+def mdc():
+    return ModelDeploymentCard.from_local_path(MODEL_DIR, name="tiny")
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    return HfTokenizer.from_file(MODEL_DIR / "tokenizer.json")
+
+
+def make_chat_pipeline(mdc, tokenizer):
+    return ChatPreprocessor(mdc, tokenizer).wrap(Backend(tokenizer).wrap(EchoEngineCore()))
+
+
+def make_completion_pipeline(mdc, tokenizer):
+    return CompletionPreprocessor(mdc, tokenizer).wrap(Backend(tokenizer).wrap(EchoEngineCore()))
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+
+def test_stop_jail_holds_partial_and_matches():
+    jail = StopSequenceJail(["</stop>"])
+    out, matched = jail.push("hello <")
+    assert out == "hello " and not matched
+    out, matched = jail.push("/st")
+    assert out == "" and not matched
+    out, matched = jail.push("op> tail")
+    assert matched and out == ""
+
+
+def test_stop_jail_releases_diverged_text():
+    jail = StopSequenceJail(["STOP"])
+    out, matched = jail.push("abcST")
+    assert out == "abc" and not matched
+    out, matched = jail.push("xyz")
+    assert out == "STxyz" and not matched
+
+
+def test_decode_stream_multibyte(tokenizer):
+    ids = tokenizer.encode("héllo 你好 🚀 done")
+    stream = tokenizer.decode_stream()
+    text = "".join(piece for piece in (stream.step(i) for i in ids) if piece)
+    assert text == "héllo 你好 🚀 done"
+
+
+def test_chat_template_rendering(mdc, tokenizer):
+    from dynamo_tpu.llm.preprocessor import PromptFormatter
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    formatter = PromptFormatter(mdc.chat_template)
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "tiny",
+            "messages": [
+                {"role": "system", "content": "be brief"},
+                {"role": "user", "content": "hello world"},
+            ],
+        }
+    )
+    prompt = formatter.render(req)
+    assert prompt == "<|bos|><|sys|>be brief<|end|><|user|>hello world<|end|><|asst|>"
+
+
+# ---------------------------------------------------------------------------
+# pipeline (no HTTP)
+# ---------------------------------------------------------------------------
+
+
+async def test_chat_pipeline_echoes_prompt(mdc, tokenizer):
+    pipeline = make_chat_pipeline(mdc, tokenizer)
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+
+    req = ChatCompletionRequest.model_validate(
+        {"model": "tiny", "messages": [{"role": "user", "content": "the quick brown fox"}]}
+    )
+    stream = await pipeline.generate(Context(req))
+    text = ""
+    async for ann in stream:
+        if ann.data is not None and ann.data.choices:
+            text += ann.data.choices[0].delta.content or ""
+    # echo returns the full rendered prompt (special tokens stripped on decode)
+    assert "the quick brown fox" in text
+
+
+async def test_completion_pipeline_with_stop_sequence(mdc, tokenizer):
+    pipeline = make_completion_pipeline(mdc, tokenizer)
+    from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+    req = CompletionRequest.model_validate(
+        {"model": "tiny", "prompt": "alpha beta gamma delta", "stop": ["gamma"], "max_tokens": 100}
+    )
+    stream = await pipeline.generate(Context(req))
+    text = ""
+    finish = None
+    async for ann in stream:
+        if ann.data is not None and ann.data.choices:
+            text += ann.data.choices[0].text
+            if ann.data.choices[0].finish_reason:
+                finish = ann.data.choices[0].finish_reason
+    assert "gamma" not in text
+    assert "alpha beta" in text
+    assert finish == "stop"
+
+
+async def test_max_tokens_cuts_generation(mdc, tokenizer):
+    pipeline = make_completion_pipeline(mdc, tokenizer)
+    from dynamo_tpu.llm.protocols.openai import CompletionRequest
+
+    req = CompletionRequest.model_validate(
+        {"model": "tiny", "prompt": "one two three four", "max_tokens": 2}
+    )
+    stream = await pipeline.generate(Context(req))
+    finish = None
+    n_tokens = 0
+    async for ann in stream:
+        if ann.data is not None and ann.data.choices:
+            n_tokens += 1
+            if ann.data.choices[0].finish_reason:
+                finish = ann.data.choices[0].finish_reason
+    assert finish == "length"
+    assert n_tokens <= 3
+
+
+# ---------------------------------------------------------------------------
+# HTTP service
+# ---------------------------------------------------------------------------
+
+
+async def start_service(mdc, tokenizer):
+    manager = ModelManager()
+    manager.add_chat_model("tiny", make_chat_pipeline(mdc, tokenizer))
+    manager.add_completion_model("tiny", make_completion_pipeline(mdc, tokenizer))
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service
+
+
+async def test_http_models_health_metrics(mdc, tokenizer):
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.get("/v1/models")
+            assert r.status_code == 200
+            assert [m["id"] for m in r.json()["data"]] == ["tiny"]
+            r = await client.get("/health")
+            assert r.json()["status"] == "healthy"
+            r = await client.get("/metrics")
+            assert "dyn_llm_http_service_requests_total" in r.text
+    finally:
+        await service.stop()
+
+
+async def test_http_chat_unary(mdc, tokenizer):
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello world"}],
+                },
+                timeout=30,
+            )
+            assert r.status_code == 200
+            body = r.json()
+            assert body["object"] == "chat.completion"
+            assert "hello world" in body["choices"][0]["message"]["content"]
+    finally:
+        await service.stop()
+
+
+async def test_http_chat_streaming_sse(mdc, tokenizer):
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            decoder = SseDecoder()
+            chunks = []
+            async with client.stream(
+                "POST",
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "stream me"}],
+                    "stream": True,
+                    "stream_options": {"include_usage": True},
+                },
+                timeout=30,
+            ) as r:
+                assert r.status_code == 200
+                assert r.headers["content-type"].startswith("text/event-stream")
+                async for chunk in r.aiter_bytes():
+                    for event in decoder.feed(chunk):
+                        if event["data"] and event["data"] != "[DONE]":
+                            chunks.append(json.loads(event["data"]))
+            text = "".join(
+                c["choices"][0]["delta"].get("content") or ""
+                for c in chunks
+                if c.get("choices")
+            )
+            assert "stream me" in text
+            usages = [c["usage"] for c in chunks if c.get("usage")]
+            assert usages and usages[-1]["completion_tokens"] > 0
+    finally:
+        await service.stop()
+
+
+async def test_http_unknown_model_404(mdc, tokenizer):
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "absent", "messages": [{"role": "user", "content": "x"}]},
+            )
+            assert r.status_code == 404
+    finally:
+        await service.stop()
+
+
+async def test_http_annotations_via_sse_events(mdc, tokenizer):
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            decoder = SseDecoder()
+            events = []
+            async with client.stream(
+                "POST",
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "annotate"}],
+                    "stream": True,
+                    "ext": {"annotations": ["formatted_prompt", "token_ids"]},
+                },
+                timeout=30,
+            ) as r:
+                async for chunk in r.aiter_bytes():
+                    events.extend(decoder.feed(chunk))
+            names = {e["event"] for e in events if e["event"]}
+            assert {"formatted_prompt", "token_ids"} <= names
+    finally:
+        await service.stop()
