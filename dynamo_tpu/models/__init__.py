@@ -1,0 +1,10 @@
+"""JAX model definitions, built TPU-first.
+
+Functional param-pytree models (no framework state): stacked layer weights
+scanned with ``lax.scan`` for fast compiles, PartitionSpec sharding for
+pjit/GSPMD tensor parallelism, paged KV cache threaded through the forwards.
+"""
+
+from dynamo_tpu.models.llama import LlamaConfig, llama_forward_decode, llama_forward_prefill
+
+__all__ = ["LlamaConfig", "llama_forward_decode", "llama_forward_prefill"]

@@ -1,0 +1,325 @@
+"""Llama-family model (Llama 2/3, DeepSeek-R1-Distill-Llama, Qwen2-class
+geometries via config).
+
+TPU-first design decisions:
+- layer weights stacked on a leading axis and iterated with ``lax.scan`` —
+  one compiled layer body regardless of depth (fast compile, small HLO);
+- tensor parallelism by sharding annotation only: params carry
+  ``PartitionSpec``s over mesh axis ``tp``; XLA/GSPMD inserts the
+  all-reduces (no hand-written collectives in the model);
+- paged KV cache (``[layers, num_blocks, block_size, kv_heads, head_dim]``)
+  threaded through prefill/decode as scan-carried state;
+- bf16 params/activations, fp32 softmax/norms.
+
+The reference has no model code (engines own it); this replaces the
+vLLM/TRT-LLM model layer for the native TPU engine (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.ops.attention import (
+    dense_causal_attention,
+    paged_decode_attention,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_position_embeddings: int = 131072
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "LlamaConfig":
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        heads = config["num_attention_heads"]
+        return cls(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_layers=config["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=config.get("num_key_value_heads", heads),
+            head_dim=config.get("head_dim") or config["hidden_size"] // heads,
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-5),
+            rope_theta=config.get("rope_theta", 10000.0),
+            tie_word_embeddings=config.get("tie_word_embeddings", False),
+        )
+
+    # --- presets (geometries for serving + bench; weights are loaded or
+    # random-initialized — no checkpoints ship with the framework) ---------
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(hidden_size=8192, intermediate_size=28672, num_layers=80, num_heads=64)
+
+    @classmethod
+    def llama32_3b(cls) -> "LlamaConfig":
+        return cls(
+            hidden_size=3072, intermediate_size=8192, num_layers=28, num_heads=24,
+            num_kv_heads=8, head_dim=128, rope_theta=500000.0, tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def llama32_1b(cls) -> "LlamaConfig":
+        return cls(
+            hidden_size=2048, intermediate_size=8192, num_layers=16, num_heads=32,
+            num_kv_heads=8, head_dim=64, rope_theta=500000.0, tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 512) -> "LlamaConfig":
+        """Test geometry: 2 layers, 4 heads — runs on the CPU mesh."""
+        return cls(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=16, max_position_embeddings=2048,
+            rope_theta=10000.0, tie_word_embeddings=True, dtype=jnp.float32,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
+    """Random-init parameter pytree (layer-stacked)."""
+    keys = jax.random.split(rng, 12)
+    h, i, l_ = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    params = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), 1.0),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "wq": norm_init(keys[1], (l_, h, qd), h),
+            "wk": norm_init(keys[2], (l_, h, kvd), h),
+            "wv": norm_init(keys[3], (l_, h, kvd), h),
+            "wo": norm_init(keys[4], (l_, qd, h), qd),
+            "mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "w_gate": norm_init(keys[5], (l_, h, i), h),
+            "w_up": norm_init(keys[6], (l_, h, i), h),
+            "w_down": norm_init(keys[7], (l_, i, h), i),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm_init(keys[8], (h, cfg.vocab_size), h)
+    return params
+
+
+def param_specs(cfg: LlamaConfig) -> dict:
+    """PartitionSpecs over mesh axes ('tp' for tensor parallel).  GSPMD
+    derives the collectives; this is the whole TP implementation."""
+    specs = {
+        "embed": P("tp", None),          # vocab-sharded
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),   # head-sharded
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),   # row-parallel → all-reduce
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")  # vocab-sharded logits
+    return specs
+
+
+def kv_cache_spec() -> P:
+    """KV cache sharded over kv heads on 'tp'."""
+    return P(None, None, None, "tp", None)
+
+
+def init_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int, dtype=None):
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    dtype = dtype or cfg.dtype
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+def _mlp(x, gate, up, down):
+    return jax.nn.silu(x @ gate) * (x @ up) @ down
+
+
+def _logits(params, cfg, x):
+    if cfg.tie_word_embeddings:
+        return x @ params["embed"].T.astype(x.dtype)
+    return x @ params["lm_head"]
+
+
+def llama_forward_prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,   # [seq_pad] int32
+    kv_cache: dict,           # {"k","v"}: [L, N, bs, kvh, d]
+    block_ids: jnp.ndarray,   # [max_blocks] int32
+    seq_len: jnp.ndarray,     # scalar int32: valid tokens
+    start_pos: jnp.ndarray,   # scalar int32: absolute position offset (chunked prefill)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Single-sequence prefill.  Returns (last-token logits [vocab], new cache)."""
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)  # [s, h]
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+        k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
+        attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+        x = x + attn.reshape(s, -1) @ w["wo"]
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _logits(params, cfg, last[None])[0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_forward_decode(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,     # [batch] int32 — last sampled token per seq
+    kv_cache: dict,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32 length INCLUDING this token
+    slot_ids: jnp.ndarray,      # [batch] int32 flat cache slot for this token
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Batched single-token decode.  Returns (logits [batch, vocab], cache)."""
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)  # [b, h]
+    positions = jnp.maximum(context_lens - 1, 0)      # this token's position
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q = (attn_in @ w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
+        k = (attn_in @ w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        v = (attn_in @ w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        # apply_rope expects a seq axis: insert and drop it
+        q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
+        k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
+        attn = paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
+        x = x + attn.reshape(b, -1) @ w["wo"]
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def make_rope_tables(cfg: LlamaConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
+    return rope_table(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+
+
+# ---------------------------------------------------------------------------
+# HF weight loading (safetensors) — for real checkpoints when present
+# ---------------------------------------------------------------------------
+
+_HF_LAYER_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "mlp_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+
+
+def load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
+    """Load and stack HF llama safetensors into our layer-stacked pytree.
+    (HF stores projections as [out, in]; ours are [in, out] → transpose.)"""
+    import numpy as np
+    from safetensors import safe_open
+
+    model_dir = Path(model_dir)
+    tensors: dict[str, np.ndarray] = {}
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors in {model_dir}")
+    for file in files:
+        with safe_open(str(file), framework="np") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+
+    def get(name: str, transpose: bool = False):
+        t = tensors[name]
+        if transpose:
+            t = t.T
+        return jnp.asarray(t, cfg.dtype)
+
+    layers: dict[str, list] = {k: [] for k in _HF_LAYER_MAP}
+    for i in range(cfg.num_layers):
+        for ours, theirs in _HF_LAYER_MAP.items():
+            transpose = ours.startswith("w")
+            layers[ours].append(get(theirs.format(i=i), transpose))
+    params = {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": get("model.norm.weight"),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
+        params["lm_head"] = get("lm_head.weight", transpose=True)
+    return params

@@ -1,0 +1,25 @@
+"""TPU compute ops.
+
+Pure-JAX reference implementations (run everywhere, incl. the 8-device CPU
+test mesh) + Pallas TPU kernels for the hot paths.  Everything is static-shape
+and jit-friendly: no data-dependent Python control flow.
+"""
+
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+from dynamo_tpu.ops.attention import (
+    dense_causal_attention,
+    paged_decode_attention,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_table",
+    "dense_causal_attention",
+    "paged_decode_attention",
+    "write_prefill_kv",
+    "sample_tokens",
+]

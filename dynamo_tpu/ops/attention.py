@@ -1,0 +1,160 @@
+"""Attention ops over the paged KV cache.
+
+The KV cache is a flat pool of fixed-size blocks per layer —
+``[num_blocks, block_size, kv_heads, head_dim]`` — addressed by per-sequence
+block tables (replaces the reference's engine-internal paged KV and its CUDA
+block_copy kernel, lib/llm/src/kernels/block_copy.cu, with XLA/Pallas-native
+equivalents).  All shapes are static; padding is masked, never branched on.
+
+Pure-JAX implementations here run on CPU test meshes and TPU alike; the
+Pallas TPU kernels in ``dynamo_tpu.ops.pallas`` override the hot paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def write_prefill_kv(
+    k_cache: jnp.ndarray,   # [num_blocks, block_size, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,     # [seq_pad, kv_heads, head_dim]
+    v_new: jnp.ndarray,
+    block_ids: jnp.ndarray,  # [max_blocks] int32, padded with any value
+    seq_len: jnp.ndarray,    # scalar int32: number of valid tokens
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter a prefilled sequence's K/V into its assigned cache blocks."""
+    num_blocks, block_size, _, _ = k_cache.shape
+    seq_pad = k_new.shape[0]
+    token_idx = jnp.arange(seq_pad, dtype=jnp.int32)
+    slots = block_ids[token_idx // block_size] * block_size + token_idx % block_size
+    # out-of-range sentinel for padding → dropped by scatter mode="drop"
+    slots = jnp.where(token_idx < seq_len, slots, num_blocks * block_size)
+    flat_k = k_cache.reshape(num_blocks * block_size, *k_cache.shape[2:])
+    flat_v = v_cache.reshape(num_blocks * block_size, *v_cache.shape[2:])
+    flat_k = flat_k.at[slots].set(k_new.astype(k_cache.dtype), mode="drop")
+    flat_v = flat_v.at[slots].set(v_new.astype(v_cache.dtype), mode="drop")
+    return flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape)
+
+
+def write_decode_kv(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    k_new: jnp.ndarray,      # [batch, kv_heads, head_dim] — one token per seq
+    v_new: jnp.ndarray,
+    slot_ids: jnp.ndarray,   # [batch] int32 flat slot (block*block_size+offset);
+                             # out-of-range ⇒ dropped (inactive batch lanes)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    num_blocks, block_size, _, _ = k_cache.shape
+    flat_k = k_cache.reshape(num_blocks * block_size, *k_cache.shape[2:])
+    flat_v = v_cache.reshape(num_blocks * block_size, *v_cache.shape[2:])
+    flat_k = flat_k.at[slot_ids].set(k_new.astype(k_cache.dtype), mode="drop")
+    flat_v = flat_v.at[slot_ids].set(v_new.astype(v_cache.dtype), mode="drop")
+    return flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape)
+
+
+def dense_causal_attention(
+    q: jnp.ndarray,  # [batch, seq, heads, head_dim]
+    k: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
+    v: jnp.ndarray,
+    seq_len: jnp.ndarray | None = None,  # [batch] valid lengths (padding mask)
+) -> jnp.ndarray:
+    """Causal self-attention for prefill (GQA-aware, fp32 softmax)."""
+    b, s, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    qg = q.reshape(b, s, kvh, groups, d)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum("bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    pos = jnp.arange(s)
+    causal = pos[None, :] <= pos[:, None]  # [q, s]
+    mask = causal[None, None, None, :, :]
+    if seq_len is not None:
+        valid = pos[None, :] < seq_len[:, None]  # [b, s]
+        mask = mask & valid[:, None, None, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", weights, v.astype(jnp.float32))
+    return out.reshape(b, s, h, d).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,            # [batch, heads, head_dim] — one query per seq
+    k_cache: jnp.ndarray,      # [num_blocks, block_size, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32 (0 ⇒ inactive lane)
+) -> jnp.ndarray:
+    """Decode-step attention: gather each sequence's pages and attend.
+
+    Pure-JAX fallback path; the Pallas kernel reads pages from HBM without
+    materializing the gather.
+    """
+    b, h, d = q.shape
+    _, block_size, kvh, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    groups = h // kvh
+
+    k = k_cache[block_tables]  # [b, max_blocks, bs, kvh, d]
+    v = v_cache[block_tables]
+    length = max_blocks * block_size
+    k = k.reshape(b, length, kvh, d)
+    v = v.reshape(b, length, kvh, d)
+
+    qg = q.reshape(b, kvh, groups, d).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum("bkgd,blkd->bkgl", qg, k.astype(jnp.float32)) * scale
+    valid = jnp.arange(length)[None, :] < context_lens[:, None]  # [b, l]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # fully-masked (inactive) lanes produce uniform weights; output is junk
+    # but those lanes are discarded by the scheduler
+    out = jnp.einsum("bkgl,blkd->bkgd", weights, v.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+def gather_prefix_kv(
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_ids: jnp.ndarray,  # [max_blocks]
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Materialize a sequence's cached K/V (for chunked prefill with reused
+    prefix blocks): returns [max_blocks*block_size, kv_heads, head_dim]."""
+    k = k_cache[block_ids]
+    v = v_cache[block_ids]
+    n, bs = k.shape[0], k.shape[1]
+    return k.reshape(n * bs, *k.shape[2:]), v.reshape(n * bs, *v.shape[2:])
+
+
+def prefill_attention_with_prefix(
+    q: jnp.ndarray,        # [seq_pad, heads, head_dim]
+    k_new: jnp.ndarray,    # [seq_pad, kv_heads, head_dim]
+    v_new: jnp.ndarray,
+    k_prefix: jnp.ndarray,  # [prefix_pad, kv_heads, head_dim] (gathered pages)
+    v_prefix: jnp.ndarray,
+    prefix_len: jnp.ndarray,  # scalar: valid prefix tokens
+    seq_len: jnp.ndarray,     # scalar: valid new tokens
+) -> jnp.ndarray:
+    """Chunked/continued prefill: queries attend to reused prefix + themselves."""
+    s, h, d = q.shape
+    kvh = k_new.shape[1]
+    groups = h // kvh
+    p = k_prefix.shape[0]
+    k = jnp.concatenate([k_prefix, k_new], axis=0).astype(jnp.float32)
+    v = jnp.concatenate([v_prefix, v_new], axis=0).astype(jnp.float32)
+    qg = q.reshape(s, kvh, groups, d).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum("qkgd,lkd->kgql", qg, k) * scale
+    q_pos = prefix_len + jnp.arange(s)
+    kv_pos = jnp.arange(p + s)
+    kv_valid = (kv_pos < prefix_len) | ((kv_pos >= p) & (kv_pos - p < seq_len))
+    causal = kv_pos[None, :] - jnp.where(kv_pos[None, :] >= p, p - prefix_len, 0) <= q_pos[:, None]
+    mask = causal & kv_valid[None, :]
+    logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("kgql,lkd->qkgd", weights, v)
+    return out.reshape(s, h, d).astype(q.dtype)

@@ -1,0 +1,15 @@
+"""Normalization ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """RMSNorm with float32 accumulation, cast back to input dtype (standard
+    llama-family numerics: normalize in fp32 even for bf16 activations)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 / jnp.sqrt(variance + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

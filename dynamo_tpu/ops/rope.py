@@ -1,0 +1,33 @@
+"""Rotary position embeddings.
+
+Split-half convention (llama-family): rotate pairs (x[..., :d/2], x[..., d/2:]).
+Tables are precomputed once per model and indexed by absolute position, so
+decode steps at arbitrary offsets are a cheap gather.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables, shape [max_len, head_dim//2], float32."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = jnp.arange(max_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,          # [..., seq, heads, head_dim]
+    positions: jnp.ndarray,  # [..., seq]
+    cos_table: jnp.ndarray,  # [max_len, head_dim//2]
+    sin_table: jnp.ndarray,
+) -> jnp.ndarray:
+    cos = cos_table[positions][..., None, :]  # [..., seq, 1, half]
+    sin = sin_table[positions][..., None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    rotated = jnp.concatenate([x1f * cos - x2f * sin, x2f * cos + x1f * sin], axis=-1)
+    return rotated.astype(x.dtype)

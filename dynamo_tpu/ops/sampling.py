@@ -1,0 +1,67 @@
+"""Token sampling: vectorized greedy / temperature / top-k / top-p.
+
+All sampling parameters are per-request arrays so one jitted call samples an
+entire continuous batch with heterogeneous settings (static shapes, no
+per-request branching).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jnp.ndarray,        # [batch, vocab] (any float dtype)
+    rng: jax.Array,
+    temperature: jnp.ndarray,   # [batch] float32; <=0 treated as greedy
+    top_k: jnp.ndarray,         # [batch] int32; <=0 disables
+    top_p: jnp.ndarray,         # [batch] float32; >=1 disables
+    greedy: jnp.ndarray,        # [batch] bool
+) -> jnp.ndarray:
+    """Returns sampled token ids [batch] int32."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    force_greedy = greedy | (temperature <= 1e-5)
+    safe_temp = jnp.where(force_greedy, 1.0, temperature)
+    scaled = logits / safe_temp[:, None]
+
+    # sorted-space filtering: one descending sort serves both top-k and top-p
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    sort_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_excl = jnp.cumsum(probs, axis=-1) - probs
+    ranks = jnp.arange(v)[None, :]
+
+    k_eff = jnp.where(top_k <= 0, v, top_k)[:, None]
+    p_eff = jnp.where(top_p >= 1.0, 2.0, top_p)[:, None]
+    keep = (ranks < k_eff) & (cum_excl < p_eff)
+    keep = keep.at[:, 0].set(True)  # always keep the best token
+
+    filtered_sorted = jnp.where(keep, sorted_logits, NEG_INF)
+    # sample in sorted space, map back through sort_idx
+    choice = jax.random.categorical(rng, filtered_sorted, axis=-1)
+    sampled_ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    return jnp.where(force_greedy, greedy_ids, sampled_ids)
+
+
+def apply_penalties(
+    logits: jnp.ndarray,            # [batch, vocab]
+    output_counts: jnp.ndarray,     # [batch, vocab] int32: tokens generated so far
+    presence_penalty: jnp.ndarray,  # [batch]
+    frequency_penalty: jnp.ndarray,  # [batch]
+    repetition_penalty: jnp.ndarray,  # [batch]; 1.0 disables
+) -> jnp.ndarray:
+    logits = logits.astype(jnp.float32)
+    appeared = (output_counts > 0).astype(jnp.float32)
+    logits = logits - presence_penalty[:, None] * appeared
+    logits = logits - frequency_penalty[:, None] * output_counts.astype(jnp.float32)
+    rep = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    logits = jnp.where(appeared > 0, penalized, logits)
+    return logits

@@ -1,0 +1,3 @@
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh, shard_pytree
+
+__all__ = ["MeshConfig", "make_mesh", "shard_pytree"]

@@ -1,0 +1,73 @@
+"""Device mesh construction and pytree sharding.
+
+The engine's parallelism is expressed entirely as a ``jax.sharding.Mesh``
+with named axes + PartitionSpecs; XLA emits the collectives over ICI/DCN
+(replaces the reference's delegation to NCCL inside engines —
+SURVEY.md §2.5).
+
+Axes (any may be 1): ``dp`` data, ``pp`` pipeline stage, ``tp`` tensor,
+``ep`` expert, ``sp`` sequence/context.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+AXIS_ORDER = ("dp", "pp", "ep", "tp", "sp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def total(self) -> int:
+        return self.dp * self.pp * self.ep * self.tp * self.sp
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    @classmethod
+    def tp_only(cls, tp: int) -> "MeshConfig":
+        return cls(tp=tp)
+
+
+def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
+    """Build a named mesh.  Defaults: all local devices on the ``tp`` axis.
+
+    Axis order puts ``tp``/``sp`` innermost so tensor-parallel collectives
+    ride the fastest ICI links (outer axes land on DCN for multi-host).
+    """
+    devices = devices if devices is not None else jax.devices()
+    if config is None:
+        config = MeshConfig(tp=len(devices))
+    n = config.total()
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    device_array = np.asarray(devices[:n]).reshape(
+        [config.axis_sizes()[a] for a in AXIS_ORDER]
+    )
+    return Mesh(device_array, AXIS_ORDER)
+
+
+def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_pytree(tree, specs, mesh: Mesh):
+    """Place a pytree on the mesh according to a matching specs pytree."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs
+    )
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())

@@ -10,8 +10,32 @@
 import asyncio
 import inspect
 import os
+import sys
 
-# Must happen before jax is imported anywhere in the test process.
+def pytest_configure(config):
+    # Tests run on 8 virtual CPU devices.  The TPU (axon) PJRT plugin
+    # registers itself at interpreter startup via sitecustomize and wedges
+    # CPU-only jax init, so if this process started with the TPU plugin
+    # active we re-exec pytest once with a clean environment (before
+    # anything initializes jax devices).
+    if os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get("_DYN_TEST_REEXEC") != "1":
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.stop_global_capturing()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        env = dict(os.environ)
+        env.update(
+            _DYN_TEST_REEXEC="1",
+            PALLAS_AXON_POOL_IPS="",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=(
+                env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+            ).strip(),
+        )
+        os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
