@@ -1,0 +1,12 @@
+"""The native JAX inference engine.
+
+Replaces the reference's external engines (vLLM/SGLang/TRT-LLM adapters,
+SURVEY.md §2.3) with an in-process TPU engine: paged KV cache in HBM,
+continuous-batching scheduler, jitted prefill/decode steps with SPMD
+sharding, per-token async streaming, and KV/load event publishing for the
+KV-aware router.
+"""
+
+from dynamo_tpu.engine.engine import EngineConfig, JaxLlmEngine
+
+__all__ = ["EngineConfig", "JaxLlmEngine"]

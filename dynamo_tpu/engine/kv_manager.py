@@ -1,0 +1,146 @@
+"""Host-side paged KV block allocator.
+
+Manages the block pool that lives in device HBM: free list, per-sequence
+block tables, and content hashes of full blocks.  Emits stored/removed KV
+events (the contract the KV-aware router indexes on — reference: vLLM
+KVEvents ingested via lib/llm/src/kv_router/publisher.rs; here the engine is
+native so events come straight from the allocator).
+
+Block hashing matches the router's scheme: xxh3_64 over
+(parent_hash, block token ids) with seed 1337 (reference:
+lib/llm/src/kv_router/indexer.rs:64,122).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable
+
+import xxhash
+
+HASH_SEED = 1337
+
+
+def compute_block_hashes(token_ids: list[int], block_size: int) -> list[int]:
+    """Chained content hashes for each FULL block of the sequence."""
+    hashes: list[int] = []
+    parent = 0
+    for start in range(0, len(token_ids) - len(token_ids) % block_size, block_size):
+        block = token_ids[start : start + block_size]
+        h = xxhash.xxh3_64(
+            parent.to_bytes(8, "little")
+            + b"".join(t.to_bytes(4, "little", signed=False) for t in block),
+            seed=HASH_SEED,
+        ).intdigest()
+        hashes.append(h)
+        parent = h
+    return hashes
+
+
+@dataclass
+class KvEvent:
+    kind: str                    # "stored" | "removed"
+    block_hashes: list[int]
+    parent_hash: int | None = None
+    token_count: int = 0
+
+
+@dataclass
+class SequenceBlocks:
+    block_ids: list[int] = field(default_factory=list)
+    published_hashes: list[int] = field(default_factory=list)
+
+
+class BlockAllocator:
+    """Free-list allocator over ``num_blocks`` fixed-size blocks."""
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        *,
+        event_sink: Callable[[KvEvent], None] | None = None,
+        watermark: float = 0.01,
+    ):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.event_sink = event_sink
+        self.watermark_blocks = max(1, int(num_blocks * watermark))
+        self._free: deque[int] = deque(range(num_blocks))
+        self._sequences: dict[str, SequenceBlocks] = {}
+
+    # -- capacity ----------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    @property
+    def usage(self) -> float:
+        return self.used_blocks / self.num_blocks
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.free_blocks - self.blocks_needed(num_tokens) >= self.watermark_blocks
+
+    # -- allocation --------------------------------------------------------
+    def allocate_sequence(self, seq_id: str, num_tokens: int) -> list[int] | None:
+        needed = self.blocks_needed(num_tokens)
+        if needed > self.free_blocks:
+            return None
+        blocks = [self._free.popleft() for _ in range(needed)]
+        self._sequences[seq_id] = SequenceBlocks(block_ids=blocks)
+        return list(blocks)
+
+    def append_slot(self, seq_id: str, context_len: int) -> int | None:
+        """Slot (flat cache index) for token at position ``context_len - 1``,
+        growing the block table if the token starts a new block.  None ⇒ OOM."""
+        seq = self._sequences[seq_id]
+        pos = context_len - 1
+        block_idx = pos // self.block_size
+        if block_idx >= len(seq.block_ids):
+            if not self._free:
+                return None
+            seq.block_ids.append(self._free.popleft())
+        return seq.block_ids[block_idx] * self.block_size + pos % self.block_size
+
+    def block_ids(self, seq_id: str) -> list[int]:
+        return list(self._sequences[seq_id].block_ids)
+
+    def free_sequence(self, seq_id: str) -> None:
+        seq = self._sequences.pop(seq_id, None)
+        if seq is None:
+            return
+        for b in seq.block_ids:
+            self._free.append(b)
+        if seq.published_hashes and self.event_sink:
+            self.event_sink(KvEvent(kind="removed", block_hashes=list(seq.published_hashes)))
+
+    # -- events ------------------------------------------------------------
+    def publish_stored(self, seq_id: str, token_ids: list[int]) -> None:
+        """Emit stored events for newly-completed full blocks of ``seq_id``."""
+        if self.event_sink is None:
+            return
+        seq = self._sequences.get(seq_id)
+        if seq is None:
+            return
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        new = hashes[len(seq.published_hashes):]
+        if not new:
+            return
+        parent = seq.published_hashes[-1] if seq.published_hashes else None
+        seq.published_hashes = hashes
+        self.event_sink(
+            KvEvent(
+                kind="stored",
+                block_hashes=new,
+                parent_hash=parent,
+                token_count=len(new) * self.block_size,
+            )
+        )

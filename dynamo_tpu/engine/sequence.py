@@ -1,0 +1,51 @@
+"""Per-request sequence state inside the engine."""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+
+from dynamo_tpu.llm.protocols.common import FinishReason, PreprocessedRequest
+
+
+class SeqStatus(enum.Enum):
+    WAITING = "waiting"       # queued for prefill
+    RUNNING = "running"       # decoding
+    PREEMPTED = "preempted"   # evicted; will re-prefill
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    seq_id: str
+    request: PreprocessedRequest
+    arrival_time: float = field(default_factory=time.monotonic)
+    status: SeqStatus = SeqStatus.WAITING
+    output_ids: list[int] = field(default_factory=list)
+    lane: int = -1            # decode batch lane while RUNNING
+    finish_reason: FinishReason | None = None
+    # callbacks into the async world (set by the engine)
+    emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.request.token_ids)
+
+    @property
+    def context_len(self) -> int:
+        return self.prompt_len + len(self.output_ids)
+
+    @property
+    def all_token_ids(self) -> list[int]:
+        return self.request.token_ids + self.output_ids
+
+    def hit_stop(self, token_id: int) -> FinishReason | None:
+        stop = self.request.stop
+        if not stop.ignore_eos and token_id in self.request.eos_token_ids:
+            return FinishReason.STOP
+        if token_id in stop.stop_token_ids:
+            return FinishReason.STOP
+        if stop.max_tokens is not None and len(self.output_ids) >= stop.max_tokens:
+            return FinishReason.LENGTH
+        return None

@@ -1,0 +1,172 @@
+"""JaxLlmEngine behavior: greedy correctness vs dense recompute, continuous
+batching, stop conditions, cancellation, preemption under KV pressure, stats.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.engine import Context
+
+from tests.models.test_llama import dense_reference_logits
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(**overrides) -> JaxLlmEngine:
+    defaults = dict(
+        model=CFG,
+        num_blocks=64,
+        block_size=4,
+        max_batch_size=4,
+        prefill_buckets=(16, 32, 64),
+        max_model_len=128,
+    )
+    defaults.update(overrides)
+    engine = JaxLlmEngine(EngineConfig(**defaults), params=PARAMS)
+    engine.start()
+    return engine
+
+
+def request(tokens, max_tokens=8, **kw) -> dict:
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, **kw),
+        eos_token_ids=[1],
+    ).to_wire()
+
+
+async def collect(engine, req_wire) -> tuple[list[int], FinishReason | None]:
+    stream = await engine.generate(Context(req_wire))
+    tokens, finish = [], None
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is None:
+            continue
+        tokens.extend(ann.data.token_ids)
+        if ann.data.finish_reason is not None:
+            finish = ann.data.finish_reason
+    return tokens, finish
+
+
+def greedy_reference(prompt, n_steps):
+    """Dense full-recompute greedy decoding."""
+    current = list(prompt)
+    out = []
+    for _ in range(n_steps):
+        logits = dense_reference_logits(PARAMS, CFG, current)
+        nxt = int(jnp.argmax(logits[len(current) - 1]))
+        out.append(nxt)
+        if nxt == 1:
+            break
+        current.append(nxt)
+    return out
+
+
+async def test_greedy_matches_dense_reference():
+    engine = make_engine()
+    try:
+        prompt = list(range(3, 13))
+        tokens, finish = await collect(engine, request(prompt, max_tokens=6))
+        ref = greedy_reference(prompt, 6)
+        assert tokens == ref
+        assert finish in (FinishReason.LENGTH, FinishReason.STOP)
+    finally:
+        engine.stop()
+
+
+async def test_concurrent_requests_batch_together():
+    engine = make_engine()
+    try:
+        prompts = [list(range(3 + i, 10 + i)) for i in range(4)]
+        results = await asyncio.gather(
+            *[collect(engine, request(p, max_tokens=5)) for p in prompts]
+        )
+        for prompt, (tokens, _) in zip(prompts, results):
+            ref = greedy_reference(prompt, 5)
+            assert tokens == ref
+        # all four ran concurrently through the batched decode path
+        assert engine.stats()["iterations_total"] < 40
+    finally:
+        engine.stop()
+
+
+async def test_max_tokens_finish_reason():
+    engine = make_engine()
+    try:
+        tokens, finish = await collect(engine, request(range(3, 9), max_tokens=3))
+        assert len(tokens) == 3
+        assert finish == FinishReason.LENGTH
+    finally:
+        engine.stop()
+
+
+async def test_cancellation_frees_resources():
+    engine = make_engine()
+    try:
+        req = Context(request(range(3, 9), max_tokens=10_000))
+        stream = await engine.generate(req)
+        got = 0
+        async for _ in stream:
+            got += 1
+            if got >= 2:
+                req.ctx.stop_generating()
+        for _ in range(100):
+            if engine.allocator.used_blocks == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert engine.allocator.used_blocks == 0
+        assert engine.scheduler.num_running == 0
+    finally:
+        engine.stop()
+
+
+async def test_too_long_prompt_rejected():
+    engine = make_engine()
+    try:
+        with pytest.raises(ValueError, match="exceeds engine max length"):
+            await engine.generate(Context(request(range(3, 3 + 500))))
+    finally:
+        engine.stop()
+
+
+async def test_preemption_under_kv_pressure():
+    # 8 blocks of 4 tokens = 32 slots total; two long-running requests can't
+    # both fit to completion, so the scheduler must preempt + recompute
+    engine = make_engine(num_blocks=8, max_model_len=24, max_batch_size=2)
+    try:
+        prompts = [list(range(3, 11)), list(range(4, 12))]  # 8 tokens each
+        results = await asyncio.gather(
+            *[collect(engine, request(p, max_tokens=8)) for p in prompts]
+        )
+        for prompt, (tokens, finish) in zip(prompts, results):
+            ref = greedy_reference(prompt, 8)
+            assert tokens[: len(ref)] == ref
+            assert finish is not None
+    finally:
+        engine.stop()
+
+
+async def test_stats_shape():
+    engine = make_engine()
+    try:
+        stats = engine.stats()
+        assert stats["kv_total_blocks"] == 64
+        assert stats["gpu_cache_usage_perc"] == 0.0
+        assert stats["request_total_slots"] == 4
+    finally:
+        engine.stop()
