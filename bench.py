@@ -1,0 +1,128 @@
+"""Offline serving throughput benchmark (single chip).
+
+Drives the native JAX engine with a continuous-batching workload (random
+prompts, fixed output budget, eos ignored) and reports decode throughput in
+generated tokens/s/chip.  ``vs_baseline`` compares against the reference's
+headline disaggregated H100 number (145 tok/s/GPU @45 tok/s/user,
+BASELINE.md) — not SLA-matched yet, but tracked consistently round over
+round.
+
+Prints exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+BASELINE_TOK_S_PER_GPU = 145.0
+
+
+async def run_bench() -> dict:
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    model_name = os.environ.get("DYN_BENCH_MODEL", "llama32_1b")
+    cfg = getattr(LlamaConfig, model_name)()
+    num_requests = int(os.environ.get("DYN_BENCH_REQUESTS", "32"))
+    prompt_len = int(os.environ.get("DYN_BENCH_ISL", "128"))
+    output_len = int(os.environ.get("DYN_BENCH_OSL", "64"))
+    max_batch = int(os.environ.get("DYN_BENCH_BATCH", "16"))
+
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg,
+            num_blocks=int(os.environ.get("DYN_BENCH_BLOCKS", "512")),
+            block_size=16,
+            max_batch_size=max_batch,
+            max_model_len=prompt_len + output_len + 16,
+            prefill_buckets=(prompt_len,),
+        )
+    )
+    engine.start()
+    rng = np.random.default_rng(0)
+
+    def make_request(i: int) -> dict:
+        tokens = rng.integers(10, cfg.vocab_size - 10, size=prompt_len).tolist()
+        return PreprocessedRequest(
+            token_ids=tokens,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=output_len, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+
+    async def drive(req: dict) -> tuple[int, float]:
+        t0 = time.monotonic()
+        ttft = None
+        count = 0
+        stream = await engine.generate(Context(req))
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None and ann.data.token_ids:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                count += len(ann.data.token_ids)
+        return count, ttft or 0.0
+
+    # warmup: trigger prefill + decode compiles
+    print("bench: warming up (compiles)...", file=sys.stderr)
+    t0 = time.monotonic()
+    await drive(make_request(-1))
+    print(f"bench: warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*[drive(make_request(i)) for i in range(num_requests)])
+    wall = time.monotonic() - t0
+    engine.stop()
+
+    total_tokens = sum(c for c, _ in results)
+    ttfts = sorted(t for _, t in results)
+    tok_s = total_tokens / wall
+    p50 = ttfts[len(ttfts) // 2]
+    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+    print(
+        f"bench: {num_requests} reqs isl={prompt_len} osl={output_len} "
+        f"wall={wall:.2f}s tokens={total_tokens} tok/s={tok_s:.1f} "
+        f"ttft p50={p50*1000:.0f}ms p99={p99*1000:.0f}ms "
+        f"req/s={num_requests/wall:.2f} platform={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    return {
+        "metric": "decode_tok_s_per_chip",
+        "value": round(tok_s, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_GPU, 3),
+        "detail": {
+            "model": model_name,
+            "num_requests": num_requests,
+            "isl": prompt_len,
+            "osl": output_len,
+            "wall_s": round(wall, 2),
+            "ttft_p50_ms": round(p50 * 1000, 1),
+            "ttft_p99_ms": round(p99 * 1000, 1),
+            "req_s": round(num_requests / wall, 3),
+        },
+    }
+
+
+def main() -> None:
+    result = asyncio.run(run_bench())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,25 +17,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
-import xxhash
-
-HASH_SEED = 1337
-
-
-def compute_block_hashes(token_ids: list[int], block_size: int) -> list[int]:
-    """Chained content hashes for each FULL block of the sequence."""
-    hashes: list[int] = []
-    parent = 0
-    for start in range(0, len(token_ids) - len(token_ids) % block_size, block_size):
-        block = token_ids[start : start + block_size]
-        h = xxhash.xxh3_64(
-            parent.to_bytes(8, "little")
-            + b"".join(t.to_bytes(4, "little", signed=False) for t in block),
-            seed=HASH_SEED,
-        ).intdigest()
-        hashes.append(h)
-        parent = h
-    return hashes
+from dynamo_tpu.llm.kv_router.hashing import HASH_SEED, compute_block_hashes  # noqa: F401
 
 
 @dataclass

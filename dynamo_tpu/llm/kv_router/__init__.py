@@ -1,0 +1,39 @@
+"""KV-cache-aware routing.
+
+The feedback loop (reference: SURVEY.md §3.3, lib/llm/src/kv_router/):
+engines publish block stored/removed events + load metrics onto the bus; the
+router maintains a global radix index of block hashes per worker and a load
+view, and scores workers as
+
+    logit = overlap_weight * overlap_norm
+          - usage_weight * cache_usage
+          - waiting_weight * waiting_norm
+
+(reference: lib/llm/src/kv_router/scheduler.rs:248-330, weights
+kv_router.rs:59-82), picking the argmax with random tie-break.
+"""
+
+from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    OverlapScores,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
+
+__all__ = [
+    "compute_block_hashes",
+    "ForwardPassMetrics",
+    "KvCacheEvent",
+    "KvIndexer",
+    "KvPushRouter",
+    "KvRouter",
+    "KvRouterConfig",
+    "KvScheduler",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+]

@@ -1,0 +1,107 @@
+"""KV-routing wire protocols (reference: lib/llm/src/kv_router/protocols.rs)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+
+@dataclass
+class KvCacheEvent:
+    """A stored/removed block event from an engine."""
+
+    kind: str                        # "stored" | "removed" | "cleared"
+    block_hashes: list[int] = field(default_factory=list)
+    parent_hash: int | None = None
+    token_count: int = 0
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "KvCacheEvent":
+        return cls(**json.loads(data))
+
+
+@dataclass
+class RouterEvent:
+    """A KvCacheEvent attributed to a worker instance."""
+
+    worker_id: int
+    event: KvCacheEvent
+
+    def to_json(self) -> bytes:
+        return json.dumps({"worker_id": self.worker_id, "event": asdict(self.event)}).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "RouterEvent":
+        d = json.loads(data)
+        return cls(worker_id=d["worker_id"], event=KvCacheEvent(**d["event"]))
+
+
+@dataclass
+class ForwardPassMetrics:
+    """Per-engine load snapshot (reference: protocols.rs:43-59; the
+    ``gpu_cache_usage_perc`` name is kept for wire parity — on TPU it is HBM
+    cache usage)."""
+
+    worker_id: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    num_requests_waiting: int = 0
+    num_requests_running: int = 0
+    request_total_slots: int = 0
+    iterations_total: int = 0
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "ForwardPassMetrics":
+        d = json.loads(data)
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_stats(cls, worker_id: int, stats: dict) -> "ForwardPassMetrics":
+        return cls(
+            worker_id=worker_id,
+            kv_active_blocks=stats.get("kv_active_blocks", 0),
+            kv_total_blocks=stats.get("kv_total_blocks", 0),
+            gpu_cache_usage_perc=stats.get("gpu_cache_usage_perc", 0.0),
+            num_requests_waiting=stats.get("num_requests_waiting", 0),
+            num_requests_running=stats.get("num_requests_running", 0),
+            request_total_slots=stats.get("request_total_slots", 0),
+            iterations_total=stats.get("iterations_total", 0),
+        )
+
+
+@dataclass
+class OverlapScores:
+    """find_matches result: worker → number of matched prefix blocks."""
+
+    scores: dict[int, int] = field(default_factory=dict)
+    total_blocks: int = 0
+
+
+@dataclass
+class KvHitRateEvent:
+    """Per-request routing outcome for observability (reference:
+    lib/llm/src/kv_router/scheduler.rs:32)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_json(self) -> bytes:
+        return json.dumps(asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "KvHitRateEvent":
+        return cls(**json.loads(data))
+
+
+KV_EVENT_SUBJECT = "kv_events"
+LOAD_METRICS_SUBJECT = "load_metrics"
+KV_HIT_RATE_SUBJECT = "kv_hit_rate"

@@ -1,0 +1,107 @@
+"""Worker-side publishers: KV events + load metrics.
+
+(Reference: lib/llm/src/kv_router/publisher.rs — there, events arrive from
+vLLM over ZMQ; here the native engine calls straight into the publisher.)
+
+Subjects are component-scoped event subjects on the control-plane bus:
+``{ns}.{component}._events.kv_events`` and ``..._events.load_metrics``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.engine.kv_manager import KvEvent
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_EVENT_SUBJECT,
+    LOAD_METRICS_SUBJECT,
+    ForwardPassMetrics,
+    KvCacheEvent,
+    RouterEvent,
+)
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.kv_router.publisher")
+
+
+class KvEventPublisher:
+    """Forwards engine allocator events to the bus, attributed to a worker.
+
+    ``sink`` (a plain callable) is handed to the engine's BlockAllocator; it
+    is thread-safe (the engine's device thread produces events) by hopping
+    through ``loop.call_soon_threadsafe``.
+    """
+
+    def __init__(self, component: Component, worker_id: int):
+        self.component = component
+        self.worker_id = worker_id
+        self.subject = component.event_subject(KV_EVENT_SUBJECT)
+        self._loop = asyncio.get_event_loop()
+        self._queue: asyncio.Queue[RouterEvent] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._loop = asyncio.get_event_loop()
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._pump())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def sink(self, event: KvEvent) -> None:
+        """Engine-facing callback (called from the device thread)."""
+        router_event = RouterEvent(
+            worker_id=self.worker_id,
+            event=KvCacheEvent(
+                kind=event.kind,
+                block_hashes=list(event.block_hashes),
+                parent_hash=event.parent_hash,
+                token_count=event.token_count,
+            ),
+        )
+        self._loop.call_soon_threadsafe(self._queue.put_nowait, router_event)
+
+    async def _pump(self) -> None:
+        bus = self.component.runtime.plane.bus
+        while True:
+            event = await self._queue.get()
+            try:
+                await bus.publish(self.subject, event.to_json())
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to publish kv event")
+
+
+class WorkerMetricsPublisher:
+    """Periodically publishes ForwardPassMetrics from an engine's stats."""
+
+    def __init__(self, component: Component, worker_id: int, stats_fn, *, period_s: float = 1.0):
+        self.component = component
+        self.worker_id = worker_id
+        self.stats_fn = stats_fn
+        self.period_s = period_s
+        self.subject = component.event_subject(LOAD_METRICS_SUBJECT)
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def publish_once(self) -> None:
+        metrics = ForwardPassMetrics.from_stats(self.worker_id, self.stats_fn())
+        await self.component.runtime.plane.bus.publish(self.subject, metrics.to_json())
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.publish_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("failed to publish metrics")
+            await asyncio.sleep(self.period_s)

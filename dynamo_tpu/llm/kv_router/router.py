@@ -1,0 +1,117 @@
+"""KvRouter: the routing decision plane.
+
+Subscribes the component's KV-event and load-metrics subjects, feeds the
+radix indexer and scheduler, and picks a worker per request (reference:
+lib/llm/src/kv_router.rs:104 KvRouter, :220 KvPushRouter).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.protocols import (
+    KV_EVENT_SUBJECT,
+    KV_HIT_RATE_SUBJECT,
+    LOAD_METRICS_SUBJECT,
+    ForwardPassMetrics,
+    KvHitRateEvent,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.runtime.client import PushRouter
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.kv_router")
+
+
+class KvRouter:
+    """Indexer + scheduler fed by bus subscriptions."""
+
+    def __init__(
+        self,
+        component: Component,
+        *,
+        block_size: int = 16,
+        config: KvRouterConfig | None = None,
+    ):
+        self.component = component
+        self.block_size = block_size
+        self.indexer = KvIndexer()
+        self.scheduler = KvScheduler(config)
+        self._subs = []
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        bus = self.component.runtime.plane.bus
+        self.indexer.start()
+        kv_sub = await bus.subscribe(self.component.event_subject(KV_EVENT_SUBJECT))
+        load_sub = await bus.subscribe(self.component.event_subject(LOAD_METRICS_SUBJECT))
+        self._subs = [kv_sub, load_sub]
+        self._tasks = [
+            asyncio.ensure_future(self._kv_loop(kv_sub)),
+            asyncio.ensure_future(self._load_loop(load_sub)),
+        ]
+
+    async def stop(self) -> None:
+        for sub in self._subs:
+            await sub.unsubscribe()
+        for task in self._tasks:
+            task.cancel()
+        await self.indexer.stop()
+
+    async def _kv_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.indexer.push(RouterEvent.from_json(msg.payload))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad kv event")
+
+    async def _load_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.scheduler.update_metrics(ForwardPassMetrics.from_json(msg.payload))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad load metrics")
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.indexer.remove_worker(worker_id)
+        self.scheduler.remove_worker(worker_id)
+
+    async def schedule(self, token_ids: list[int], worker_ids: list[int]) -> tuple[int, int]:
+        """Pick a worker for a tokenized request.  Returns
+        (worker_id, matched_prefix_blocks)."""
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        overlap = self.indexer.find_matches(hashes)
+        worker_id, ratio = self.scheduler.select_worker(worker_ids, overlap, len(hashes))
+        matched = overlap.scores.get(worker_id, 0)
+        # hit-rate observability event (best-effort)
+        try:
+            await self.component.runtime.plane.bus.publish(
+                self.component.event_subject(KV_HIT_RATE_SUBJECT),
+                KvHitRateEvent(
+                    worker_id=worker_id, isl_blocks=len(hashes), overlap_blocks=matched
+                ).to_json(),
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        return worker_id, matched
+
+
+class KvPushRouter:
+    """AsyncEngine facade: schedules KV-aware, then dispatches direct to the
+    chosen instance through a PushRouter (wire-dict PreprocessedRequests)."""
+
+    def __init__(self, push_router: PushRouter, kv_router: KvRouter):
+        self.push_router = push_router
+        self.kv_router = kv_router
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        token_ids = request.data.get("token_ids", [])
+        worker_ids = self.push_router.client.instance_ids
+        worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+        request.data["estimated_prefix_hit_blocks"] = matched
+        return await self.push_router.generate(request, instance_id=worker_id)

@@ -1,0 +1,230 @@
+"""KV-aware routing: radix indexer semantics, scheduler logit formula,
+event plumbing over the bus, recorder replay, and KV-aware dispatch e2e.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import (
+    KvIndexer,
+    KvPushRouter,
+    KvRouter,
+    KvRouterConfig,
+    KvScheduler,
+    RadixTree,
+    compute_block_hashes,
+)
+from dynamo_tpu.llm.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    OverlapScores,
+    RouterEvent,
+)
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.kv_router.recorder import KvRecorder, replay_into_tree
+from dynamo_tpu.engine.kv_manager import BlockAllocator
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.client import PushRouter, RouterMode
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+BS = 4
+
+
+def stored(worker, tokens, parent=None):
+    hashes = compute_block_hashes(tokens, BS)
+    return RouterEvent(
+        worker_id=worker,
+        event=KvCacheEvent(kind="stored", block_hashes=hashes, parent_hash=None),
+    )
+
+
+# ---------------------------------------------------------------------------
+# radix tree
+# ---------------------------------------------------------------------------
+
+
+def test_radix_prefix_matching():
+    tree = RadixTree()
+    seq_a = list(range(1, 13))      # 3 full blocks
+    seq_b = seq_a[:8] + [99, 98, 97, 96]  # shares 2 blocks with A
+    tree.apply(stored(1, seq_a))
+    tree.apply(stored(2, seq_b))
+
+    req = compute_block_hashes(seq_a, BS)
+    scores = tree.find_matches(req)
+    assert scores.scores[1] == 3
+    assert scores.scores[2] == 2
+
+    req_b = compute_block_hashes(seq_b, BS)
+    scores = tree.find_matches(req_b)
+    assert scores.scores[2] == 3
+    assert scores.scores[1] == 2
+
+    # no-match request
+    scores = tree.find_matches(compute_block_hashes([7, 7, 7, 7, 7, 7, 7, 7], BS))
+    assert scores.scores == {}
+
+
+def test_radix_removal_and_prune():
+    tree = RadixTree()
+    seq = list(range(1, 13))
+    hashes = compute_block_hashes(seq, BS)
+    tree.apply(stored(1, seq))
+    assert tree.size() == 3
+
+    tree.apply(RouterEvent(worker_id=1, event=KvCacheEvent(kind="removed", block_hashes=[hashes[-1]])))
+    scores = tree.find_matches(hashes)
+    assert scores.scores[1] == 2
+    assert tree.size() == 2  # leaf pruned
+
+    tree.apply(RouterEvent(worker_id=1, event=KvCacheEvent(kind="cleared")))
+    assert tree.size() == 0
+    assert tree.find_matches(hashes).scores == {}
+
+
+def test_radix_worker_removed_on_death():
+    tree = RadixTree()
+    seq = list(range(1, 9))
+    tree.apply(stored(1, seq))
+    tree.apply(stored(2, seq))
+    tree.remove_worker(1)
+    scores = tree.find_matches(compute_block_hashes(seq, BS))
+    assert 1 not in scores.scores and scores.scores[2] == 2
+
+
+def test_allocator_events_match_router_hashes():
+    """Engine allocator events must produce hashes the router can match."""
+    events = []
+    alloc = BlockAllocator(16, BS, event_sink=events.append)
+    tokens = list(range(10, 23))  # 13 tokens → 3 full blocks
+    alloc.allocate_sequence("s", len(tokens))
+    alloc.publish_stored("s", tokens)
+    assert events[0].kind == "stored"
+    assert events[0].block_hashes == compute_block_hashes(tokens, BS)
+    alloc.free_sequence("s")
+    assert events[1].kind == "removed"
+    assert set(events[1].block_hashes) == set(events[0].block_hashes)
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_prefers_overlap():
+    sched = KvScheduler(rng=random.Random(0))
+    overlap = OverlapScores(scores={1: 3, 2: 1}, total_blocks=4)
+    worker, ratio = sched.select_worker([1, 2], overlap, 4)
+    assert worker == 1 and ratio == 0.75
+
+
+def test_scheduler_penalizes_usage_and_waiting():
+    sched = KvScheduler(KvRouterConfig(), rng=random.Random(0))
+    sched.update_metrics(ForwardPassMetrics(
+        worker_id=1, gpu_cache_usage_perc=0.9, num_requests_waiting=8, request_total_slots=8))
+    sched.update_metrics(ForwardPassMetrics(
+        worker_id=2, gpu_cache_usage_perc=0.1, num_requests_waiting=0, request_total_slots=8))
+    # same overlap: loaded worker must lose
+    overlap = OverlapScores(scores={1: 2, 2: 2}, total_blocks=4)
+    worker, _ = sched.select_worker([1, 2], overlap, 4)
+    assert worker == 2
+    # enough extra overlap flips it: 2.0*(4/4 - 2/4) = 1.0 > 1.9-0.1... not enough
+    overlap = OverlapScores(scores={1: 4, 2: 2}, total_blocks=4)
+    worker, _ = sched.select_worker([1, 2], overlap, 4)
+    assert worker == 2  # 2.0-0.9-1.0=0.1 vs 1.0-0.1-0.0=0.9
+    sched.update_metrics(ForwardPassMetrics(
+        worker_id=1, gpu_cache_usage_perc=0.2, num_requests_waiting=0, request_total_slots=8))
+    worker, _ = sched.select_worker([1, 2], overlap, 4)
+    assert worker == 1  # 2.0-0.2=1.8 vs 0.9
+
+
+def test_scheduler_random_tiebreak_spreads():
+    sched = KvScheduler(rng=random.Random(0))
+    seen = {sched.select_worker([1, 2, 3], OverlapScores(), 1)[0] for _ in range(50)}
+    assert seen == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_replay(tmp_path):
+    path = tmp_path / "events.jsonl"
+    rec = KvRecorder(path)
+    seq = list(range(1, 9))
+    rec.record(stored(1, seq))
+    rec.record(stored(2, seq[:4] + [5, 5, 5, 5]))
+    rec.close()
+    tree = replay_into_tree(path)
+    scores = tree.find_matches(compute_block_hashes(seq, BS))
+    assert scores.scores[1] == 2 and scores.scores[2] == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e over the bus: publishers → KvRouter → KV-aware dispatch
+# ---------------------------------------------------------------------------
+
+
+class TaggedEcho:
+    def __init__(self, tag):
+        self.tag = tag
+
+    async def generate(self, request):
+        from dynamo_tpu.runtime.engine import ResponseStream
+
+        async def gen():
+            yield {"worker": self.tag}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+async def test_kv_router_end_to_end():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://kvtest"))
+    try:
+        component = rt.namespace("ns").component("backend")
+        ep = component.endpoint("generate")
+        s1 = await ep.serve(TaggedEcho("w1"), instance_id=101)
+        s2 = await ep.serve(TaggedEcho("w2"), instance_id=202)
+
+        kv_router = KvRouter(component, block_size=BS)
+        await kv_router.start()
+
+        # worker 101 publishes that it cached seq_a's blocks
+        pub1 = KvEventPublisher(component, worker_id=101)
+        pub1.start()
+        seq_a = list(range(1, 17))
+        from dynamo_tpu.engine.kv_manager import KvEvent
+
+        pub1.sink(KvEvent(kind="stored", block_hashes=compute_block_hashes(seq_a, BS)))
+
+        # metrics: both lightly loaded
+        metrics1 = WorkerMetricsPublisher(
+            component, 101, lambda: {"gpu_cache_usage_perc": 0.1, "request_total_slots": 8}
+        )
+        metrics2 = WorkerMetricsPublisher(
+            component, 202, lambda: {"gpu_cache_usage_perc": 0.1, "request_total_slots": 8}
+        )
+        await metrics1.publish_once()
+        await metrics2.publish_once()
+        await asyncio.sleep(0.1)  # let events flow
+
+        push = await PushRouter.from_endpoint(ep, RouterMode.KV)
+        await push.client.wait_for_instances(2, timeout=5)
+        engine = KvPushRouter(push, kv_router)
+
+        # request sharing seq_a prefix must land on worker 101
+        req = Context({"token_ids": seq_a})
+        out = await (await engine.generate(req)).collect()
+        assert out[0]["worker"] == "w1"
+        assert req.data["estimated_prefix_hit_blocks"] == 4
+
+        await kv_router.stop()
+        await s1.shutdown(drain_timeout=1)
+        await s2.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
