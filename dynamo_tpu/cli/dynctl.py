@@ -1,0 +1,73 @@
+"""dynctl — run the standalone control-plane server, or administer models
+registered in it (the reference's etcd+NATS deployment and llmctl admin CLI
+in one tool: launch/llmctl/src/main.rs).
+
+Usage:
+  python -m dynamo_tpu.cli.dynctl serve [--host H] [--port P]
+  python -m dynamo_tpu.cli.dynctl list-models   [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl list-instances [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl remove-model NAME [--control-plane H:P]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+
+
+async def _amain(args) -> int:
+    if args.cmd == "serve":
+        from dynamo_tpu.runtime.controlplane.server import run_server
+
+        await run_server(args.host, args.port)
+        return 0
+
+    from dynamo_tpu.llm.discovery import MODELS_PREFIX, ModelEntry
+    from dynamo_tpu.runtime.component import ROOT_PATH
+    from dynamo_tpu.runtime.controlplane import connect_control_plane
+
+    plane = await connect_control_plane(args.control_plane)
+    try:
+        if args.cmd == "list-models":
+            entries = await plane.kv.get_prefix(MODELS_PREFIX)
+            for e in entries:
+                entry = ModelEntry.from_json(e.value)
+                print(
+                    f"{entry.name}\t{entry.endpoint_path()}\t{entry.instance_id:016x}\t"
+                    f"{','.join(entry.model_types)}"
+                )
+            if not entries:
+                print("(no models registered)")
+        elif args.cmd == "list-instances":
+            entries = await plane.kv.get_prefix(ROOT_PATH)
+            for e in entries:
+                if "/instances/" in e.key:
+                    d = json.loads(e.value)
+                    print(f"{d['namespace']}.{d['component']}.{d['endpoint']}\t{d['instance_id']:016x}")
+        elif args.cmd == "remove-model":
+            n = await plane.kv.delete_prefix(f"{MODELS_PREFIX}{args.name}/")
+            print(f"removed {n} registration(s) for {args.name}")
+    finally:
+        await plane.close()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(prog="dynctl")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    serve = sub.add_parser("serve", help="run the control-plane server")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=2379)
+    for name in ("list-models", "list-instances"):
+        p = sub.add_parser(name)
+        p.add_argument("--control-plane", default="127.0.0.1:2379")
+    rm = sub.add_parser("remove-model")
+    rm.add_argument("name")
+    rm.add_argument("--control-plane", default="127.0.0.1:2379")
+    args = parser.parse_args()
+    return asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""Mocker: a simulated engine for infrastructure testing at scale.
+
+Mirrors the reference's mocker (lib/llm/src/mocker/: watermark+budget
+scheduler, KV manager with prefix bookkeeping, cost model "prefill quadratic,
+decode ∝ active blocks", scheduler.rs:31-33) without any device work: it
+reuses the real BlockAllocator + Scheduler host logic, sleeps according to
+the cost model, emits deterministic tokens, and publishes the same KV/load
+events as the real engine — so routers, disagg and planners can be exercised
+with hundreds of simulated workers on one CPU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass
+from typing import AsyncIterator, Callable
+
+from dynamo_tpu.engine.kv_manager import BlockAllocator, KvEvent
+from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.engine.sequence import Sequence, SeqStatus
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.engine import Context, ResponseStream
+
+
+@dataclass
+class MockerConfig:
+    num_blocks: int = 512
+    block_size: int = 16
+    max_batch_size: int = 16
+    speedup: float = 100.0               # simulation time compression
+    # cost model (seconds at speedup=1)
+    prefill_linear_s: float = 0.0002     # per prompt token
+    prefill_quadratic_s: float = 2e-8    # per token^2 (attention)
+    decode_base_s: float = 0.01          # per decode iteration
+    decode_per_block_s: float = 0.00005  # per active KV block
+
+
+class MockerEngine:
+    """Wire-compatible with JaxLlmEngine (PreprocessedRequest dicts in,
+    Annotated[LLMEngineOutput] wire dicts out) but fully simulated."""
+
+    def __init__(
+        self,
+        config: MockerConfig | None = None,
+        *,
+        event_sink: Callable[[KvEvent], None] | None = None,
+    ):
+        self.config = config or MockerConfig()
+        self._event_sink = event_sink
+        self.allocator = BlockAllocator(
+            self.config.num_blocks, self.config.block_size, event_sink=self._sink
+        )
+        self.scheduler = Scheduler(self.allocator, max_batch_size=self.config.max_batch_size)
+        self._task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+        self._iterations = 0
+
+    def _sink(self, event: KvEvent) -> None:
+        if self._event_sink is not None:
+            self._event_sink(event)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def stats(self) -> dict:
+        return {
+            "kv_active_blocks": self.allocator.used_blocks,
+            "kv_total_blocks": self.allocator.num_blocks,
+            "gpu_cache_usage_perc": self.allocator.usage,
+            "num_requests_waiting": self.scheduler.num_waiting,
+            "num_requests_running": self.scheduler.num_running,
+            "request_total_slots": self.config.max_batch_size,
+            "iterations_total": self._iterations,
+        }
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        pre = PreprocessedRequest.from_wire(request.data)
+        ctx = request.ctx
+        out_q: asyncio.Queue = asyncio.Queue()
+        seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
+
+        def emit(tokens: list[int], finish: FinishReason | None) -> None:
+            wire = Annotated.from_data(
+                LLMEngineOutput(token_ids=tokens, finish_reason=finish)
+            ).to_wire(LLMEngineOutput.to_wire)
+            out_q.put_nowait(wire)
+            if finish is not None:
+                out_q.put_nowait(None)
+
+        seq.emit = emit
+        self.scheduler.add(seq)
+        self._wake.set()
+
+        watcher = asyncio.ensure_future(self._watch_cancel(ctx, seq))
+
+        async def gen() -> AsyncIterator[dict]:
+            try:
+                while True:
+                    item = await out_q.get()
+                    if item is None:
+                        return
+                    yield item
+            finally:
+                watcher.cancel()
+
+        return ResponseStream(gen(), ctx)
+
+    async def _watch_cancel(self, ctx, seq: Sequence) -> None:
+        await ctx.stopped()
+        if seq.status != SeqStatus.FINISHED:
+            self.scheduler.abort(seq)
+            seq.status = SeqStatus.FINISHED
+            if seq.emit:
+                seq.emit([], FinishReason.CANCELLED)
+
+    async def _loop(self) -> None:
+        cfg = self.config
+        while True:
+            if not self.scheduler.has_work():
+                self._wake.clear()
+                await self._wake.wait()
+            decision = self.scheduler.schedule()
+            cost = 0.0
+            for seq in decision.prefills:
+                n = seq.context_len
+                cost += cfg.prefill_linear_s * n + cfg.prefill_quadratic_s * n * n
+                self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)
+                self._emit_next(seq)
+            decodes = [s for s in self.scheduler.running if s.status == SeqStatus.RUNNING]
+            if decodes:
+                cost += cfg.decode_base_s + cfg.decode_per_block_s * self.allocator.used_blocks
+                for seq in decodes:
+                    slot = self.scheduler.ensure_slot(seq)
+                    if slot is None:
+                        self.scheduler.preempt(seq)
+                        continue
+                    self._emit_next(seq)
+            self._iterations += 1
+            await asyncio.sleep(cost / cfg.speedup)
+
+    def _emit_next(self, seq: Sequence) -> None:
+        # deterministic "generation": next token = (last + 1) mod 1000
+        token = (seq.all_token_ids[-1] + 1) % 1000 if seq.all_token_ids else 0
+        seq.output_ids.append(token)
+        finish = seq.hit_stop(token)
+        if seq.emit:
+            seq.emit([token], finish)
+        if finish is not None:
+            self.scheduler.finish(seq)
+        elif seq.context_len % self.config.block_size == 0:
+            self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)

@@ -1,0 +1,126 @@
+"""High-level serving assembly.
+
+``serve_worker`` = engine + endpoint + model registration + KV/metrics
+publishers (what the reference's engine subprocesses do on startup,
+launch/dynamo-run/src/subprocess/*_inc.py); ``serve_frontend`` = HTTP service
++ model watcher (the ``in=http`` frontend, launch/dynamo-run/src/input/http.rs).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+from dynamo_tpu.llm.discovery import ModelWatcher, register_llm
+from dynamo_tpu.llm.engines import EchoEngineCore
+from dynamo_tpu.llm.http import HttpService, ModelManager
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.client import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("serve")
+
+
+@dataclass
+class WorkerHandle:
+    service: object
+    engine: object
+    publishers: list
+
+    async def shutdown(self) -> None:
+        for pub in self.publishers:
+            await pub.stop()
+        await self.service.shutdown()
+        if hasattr(self.engine, "stop"):
+            self.engine.stop()
+
+
+def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **overrides):
+    """Build a JaxLlmEngine from a local model dir (config.json; weights from
+    safetensors when present, random-init otherwise)."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.models.llama import LlamaConfig, init_params, load_hf_weights
+
+    model_dir = Path(model_dir)
+    cfg = LlamaConfig.from_hf_config(model_dir / "config.json")
+    defaults = dict(
+        model=cfg,
+        block_size=mdc.kv_block_size,
+        num_blocks=overrides.pop("num_blocks", 256),
+        max_batch_size=overrides.pop("max_batch_size", 8),
+        max_model_len=overrides.pop("max_model_len", mdc.context_length),
+    )
+    defaults.update(overrides)
+    config = EngineConfig(**defaults)
+    try:
+        params = load_hf_weights(cfg, model_dir)
+        logger.info("loaded weights from %s", model_dir)
+    except FileNotFoundError:
+        logger.warning("no safetensors in %s — random-initializing weights", model_dir)
+        params = None
+    return JaxLlmEngine(config, params=params)
+
+
+async def serve_worker(
+    runtime: DistributedRuntime,
+    model_dir: str | Path,
+    *,
+    model_name: str | None = None,
+    namespace: str | None = None,
+    component: str = "backend",
+    endpoint: str = "generate",
+    engine_kind: str = "jax",
+    model_types: list[str] | None = None,
+    **engine_overrides,
+) -> WorkerHandle:
+    mdc = ModelDeploymentCard.from_local_path(model_dir, name=model_name)
+    ep = runtime.namespace(namespace).component(component).endpoint(endpoint)
+
+    publishers: list = []
+    if engine_kind == "echo":
+        engine = EchoEngineCore()
+        service = await ep.serve(engine)
+    elif engine_kind == "mocker":
+        from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+
+        engine = MockerEngine(MockerConfig(block_size=mdc.kv_block_size))
+        engine.start()
+        service = await ep.serve(engine, stats_handler=engine.stats)
+    elif engine_kind == "jax":
+        # publishers are wired before the engine so allocator events flow
+        engine = build_jax_engine(model_dir, mdc, **engine_overrides)
+        service = await ep.serve(engine, stats_handler=engine.stats)
+        kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)
+        kv_pub.start()
+        engine._event_sink = kv_pub.sink
+        metrics_pub = WorkerMetricsPublisher(
+            ep.component, service.instance.instance_id, engine.stats
+        )
+        metrics_pub.start()
+        publishers = [kv_pub, metrics_pub]
+        engine.start()
+    else:
+        raise ValueError(f"unknown engine kind {engine_kind!r}")
+
+    await register_llm(service, mdc, model_types=model_types)
+    return WorkerHandle(service=service, engine=engine, publishers=publishers)
+
+
+async def serve_frontend(
+    runtime: DistributedRuntime,
+    *,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+) -> tuple[HttpService, ModelWatcher]:
+    manager = ModelManager()
+    service = HttpService(manager, host=host, port=port)
+    watcher = ModelWatcher(runtime, manager, router_mode=router_mode)
+    await watcher.start()
+    await service.start()
+    return service, watcher
