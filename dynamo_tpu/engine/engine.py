@@ -134,6 +134,8 @@ class JaxLlmEngine:
         self._thread: threading.Thread | None = None
         self._jit_prefill = self._build_prefill()
         self._jit_decode = self._build_decode()
+        self._jit_extract = self._build_extract()
+        self._jit_inject = self._build_inject()
 
     # -- jitted steps ------------------------------------------------------
     def _build_prefill(self):
@@ -177,6 +179,32 @@ class JaxLlmEngine:
                 self._cache_sharding,
             )
         return jax.jit(step, donate_argnums=(1,), **kwargs)
+
+    def _build_extract(self):
+        """Gather a sequence's KV blocks (padded to max_blocks_per_seq) for
+        cross-worker transfer — the TPU-native replacement for NIXL reads
+        (SURVEY.md §2.5 KV transfer plane)."""
+
+        def fn(cache, block_ids):
+            return cache["k"][:, block_ids], cache["v"][:, block_ids]
+
+        return jax.jit(fn)
+
+    def _build_inject(self):
+        """Scatter transferred KV blocks into this engine's cache."""
+        num_blocks = self.config.num_blocks
+
+        def fn(cache, k_new, v_new, block_ids, n):
+            maxb = block_ids.shape[0]
+            ids = jnp.where(jnp.arange(maxb) < n, block_ids, num_blocks)
+            k = cache["k"].at[:, ids].set(k_new.astype(cache["k"].dtype), mode="drop")
+            v = cache["v"].at[:, ids].set(v_new.astype(cache["v"].dtype), mode="drop")
+            return {"k": k, "v": v}
+
+        kwargs = {}
+        if self.mesh is not None:
+            kwargs["out_shardings"] = self._cache_sharding
+        return jax.jit(fn, donate_argnums=(0,), **kwargs)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -235,6 +263,90 @@ class JaxLlmEngine:
         self._submit_q.put(("abort", seq))
         self._wake.set()
 
+    # -- disaggregation API ------------------------------------------------
+    async def prefill_extract(self, pre: PreprocessedRequest) -> tuple[int, "np.ndarray", "np.ndarray", int]:
+        """Prefill-worker side: run prefill only, return (first_token,
+        k_blocks, v_blocks, n_blocks).  KV arrays are host numpy
+        [layers, n_blocks, block_size, kv_heads, head_dim]."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        seq = Sequence(seq_id=uuid.uuid4().hex, request=pre, prefill_only=True)
+
+        def on_done(result) -> None:
+            loop.call_soon_threadsafe(
+                lambda: fut.set_result(result) if not fut.done() else None
+            )
+
+        seq.on_prefill_done = on_done
+        self._submit_q.put(("add", seq))
+        self._wake.set()
+        return await fut
+
+    def reserve_blocks(self, num_tokens: int) -> list[int] | None:
+        return self.allocator.reserve_blocks(num_tokens)
+
+    def release_blocks(self, block_ids: list[int]) -> None:
+        self.allocator.release_blocks(block_ids)
+
+    async def inject_blocks(self, block_ids: list[int], k_blocks, v_blocks) -> None:
+        """Decode-worker side: write transferred KV blocks into the cache
+        (runs on the device thread to serialize with step functions)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def done() -> None:
+            loop.call_soon_threadsafe(lambda: fut.set_result(None) if not fut.done() else None)
+
+        self._submit_q.put(("inject", (list(block_ids), k_blocks, v_blocks, done)))
+        self._wake.set()
+        await fut
+
+    async def generate_prefilled(
+        self, request: Context[dict], block_ids: list[int], first_token: int
+    ) -> ResponseStream[dict]:
+        """Decode-worker side: start decoding a sequence whose prompt KV was
+        injected into ``block_ids`` and whose first token was already sampled
+        by the prefill worker."""
+        pre = PreprocessedRequest.from_wire(request.data)
+        ctx = request.ctx
+        loop = asyncio.get_running_loop()
+        out_q: asyncio.Queue = asyncio.Queue()
+        seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, remote_prefilled=True)
+        seq.output_ids.append(first_token)
+        self.allocator.adopt_sequence(seq.seq_id, block_ids)
+
+        def emit(tokens: list[int], finish: FinishReason | None) -> None:
+            wire = Annotated.from_data(
+                LLMEngineOutput(token_ids=tokens, finish_reason=finish)
+            ).to_wire(LLMEngineOutput.to_wire)
+            loop.call_soon_threadsafe(out_q.put_nowait, wire)
+            if finish is not None:
+                loop.call_soon_threadsafe(out_q.put_nowait, None)
+
+        seq.emit = emit
+        # surface the prefill worker's token as the first stream item
+        finish = seq.hit_stop(first_token)
+        emit([first_token], finish)
+        if finish is None:
+            self._submit_q.put(("add", seq))
+            self._wake.set()
+        else:
+            self.allocator.free_sequence(seq.seq_id)
+
+        cancel_task = asyncio.ensure_future(self._watch_cancel(ctx, seq))
+
+        async def gen() -> AsyncIterator[dict]:
+            try:
+                while True:
+                    item = await out_q.get()
+                    if item is None:
+                        break
+                    yield item
+            finally:
+                cancel_task.cancel()
+
+        return ResponseStream(gen(), ctx)
+
     # -- stats / events ----------------------------------------------------
     def _sink_event(self, event: KvEvent) -> None:
         if self._event_sink is not None:
@@ -286,6 +398,25 @@ class JaxLlmEngine:
                     seq.status = SeqStatus.FINISHED
                     if seq.emit:
                         seq.emit([], FinishReason.CANCELLED)
+            elif op == "inject":
+                block_ids, k_np, v_np, done = seq  # payload tuple
+                n = len(block_ids)
+                ids = np.zeros((self.max_blocks_per_seq,), np.int32)
+                ids[:n] = block_ids
+                shape = (
+                    self.config.model.num_layers, self.max_blocks_per_seq,
+                    self.config.block_size, self.config.model.num_kv_heads,
+                    self.config.model.head_dim,
+                )
+                k_pad = np.zeros(shape, np.asarray(k_np).dtype)
+                v_pad = np.zeros(shape, np.asarray(v_np).dtype)
+                k_pad[:, :n] = k_np
+                v_pad[:, :n] = v_np
+                self.cache = self._jit_inject(
+                    self.cache, jnp.asarray(k_pad), jnp.asarray(v_pad),
+                    jnp.asarray(ids), jnp.int32(n),
+                )
+                done()
 
     def _bucket_len(self, n: int) -> int:
         for b in self.buckets:
@@ -330,6 +461,22 @@ class JaxLlmEngine:
             jnp.int32(n), jnp.int32(0), self._next_rng(),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
         )
+        if seq.prefill_only:
+            # disagg prefill worker: hand back first token + the KV blocks
+            ids = np.zeros((self.max_blocks_per_seq,), np.int32)
+            ids[: len(blocks)] = blocks
+            k_all, v_all = self._jit_extract(self.cache, jnp.asarray(ids))
+            n_used = self.allocator.blocks_needed(n)
+            result = (
+                int(token),
+                np.asarray(k_all)[:, :n_used],
+                np.asarray(v_all)[:, :n_used],
+                n_used,
+            )
+            self.scheduler.finish(seq)
+            if seq.on_prefill_done:
+                seq.on_prefill_done(result)
+            return
         self.allocator.publish_stored(seq.seq_id, tokens)
         self._process_token(seq, int(token))
 

@@ -92,6 +92,23 @@ class BlockAllocator:
             seq.block_ids.append(self._free.popleft())
         return seq.block_ids[block_idx] * self.block_size + pos % self.block_size
 
+    def adopt_sequence(self, seq_id: str, block_ids: list[int]) -> None:
+        """Register blocks reserved earlier (disagg: reserved before remote
+        prefill, adopted when the sequence starts decoding)."""
+        self._sequences[seq_id] = SequenceBlocks(block_ids=list(block_ids))
+
+    def reserve_blocks(self, num_tokens: int) -> list[int] | None:
+        """Take blocks off the free list without a sequence (disagg decode
+        side reserves the landing zone for remotely-prefilled KV)."""
+        needed = self.blocks_needed(num_tokens)
+        if needed > self.free_blocks:
+            return None
+        return [self._free.popleft() for _ in range(needed)]
+
+    def release_blocks(self, block_ids: list[int]) -> None:
+        for b in block_ids:
+            self._free.append(b)
+
     def block_ids(self, seq_id: str) -> list[int]:
         return list(self._sequences[seq_id].block_ids)
 

@@ -89,6 +89,14 @@ class Scheduler:
             and self._free_lanes
         ):
             candidate = self.waiting[0]
+            if candidate.remote_prefilled:
+                # KV was injected by a prefill worker into blocks this engine
+                # reserved earlier (already adopted): no local prefill compute
+                self.waiting.popleft()
+                candidate.status = SeqStatus.RUNNING
+                candidate.lane = self._free_lanes.pop()
+                self.running.append(candidate)
+                continue
             # context_len covers preempted sequences re-prefilling with their
             # generated tokens appended; +1 reserves the first decode slot
             if not self.allocator.can_allocate(candidate.context_len + 1):
@@ -128,6 +136,8 @@ class Scheduler:
         logger.warning("preempting sequence %s (recompute)", seq.seq_id)
         self._release(seq)
         seq.status = SeqStatus.PREEMPTED
+        # remotely-prefilled KV is gone once blocks are freed: recompute locally
+        seq.remote_prefilled = False
         # re-queue at the front: preempted sequences restart first (their
         # prompt now includes generated tokens, so recompute is exact)
         self.waiting.appendleft(seq)

@@ -25,8 +25,12 @@ class Sequence:
     output_ids: list[int] = field(default_factory=list)
     lane: int = -1            # decode batch lane while RUNNING
     finish_reason: FinishReason | None = None
+    # disaggregation modes
+    prefill_only: bool = False       # prefill worker: stop after first token
+    remote_prefilled: bool = False   # decode worker: KV already injected
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
+    on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only
 
     @property
     def prompt_len(self) -> int:

@@ -1,0 +1,245 @@
+"""Disaggregated prefill/decode.
+
+Decision + orchestration (reference: SURVEY.md §3.4; decision thresholds
+lib/llm/src/disagg_router.rs:25-34 with etcd hot-reload :38-90; queue
+examples/llm/utils/prefill_queue.py + NatsQueue):
+
+- ``DisaggRouter``    — prefill locally vs remotely: remote iff prompt length
+  exceeds ``max_local_prefill_length`` AND the prefill queue is not backed
+  up; config hot-reloads from a control-plane KV key watch.
+- ``PrefillQueue``    — durable work queue on the control-plane bus.
+- ``DisaggDecodeEngine`` — decode-worker engine wrapper: on remote decision,
+  reserves landing blocks, enqueues a RemotePrefillRequest, waits for the KV
+  transfer, then decodes.  Local decision falls through to the inner engine.
+- ``PrefillWorker``   — dequeues, prefills on its own engine/mesh, ships KV
+  blocks to the decode worker's transfer server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import uuid
+from dataclasses import dataclass
+
+from dynamo_tpu.engine.engine import JaxLlmEngine
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.parallel.kv_transfer import (
+    KvTransferClient,
+    KvTransferPayload,
+    KvTransferServer,
+)
+from dynamo_tpu.runtime.component import ROOT_PATH
+from dynamo_tpu.runtime.controlplane.interface import WatchEventType
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.disagg")
+
+
+def disagg_config_key(model: str) -> str:
+    return f"{ROOT_PATH}public/components/disagg_router/models/chat/{model}"
+
+
+@dataclass
+class DisaggConfig:
+    max_local_prefill_length: int = 512
+    max_prefill_queue_size: int = 16
+
+
+class DisaggRouter:
+    """Local-vs-remote prefill decision with KV-watched hot reload."""
+
+    def __init__(self, runtime: DistributedRuntime, model: str, config: DisaggConfig | None = None):
+        self.runtime = runtime
+        self.model = model
+        self.config = config or DisaggConfig()
+        self._watch = None
+        self._task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        self._watch = self.runtime.plane.kv.watch_prefix(disagg_config_key(self.model))
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.cancel()
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        async for event in self._watch:
+            if event.type != WatchEventType.PUT:
+                continue
+            try:
+                d = json.loads(event.entry.value)
+                self.config = DisaggConfig(
+                    max_local_prefill_length=d.get(
+                        "max_local_prefill_length", self.config.max_local_prefill_length
+                    ),
+                    max_prefill_queue_size=d.get(
+                        "max_prefill_queue_size", self.config.max_prefill_queue_size
+                    ),
+                )
+                logger.info("disagg config reloaded: %s", self.config)
+            except Exception:  # noqa: BLE001
+                logger.exception("bad disagg config update")
+
+    def prefill_remote(self, prefill_length: int, queue_size: int) -> bool:
+        return (
+            prefill_length > self.config.max_local_prefill_length
+            and queue_size < self.config.max_prefill_queue_size
+        )
+
+
+class PrefillQueue:
+    """Durable prefill work queue (JetStream-analog on the control-plane bus)."""
+
+    def __init__(self, runtime: DistributedRuntime, namespace: str, component: str):
+        self.runtime = runtime
+        self.queue_name = f"{namespace}.{component}.prefill"
+
+    async def enqueue(self, request: dict) -> None:
+        await self.runtime.plane.bus.queue_publish(
+            self.queue_name, json.dumps(request).encode()
+        )
+
+    async def dequeue(self, timeout: float | None = None) -> dict | None:
+        raw = await self.runtime.plane.bus.queue_pop(self.queue_name, timeout)
+        return json.loads(raw) if raw is not None else None
+
+    async def size(self) -> int:
+        return await self.runtime.plane.bus.queue_len(self.queue_name)
+
+
+class DisaggDecodeEngine:
+    """Engine wrapper on the decode worker implementing the remote-prefill
+    flow; wire-compatible AsyncEngine."""
+
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        engine: JaxLlmEngine,
+        router: DisaggRouter,
+        queue: PrefillQueue,
+        *,
+        transfer_host: str = "127.0.0.1",
+    ):
+        self.runtime = runtime
+        self.engine = engine
+        self.router = router
+        self.queue = queue
+        self._pending: dict[str, asyncio.Future] = {}
+        self.transfer_server = KvTransferServer(self._on_transfer, host=transfer_host)
+        # observability
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    async def start(self) -> None:
+        await self.transfer_server.start()
+
+    async def stop(self) -> None:
+        await self.transfer_server.stop()
+
+    async def _on_transfer(self, payload: KvTransferPayload) -> None:
+        await self.engine.inject_blocks(payload.block_ids, payload.k_blocks, payload.v_blocks)
+        fut = self._pending.pop(payload.seq_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(payload.first_token)
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        pre = PreprocessedRequest.from_wire(request.data)
+        queue_size = await self.queue.size()
+        if not self.router.prefill_remote(len(pre.token_ids), queue_size):
+            self.local_prefills += 1
+            return await self.engine.generate(request)
+
+        # remote prefill: reserve the KV landing zone first
+        block_ids = self.engine.reserve_blocks(len(pre.token_ids) + 1)
+        if block_ids is None:
+            logger.warning("no blocks free for remote prefill; falling back local")
+            self.local_prefills += 1
+            return await self.engine.generate(request)
+
+        self.remote_prefills += 1
+        seq_id = request.ctx.id or uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[seq_id] = fut
+        n_kv_blocks = self.engine.allocator.blocks_needed(len(pre.token_ids))
+        await self.queue.enqueue(
+            {
+                "seq_id": seq_id,
+                "request": request.data,
+                "dst_block_ids": block_ids[:n_kv_blocks],
+                "transfer_address": self.transfer_server.address,
+            }
+        )
+        try:
+            first_token = await asyncio.wait_for(fut, timeout=300)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            self._pending.pop(seq_id, None)
+            self.engine.release_blocks(block_ids)
+            raise RuntimeError(f"remote prefill for {seq_id} timed out")
+        return await self.engine.generate_prefilled(request, block_ids, first_token)
+
+    def stats(self) -> dict:
+        stats = self.engine.stats()
+        stats["remote_prefills"] = self.remote_prefills
+        stats["local_prefills"] = self.local_prefills
+        return stats
+
+
+class PrefillWorker:
+    """Prefill-side pump: dequeue → prefill → ship KV → (decode worker
+    continues).  One pump per prefill engine instance."""
+
+    def __init__(self, runtime: DistributedRuntime, engine: JaxLlmEngine, queue: PrefillQueue):
+        self.runtime = runtime
+        self.engine = engine
+        self.queue = queue
+        self.client = KvTransferClient()
+        self._task: asyncio.Task | None = None
+        self.prefills_done = 0
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.client.close()
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                item = await self.queue.dequeue(timeout=1.0)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                logger.exception("prefill queue pop failed")
+                await asyncio.sleep(0.5)
+                continue
+            if item is None:
+                continue
+            try:
+                await self._handle(item)
+                self.prefills_done += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("remote prefill failed for %s", item.get("seq_id"))
+
+    async def _handle(self, item: dict) -> None:
+        pre = PreprocessedRequest.from_wire(item["request"])
+        first_token, k_blocks, v_blocks, n = await self.engine.prefill_extract(pre)
+        await self.client.send(
+            item["transfer_address"],
+            KvTransferPayload(
+                seq_id=item["seq_id"],
+                first_token=first_token,
+                block_ids=item["dst_block_ids"][:n],
+                k_blocks=k_blocks,
+                v_blocks=v_blocks,
+            ),
+        )

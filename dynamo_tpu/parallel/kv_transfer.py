@@ -1,0 +1,147 @@
+"""Cross-worker KV block transfer.
+
+The TPU-native replacement for the reference's NIXL/RDMA plane (SURVEY.md
+§2.5): prefill and decode engines live on separate mesh partitions/processes,
+so prefilled KV blocks are shipped prefill→decode.
+
+Paths:
+- **DCN/TCP (implemented)**: device→host staging (``jax.device_get``), raw
+  bf16 bytes over a TCP stream with the two-part codec, host→device scatter
+  on the receiver.  Works across hosts and processes.
+- **ICI (same-slice)**: when both engines share a mesh, ``jax.device_put``
+  between shardings moves blocks over ICI without host staging (used
+  automatically when the engines are in-process; cross-process ICI transfer
+  lands with multi-host support).
+
+Wire: header {seq_id, dtype, shape, first_token, block_ids} + payload bytes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Awaitable, Callable
+
+import numpy as np
+
+from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.kv_transfer")
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """np.dtype, accepting accelerator dtypes (bfloat16 via ml_dtypes)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+@dataclass
+class KvTransferPayload:
+    seq_id: str
+    first_token: int
+    block_ids: list[int]          # destination (decode-side) block ids
+    k_blocks: np.ndarray          # [layers, n, block_size, kv_heads, head_dim]
+    v_blocks: np.ndarray
+
+
+class KvTransferServer:
+    """Decode-worker side: receives KV payloads and hands them to a sink
+    (typically ``engine.inject_blocks`` + completion notification)."""
+
+    def __init__(
+        self,
+        sink: Callable[[KvTransferPayload], Awaitable[None]],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.sink = sink
+        self.host = host
+        self.port = port
+        self._server: asyncio.Server | None = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                frame = await read_two_part(reader)
+                if frame is None:
+                    return
+                h = frame.header
+                dtype = resolve_dtype(h["dtype"])
+                shape = tuple(h["shape"])
+                k_size = int(np.prod(shape)) * dtype.itemsize
+                k = np.frombuffer(frame.payload[:k_size], dtype).reshape(shape)
+                v = np.frombuffer(frame.payload[k_size:], dtype).reshape(shape)
+                payload = KvTransferPayload(
+                    seq_id=h["seq_id"],
+                    first_token=h["first_token"],
+                    block_ids=list(h["block_ids"]),
+                    k_blocks=k,
+                    v_blocks=v,
+                )
+                await self.sink(payload)
+                writer.write(encode_frame(TwoPartMessage(header={"ok": True, "seq_id": h["seq_id"]})))
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+
+class KvTransferClient:
+    """Prefill-worker side: pooled connections to decode workers."""
+
+    def __init__(self) -> None:
+        self._conns: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter, asyncio.Lock]] = {}
+
+    async def _conn(self, address: str):
+        entry = self._conns.get(address)
+        if entry is not None and not entry[1].is_closing():
+            return entry
+        host, _, port = address.rpartition(":")
+        reader, writer = await asyncio.open_connection(host, int(port))
+        entry = (reader, writer, asyncio.Lock())
+        self._conns[address] = entry
+        return entry
+
+    async def send(self, address: str, payload: KvTransferPayload) -> None:
+        reader, writer, lock = await self._conn(address)
+        k = np.ascontiguousarray(payload.k_blocks)
+        v = np.ascontiguousarray(payload.v_blocks)
+        # bf16 numpy: ml_dtypes dtype name round-trips through np.dtype
+        header = {
+            "seq_id": payload.seq_id,
+            "first_token": payload.first_token,
+            "block_ids": payload.block_ids,
+            "dtype": k.dtype.name,
+            "shape": list(k.shape),
+        }
+        async with lock:
+            writer.write(encode_frame(TwoPartMessage(header=header, payload=k.tobytes() + v.tobytes())))
+            await writer.drain()
+            ack = await read_two_part(reader)
+            if ack is None or not ack.header.get("ok"):
+                raise ConnectionError(f"kv transfer to {address} failed")
+
+    async def close(self) -> None:
+        for _, writer, _ in self._conns.values():
+            writer.close()
+        self._conns.clear()

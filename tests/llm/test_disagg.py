@@ -1,0 +1,193 @@
+"""Disaggregated prefill/decode: the remote-prefill flow must be *exact* —
+tokens produced via (prefill engine → KV transfer → decode engine) equal the
+single-engine greedy output.  Plus decision logic and queue behavior.
+"""
+
+import asyncio
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    DisaggRouter,
+    PrefillQueue,
+    PrefillWorker,
+    disagg_config_key,
+)
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+from tests.engine.test_jax_engine import greedy_reference
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine():
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=CFG, num_blocks=64, block_size=4, max_batch_size=4,
+            prefill_buckets=(16, 32), max_model_len=64,
+        ),
+        params=PARAMS,
+    )
+    engine.start()
+    return engine
+
+
+def request(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens),
+        eos_token_ids=[1],
+    ).to_wire()
+
+
+async def collect(stream):
+    tokens = []
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is not None:
+            tokens.extend(ann.data.token_ids)
+    return tokens
+
+
+def test_disagg_decision():
+    router = DisaggRouter.__new__(DisaggRouter)
+    router.config = DisaggConfig(max_local_prefill_length=512, max_prefill_queue_size=4)
+    assert not router.prefill_remote(100, 0)        # short → local
+    assert router.prefill_remote(1000, 0)           # long → remote
+    assert not router.prefill_remote(1000, 10)      # queue backed up → local
+
+
+async def test_disagg_config_hot_reload():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg1"))
+    try:
+        router = DisaggRouter(rt, "tiny")
+        await router.start()
+        assert router.config.max_local_prefill_length == 512
+        await rt.plane.kv.put(
+            disagg_config_key("tiny"),
+            b'{"max_local_prefill_length": 4, "max_prefill_queue_size": 2}',
+        )
+        for _ in range(50):
+            if router.config.max_local_prefill_length == 4:
+                break
+            await asyncio.sleep(0.02)
+        assert router.config.max_local_prefill_length == 4
+        assert router.config.max_prefill_queue_size == 2
+        await router.stop()
+    finally:
+        await rt.close()
+
+
+async def test_remote_prefill_exactness():
+    """The flagship correctness test: prefill on engine A, decode on engine B,
+    outputs must equal single-engine greedy decoding bit-for-bit."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg2"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        prompt = list(range(3, 13))  # 10 tokens > threshold 4 → remote
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        tokens = await collect(stream)
+
+        ref = greedy_reference(prompt, 6)
+        assert tokens == ref, f"disagg {tokens} != reference {ref}"
+        assert disagg.remote_prefills == 1
+        assert prefill_worker.prefills_done == 1
+        # prefill engine freed its blocks after extraction
+        assert prefill_engine.allocator.used_blocks == 0
+        # decode engine freed blocks after the request finished
+        for _ in range(100):
+            if decode_engine.allocator.used_blocks == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert decode_engine.allocator.used_blocks == 0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
+async def test_short_prompt_stays_local():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg3"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=512))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+
+        prompt = list(range(3, 9))
+        tokens = await collect(await disagg.generate(Context(request(prompt, max_tokens=4))))
+        assert tokens == greedy_reference(prompt, 4)
+        assert disagg.local_prefills == 1 and disagg.remote_prefills == 0
+        assert await queue.size() == 0
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
+
+
+async def test_concurrent_disagg_requests():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg4"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        prompts = [list(range(3 + i, 11 + i)) for i in range(3)]
+        results = await asyncio.gather(
+            *[collect(await disagg.generate(Context(request(p, max_tokens=4)))) for p in prompts]
+        )
+        for prompt, tokens in zip(prompts, results):
+            assert tokens == greedy_reference(prompt, 4)
+        assert disagg.remote_prefills == 3
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
