@@ -1,0 +1,44 @@
+"""Multi-tier KV block manager (KVBM).
+
+TPU-native re-design of the reference's block manager (reference:
+lib/llm/src/block_manager.rs:68-118 and block_manager/): a hierarchy of
+fixed-size KV block pools
+
+    G1 device HBM  →  G2 host DRAM  →  G3 local disk (→ G4 remote)
+
+with block lifecycle Reset → Partial → Complete → Registered, content-hash
+registry for dedupe/reuse, LRU eviction of registered blocks, and an offload
+manager that moves cold blocks down-tier and onboards prefix hits back up.
+
+Data movement is XLA-native: device↔host via ``jax.device_put``/
+``device_get`` (replaces cudaMemcpyAsync), host↔disk via memory-mapped
+files (replaces GDS), remote via the DCN transfer client (replaces NIXL
+RDMA).  The Null storage backend provides metadata-only pools for
+infrastructure tests, mirroring the reference's Null allocators
+(block_manager/storage.rs:446-519).
+"""
+
+from dynamo_tpu.llm.block_manager.storage import (
+    DeviceStorage,
+    DiskStorage,
+    HostStorage,
+    NullStorage,
+    block_nbytes,
+)
+from dynamo_tpu.llm.block_manager.pool import BlockPool, BlockState
+from dynamo_tpu.llm.block_manager.manager import KvBlockManager, KvbmConfig, Tier
+from dynamo_tpu.llm.block_manager.offload import OffloadManager
+
+__all__ = [
+    "BlockPool",
+    "BlockState",
+    "DeviceStorage",
+    "DiskStorage",
+    "HostStorage",
+    "KvBlockManager",
+    "KvbmConfig",
+    "NullStorage",
+    "OffloadManager",
+    "Tier",
+    "block_nbytes",
+]

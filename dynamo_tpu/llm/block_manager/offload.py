@@ -1,0 +1,166 @@
+"""Offload manager: moves KV blocks between tiers.
+
+(Reference: lib/llm/src/block_manager/offload.rs — priority queue, bounded
+concurrency MAX_CONCURRENT_TRANSFERS=4, batching BATCH=16, per-pair transfer
+strategies.)  Here the strategies are XLA/OS-native:
+
+    G1→G2  jax.device_get (device→host DMA)
+    G2→G1  jax.device_put (host→device DMA)
+    G2↔G3  memmap IO
+    G1→G3  staged through G2
+
+Transfers are batched and run on a bounded set of worker tasks; completion
+registers the block's hash in the destination pool.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import heapq
+import itertools
+from dataclasses import dataclass, field
+
+from dynamo_tpu.llm.block_manager.pool import BlockPool
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.block_manager.offload")
+
+MAX_CONCURRENT_TRANSFERS = 4
+TRANSFER_BATCH = 16
+
+
+@dataclass(order=True)
+class _Job:
+    priority: int
+    seq: int
+    src_tier: str = field(compare=False)
+    dst_tier: str = field(compare=False)
+    block_id: int = field(compare=False)
+    seq_hash: int = field(compare=False)
+
+
+class OffloadManager:
+    def __init__(self, pools: dict[str, BlockPool]):
+        self.pools = pools
+        self._queue: list[_Job] = []
+        self._seq = itertools.count()
+        self._wake = asyncio.Event()
+        self._workers: list[asyncio.Task] = []
+        self._inflight = 0
+        self.completed = 0
+        self.failed = 0
+        self.skipped = 0
+
+    def start(self, workers: int = MAX_CONCURRENT_TRANSFERS) -> None:
+        if not self._workers:
+            self._workers = [
+                asyncio.ensure_future(self._worker()) for _ in range(workers)
+            ]
+
+    async def stop(self) -> None:
+        for w in self._workers:
+            w.cancel()
+        self._workers = []
+
+    # -- API -----------------------------------------------------------------
+    def request_offload(
+        self, src_tier: str, dst_tier: str, block_id: int, seq_hash: int, *, priority: int = 10
+    ) -> None:
+        """Queue a copy of a registered block down-tier (lower priority value
+        = sooner)."""
+        heapq.heappush(
+            self._queue,
+            _Job(priority, next(self._seq), src_tier, dst_tier, block_id, seq_hash),
+        )
+        self._wake.set()
+
+    async def onboard(self, seq_hashes: list[int], dst_tier: str, src_tier: str) -> list[int] | None:
+        """Synchronously bring blocks up-tier (prefix hit on a lower tier).
+        Returns destination block ids, or None if allocation failed."""
+        src = self.pools[src_tier]
+        dst = self.pools[dst_tier]
+        src_ids = []
+        for h in seq_hashes:
+            bid = src.match_hash(h)
+            if bid is None:
+                return None
+            src_ids.append(bid)
+        dst_ids = []
+        for h in seq_hashes:
+            bid = dst.allocate()
+            if bid is None:
+                for b in dst_ids:
+                    dst.release(b)
+                for h2, b in zip(seq_hashes, src_ids):
+                    src.release(b)
+                return None
+            dst_ids.append(bid)
+        # batched copy through host
+        for start in range(0, len(src_ids), TRANSFER_BATCH):
+            chunk_src = src_ids[start : start + TRANSFER_BATCH]
+            chunk_dst = dst_ids[start : start + TRANSFER_BATCH]
+            data = await asyncio.to_thread(src.read, chunk_src)
+            await asyncio.to_thread(dst.write, chunk_dst, data)
+        for h, bid, n in zip(seq_hashes, dst_ids, itertools.count()):
+            dst.complete(bid, dst.blocks[bid].token_count)
+            dst.register(bid, h)
+        for bid in src_ids:
+            src.release(bid)
+        self.completed += len(seq_hashes)
+        return dst_ids
+
+    # -- workers ---------------------------------------------------------------
+    async def _worker(self) -> None:
+        while True:
+            while not self._queue:
+                self._wake.clear()
+                await self._wake.wait()
+            # batch same src→dst pairs
+            job = heapq.heappop(self._queue)
+            batch = [job]
+            rest: list[_Job] = []
+            while self._queue and len(batch) < TRANSFER_BATCH:
+                nxt = heapq.heappop(self._queue)
+                if nxt.src_tier == job.src_tier and nxt.dst_tier == job.dst_tier:
+                    batch.append(nxt)
+                else:
+                    rest.append(nxt)
+            for r in rest:
+                heapq.heappush(self._queue, r)
+            try:
+                await self._transfer(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                self.failed += len(batch)
+                logger.exception("offload batch failed")
+
+    async def _transfer(self, batch: list[_Job]) -> None:
+        src = self.pools[batch[0].src_tier]
+        dst = self.pools[batch[0].dst_tier]
+        jobs = []
+        for job in batch:
+            if dst.has_hash(job.seq_hash):
+                self.skipped += 1  # already down-tier (dedupe)
+                continue
+            jobs.append(job)
+        if not jobs:
+            return
+        dst_ids = []
+        kept: list[_Job] = []
+        for job in jobs:
+            bid = dst.allocate()
+            if bid is None:
+                self.failed += 1
+                continue
+            dst_ids.append(bid)
+            kept.append(job)
+        if not kept:
+            return
+        data = await asyncio.to_thread(src.read, [j.block_id for j in kept])
+        await asyncio.to_thread(dst.write, dst_ids, data)
+        for job, bid in zip(kept, dst_ids):
+            dst.complete(bid, src.blocks[job.block_id].token_count)
+            dst.register(bid, job.seq_hash)
+            dst.release(bid)  # parks in inactive LRU, discoverable
+            self.completed += 1
