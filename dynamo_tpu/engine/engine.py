@@ -68,6 +68,9 @@ class EngineConfig:
     mesh: MeshConfig | None = None
     seed: int = 0
     kv_cache_dtype: object = None  # default: model dtype
+    # "auto": Pallas paged-attention kernel on single-chip TPU, gather-based
+    # XLA fallback otherwise.  "jax" | "pallas" | "pallas_interpret" force.
+    attention_impl: str = "auto"
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -94,6 +97,13 @@ class JaxLlmEngine:
         self.mesh = None
         if config.mesh is not None and config.mesh.total() > 1:
             self.mesh = make_mesh(config.mesh)
+
+        if config.attention_impl == "auto":
+            self.attention_impl = (
+                "pallas" if (jax.default_backend() == "tpu" and self.mesh is None) else "jax"
+            )
+        else:
+            self.attention_impl = config.attention_impl
 
         rng = jax.random.PRNGKey(config.seed)
         self._rng = jax.random.fold_in(rng, 1)
@@ -165,7 +175,7 @@ class JaxLlmEngine:
         def step(params, cache, token_ids, block_tables, context_lens, slot_ids, rng, temp, top_k, top_p, greedy):
             logits, cache = llama_forward_decode(
                 params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
-                self.cos, self.sin,
+                self.cos, self.sin, attention=self.attention_impl,
             )
             tokens = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
             return tokens, cache

@@ -237,11 +237,28 @@ def llama_forward_decode(
     slot_ids: jnp.ndarray,      # [batch] int32 flat cache slot for this token
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    *,
+    attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
 ) -> tuple[jnp.ndarray, dict]:
-    """Batched single-token decode.  Returns (logits [batch, vocab], cache)."""
+    """Batched single-token decode.  Returns (logits [batch, vocab], cache).
+
+    ``attention="pallas"`` uses the Pallas paged-attention kernel (no
+    materialized page gather) — single-chip only until the shard_map
+    integration lands; "jax" is the portable gather-based fallback.
+    """
     b = token_ids.shape[0]
     x = params["embed"][token_ids].astype(cfg.dtype)  # [b, h]
     positions = jnp.maximum(context_lens - 1, 0)      # this token's position
+
+    def attend(q, k_layer, v_layer):
+        if attention.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import paged_attention_decode
+
+            return paged_attention_decode(
+                q, k_layer, v_layer, block_tables, context_lens,
+                interpret=attention == "pallas_interpret",
+            )
+        return paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
@@ -253,7 +270,7 @@ def llama_forward_decode(
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
         k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
-        attn = paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
+        attn = attend(q, k_layer, v_layer)
         x = x + attn.reshape(b, -1) @ w["wo"]
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])

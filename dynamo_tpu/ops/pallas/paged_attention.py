@@ -1,0 +1,142 @@
+"""Paged decode attention — Pallas TPU kernel.
+
+One grid step = (sequence, page): the page's K/V tiles are pipelined from
+HBM into VMEM by the BlockSpec index_map reading the scalar-prefetched block
+table (so the "gather" is just DMA addressing), and softmax is accumulated
+online flash-style in VMEM scratch across a sequence's pages.
+
+Layout notes (TPU tiling):
+- K/V cache pages are [block_size, kv_heads*head_dim] per page after
+  flattening heads into the lane dimension (head_dim multiple of 128 keeps
+  lanes aligned; block_size ≥ 8 keeps sublanes aligned).
+- GQA: queries [kv_heads*group, head_dim]; per page we contract
+  [G_all, D] × [bs, KVH, D] per kv head.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    block_tables_ref,   # [B, maxb] int32
+    context_lens_ref,   # [B] int32
+    # inputs
+    q_ref,              # [1, H, D]        (this sequence's queries)
+    k_page_ref,         # [1, bs, KVH, D]  (this grid step's page)
+    v_page_ref,
+    # output
+    out_ref,            # [1, H, D]
+    # scratch
+    m_ref,              # [KVH, G, 128] f32 running max (broadcast on lanes)
+    l_ref,              # [KVH, G, 128] f32 running denom
+    acc_ref,            # [KVH, G, D] f32 running numerator
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    groups: int,
+    head_dim: int,
+    max_blocks: int,
+):
+    seq = pl.program_id(0)
+    page = pl.program_id(1)
+    ctx = context_lens_ref[seq]
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_start = page * block_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        q = q_ref[0].reshape(num_kv_heads, groups, head_dim).astype(jnp.float32)
+        k = k_page_ref[0].astype(jnp.float32)   # [bs, KVH, D]
+        v = v_page_ref[0].astype(jnp.float32)
+        scale = 1.0 / (head_dim ** 0.5)
+        # [KVH, G, bs] = batch(KVH) contract(D)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_ref[:, :, :1]                            # [KVH, G, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)          # [KVH, G, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                              # [KVH, G, bs]
+        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # [KVH, G, D] = batch(KVH) contract(bs)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(page == max_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
+        out = (acc_ref[...] / denom).reshape(num_kv_heads * groups, head_dim)
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode(
+    q: jnp.ndarray,            # [B, H, D]
+    k_cache: jnp.ndarray,      # [N, bs, KVH, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, maxb] int32
+    context_lens: jnp.ndarray,  # [B] int32
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, h, d = q.shape
+    _, bs, kvh, _ = k_cache.shape
+    maxb = block_tables.shape[1]
+    groups = h // kvh
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda s, p, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, groups, 128), jnp.float32),
+            pltpu.VMEM((kvh, groups, 128), jnp.float32),
+            pltpu.VMEM((kvh, groups, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel,
+        block_size=bs,
+        num_kv_heads=kvh,
+        groups=groups,
+        head_dim=d,
+        max_blocks=maxb,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, q, k_cache, v_cache)

@@ -170,3 +170,15 @@ async def test_stats_shape():
         assert stats["request_total_slots"] == 4
     finally:
         engine.stop()
+
+
+async def test_pallas_attention_engine_matches_reference():
+    """Engine with the Pallas paged-attention path (interpret on CPU) must
+    produce identical greedy output."""
+    engine = make_engine(attention_impl="pallas_interpret", block_size=8, num_blocks=32)
+    try:
+        prompt = list(range(3, 13))
+        tokens, _ = await collect(engine, request(prompt, max_tokens=5))
+        assert tokens == greedy_reference(prompt, 5)
+    finally:
+        engine.stop()
