@@ -1,0 +1,29 @@
+"""Autoscaling planner.
+
+Observes load + SLA metrics, predicts the next interval, computes required
+prefill/decode replica counts from profiled performance, and scales through
+a connector (reference: components/planner — load-based planner_core.py and
+SLA planner_sla.py, predictors utils/load_predictor.py, interpolation
+utils/perf_interpolation.py, connectors local/kubernetes).
+"""
+
+from dynamo_tpu.planner.load_predictor import (
+    ConstantPredictor,
+    EwmaPredictor,
+    LinearTrendPredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.perf_interpolation import PerfProfile, ProfilePoint
+from dynamo_tpu.planner.planner import Planner, PlannerConfig, PlannerDecision
+
+__all__ = [
+    "ConstantPredictor",
+    "EwmaPredictor",
+    "LinearTrendPredictor",
+    "make_predictor",
+    "PerfProfile",
+    "ProfilePoint",
+    "Planner",
+    "PlannerConfig",
+    "PlannerDecision",
+]

@@ -1,0 +1,69 @@
+"""Planner connectors: apply scaling decisions to a deployment substrate
+(reference: components/planner local_connector.py (circus) and
+kubernetes_connector.py (CRD scaling))."""
+
+from __future__ import annotations
+
+from dynamo_tpu.planner.planner import PlannerDecision
+from dynamo_tpu.sdk.supervisor import ProcessSupervisor
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("planner.connectors")
+
+
+class LocalConnector:
+    """Scales prefill/decode worker replicas under the local supervisor."""
+
+    def __init__(
+        self,
+        supervisor: ProcessSupervisor,
+        *,
+        prefill_watcher: str = "prefill",
+        decode_watcher: str = "decode",
+    ):
+        self.supervisor = supervisor
+        self.prefill_watcher = prefill_watcher
+        self.decode_watcher = decode_watcher
+
+    async def scale(self, decision: PlannerDecision) -> None:
+        await self.supervisor.set_replicas(self.prefill_watcher, decision.num_prefill)
+        await self.supervisor.set_replicas(self.decode_watcher, decision.num_decode)
+
+
+class RecordingConnector:
+    """Test/dry-run connector: records decisions."""
+
+    def __init__(self) -> None:
+        self.decisions: list[PlannerDecision] = []
+
+    async def scale(self, decision: PlannerDecision) -> None:
+        self.decisions.append(decision)
+
+
+class KubernetesConnector:
+    """Emits scale patches for DynamoGraphDeployment-style CRs.  Without a
+    cluster in this environment, the connector renders the patch bodies and
+    hands them to an injectable ``apply`` callable (kubectl/API client in
+    production)."""
+
+    def __init__(self, apply, *, namespace: str = "default", deployment: str = "dynamo"):
+        self._apply = apply
+        self.namespace = namespace
+        self.deployment = deployment
+
+    async def scale(self, decision: PlannerDecision) -> None:
+        for component, replicas in (
+            ("prefill-worker", decision.num_prefill),
+            ("decode-worker", decision.num_decode),
+        ):
+            await self._apply(
+                {
+                    "apiVersion": "dynamo.tpu/v1alpha1",
+                    "kind": "DynamoComponentDeployment",
+                    "metadata": {
+                        "name": f"{self.deployment}-{component}",
+                        "namespace": self.namespace,
+                    },
+                    "spec": {"replicas": replicas},
+                }
+            )

@@ -1,0 +1,69 @@
+"""Load predictors (reference: components/planner/.../utils/load_predictor.py
+— constant / ARIMA / Prophet; here: constant, EWMA, and linear-trend, which
+cover the same roles without heavyweight deps)."""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class ConstantPredictor:
+    """Next value = last observation."""
+
+    def __init__(self, **_):
+        self._last = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = value
+
+    def predict(self) -> float:
+        return self._last
+
+
+class EwmaPredictor:
+    """Exponentially-weighted moving average."""
+
+    def __init__(self, alpha: float = 0.5, **_):
+        self.alpha = alpha
+        self._value: float | None = None
+
+    def observe(self, value: float) -> None:
+        if self._value is None:
+            self._value = value
+        else:
+            self._value = self.alpha * value + (1 - self.alpha) * self._value
+
+    def predict(self) -> float:
+        return self._value or 0.0
+
+
+class LinearTrendPredictor:
+    """Least-squares line over a sliding window, extrapolated one step."""
+
+    def __init__(self, window: int = 8, **_):
+        self._obs: deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._obs.append(value)
+
+    def predict(self) -> float:
+        n = len(self._obs)
+        if n == 0:
+            return 0.0
+        if n == 1:
+            return self._obs[0]
+        xs = range(n)
+        mean_x = (n - 1) / 2
+        mean_y = sum(self._obs) / n
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._obs))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))
+
+
+def make_predictor(kind: str = "constant", **kwargs):
+    return {
+        "constant": ConstantPredictor,
+        "ewma": EwmaPredictor,
+        "linear": LinearTrendPredictor,
+    }[kind](**kwargs)

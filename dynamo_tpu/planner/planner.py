@@ -1,0 +1,170 @@
+"""Planner core loop.
+
+Every ``adjustment_interval``: observe (request rate, ISL/OSL, TTFT/ITL) →
+apply correction factors vs the profile → predict next-interval load →
+compute required prefill/decode replicas → scale via the connector, within
+min/max bounds and chip budget (reference: planner_core.py:162-240,
+planner_sla.py:115).
+
+Disaggregation-aware: prefill replicas are sized from predicted prompt
+tokens/s against profiled prefill throughput; decode replicas from predicted
+generated tokens/s against profiled decode throughput (degraded by the
+observed correction factor).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass, field
+
+from dynamo_tpu.planner.load_predictor import make_predictor
+from dynamo_tpu.planner.perf_interpolation import PerfProfile
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("planner")
+
+
+@dataclass
+class WorkloadSample:
+    request_rate: float        # req/s
+    avg_isl: float             # prompt tokens/request
+    avg_osl: float             # generated tokens/request
+    ttft_s: float = 0.0
+    itl_s: float = 0.0
+
+
+@dataclass
+class PlannerConfig:
+    adjustment_interval_s: float = 30.0
+    predictor: str = "ewma"
+    min_prefill: int = 1
+    max_prefill: int = 8
+    min_decode: int = 1
+    max_decode: int = 8
+    max_total_chips: int = 16
+    chips_per_prefill: int = 1
+    chips_per_decode: int = 1
+    # SLA targets (0 disables the SLA term)
+    ttft_target_s: float = 0.0
+    itl_target_s: float = 0.0
+    scale_down_headroom: float = 1.3   # keep 30% slack before scaling down
+
+
+@dataclass
+class PlannerDecision:
+    num_prefill: int
+    num_decode: int
+    reason: str = ""
+
+
+class Planner:
+    def __init__(
+        self,
+        profile: PerfProfile,
+        connector,
+        config: PlannerConfig | None = None,
+    ):
+        self.profile = profile
+        self.connector = connector
+        self.config = config or PlannerConfig()
+        self._rate_pred = make_predictor(self.config.predictor)
+        self._isl_pred = make_predictor(self.config.predictor)
+        self._osl_pred = make_predictor(self.config.predictor)
+        # correction factors: observed perf / profiled perf (reference:
+        # planner_core.py correction factors)
+        self._ttft_correction = 1.0
+        self._itl_correction = 1.0
+        self.last_decision: PlannerDecision | None = None
+        self._task: asyncio.Task | None = None
+        self.metrics_source = None  # set for loop mode
+
+    # -- one planning step -------------------------------------------------
+    def observe(self, sample: WorkloadSample) -> None:
+        self._rate_pred.observe(sample.request_rate)
+        self._isl_pred.observe(sample.avg_isl)
+        self._osl_pred.observe(sample.avg_osl)
+        if sample.ttft_s > 0:
+            expected = self.profile.ttft_s(sample.avg_isl, sample.avg_osl)
+            if expected > 0:
+                self._ttft_correction = sample.ttft_s / expected
+        if sample.itl_s > 0:
+            expected = self.profile.itl_s(sample.avg_isl, sample.avg_osl)
+            if expected > 0:
+                self._itl_correction = sample.itl_s / expected
+
+    def plan(self) -> PlannerDecision:
+        cfg = self.config
+        rate = self._rate_pred.predict()
+        isl = max(self._isl_pred.predict(), 1.0)
+        osl = max(self._osl_pred.predict(), 1.0)
+
+        prefill_demand = rate * isl          # prompt tokens/s
+        decode_demand = rate * osl           # generated tokens/s
+
+        prefill_capacity = self.profile.prefill_tok_s(isl, osl) / max(self._ttft_correction, 1e-6)
+        decode_capacity = self.profile.decode_tok_s(isl, osl) / max(self._itl_correction, 1e-6)
+
+        num_prefill = math.ceil(prefill_demand / max(prefill_capacity, 1e-6) * cfg.scale_down_headroom) if prefill_demand else cfg.min_prefill
+        num_decode = math.ceil(decode_demand / max(decode_capacity, 1e-6) * cfg.scale_down_headroom) if decode_demand else cfg.min_decode
+
+        # SLA escalation: if observed latency breaches target, add capacity
+        reason = "load"
+        if cfg.ttft_target_s and self._ttft_correction * self.profile.ttft_s(isl, osl) > cfg.ttft_target_s:
+            num_prefill += 1
+            reason = "ttft_sla"
+        if cfg.itl_target_s and self._itl_correction * self.profile.itl_s(isl, osl) > cfg.itl_target_s:
+            num_decode += 1
+            reason = "itl_sla" if reason == "load" else "ttft+itl_sla"
+
+        num_prefill = min(max(num_prefill, cfg.min_prefill), cfg.max_prefill)
+        num_decode = min(max(num_decode, cfg.min_decode), cfg.max_decode)
+
+        # chip budget: shrink the larger pool first
+        while (
+            num_prefill * cfg.chips_per_prefill + num_decode * cfg.chips_per_decode
+            > cfg.max_total_chips
+        ):
+            if num_prefill * cfg.chips_per_prefill >= num_decode * cfg.chips_per_decode and num_prefill > cfg.min_prefill:
+                num_prefill -= 1
+            elif num_decode > cfg.min_decode:
+                num_decode -= 1
+            else:
+                break
+
+        decision = PlannerDecision(num_prefill=num_prefill, num_decode=num_decode, reason=reason)
+        self.last_decision = decision
+        return decision
+
+    async def step(self, sample: WorkloadSample) -> PlannerDecision:
+        self.observe(sample)
+        decision = self.plan()
+        await self.connector.scale(decision)
+        return decision
+
+    # -- loop mode -----------------------------------------------------------
+    def start(self, metrics_source) -> None:
+        """metrics_source: async callable returning WorkloadSample."""
+        self.metrics_source = metrics_source
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                sample = await self.metrics_source()
+                decision = await self.step(sample)
+                logger.info(
+                    "planner: rate=%.2f → prefill=%d decode=%d (%s)",
+                    sample.request_rate, decision.num_prefill, decision.num_decode,
+                    decision.reason,
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                logger.exception("planner step failed")
+            await asyncio.sleep(self.config.adjustment_interval_s)

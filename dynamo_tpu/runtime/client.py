@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import enum
 import random
+import uuid
 
 import msgpack
 
@@ -144,10 +145,13 @@ class PushRouter:
         runtime = self.client.runtime
         server = await runtime.data_server()
         ctx = request.ctx
-        pending = server.register(ctx.id, ctx)
+        # stream ids are per-hop (a pipeline stage calling downstream reuses
+        # the request ctx, so ctx.id alone would collide on the shared server)
+        stream_id = uuid.uuid4().hex
+        pending = server.register(stream_id, ctx)
         envelope = msgpack.packb(
             {
-                "c": {"id": ctx.id, "ci": server.connection_info(ctx.id).to_dict()},
+                "c": {"id": ctx.id, "ci": server.connection_info(stream_id).to_dict()},
                 "p": request.data,
             },
             use_bin_type=True,
@@ -159,7 +163,7 @@ class PushRouter:
             # returning the stream (the reference awaits the prologue)
             await asyncio.wait_for(pending.connected.wait(), timeout=30.0)
         except Exception:
-            server.unregister(ctx.id)
+            server.unregister(stream_id)
             raise
         return ResponseStream(pending, ctx)
 

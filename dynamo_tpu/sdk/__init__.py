@@ -1,0 +1,15 @@
+"""Service-graph SDK: declare components, run them locally under a process
+supervisor, deploy to k8s (reference: deploy/sdk — @service/@endpoint/
+depends DSL + circus-based ``dynamo serve``)."""
+
+from dynamo_tpu.sdk.supervisor import ProcessSpec, ProcessSupervisor
+from dynamo_tpu.sdk.graph import DynamoService, depends, endpoint, service
+
+__all__ = [
+    "ProcessSpec",
+    "ProcessSupervisor",
+    "DynamoService",
+    "depends",
+    "endpoint",
+    "service",
+]

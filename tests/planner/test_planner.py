@@ -1,0 +1,106 @@
+"""Planner: predictors, interpolation, replica math, SLA escalation, chip
+budget, connector application."""
+
+import pytest
+
+from dynamo_tpu.planner import (
+    EwmaPredictor,
+    LinearTrendPredictor,
+    PerfProfile,
+    Planner,
+    PlannerConfig,
+    ProfilePoint,
+)
+from dynamo_tpu.planner.connectors import KubernetesConnector, RecordingConnector
+from dynamo_tpu.planner.planner import WorkloadSample
+
+
+def profile():
+    return PerfProfile(
+        [
+            ProfilePoint(isl=512, osl=64, prefill_tok_s=10_000, decode_tok_s=1_000,
+                         ttft_s=0.1, itl_s=0.02),
+            ProfilePoint(isl=2048, osl=128, prefill_tok_s=8_000, decode_tok_s=800,
+                         ttft_s=0.3, itl_s=0.025),
+        ]
+    )
+
+
+def test_predictors():
+    ewma = EwmaPredictor(alpha=0.5)
+    for v in (10, 20):
+        ewma.observe(v)
+    assert ewma.predict() == 15
+
+    lin = LinearTrendPredictor(window=4)
+    for v in (1, 2, 3, 4):
+        lin.observe(v)
+    assert lin.predict() > 4  # extrapolates the trend
+
+
+def test_interpolation_exact_and_between():
+    p = profile()
+    assert p.prefill_tok_s(512, 64) == 10_000
+    mid = p.prefill_tok_s(1280, 96)
+    assert 8_000 < mid < 10_000
+
+
+async def test_scale_up_with_load():
+    connector = RecordingConnector()
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", max_prefill=16, max_decode=16, max_total_chips=64,
+        scale_down_headroom=1.0,
+    ))
+    # 10 req/s × 512 isl = 5120 prompt tok/s → 1 prefill; ×64 osl=640 tok/s → 1 decode
+    d1 = await planner.step(WorkloadSample(request_rate=10, avg_isl=512, avg_osl=64))
+    assert (d1.num_prefill, d1.num_decode) == (1, 1)
+    # 100 req/s: 51200/10000 → 6 prefill; 6400/1000 → 7 decode
+    d2 = await planner.step(WorkloadSample(request_rate=100, avg_isl=512, avg_osl=64))
+    assert d2.num_prefill == 6 and d2.num_decode == 7
+    assert connector.decisions == [d1, d2]
+
+
+async def test_sla_escalation():
+    connector = RecordingConnector()
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", ttft_target_s=0.15, scale_down_headroom=1.0,
+    ))
+    # observed ttft 0.4s vs profiled 0.1 → correction 4× breaches the target
+    d = await planner.step(
+        WorkloadSample(request_rate=1, avg_isl=512, avg_osl=64, ttft_s=0.4)
+    )
+    assert d.reason == "ttft_sla"
+    assert d.num_prefill >= 2
+
+
+async def test_chip_budget_clamps():
+    connector = RecordingConnector()
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", max_prefill=16, max_decode=16,
+        max_total_chips=4, scale_down_headroom=1.0,
+    ))
+    d = await planner.step(WorkloadSample(request_rate=1000, avg_isl=512, avg_osl=64))
+    assert d.num_prefill + d.num_decode <= 4
+
+
+async def test_kubernetes_connector_renders_patches():
+    patches = []
+
+    async def apply(p):
+        patches.append(p)
+
+    connector = KubernetesConnector(apply, deployment="graph")
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", scale_down_headroom=1.0))
+    await planner.step(WorkloadSample(request_rate=10, avg_isl=512, avg_osl=64))
+    assert len(patches) == 2
+    names = {p["metadata"]["name"] for p in patches}
+    assert names == {"graph-prefill-worker", "graph-decode-worker"}
+    assert all(p["spec"]["replicas"] >= 1 for p in patches)
+
+
+def test_profile_save_load(tmp_path):
+    p = profile()
+    p.save(tmp_path / "profile.json")
+    loaded = PerfProfile.load(tmp_path / "profile.json")
+    assert loaded.prefill_tok_s(512, 64) == 10_000

@@ -1,0 +1,112 @@
+"""SDK graph DSL + supervisor: declaration, dependency wiring over the
+control plane, in-process deployment, supervisor replica management."""
+
+import asyncio
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.sdk import ProcessSpec, ProcessSupervisor
+from dynamo_tpu.sdk.graph import (
+    Depends,
+    dependency_closure,
+    deploy_inprocess,
+    depends,
+    endpoint,
+    service,
+)
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+@service(workers=2)
+class Worker:
+    @endpoint()
+    async def generate(self, request, ctx):
+        for tok in request["tokens"]:
+            yield {"token": tok * 2}
+
+
+@service()
+class Processor:
+    worker = depends(Worker)
+
+    @endpoint()
+    async def generate(self, request, ctx):
+        request["tokens"] = [t + 1 for t in request["tokens"]]
+        stream = await self.worker.generate(Context(request, ctx))
+        async for item in stream:
+            yield item
+
+
+@service()
+class Frontend:
+    processor = depends(Processor)
+
+    @endpoint()
+    async def generate(self, request, ctx):
+        stream = await self.processor.generate(Context(request, ctx))
+        async for item in stream:
+            yield {"final": item["token"]}
+
+
+def test_declarations():
+    assert Worker._dyn_service.name == "worker"
+    assert Worker._dyn_service.workers == 2
+    assert [e.name for e in Worker._dyn_endpoints] == ["generate"]
+    assert isinstance(vars(Processor)["worker"], Depends)
+    assert dependency_closure(Frontend) == [Worker, Processor, Frontend]
+
+
+async def test_inprocess_graph_deploy():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://sdk"))
+    try:
+        handles = await deploy_inprocess(Frontend, rt)
+        assert set(handles) == {Worker, Processor, Frontend}
+
+        ep = rt.namespace("dynamo").component("frontend").endpoint("generate")
+        from dynamo_tpu.runtime.client import PushRouter
+
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(1, timeout=5)
+        out = await (await router.generate(Context({"tokens": [1, 2, 3]}))).collect()
+        # (t + 1) * 2 through Processor → Worker
+        assert [o["final"] for o in out] == [4, 6, 8]
+        for services in handles.values():
+            for s in services:
+                await s.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()
+
+
+async def test_supervisor_scales_and_restarts():
+    sup = ProcessSupervisor()
+    sup.add_watcher(
+        ProcessSpec(
+            name="sleeper",
+            cmd=[sys.executable, "-c", "import time; time.sleep(60)"],
+            restart=True,
+        ),
+        replicas=2,
+    )
+    await sup.start()
+    try:
+        assert sup.replica_count("sleeper") == 2
+        await sup.set_replicas("sleeper", 3)
+        assert sup.replica_count("sleeper") == 3
+        # crash one: monitor should restart it
+        victim = sup._replicas["sleeper"][0]
+        victim.process.kill()
+        for _ in range(100):
+            current = sup._replicas["sleeper"].get(0)
+            if current is not None and current is not victim:
+                break
+            await asyncio.sleep(0.1)
+        assert sup.replica_count("sleeper") == 3
+        await sup.set_replicas("sleeper", 1)
+        assert sup.replica_count("sleeper") == 1
+    finally:
+        await sup.stop()
+    assert sup.replica_count("sleeper") == 0
