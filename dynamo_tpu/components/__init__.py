@@ -1,0 +1,2 @@
+"""Standalone deployable service components (reference: components/ —
+router, metrics, planner binaries)."""

@@ -1,0 +1,133 @@
+"""Metrics service: aggregates worker load metrics into Prometheus.
+
+(Reference: components/metrics/src/lib.rs — scrapes ``load_metrics``,
+aggregates ProcessedEndpoints, exposes Prometheus; plus the KV-hit-rate
+event subscription, KVHitRateEvent.)
+
+Run: ``python -m dynamo_tpu.components.metrics_service --control-plane H:P``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from aiohttp import web
+from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+
+from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
+from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, KvHitRateEvent
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger("components.metrics")
+
+PREFIX = "dyn_worker"
+
+
+class MetricsService:
+    def __init__(self, component: Component, *, host: str = "0.0.0.0", port: int = 9091):
+        self.component = component
+        self.host = host
+        self.port = port
+        self.aggregator = KvMetricsAggregator(component)
+        self.registry = CollectorRegistry()
+        self.kv_active = Gauge(
+            f"{PREFIX}_kv_active_blocks", "Active KV blocks", ["worker"], registry=self.registry
+        )
+        self.kv_total = Gauge(
+            f"{PREFIX}_kv_total_blocks", "Total KV blocks", ["worker"], registry=self.registry
+        )
+        self.cache_usage = Gauge(
+            f"{PREFIX}_cache_usage_perc", "KV cache usage", ["worker"], registry=self.registry
+        )
+        self.waiting = Gauge(
+            f"{PREFIX}_requests_waiting", "Queued requests", ["worker"], registry=self.registry
+        )
+        self.hit_blocks = Counter(
+            f"{PREFIX}_kv_hit_blocks_total", "Matched prefix blocks routed", registry=self.registry
+        )
+        self.isl_blocks = Counter(
+            f"{PREFIX}_kv_isl_blocks_total", "Total request prefix blocks", registry=self.registry
+        )
+        self._hit_sub = None
+        self._hit_task: asyncio.Task | None = None
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        await self.aggregator.start()
+        bus = self.component.runtime.plane.bus
+        self._hit_sub = await bus.subscribe(self.component.event_subject(KV_HIT_RATE_SUBJECT))
+        self._hit_task = asyncio.ensure_future(self._hit_loop())
+
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:
+            self.port = s.getsockname()[1]
+            break
+        logger.info("metrics service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        await self.aggregator.stop()
+        if self._hit_sub is not None:
+            await self._hit_sub.unsubscribe()
+        if self._hit_task is not None:
+            self._hit_task.cancel()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _hit_loop(self) -> None:
+        async for msg in self._hit_sub:
+            try:
+                event = KvHitRateEvent.from_json(msg.payload)
+            except Exception:  # noqa: BLE001
+                continue
+            self.hit_blocks.inc(event.overlap_blocks)
+            self.isl_blocks.inc(max(event.isl_blocks, 0))
+
+    def _refresh(self) -> None:
+        snapshot = self.aggregator.snapshot()
+        for wid, m in snapshot.workers.items():
+            label = f"{wid:x}"
+            self.kv_active.labels(label).set(m.kv_active_blocks)
+            self.kv_total.labels(label).set(m.kv_total_blocks)
+            self.cache_usage.labels(label).set(m.gpu_cache_usage_perc)
+            self.waiting.labels(label).set(m.num_requests_waiting)
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        self._refresh()
+        return web.Response(body=generate_latest(self.registry), content_type="text/plain")
+
+
+async def amain(args) -> int:
+    configure_logging()
+    runtime = await DistributedRuntime.create(
+        RuntimeConfig(control_plane=args.control_plane)
+    )
+    component = runtime.namespace(args.namespace).component(args.component)
+    service = MetricsService(component, host=args.host, port=args.port)
+    await service.start()
+    await runtime.wait_for_shutdown()
+    await service.stop()
+    await runtime.close()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--control-plane", default="127.0.0.1:2379")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9091)
+    return asyncio.run(amain(parser.parse_args()))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

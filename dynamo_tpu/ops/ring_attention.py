@@ -1,0 +1,129 @@
+"""Ring attention: causal attention with the sequence sharded over mesh axis
+``sp``.
+
+Long-context prefill beyond one chip's HBM: each device holds a contiguous
+sequence chunk of Q/K/V; K/V chunks rotate around the ring via
+``jax.lax.ppermute`` (ICI neighbor exchange) while each device accumulates
+flash-style online softmax against its local queries.  Compute overlaps the
+rotation; memory per device is O(S/n).
+
+The reference has no sequence/context parallelism (SURVEY.md §2.5 marks it
+absent) — this is a TPU-native extension enabling prefill of sequences that
+exceed single-chip HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, q_offset, kv_offset, q_valid, kv_valid):
+    """Partial (unnormalized) attention of local q against one K/V chunk.
+
+    q: [B, Sq, KVH, G, D] f32; k/v: [B, Sk, KVH, D] f32.
+    Returns (m [B,Sq,KVH,G,1], l [B,...,1], acc [B,Sq,KVH,G,D]).
+    """
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    s = jnp.einsum("bqkgd,bskd->bqkgs", q, k) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    q_pos = q_offset + jnp.arange(sq)
+    kv_pos = kv_offset + jnp.arange(sk)
+    mask = (kv_pos[None, :] <= q_pos[:, None]) & q_valid[:, None] & kv_valid[None, :]
+    s = jnp.where(mask[None, :, None, None, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, -1e29)  # keep fully-masked rows finite
+    p = jnp.exp(s - m)
+    p = jnp.where(mask[None, :, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bqkgs,bskd->bqkgd", p, v)
+    return m, l, acc
+
+
+def _merge(m1, l1, acc1, m2, l2, acc2):
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return m, l1 * a1 + l2 * a2, acc1 * a1 + acc2 * a2
+
+
+def _ring_body(q, k, v, seq_len, axis_name: str, num_chunks: int, chunk: int):
+    """Per-device shard_map body."""
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qf = q.reshape(b, sq, kvh, groups, d).astype(jnp.float32)
+    q_offset = my_idx * chunk
+    q_valid = q_offset + jnp.arange(sq) < seq_len
+
+    # mark the fresh accumulators as device-varying over the ring axis so the
+    # scan carry types line up (shard_map varying-manual-axes tracking)
+    m0 = jax.lax.pcast(
+        jnp.full((b, sq, kvh, groups, 1), NEG_INF, jnp.float32), (axis_name,), to="varying"
+    )
+    l0 = jax.lax.pcast(
+        jnp.zeros((b, sq, kvh, groups, 1), jnp.float32), (axis_name,), to="varying"
+    )
+    acc0 = jax.lax.pcast(
+        jnp.zeros((b, sq, kvh, groups, d), jnp.float32), (axis_name,), to="varying"
+    )
+    perm = [(i, (i + 1) % num_chunks) for i in range(num_chunks)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        # chunk currently held after i rotations originated at (my - i) mod n
+        kv_idx = (my_idx - i) % num_chunks
+        kv_offset = kv_idx * chunk
+        kv_valid = kv_offset + jnp.arange(k_cur.shape[1]) < seq_len
+        mc, lc, accc = _chunk_attention(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q_offset, kv_offset, q_valid, kv_valid,
+        )
+        m, l, acc = _merge(m, l, acc, mc, lc, accc)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    (k_fin, v_fin, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(num_chunks)
+    )
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,   # [B, S, H, D], S divisible by sp size
+    k: jnp.ndarray,   # [B, S, KVH, D]
+    v: jnp.ndarray,
+    seq_len: jnp.ndarray,  # scalar int32 valid length (padding masked)
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Causal self-attention with sequence sharded over ``axis_name``."""
+    num_chunks = mesh.shape[axis_name]
+    s = q.shape[1]
+    if s % num_chunks:
+        raise ValueError(f"sequence {s} not divisible by {axis_name}={num_chunks}")
+    chunk = s // num_chunks
+    spec = P(None, axis_name, None, None)
+
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, num_chunks=num_chunks, chunk=chunk
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P()),
+        out_specs=spec,
+    )
+    return fn(q, k, v, seq_len)

@@ -1,0 +1,74 @@
+"""Multi-host bootstrap.
+
+Coordinates a multi-host JAX process group through the control plane
+(reference: MultiNodeConfig lib/llm/src/engines.rs:44-60 + etcd
+leader/worker barrier for engine bring-up; the engine-internal bootstrap —
+torch.distributed/NCCL there — is ``jax.distributed.initialize`` + XLA
+collectives over ICI/DCN here).
+
+Flow: the leader (node_rank 0) publishes its coordinator address through a
+LeaderBarrier; workers pick it up, everyone calls
+``jax.distributed.initialize``, and the global mesh spans all hosts' devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("parallel.multihost")
+
+
+@dataclass
+class MultiNodeConfig:
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str | None = None   # host:port of the jax coordinator
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+async def bootstrap_multihost(
+    kv,
+    config: MultiNodeConfig,
+    *,
+    barrier_id: str = "jax-bootstrap",
+    coordinator_port: int = 8476,
+    timeout: float = 300.0,
+) -> None:
+    """Rendezvous + ``jax.distributed.initialize``.  No-op for single node."""
+    if config.num_nodes <= 1:
+        return
+    import socket
+
+    import jax
+
+    if config.is_leader:
+        addr = config.leader_addr or f"{socket.gethostbyname(socket.gethostname())}:{coordinator_port}"
+        leader = LeaderBarrier(kv, barrier_id, num_workers=config.num_nodes - 1)
+        # publish before initialize so workers can join while the leader blocks
+        import asyncio
+
+        sync_task = asyncio.ensure_future(leader.sync({"coordinator": addr}, timeout=timeout))
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=config.num_nodes,
+            process_id=0,
+        )
+        await sync_task
+    else:
+        worker = WorkerBarrier(kv, barrier_id, worker_id=str(config.node_rank))
+        data = await worker.sync(timeout=timeout)
+        jax.distributed.initialize(
+            coordinator_address=data["coordinator"],
+            num_processes=config.num_nodes,
+            process_id=config.node_rank,
+        )
+    logger.info(
+        "multihost up: rank %d/%d, %d global devices",
+        config.node_rank, config.num_nodes, jax.device_count(),
+    )

@@ -1,0 +1,54 @@
+"""Leader/worker barrier rendezvous semantics."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+
+
+async def test_barrier_rendezvous():
+    plane = MemoryControlPlane()
+    leader = LeaderBarrier(plane.kv, "b1", num_workers=3)
+    workers = [WorkerBarrier(plane.kv, "b1", worker_id=str(i)) for i in range(3)]
+
+    async def run_worker(w):
+        data = await w.sync(timeout=5)
+        return data["coordinator"]
+
+    leader_task = asyncio.ensure_future(leader.sync({"coordinator": "10.0.0.1:8476"}, timeout=5))
+    results = await asyncio.gather(*[run_worker(w) for w in workers])
+    joined = await leader_task
+    assert results == ["10.0.0.1:8476"] * 3
+    assert joined == ["0", "1", "2"]
+
+
+async def test_barrier_worker_first():
+    # worker arrives before the leader posts: must still rendezvous
+    plane = MemoryControlPlane()
+    worker = WorkerBarrier(plane.kv, "b2", worker_id="w")
+    worker_task = asyncio.ensure_future(worker.sync(timeout=5))
+    await asyncio.sleep(0.1)
+    leader = LeaderBarrier(plane.kv, "b2", num_workers=1)
+    await leader.sync({"coordinator": "x:1"}, timeout=5)
+    assert (await worker_task)["coordinator"] == "x:1"
+
+
+async def test_barrier_timeout():
+    plane = MemoryControlPlane()
+    leader = LeaderBarrier(plane.kv, "b3", num_workers=2)
+    with pytest.raises(TimeoutError, match="0/2 workers"):
+        await leader.sync({}, timeout=0.3)
+
+
+async def test_double_leader_rejected():
+    plane = MemoryControlPlane()
+    l1 = LeaderBarrier(plane.kv, "b4", num_workers=1)
+    task = asyncio.ensure_future(l1.sync({}, timeout=2))
+    await asyncio.sleep(0.05)
+    l2 = LeaderBarrier(plane.kv, "b4", num_workers=1)
+    with pytest.raises(RuntimeError, match="already has a leader"):
+        await l2.sync({}, timeout=1)
+    await WorkerBarrier(plane.kv, "b4", "w").sync(timeout=2)
+    await task
