@@ -1,0 +1,3 @@
+"""Benchmarking toolkit: trace synthesis, concurrency sweeps, SLA profiling
+(reference: benchmarks/ — perf.sh genai-perf sweep, data_generator trace
+synthesizer, profiler/profile_sla.py)."""

@@ -1,0 +1,52 @@
+"""SLA profiler: measure prefill/decode performance over an (isl, osl) grid
+to produce the planner's PerfProfile (reference:
+benchmarks/profiler/profile_sla.py feeding the SLA planner's interpolators).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+from dynamo_tpu.bench.sweep import _drive_one
+from dynamo_tpu.planner.perf_interpolation import PerfProfile, ProfilePoint
+
+
+async def profile_engine(
+    engine,
+    *,
+    isl_grid=(128, 512, 2048),
+    osl_grid=(32, 128),
+    requests_per_point: int = 4,
+    vocab_size: int = 32_000,
+    seed: int = 0,
+) -> PerfProfile:
+    rng = random.Random(seed)
+    points: list[ProfilePoint] = []
+    for isl in isl_grid:
+        for osl in osl_grid:
+            ttfts, itls, prefill_rates = [], [], []
+            total_tokens = 0
+            t0 = time.monotonic()
+            for _ in range(requests_per_point):
+                tokens = [rng.randrange(10, vocab_size) for _ in range(isl)]
+                count, ttft, stamps = await _drive_one(engine, tokens, osl)
+                total_tokens += count
+                if ttft > 0:
+                    ttfts.append(ttft)
+                    prefill_rates.append(isl / ttft)
+                itls.extend(b - a for a, b in zip(stamps, stamps[1:]))
+            wall = time.monotonic() - t0
+            points.append(
+                ProfilePoint(
+                    isl=isl,
+                    osl=osl,
+                    concurrency=1,
+                    prefill_tok_s=sum(prefill_rates) / len(prefill_rates) if prefill_rates else 0.0,
+                    decode_tok_s=total_tokens / wall,
+                    ttft_s=sum(ttfts) / len(ttfts) if ttfts else 0.0,
+                    itl_s=sum(itls) / len(itls) if itls else 0.0,
+                )
+            )
+    return PerfProfile(points)

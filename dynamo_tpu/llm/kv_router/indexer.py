@@ -117,8 +117,17 @@ class KvIndexer:
     consumer).  ``find_matches`` is safe to call from the event loop since
     application and matching interleave cooperatively."""
 
-    def __init__(self) -> None:
-        self.tree = RadixTree()
+    def __init__(self, *, native: bool | None = None) -> None:
+        tree = None
+        if native is not False:
+            try:
+                from dynamo_tpu.native.radix import NativeRadixTree
+
+                tree = NativeRadixTree()
+            except Exception:  # noqa: BLE001 — fall back to the Python spec
+                if native is True:
+                    raise
+        self.tree = tree if tree is not None else RadixTree()
         self._queue: asyncio.Queue[RouterEvent | None] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         self.events_applied = 0

@@ -1,0 +1,61 @@
+"""Benchmark toolkit: trace synthesis determinism + prefix sharing, sweep
+harness over the mocker engine, SLA profiler output."""
+
+import pytest
+
+from dynamo_tpu.bench.data_generator import (
+    SynthesizerConfig,
+    TraceSynthesizer,
+    analyze_prefix_sharing,
+    load_trace,
+)
+from dynamo_tpu.bench.profile_sla import profile_engine
+from dynamo_tpu.bench.sweep import SweepConfig, pareto_frontier, run_sweep
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+
+
+def test_trace_deterministic_and_shared(tmp_path):
+    config = SynthesizerConfig(num_requests=64, seed=7)
+    a = TraceSynthesizer(config).generate()
+    b = TraceSynthesizer(config).generate()
+    assert [r.token_ids for r in a] == [r.token_ids for r in b]
+    # arrivals are monotone Poisson
+    assert all(x.arrival_s < y.arrival_s for x, y in zip(a, a[1:]))
+
+    stats = analyze_prefix_sharing(a)
+    assert stats["sharing_ratio"] > 0.2  # the prefix tree creates real overlap
+
+    path = tmp_path / "trace.jsonl"
+    TraceSynthesizer(config).write_jsonl(path)
+    loaded = load_trace(path)
+    assert [r.token_ids for r in loaded] == [r.token_ids for r in a]
+
+
+async def test_sweep_over_mocker():
+    engine = MockerEngine(MockerConfig(speedup=1000.0, num_blocks=2048, max_batch_size=64))
+    engine.start()
+    try:
+        points = await run_sweep(
+            engine,
+            SweepConfig(concurrencies=(1, 4), requests_per_level=8, isl=64, osl=16),
+        )
+        assert len(points) == 2
+        assert all(p.output_tokens == 8 * 16 for p in points)
+        assert points[1].tok_s_total >= points[0].tok_s_total  # batching helps
+        frontier = pareto_frontier(points)
+        assert frontier
+    finally:
+        engine.stop()
+
+
+async def test_profile_sla_over_mocker():
+    engine = MockerEngine(MockerConfig(speedup=1000.0, num_blocks=2048, max_batch_size=64))
+    engine.start()
+    try:
+        profile = await profile_engine(
+            engine, isl_grid=(32, 128), osl_grid=(8,), requests_per_point=2
+        )
+        assert len(profile.points) == 2
+        assert profile.decode_tok_s(64, 8) > 0
+    finally:
+        engine.stop()

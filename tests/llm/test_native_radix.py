@@ -1,0 +1,73 @@
+"""Native C++ radix index vs the Python behavioral spec: randomized parity."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv_router import KvIndexer, RadixTree, compute_block_hashes
+from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
+
+native = pytest.importorskip("dynamo_tpu.native.radix")
+if not native.native_available():
+    pytest.skip("g++ build unavailable", allow_module_level=True)
+
+
+def random_events(rng, n_workers=4, n_events=300):
+    """Random stored/removed/cleared event stream over overlapping sequences."""
+    base_seqs = [[rng.randrange(1000) for _ in range(16)] for _ in range(6)]
+    events = []
+    worker_hashes = {w: [] for w in range(n_workers)}
+    for _ in range(n_events):
+        worker = rng.randrange(n_workers)
+        roll = rng.random()
+        if roll < 0.7 or not worker_hashes[worker]:
+            seq = list(rng.choice(base_seqs))
+            if rng.random() < 0.5:
+                seq = seq[: rng.randrange(4, 17)] + [rng.randrange(1000) for _ in range(4)]
+            hashes = compute_block_hashes(seq, 4)
+            events.append(RouterEvent(worker, KvCacheEvent("stored", hashes)))
+            worker_hashes[worker].extend(hashes)
+        elif roll < 0.95:
+            k = rng.randrange(1, min(4, len(worker_hashes[worker])) + 1)
+            removed = [worker_hashes[worker].pop() for _ in range(k)]
+            events.append(RouterEvent(worker, KvCacheEvent("removed", removed)))
+        else:
+            events.append(RouterEvent(worker, KvCacheEvent("cleared")))
+            worker_hashes[worker] = []
+    return events, base_seqs
+
+
+def test_native_matches_python_spec():
+    rng = random.Random(0)
+    events, base_seqs = random_events(rng)
+    py = RadixTree()
+    cc = native.NativeRadixTree()
+    for e in events:
+        py.apply(e)
+        cc.apply(e)
+    for seq in base_seqs:
+        hashes = compute_block_hashes(seq, 4)
+        assert cc.find_matches(hashes).scores == py.find_matches(hashes).scores
+    assert cc.size() == py.size()
+
+
+def test_native_worker_removal_parity():
+    rng = random.Random(1)
+    events, base_seqs = random_events(rng, n_workers=3, n_events=100)
+    py = RadixTree()
+    cc = native.NativeRadixTree()
+    for e in events:
+        py.apply(e)
+        cc.apply(e)
+    py.remove_worker(1)
+    cc.remove_worker(1)
+    for seq in base_seqs:
+        hashes = compute_block_hashes(seq, 4)
+        assert cc.find_matches(hashes).scores == py.find_matches(hashes).scores
+
+
+def test_indexer_uses_native_by_default():
+    indexer = KvIndexer()
+    assert type(indexer.tree).__name__ == "NativeRadixTree"
+    indexer_py = KvIndexer(native=False)
+    assert type(indexer_py.tree).__name__ == "RadixTree"
