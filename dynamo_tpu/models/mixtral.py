@@ -1,0 +1,214 @@
+"""Mixtral-class sparse-MoE model (Mixtral 8x7B geometry and kin).
+
+Same attention trunk as the llama family; the dense MLP is replaced by a
+top-2-of-E MoE (dynamo_tpu/ops/moe.py).  Expert parallelism is sharding
+annotation only: expert-stacked weights carry ``P(None, "ep", ...)`` and
+GSPMD emits the dispatch/combine all-to-alls over ICI.
+
+(The reference serves wide-EP MoE through SGLang+DeepEP —
+examples/sglang/README.md:105; here the MoE engine is native.)
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.ops.attention import (
+    dense_causal_attention,
+    paged_decode_attention,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.moe import moe_ffn
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rope import apply_rope
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 2.0
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "MixtralConfig":
+        return cls(
+            vocab_size=32_000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+            max_position_embeddings=32768, rope_theta=1e6,
+            num_experts=8, experts_per_token=2,
+        )
+
+    @classmethod
+    def tiny_moe(cls, vocab_size: int = 512) -> "MixtralConfig":
+        return cls(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=96,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position_embeddings=2048, rope_theta=10000.0,
+            tie_word_embeddings=True, dtype=jnp.float32,
+            num_experts=4, experts_per_token=2, capacity_factor=4.0,
+        )
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "MixtralConfig":
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        heads = config["num_attention_heads"]
+        return cls(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_layers=config["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=config.get("num_key_value_heads", heads),
+            head_dim=config.get("head_dim") or config["hidden_size"] // heads,
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-5),
+            rope_theta=config.get("rope_theta", 1e6),
+            num_experts=config.get("num_local_experts", 8),
+            experts_per_token=config.get("num_experts_per_tok", 2),
+        )
+
+
+def init_params(cfg: MixtralConfig, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 12)
+    h, i, l_, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.num_experts
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    params = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), 1.0),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "wq": norm_init(keys[1], (l_, h, qd), h),
+            "wk": norm_init(keys[2], (l_, h, kvd), h),
+            "wv": norm_init(keys[3], (l_, h, kvd), h),
+            "wo": norm_init(keys[4], (l_, qd, h), qd),
+            "mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "w_router": norm_init(keys[5], (l_, h, e), h),
+            "w_gate": norm_init(keys[6], (l_, e, h, i), h),
+            "w_up": norm_init(keys[7], (l_, e, h, i), h),
+            "w_down": norm_init(keys[8], (l_, e, i, h), i),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm_init(keys[9], (h, cfg.vocab_size), h)
+    return params
+
+
+def param_specs(cfg: MixtralConfig) -> dict:
+    """Experts sharded over 'ep'; within-expert FFN dims over 'tp'; attention
+    head-sharded over 'tp' as in the llama family."""
+    specs = {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_router": P(None, None, None),
+            "w_gate": P(None, "ep", None, "tp"),
+            "w_up": P(None, "ep", None, "tp"),
+            "w_down": P(None, "ep", "tp", None),
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def _block(cfg: MixtralConfig, w, x, attn_fn):
+    attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+    x = x + attn_fn(attn_in)
+    mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+    moe_out = moe_ffn(
+        mlp_in, w["w_router"], w["w_gate"], w["w_up"], w["w_down"],
+        top_k=cfg.experts_per_token, capacity_factor=cfg.capacity_factor,
+    )
+    return x + moe_out
+
+
+def mixtral_forward_prefill(
+    params, cfg: MixtralConfig, token_ids, kv_cache, block_ids, seq_len, start_pos, cos, sin
+):
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        state = {}
+
+        def attn(attn_in):
+            q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+            k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            state["kv"] = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
+            attn_out = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+            return attn_out.reshape(s, -1) @ w["wo"]
+
+        x = _block(cfg, w, x, attn)
+        return x, state["kv"]
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = (
+        last[None] @ params["embed"].T.astype(x.dtype)
+        if cfg.tie_word_embeddings
+        else last[None] @ params["lm_head"]
+    )[0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def mixtral_forward_decode(
+    params, cfg: MixtralConfig, token_ids, kv_cache, block_tables, context_lens, slot_ids,
+    cos, sin,
+):
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(context_lens - 1, 0)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        state = {}
+
+        def attn(attn_in):
+            q = (attn_in @ w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
+            k = (attn_in @ w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+            v = (attn_in @ w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+            q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
+            k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
+            state["kv"] = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
+            attn_out = paged_decode_attention(
+                q, state["kv"][0], state["kv"][1], block_tables, context_lens
+            )
+            return attn_out.reshape(b, -1) @ w["wo"]
+
+        x = _block(cfg, w, x, attn)
+        return x, state["kv"]
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (
+        x @ params["embed"].T.astype(x.dtype)
+        if cfg.tie_word_embeddings
+        else x @ params["lm_head"]
+    )
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}

@@ -1,0 +1,92 @@
+"""Mixture-of-Experts layer ops.
+
+Capacity-based top-k routing with static shapes (XLA-friendly: no ragged
+dispatch):
+
+    dispatch  [T, H] → [E, C, H]   (one-hot scatter by expert slot)
+    experts   batched einsum over the expert axis (MXU)
+    combine   [E, C, H] → [T, H]   weighted by router probabilities
+
+Expert parallelism = sharding the expert axis over mesh axis ``ep``; GSPMD
+lowers dispatch/combine into all-to-alls over ICI (SURVEY.md §2.5 expert
+parallel — the reference delegates this to DeepEP inside SGLang; here it is
+native).  Tokens over capacity are dropped (standard capacity-factor
+behavior); capacity is sized to make drops negligible at serving batch sizes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_router(x: jnp.ndarray, w_router: jnp.ndarray, top_k: int):
+    """Returns (expert_ids [T, k], probs [T, k]) with renormalized top-k."""
+    logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_probs, top_ids = jax.lax.top_k(probs, top_k)
+    top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+    return top_ids.astype(jnp.int32), top_probs
+
+
+def moe_dispatch_combine(
+    x: jnp.ndarray,          # [T, H]
+    expert_ids: jnp.ndarray,  # [T, k]
+    probs: jnp.ndarray,       # [T, k] f32
+    w_gate: jnp.ndarray,      # [E, H, I]
+    w_up: jnp.ndarray,        # [E, H, I]
+    w_down: jnp.ndarray,      # [E, I, H]
+    *,
+    capacity: int,
+) -> jnp.ndarray:
+    t, h = x.shape
+    e = w_gate.shape[0]
+    k = expert_ids.shape[1]
+
+    flat_ids = expert_ids.reshape(-1)                      # [T*k]
+    onehot = jax.nn.one_hot(flat_ids, e, dtype=jnp.int32)  # [T*k, E]
+    # slot of each (token, k) within its expert's buffer
+    slot = jnp.cumsum(onehot, axis=0) * onehot             # [T*k, E]
+    slots = jnp.max(slot, axis=-1) - 1                     # [T*k] position, -1 invalid
+    within_capacity = (slots >= 0) & (slots < capacity)
+
+    # scatter tokens into [E, C, H]
+    buffers = jnp.zeros((e, capacity, h), x.dtype)
+    token_idx = jnp.repeat(jnp.arange(t), k)
+    safe_expert = jnp.where(within_capacity, flat_ids, 0)
+    safe_slot = jnp.where(within_capacity, slots, capacity)  # OOB → dropped
+    buffers = buffers.at[safe_expert, safe_slot].set(
+        x[token_idx], mode="drop"
+    )
+
+    # expert FFN batched over E (rides the MXU per expert shard)
+    hidden = jax.nn.silu(jnp.einsum("ech,ehi->eci", buffers, w_gate)) * jnp.einsum(
+        "ech,ehi->eci", buffers, w_up
+    )
+    out_buffers = jnp.einsum("eci,eih->ech", hidden, w_down)  # [E, C, H]
+
+    # combine: gather each (token, k)'s expert output, weight by prob
+    gathered = out_buffers[safe_expert, safe_slot]            # [T*k, H]
+    weights = jnp.where(within_capacity, probs.reshape(-1), 0.0)
+    weighted = gathered.astype(jnp.float32) * weights[:, None]
+    combined = jnp.zeros((t, h), jnp.float32).at[token_idx].add(weighted)
+    return combined.astype(x.dtype)
+
+
+def moe_ffn(
+    x: jnp.ndarray,
+    w_router: jnp.ndarray,
+    w_gate: jnp.ndarray,
+    w_up: jnp.ndarray,
+    w_down: jnp.ndarray,
+    *,
+    top_k: int,
+    capacity_factor: float = 2.0,
+) -> jnp.ndarray:
+    t = x.shape[0]
+    e = w_gate.shape[0]
+    capacity = max(1, int(t * top_k / e * capacity_factor))
+    ids, probs = moe_router(x, w_router, top_k)
+    return moe_dispatch_combine(
+        x, ids, probs, w_gate, w_up, w_down, capacity=capacity
+    )
