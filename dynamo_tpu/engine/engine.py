@@ -42,13 +42,10 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.models.llama import (
     LlamaConfig,
     init_kv_cache,
-    init_params,
     kv_cache_spec,
-    llama_forward_decode,
-    llama_forward_prefill,
     make_rope_tables,
-    param_specs,
 )
+from dynamo_tpu.models.registry import get_family
 from dynamo_tpu.ops.sampling import sample_tokens
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.runtime.engine import Context, ResponseStream
@@ -59,7 +56,8 @@ logger = get_logger("engine")
 
 @dataclass
 class EngineConfig:
-    model: LlamaConfig
+    model: LlamaConfig                 # any registered family's config
+    model_family: str = "llama"        # registry key (llama/qwen2/mixtral)
     num_blocks: int = 256
     block_size: int = 16
     max_batch_size: int = 8
@@ -88,6 +86,7 @@ class JaxLlmEngine:
     ):
         self.config = config
         cfg = config.model
+        self.family = get_family(config.model_family)
         self.max_len = config.resolved_max_len()
         self.max_blocks_per_seq = (self.max_len + config.block_size - 1) // config.block_size
         self.buckets = sorted({min(b, self.max_len) for b in config.prefill_buckets})
@@ -107,7 +106,7 @@ class JaxLlmEngine:
 
         rng = jax.random.PRNGKey(config.seed)
         self._rng = jax.random.fold_in(rng, 1)
-        raw_params = params if params is not None else init_params(cfg, rng)
+        raw_params = params if params is not None else self.family.init_params(cfg, rng)
         raw_cache = init_kv_cache(
             cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
         )
@@ -115,7 +114,7 @@ class JaxLlmEngine:
             from jax.sharding import NamedSharding
 
             self._param_shardings = jax.tree.map(
-                lambda s: NamedSharding(self.mesh, s), param_specs(cfg)
+                lambda s: NamedSharding(self.mesh, s), self.family.param_specs(cfg)
             )
             self._cache_sharding = {
                 "k": NamedSharding(self.mesh, kv_cache_spec()),
@@ -152,7 +151,7 @@ class JaxLlmEngine:
         cfg = self.config.model
 
         def step(params, cache, token_ids, block_ids, seq_len, start_pos, rng, temp, top_k, top_p, greedy):
-            logits, cache = llama_forward_prefill(
+            logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
                 self.cos, self.sin,
             )
@@ -173,7 +172,7 @@ class JaxLlmEngine:
         cfg = self.config.model
 
         def step(params, cache, token_ids, block_tables, context_lens, slot_ids, rng, temp, top_k, top_p, greedy):
-            logits, cache = llama_forward_decode(
+            logits, cache = self.family.forward_decode(
                 params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
                 self.cos, self.sin, attention=self.attention_impl,
             )

@@ -49,6 +49,8 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
+    # qkv projection biases (Qwen2-family geometry; llama proper has none)
+    attention_bias: bool = False
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -68,6 +70,7 @@ class LlamaConfig:
             rms_norm_eps=config.get("rms_norm_eps", 1e-5),
             rope_theta=config.get("rope_theta", 10000.0),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
+            attention_bias=config.get("attention_bias", False),
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or
@@ -133,6 +136,10 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
             "w_down": norm_init(keys[7], (l_, i, h), i),
         },
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = jnp.zeros((l_, qd), cfg.dtype)
+        params["layers"]["bk"] = jnp.zeros((l_, kvd), cfg.dtype)
+        params["layers"]["bv"] = jnp.zeros((l_, kvd), cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm_init(keys[8], (h, cfg.vocab_size), h)
     return params
@@ -156,6 +163,10 @@ def param_specs(cfg: LlamaConfig) -> dict:
             "w_down": P(None, "tp", None),
         },
     }
+    if cfg.attention_bias:
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")  # vocab-sharded logits
     return specs
@@ -206,9 +217,14 @@ def llama_forward_prefill(
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
         attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
-        k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
-        v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        q_proj = attn_in @ w["wq"]
+        k_proj = attn_in @ w["wk"]
+        v_proj = attn_in @ w["wv"]
+        if cfg.attention_bias:
+            q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
+        q = q_proj.reshape(s, cfg.num_heads, cfg.head_dim)
+        k = k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        v = v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
@@ -263,9 +279,14 @@ def llama_forward_decode(
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
         attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q = (attn_in @ w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
-        k = (attn_in @ w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
-        v = (attn_in @ w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        q_proj = attn_in @ w["wq"]
+        k_proj = attn_in @ w["wk"]
+        v_proj = attn_in @ w["wv"]
+        if cfg.attention_bias:
+            q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
+        q = q_proj.reshape(b, cfg.num_heads, cfg.head_dim)
+        k = k_proj.reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        v = v_proj.reshape(b, cfg.num_kv_heads, cfg.head_dim)
         # apply_rope expects a seq axis: insert and drop it
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
@@ -327,9 +348,16 @@ def load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
             t = t.T
         return jnp.asarray(t, cfg.dtype)
 
-    layers: dict[str, list] = {k: [] for k in _HF_LAYER_MAP}
+    layer_map = dict(_HF_LAYER_MAP)
+    if cfg.attention_bias:
+        layer_map.update(
+            bq="model.layers.{i}.self_attn.q_proj.bias",
+            bk="model.layers.{i}.self_attn.k_proj.bias",
+            bv="model.layers.{i}.self_attn.v_proj.bias",
+        )
+    layers: dict[str, list] = {k: [] for k in layer_map}
     for i in range(cfg.num_layers):
-        for ours, theirs in _HF_LAYER_MAP.items():
+        for ours, theirs in layer_map.items():
             transpose = ours.startswith("w")
             layers[ours].append(get(theirs.format(i=i), transpose))
     params = {

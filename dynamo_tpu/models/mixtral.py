@@ -179,9 +179,20 @@ def mixtral_forward_prefill(
 
 def mixtral_forward_decode(
     params, cfg: MixtralConfig, token_ids, kv_cache, block_tables, context_lens, slot_ids,
-    cos, sin,
+    cos, sin, *, attention: str = "jax",
 ):
     b = token_ids.shape[0]
+
+    def paged_attn(q, k_layer, v_layer):
+        if attention.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import paged_attention_decode
+
+            return paged_attention_decode(
+                q, k_layer, v_layer, block_tables, context_lens,
+                interpret=attention == "pallas_interpret",
+            )
+        return paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
+
     x = params["embed"][token_ids].astype(cfg.dtype)
     positions = jnp.maximum(context_lens - 1, 0)
 
@@ -196,9 +207,7 @@ def mixtral_forward_decode(
             q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
             state["kv"] = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
-            attn_out = paged_decode_attention(
-                q, state["kv"][0], state["kv"][1], block_tables, context_lens
-            )
+            attn_out = paged_attn(q, state["kv"][0], state["kv"][1])
             return attn_out.reshape(b, -1) @ w["wo"]
 
         x = _block(cfg, w, x, attn)

@@ -1,0 +1,94 @@
+"""Model family registry.
+
+Binds a ``model_type`` (HF config.json naming) to the functional pieces the
+engine needs: config parsing, param init, sharding specs, prefill/decode
+forwards.  Families registered here are served by the same engine,
+scheduler, router and disagg machinery.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class ModelFamily:
+    name: str
+    config_from_hf: Callable[[Any], Any]
+    init_params: Callable
+    param_specs: Callable
+    forward_prefill: Callable
+    forward_decode: Callable
+
+
+def _llama_family() -> ModelFamily:
+    from dynamo_tpu.models import llama
+
+    return ModelFamily(
+        name="llama",
+        config_from_hf=llama.LlamaConfig.from_hf_config,
+        init_params=llama.init_params,
+        param_specs=llama.param_specs,
+        forward_prefill=llama.llama_forward_prefill,
+        forward_decode=llama.llama_forward_decode,
+    )
+
+
+def _qwen2_family() -> ModelFamily:
+    # Qwen2/2.5 = llama geometry + attention qkv biases (config flag); the
+    # llama implementation handles both (attention_bias).
+    from dynamo_tpu.models import llama
+
+    def config_from_hf(config):
+        import json
+
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        config = dict(config)
+        config.setdefault("attention_bias", True)
+        return llama.LlamaConfig.from_hf_config(config)
+
+    return ModelFamily(
+        name="qwen2",
+        config_from_hf=config_from_hf,
+        init_params=llama.init_params,
+        param_specs=llama.param_specs,
+        forward_prefill=llama.llama_forward_prefill,
+        forward_decode=llama.llama_forward_decode,
+    )
+
+
+def _mixtral_family() -> ModelFamily:
+    from dynamo_tpu.models import mixtral
+
+    return ModelFamily(
+        name="mixtral",
+        config_from_hf=mixtral.MixtralConfig.from_hf_config,
+        init_params=mixtral.init_params,
+        param_specs=mixtral.param_specs,
+        forward_prefill=mixtral.mixtral_forward_prefill,
+        forward_decode=mixtral.mixtral_forward_decode,
+    )
+
+
+_FAMILIES: dict[str, Callable[[], ModelFamily]] = {
+    "llama": _llama_family,
+    "qwen2": _qwen2_family,
+    "qwen3": _qwen2_family,
+    "mixtral": _mixtral_family,
+}
+
+
+def get_family(model_type: str) -> ModelFamily:
+    factory = _FAMILIES.get(model_type)
+    if factory is None:
+        raise ValueError(
+            f"unknown model family {model_type!r}; known: {sorted(_FAMILIES)}"
+        )
+    return factory()
+
+
+def register_family(name: str, factory: Callable[[], ModelFamily]) -> None:
+    _FAMILIES[name] = factory

@@ -41,15 +41,21 @@ class WorkerHandle:
 def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **overrides):
     """Build a JaxLlmEngine from a local model dir (config.json; weights from
     safetensors when present, random-init otherwise)."""
-    import jax
+    import json as _json
 
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
-    from dynamo_tpu.models.llama import LlamaConfig, init_params, load_hf_weights
+    from dynamo_tpu.models.llama import load_hf_weights
+    from dynamo_tpu.models.registry import get_family
 
     model_dir = Path(model_dir)
-    cfg = LlamaConfig.from_hf_config(model_dir / "config.json")
+    hf_config = _json.loads((model_dir / "config.json").read_text())
+    model_type = hf_config.get("model_type", "llama")
+    family_name = model_type if model_type in ("llama", "qwen2", "qwen3", "mixtral") else "llama"
+    family = get_family(family_name)
+    cfg = family.config_from_hf(hf_config)
     defaults = dict(
         model=cfg,
+        model_family=family_name,
         block_size=mdc.kv_block_size,
         num_blocks=overrides.pop("num_blocks", 256),
         max_batch_size=overrides.pop("max_batch_size", 8),
@@ -57,12 +63,13 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
     )
     defaults.update(overrides)
     config = EngineConfig(**defaults)
-    try:
-        params = load_hf_weights(cfg, model_dir)
-        logger.info("loaded weights from %s", model_dir)
-    except FileNotFoundError:
-        logger.warning("no safetensors in %s — random-initializing weights", model_dir)
-        params = None
+    params = None
+    if family_name in ("llama", "qwen2", "qwen3"):
+        try:
+            params = load_hf_weights(cfg, model_dir)
+            logger.info("loaded weights from %s", model_dir)
+        except FileNotFoundError:
+            logger.warning("no safetensors in %s — random-initializing weights", model_dir)
     return JaxLlmEngine(config, params=params)
 
 

@@ -1,0 +1,68 @@
+"""Engine serves any registered model family through the same machinery."""
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.mixtral import MixtralConfig
+from dynamo_tpu.models.registry import get_family
+from dynamo_tpu.runtime.engine import Context
+
+from tests.engine.test_jax_engine import collect, request
+
+
+def test_registry_families():
+    assert get_family("llama").name == "llama"
+    assert get_family("qwen2").name == "qwen2"
+    assert get_family("mixtral").name == "mixtral"
+    with pytest.raises(ValueError, match="unknown model family"):
+        get_family("gpt-oss")
+
+
+def test_qwen2_config_enables_bias():
+    cfg = get_family("qwen2").config_from_hf(
+        {
+            "vocab_size": 128, "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+        }
+    )
+    assert cfg.attention_bias is True
+    params = get_family("qwen2").init_params(cfg, jax.random.PRNGKey(0))
+    assert "bq" in params["layers"]
+
+
+async def test_mixtral_engine_generates():
+    cfg = MixtralConfig.tiny_moe()
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="mixtral", num_blocks=32, block_size=4,
+            max_batch_size=2, prefill_buckets=(16,), max_model_len=32,
+        )
+    )
+    engine.start()
+    try:
+        tokens, finish = await collect(engine, request(range(3, 10), max_tokens=4))
+        assert len(tokens) == 4
+        assert finish is not None
+    finally:
+        engine.stop()
+
+
+async def test_qwen2_engine_generates():
+    cfg = LlamaConfig.tiny()
+    from dataclasses import replace
+
+    cfg = replace(cfg, attention_bias=True)
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="qwen2", num_blocks=32, block_size=4,
+            max_batch_size=2, prefill_buckets=(16,), max_model_len=32,
+        )
+    )
+    engine.start()
+    try:
+        tokens, finish = await collect(engine, request(range(3, 10), max_tokens=4))
+        assert len(tokens) == 4
+    finally:
+        engine.stop()
