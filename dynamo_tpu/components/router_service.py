@@ -1,0 +1,96 @@
+"""Standalone KV-aware router component.
+
+Hosts a KvRouter behind a control-plane endpoint: ``generate`` takes
+``{"token_ids": [...]}`` and streams back the selected ``worker_id`` +
+matched prefix blocks (reference: components/router/src/main.rs — the
+router-as-a-service deployment shape, used when routing decisions are made
+outside the frontend process).
+
+Run: ``python -m dynamo_tpu.components.router_service --control-plane H:P``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.runtime.component import instances_prefix
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger("components.router")
+
+
+class RouterEngine:
+    """AsyncEngine answering scheduling queries."""
+
+    def __init__(self, runtime: DistributedRuntime, kv_router: KvRouter,
+                 namespace: str, component: str, endpoint: str):
+        self.runtime = runtime
+        self.kv_router = kv_router
+        self._prefix = instances_prefix(namespace, component, endpoint)
+
+    async def _worker_ids(self) -> list[int]:
+        import json
+
+        entries = await self.runtime.plane.kv.get_prefix(self._prefix)
+        return [json.loads(e.value)["instance_id"] for e in entries]
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        token_ids = request.data.get("token_ids", [])
+        worker_ids = await self._worker_ids()
+        worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+
+        async def gen():
+            yield {"worker_id": worker_id, "overlap_blocks": matched}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+async def serve_router(
+    runtime: DistributedRuntime,
+    *,
+    namespace: str = "dynamo",
+    component: str = "backend",
+    endpoint: str = "generate",
+    block_size: int = 16,
+):
+    """Start the router service; returns (EndpointService, KvRouter)."""
+    backend_component = runtime.namespace(namespace).component(component)
+    kv_router = KvRouter(backend_component, block_size=block_size)
+    await kv_router.start()
+    engine = RouterEngine(runtime, kv_router, namespace, component, endpoint)
+    router_ep = runtime.namespace(namespace).component("router").endpoint("generate")
+    service = await router_ep.serve(engine)
+    return service, kv_router
+
+
+async def amain(args) -> int:
+    configure_logging()
+    runtime = await DistributedRuntime.create(RuntimeConfig(control_plane=args.control_plane))
+    service, kv_router = await serve_router(
+        runtime, namespace=args.namespace, component=args.component,
+        block_size=args.kv_block_size,
+    )
+    logger.info("router service up")
+    await runtime.wait_for_shutdown()
+    await service.shutdown()
+    await kv_router.stop()
+    await runtime.close()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--control-plane", default="127.0.0.1:2379")
+    parser.add_argument("--namespace", default="dynamo")
+    parser.add_argument("--component", default="backend")
+    parser.add_argument("--kv-block-size", type=int, default=16)
+    return asyncio.run(amain(parser.parse_args()))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

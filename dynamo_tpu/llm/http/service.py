@@ -81,11 +81,13 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics: FrontendMetrics | None = None,
+        request_template=None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
+        self.request_template = request_template
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
@@ -126,6 +128,8 @@ class HttpService:
     async def handle_chat(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
+            if self.request_template is not None:
+                body = self.request_template.apply(body)
             chat_request = ChatCompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
             return _error(400, f"invalid request: {exc}")
@@ -159,6 +163,8 @@ class HttpService:
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
+            if self.request_template is not None:
+                body = self.request_template.apply(body)
             completion_request = CompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
             return _error(400, f"invalid request: {exc}")

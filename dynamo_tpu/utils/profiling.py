@@ -1,0 +1,60 @@
+"""Profiling hooks.
+
+The reference relies on external genai-perf plus ``tracing`` spans
+(SURVEY.md §5); on TPU the interesting plane is the device: this wraps
+``jax.profiler`` so any engine process can expose traces.
+
+- ``start_server(port)``: serve the profiler so TensorBoard/xprof can attach.
+- ``trace(path)``: context manager capturing a trace of the enclosed steps.
+- env ``DYN_PROFILER_PORT``: auto-start in the engine at import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("utils.profiling")
+
+_server_started = False
+
+
+def start_server(port: int = 9012) -> None:
+    global _server_started
+    if _server_started:
+        return
+    import jax
+
+    jax.profiler.start_server(port)
+    _server_started = True
+    logger.info("jax profiler server on port %d", port)
+
+
+def maybe_start_from_env() -> None:
+    port = os.environ.get("DYN_PROFILER_PORT")
+    if port:
+        start_server(int(port))
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a device trace: ``with trace('/tmp/tb'): run_steps()``."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("trace written to %s", log_dir)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named span visible in device traces."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,117 @@
+"""Embedding engine through HTTP, standalone router service, request
+template defaults."""
+
+import asyncio
+from pathlib import Path
+
+import httpx
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.embedding import EmbeddingEngineConfig, JaxEmbeddingEngine
+from dynamo_tpu.components.router_service import serve_router
+from dynamo_tpu.engine.kv_manager import KvEvent
+from dynamo_tpu.llm.http import HttpService, ModelManager
+from dynamo_tpu.llm.kv_router import compute_block_hashes
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.llm.request_template import RequestTemplate
+from dynamo_tpu.llm.tokenizer import HfTokenizer
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.client import PushRouter
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = Path(__file__).parent.parent / "data" / "tiny-chat-model"
+
+
+async def test_embeddings_http():
+    tokenizer = HfTokenizer.from_file(MODEL_DIR / "tokenizer.json")
+    engine = JaxEmbeddingEngine(
+        EmbeddingEngineConfig(model=LlamaConfig.tiny(), max_length=32), tokenizer
+    )
+    manager = ModelManager()
+    manager.add_embedding_model("tiny-embed", engine)
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.post(
+                "/v1/embeddings",
+                json={"model": "tiny-embed", "input": ["hello world", "the quick brown fox"]},
+                timeout=60,
+            )
+            assert r.status_code == 200
+            body = r.json()
+            assert len(body["data"]) == 2
+            v0 = np.asarray(body["data"][0]["embedding"])
+            v1 = np.asarray(body["data"][1]["embedding"])
+            assert v0.shape == (64,)
+            np.testing.assert_allclose(np.linalg.norm(v0), 1.0, rtol=1e-5)
+            # same input twice embeds identically; different inputs differ
+            r2 = await client.post(
+                "/v1/embeddings", json={"model": "tiny-embed", "input": "hello world"},
+                timeout=60,
+            )
+            np.testing.assert_allclose(
+                np.asarray(r2.json()["data"][0]["embedding"]), v0, rtol=1e-5, atol=1e-6
+            )
+            assert not np.allclose(v0, v1)
+    finally:
+        await service.stop()
+
+
+async def test_router_service_endpoint():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://router-svc"))
+    service = kv_router = None
+    try:
+        # a fake backend instance registers so the router sees worker ids
+        backend_ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+
+        class Noop:
+            async def generate(self, request):
+                from dynamo_tpu.runtime.engine import ResponseStream
+
+                async def gen():
+                    yield {}
+
+                return ResponseStream(gen(), request.ctx)
+
+        worker = await backend_ep.serve(Noop(), instance_id=42)
+        service, kv_router = await serve_router(rt, block_size=4)
+
+        # publish cached blocks for worker 42
+        pub = KvEventPublisher(rt.namespace("dynamo").component("backend"), worker_id=42)
+        pub.start()
+        seq = list(range(1, 17))
+        pub.sink(KvEvent(kind="stored", block_hashes=compute_block_hashes(seq, 4)))
+        await asyncio.sleep(0.1)
+
+        router_ep = rt.namespace("dynamo").component("router").endpoint("generate")
+        client = await PushRouter.from_endpoint(router_ep)
+        await client.client.wait_for_instances(1, timeout=5)
+        out = await (await client.generate(Context({"token_ids": seq}))).collect()
+        assert out[0]["worker_id"] == 42
+        assert out[0]["overlap_blocks"] == 4
+        await worker.shutdown(drain_timeout=1)
+    finally:
+        if service:
+            await service.shutdown(drain_timeout=1)
+        if kv_router:
+            await kv_router.stop()
+        await rt.close()
+
+
+def test_request_template(tmp_path):
+    path = tmp_path / "template.json"
+    path.write_text('{"model": "tiny", "temperature": 0.6, "max_completion_tokens": 32}')
+    template = RequestTemplate.load(path)
+    body = template.apply({"messages": []})
+    assert body["model"] == "tiny"
+    assert body["temperature"] == 0.6
+    assert body["max_completion_tokens"] == 32
+    # explicit values win
+    body = template.apply({"model": "other", "temperature": 0.1, "max_tokens": 4})
+    assert body["model"] == "other" and body["temperature"] == 0.1
+    assert "max_completion_tokens" not in body

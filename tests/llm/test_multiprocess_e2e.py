@@ -1,0 +1,101 @@
+"""True multi-process distributed serving: dynctl control-plane server, an
+echo worker in a separate OS process, and the frontend in this process —
+requests cross real process boundaries (bus push over TCP, response streams
+over TCP connect-back).  This is the distributed mode the reference runs
+with etcd+NATS (SURVEY.md §3.2).
+"""
+
+import asyncio
+import sys
+import textwrap
+
+import httpx
+import pytest
+
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.server import ControlPlaneServer
+from dynamo_tpu.serve import serve_frontend
+from dynamo_tpu.utils.config import RuntimeConfig
+
+WORKER_SCRIPT = textwrap.dedent(
+    """
+    import asyncio, sys
+
+    async def main():
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.serve import serve_worker
+        from dynamo_tpu.utils.config import RuntimeConfig
+
+        control_plane, model_dir = sys.argv[1], sys.argv[2]
+        rt = await DistributedRuntime.create(RuntimeConfig(control_plane=control_plane))
+        worker = await serve_worker(rt, model_dir, model_name="tiny", engine_kind="echo")
+        print("WORKER_READY", flush=True)
+        await asyncio.sleep(3600)
+
+    asyncio.run(main())
+    """
+)
+
+
+@pytest.mark.integration
+async def test_cross_process_serving(tmp_path):
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    address = f"127.0.0.1:{server.port}"
+
+    import os
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).parent.parent.parent)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    worker_proc = await asyncio.create_subprocess_exec(
+        sys.executable, str(script), address, str(Path(repo_root) / "tests/data/tiny-chat-model"),
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.DEVNULL, env=env,
+    )
+    runtime = service = watcher = None
+    try:
+        line = await asyncio.wait_for(worker_proc.stdout.readline(), 30)
+        assert b"WORKER_READY" in line
+
+        runtime = await DistributedRuntime.create(RuntimeConfig(control_plane=address))
+        service, watcher = await serve_frontend(runtime, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            for _ in range(100):
+                r = await client.get("/v1/models")
+                if any(m["id"] == "tiny" for m in r.json().get("data", [])):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                pytest.fail("model never discovered across processes")
+
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "cross process hello"}]},
+                timeout=30,
+            )
+            assert r.status_code == 200
+            assert "cross process hello" in r.json()["choices"][0]["message"]["content"]
+
+            # kill the worker: lease lapses, model disappears, requests 404
+            worker_proc.kill()
+            await worker_proc.wait()
+            for _ in range(150):
+                r = await client.get("/v1/models")
+                if not r.json()["data"]:
+                    break
+                await asyncio.sleep(0.1)
+            assert r.json()["data"] == [], "dead worker's model must be evicted by lease expiry"
+    finally:
+        if worker_proc.returncode is None:
+            worker_proc.kill()
+            await worker_proc.wait()
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if runtime:
+            await runtime.close()
+        await server.stop()
