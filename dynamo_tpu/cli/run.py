@@ -44,6 +44,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--component", default="backend")
     run.add_argument("--endpoint", default="generate")
     run.add_argument("--router-mode", choices=[m.value for m in RouterMode], default="round_robin")
+    run.add_argument("--request-template", default=None,
+                     help="JSON file with default model/temperature/max_tokens")
     run.add_argument("--num-blocks", type=int, default=256, help="KV cache blocks in HBM")
     run.add_argument("--kv-block-size", type=int, default=16)
     run.add_argument("--max-batch-size", type=int, default=8)
@@ -108,6 +110,7 @@ async def _run(args) -> int:
                 host=args.host,
                 port=args.port,
                 router_mode=RouterMode(args.router_mode),
+                request_template=args.request_template,
             )
             print(f"listening on http://{args.host}:{service.port}/v1", file=sys.stderr)
             await runtime.wait_for_shutdown()

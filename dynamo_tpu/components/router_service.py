@@ -15,7 +15,7 @@ import argparse
 import asyncio
 
 from dynamo_tpu.llm.kv_router.router import KvRouter
-from dynamo_tpu.runtime.component import instances_prefix
+from dynamo_tpu.runtime.client import Client
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.config import RuntimeConfig
@@ -25,24 +25,18 @@ logger = get_logger("components.router")
 
 
 class RouterEngine:
-    """AsyncEngine answering scheduling queries."""
+    """AsyncEngine answering scheduling queries.  Worker membership comes
+    from the watch-backed Client view (no control-plane scan per request)."""
 
-    def __init__(self, runtime: DistributedRuntime, kv_router: KvRouter,
-                 namespace: str, component: str, endpoint: str):
-        self.runtime = runtime
+    def __init__(self, kv_router: KvRouter, client: Client):
         self.kv_router = kv_router
-        self._prefix = instances_prefix(namespace, component, endpoint)
-
-    async def _worker_ids(self) -> list[int]:
-        import json
-
-        entries = await self.runtime.plane.kv.get_prefix(self._prefix)
-        return [json.loads(e.value)["instance_id"] for e in entries]
+        self.client = client
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         token_ids = request.data.get("token_ids", [])
-        worker_ids = await self._worker_ids()
-        worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+        worker_id, matched = await self.kv_router.schedule(
+            token_ids, self.client.instance_ids
+        )
 
         async def gen():
             yield {"worker_id": worker_id, "overlap_blocks": matched}
@@ -62,7 +56,9 @@ async def serve_router(
     backend_component = runtime.namespace(namespace).component(component)
     kv_router = KvRouter(backend_component, block_size=block_size)
     await kv_router.start()
-    engine = RouterEngine(runtime, kv_router, namespace, component, endpoint)
+    client = Client(runtime, backend_component.endpoint(endpoint))
+    await client.start()
+    engine = RouterEngine(kv_router, client)
     router_ep = runtime.namespace(namespace).component("router").endpoint("generate")
     service = await router_ep.serve(engine)
     return service, kv_router

@@ -1,0 +1,192 @@
+"""CRD-shaped deployment specs (reference:
+deploy/cloud/operator/api/v1alpha1/dynamographdeployment_types.go:33-141 and
+dynamocomponentdeployment_types.go — a graph CR fans out into one component
+CR per service).
+
+Group/version ``dynamo.tpu/v1alpha1``; YAML CRD definitions for a real
+cluster live under ``deploy/crds/``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any
+
+import yaml
+
+GROUP = "dynamo.tpu"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+
+COMPONENT_KINDS = ("frontend", "worker", "prefill-worker", "router", "planner", "metrics")
+
+
+@dataclass
+class Resources:
+    """Per-replica resource requests. ``tpu`` counts chips; ``tpu_topology``
+    (e.g. "2x4") selects the slice shape via node selectors."""
+
+    cpu: str = "1"
+    memory: str = "2Gi"
+    tpu: int = 0
+    tpu_topology: str = ""
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict | None) -> "Resources":
+        d = d or {}
+        return cls(
+            cpu=str(d.get("cpu", "1")),
+            memory=str(d.get("memory", "2Gi")),
+            tpu=int(d.get("tpu", 0)),
+            tpu_topology=str(d.get("tpu_topology", d.get("tpuTopology", ""))),
+        )
+
+
+@dataclass
+class ComponentSpec:
+    """One service in the graph (reference: operator service spec,
+    internal/dynamo/graph.go:556 translation input)."""
+
+    component_type: str = "worker"  # one of COMPONENT_KINDS
+    replicas: int = 1
+    image: str = "dynamo-tpu:latest"
+    command: list[str] = field(default_factory=list)
+    args: list[str] = field(default_factory=list)
+    envs: dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    config: dict[str, Any] = field(default_factory=dict)  # service YAML payload
+    port: int = 0  # exposed service port (frontend/router/metrics)
+
+    def validate(self, name: str) -> None:
+        if self.component_type not in COMPONENT_KINDS:
+            raise ValueError(
+                f"service {name!r}: unknown componentType {self.component_type!r} "
+                f"(expected one of {COMPONENT_KINDS})"
+            )
+        if self.replicas < 0:
+            raise ValueError(f"service {name!r}: negative replicas")
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        d["componentType"] = d.pop("component_type")
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ComponentSpec":
+        return cls(
+            component_type=d.get("componentType", d.get("component_type", "worker")),
+            replicas=int(d.get("replicas", 1)),
+            image=d.get("image", "dynamo-tpu:latest"),
+            command=list(d.get("command", [])),
+            args=list(d.get("args", [])),
+            envs=dict(d.get("envs", {})),
+            resources=Resources.from_dict(d.get("resources")),
+            config=dict(d.get("config", {})),
+            port=int(d.get("port", 0)),
+        )
+
+
+@dataclass
+class DynamoGraphDeployment:
+    """The graph CR: a named set of services deployed together."""
+
+    name: str
+    namespace: str = "default"
+    services: dict[str, ComponentSpec] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    kind = "DynamoGraphDeployment"
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("graph deployment needs metadata.name")
+        if not self.services:
+            raise ValueError(f"graph {self.name!r} has no services")
+        for name, svc in self.services.items():
+            svc.validate(name)
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.kind,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": self.labels,
+            },
+            "spec": {"services": {n: s.to_dict() for n, s in self.services.items()}},
+        }
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "DynamoGraphDeployment":
+        if manifest.get("kind") != cls.kind:
+            raise ValueError(f"expected kind {cls.kind}, got {manifest.get('kind')!r}")
+        meta = manifest.get("metadata", {})
+        spec = manifest.get("spec", {})
+        obj = cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            services={
+                n: ComponentSpec.from_dict(s) for n, s in spec.get("services", {}).items()
+            },
+            labels=dict(meta.get("labels", {})),
+        )
+        obj.validate()
+        return obj
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "DynamoGraphDeployment":
+        return cls.from_manifest(yaml.safe_load(text))
+
+
+@dataclass
+class DynamoComponentDeployment:
+    """Child CR: one service of a graph (reference:
+    dynamocomponentdeployment_controller.go reconciles these into
+    Deployments/Services)."""
+
+    name: str
+    namespace: str
+    graph: str  # owning DynamoGraphDeployment name
+    service_name: str
+    spec: ComponentSpec
+
+    kind = "DynamoComponentDeployment"
+
+    def to_manifest(self) -> dict:
+        return {
+            "apiVersion": API_VERSION,
+            "kind": self.kind,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": {
+                    "dynamo.tpu/graph": self.graph,
+                    "dynamo.tpu/service": self.service_name,
+                    "dynamo.tpu/component-type": self.spec.component_type,
+                },
+                "ownerReferences": [
+                    {
+                        "apiVersion": API_VERSION,
+                        "kind": DynamoGraphDeployment.kind,
+                        "name": self.graph,
+                    }
+                ],
+            },
+            "spec": self.spec.to_dict(),
+        }
+
+    @classmethod
+    def from_manifest(cls, manifest: dict) -> "DynamoComponentDeployment":
+        meta = manifest.get("metadata", {})
+        labels = meta.get("labels", {})
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            graph=labels.get("dynamo.tpu/graph", ""),
+            service_name=labels.get("dynamo.tpu/service", ""),
+            spec=ComponentSpec.from_dict(manifest.get("spec", {})),
+        )

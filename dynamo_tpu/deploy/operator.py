@@ -1,0 +1,280 @@
+"""Reconciling operator: graph CR → component CRs → Kubernetes manifests
+(reference: deploy/cloud/operator/internal/controller/
+dynamographdeployment_controller.go:263 fan-out and
+dynamocomponentdeployment_controller.go:2025 manifest construction, plus the
+graph translation in internal/dynamo/graph.go:556).
+
+The reconcile loop is substrate-agnostic: it computes desired objects and
+applies the diff through a :class:`KubeClient`.  ``FakeKube`` keeps objects
+in memory (tests / dry-run); ``KubectlClient`` shells out to ``kubectl``
+when a real cluster is reachable.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from abc import ABC, abstractmethod
+
+from dynamo_tpu.deploy.crds import (
+    API_VERSION,
+    ComponentSpec,
+    DynamoComponentDeployment,
+    DynamoGraphDeployment,
+)
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("deploy.operator")
+
+MANAGED_BY = "dynamo-tpu-operator"
+
+
+def _obj_key(manifest: dict) -> tuple[str, str, str]:
+    meta = manifest.get("metadata", {})
+    return (manifest.get("kind", ""), meta.get("namespace", "default"), meta.get("name", ""))
+
+
+class KubeClient(ABC):
+    """Minimal apply/list/delete surface the reconciler needs."""
+
+    @abstractmethod
+    async def apply(self, manifest: dict) -> None: ...
+
+    @abstractmethod
+    async def list(self, kind: str, namespace: str, labels: dict[str, str]) -> list[dict]: ...
+
+    @abstractmethod
+    async def delete(self, kind: str, namespace: str, name: str) -> None: ...
+
+
+class FakeKube(KubeClient):
+    """In-memory object store (the envtest analog for our reconciler tests)."""
+
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str, str], dict] = {}
+        self.applies = 0
+        self.deletes = 0
+
+    async def apply(self, manifest: dict) -> None:
+        self.objects[_obj_key(manifest)] = json.loads(json.dumps(manifest))
+        self.applies += 1
+
+    async def list(self, kind: str, namespace: str, labels: dict[str, str]) -> list[dict]:
+        out = []
+        for (k, ns, _), obj in self.objects.items():
+            if k != kind or ns != namespace:
+                continue
+            obj_labels = obj.get("metadata", {}).get("labels", {})
+            if all(obj_labels.get(lk) == lv for lk, lv in labels.items()):
+                out.append(obj)
+        return out
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        self.objects.pop((kind, namespace, name), None)
+        self.deletes += 1
+
+
+class KubectlClient(KubeClient):
+    """Shells out to kubectl; used only when a cluster is configured."""
+
+    async def _run(self, *args: str, stdin: bytes | None = None) -> bytes:
+        proc = await asyncio.create_subprocess_exec(
+            "kubectl", *args,
+            stdin=asyncio.subprocess.PIPE if stdin is not None else None,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        out, err = await proc.communicate(stdin)
+        if proc.returncode != 0:
+            raise RuntimeError(f"kubectl {' '.join(args)} failed: {err.decode()}")
+        return out
+
+    async def apply(self, manifest: dict) -> None:
+        await self._run("apply", "-f", "-", stdin=json.dumps(manifest).encode())
+
+    async def list(self, kind: str, namespace: str, labels: dict[str, str]) -> list[dict]:
+        selector = ",".join(f"{k}={v}" for k, v in labels.items())
+        out = await self._run(
+            "get", kind, "-n", namespace, "-l", selector, "-o", "json"
+        )
+        return json.loads(out).get("items", [])
+
+    async def delete(self, kind: str, namespace: str, name: str) -> None:
+        await self._run("delete", kind, name, "-n", namespace, "--ignore-not-found")
+
+
+# ---------------------------------------------------------------- rendering
+
+
+def _component_labels(cd: DynamoComponentDeployment) -> dict[str, str]:
+    return {
+        "app.kubernetes.io/managed-by": MANAGED_BY,
+        "dynamo.tpu/graph": cd.graph,
+        "dynamo.tpu/service": cd.service_name,
+        "dynamo.tpu/component-type": cd.spec.component_type,
+    }
+
+
+def render_component_manifests(cd: DynamoComponentDeployment) -> list[dict]:
+    """One component CR → Deployment (+ Service when a port is exposed,
+    + ConfigMap when it carries config).  The reference emits the same trio
+    per component (dynamocomponentdeployment_controller.go)."""
+    spec: ComponentSpec = cd.spec
+    labels = _component_labels(cd)
+    manifests: list[dict] = []
+
+    env = [{"name": k, "value": v} for k, v in sorted(spec.envs.items())]
+    volume_mounts = []
+    volumes = []
+    if spec.config:
+        cm_name = f"{cd.name}-config"
+        manifests.append(
+            {
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {"name": cm_name, "namespace": cd.namespace, "labels": labels},
+                "data": {"service.yaml": json.dumps(spec.config, indent=2, sort_keys=True)},
+            }
+        )
+        volumes.append({"name": "service-config", "configMap": {"name": cm_name}})
+        volume_mounts.append({"name": "service-config", "mountPath": "/etc/dynamo"})
+        env.append({"name": "DYN_SERVICE_CONFIG", "value": "/etc/dynamo/service.yaml"})
+
+    resources: dict = {
+        "requests": {"cpu": spec.resources.cpu, "memory": spec.resources.memory},
+        "limits": {"memory": spec.resources.memory},
+    }
+    node_selector: dict[str, str] = {}
+    if spec.resources.tpu > 0:
+        # TPU chips are scheduled via the google.com/tpu extended resource +
+        # accelerator/topology node selectors (GKE convention)
+        resources["requests"]["google.com/tpu"] = str(spec.resources.tpu)
+        resources["limits"]["google.com/tpu"] = str(spec.resources.tpu)
+        if spec.resources.tpu_topology:
+            node_selector["cloud.google.com/gke-tpu-topology"] = spec.resources.tpu_topology
+
+    container = {
+        "name": cd.service_name,
+        "image": spec.image,
+        "env": env,
+        "resources": resources,
+    }
+    if spec.command:
+        container["command"] = list(spec.command)
+    if spec.args:
+        container["args"] = list(spec.args)
+    if volume_mounts:
+        container["volumeMounts"] = volume_mounts
+    if spec.port:
+        container["ports"] = [{"containerPort": spec.port}]
+        container["readinessProbe"] = {
+            "httpGet": {"path": "/health", "port": spec.port},
+            "periodSeconds": 5,
+        }
+
+    pod_spec: dict = {"containers": [container]}
+    if volumes:
+        pod_spec["volumes"] = volumes
+    if node_selector:
+        pod_spec["nodeSelector"] = node_selector
+
+    manifests.append(
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": cd.name, "namespace": cd.namespace, "labels": labels},
+            "spec": {
+                "replicas": spec.replicas,
+                "selector": {"matchLabels": {"dynamo.tpu/service": cd.service_name,
+                                             "dynamo.tpu/graph": cd.graph}},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+    )
+
+    if spec.port:
+        manifests.append(
+            {
+                "apiVersion": "v1",
+                "kind": "Service",
+                "metadata": {"name": cd.name, "namespace": cd.namespace, "labels": labels},
+                "spec": {
+                    "selector": {"dynamo.tpu/service": cd.service_name,
+                                 "dynamo.tpu/graph": cd.graph},
+                    "ports": [{"port": spec.port, "targetPort": spec.port}],
+                },
+            }
+        )
+    return manifests
+
+
+# ---------------------------------------------------------------- reconciler
+
+
+class GraphReconciler:
+    """Level-triggered reconcile of graph CRs: fan out component CRs, render
+    their manifests, apply, and prune children whose service disappeared."""
+
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+
+    @staticmethod
+    def component_name(graph: DynamoGraphDeployment, service_name: str) -> str:
+        return f"{graph.name}-{service_name}"
+
+    def fan_out(self, graph: DynamoGraphDeployment) -> list[DynamoComponentDeployment]:
+        graph.validate()
+        return [
+            DynamoComponentDeployment(
+                name=self.component_name(graph, svc_name),
+                namespace=graph.namespace,
+                graph=graph.name,
+                service_name=svc_name,
+                spec=spec,
+            )
+            for svc_name, spec in graph.services.items()
+        ]
+
+    async def reconcile(self, graph: DynamoGraphDeployment) -> dict:
+        """Returns a status summary {applied: n, pruned: n, components: [...]}."""
+        children = self.fan_out(graph)
+        desired_names = set()
+        applied = 0
+        for child in children:
+            desired_names.add(child.name)
+            await self.kube.apply(child.to_manifest())
+            for manifest in render_component_manifests(child):
+                await self.kube.apply(manifest)
+                applied += 1
+
+        pruned = 0
+        graph_selector = {"dynamo.tpu/graph": graph.name}
+        for kind in (DynamoComponentDeployment.kind, "Deployment", "Service", "ConfigMap"):
+            for obj in await self.kube.list(kind, graph.namespace, graph_selector):
+                name = obj["metadata"]["name"]
+                base = name[: -len("-config")] if name.endswith("-config") else name
+                if base not in desired_names:
+                    await self.kube.delete(kind, graph.namespace, name)
+                    pruned += 1
+
+        status = {
+            "applied": applied,
+            "pruned": pruned,
+            "components": sorted(desired_names),
+        }
+        logger.info("reconciled graph %s: %s", graph.name, status)
+        return status
+
+    async def teardown(self, graph: DynamoGraphDeployment) -> int:
+        """Delete everything owned by the graph (graph CR deletion path,
+        incl. the reference's etcd cleanup analog)."""
+        removed = 0
+        selector = {"dynamo.tpu/graph": graph.name}
+        for kind in (DynamoComponentDeployment.kind, "Deployment", "Service", "ConfigMap"):
+            for obj in await self.kube.list(kind, graph.namespace, selector):
+                await self.kube.delete(kind, graph.namespace, obj["metadata"]["name"])
+                removed += 1
+        return removed

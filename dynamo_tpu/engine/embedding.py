@@ -1,13 +1,15 @@
 """Embedding engine: /v1/embeddings backend.
 
-Runs the model trunk (no LM head) over the input, masked-mean-pools the
-final hidden states, L2-normalizes.  Served through the same HTTP frontend
-(reference: embeddings route lib/llm/src/http/service/openai.rs:572-577).
+Runs the model trunk (no LM head; models.llama.llama_forward_trunk) over the
+input, masked-mean-pools the final hidden states, L2-normalizes.  Served
+through the same HTTP frontend (reference: embeddings route
+lib/llm/src/http/service/openai.rs:572-577).
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
 from dataclasses import dataclass
 
 import jax
@@ -21,36 +23,12 @@ from dynamo_tpu.llm.protocols.openai import (
     Usage,
 )
 from dynamo_tpu.llm.tokenizer import HfTokenizer
-from dynamo_tpu.models.llama import LlamaConfig, init_params, make_rope_tables
-from dynamo_tpu.ops.attention import dense_causal_attention
-from dynamo_tpu.ops.norms import rms_norm
-from dynamo_tpu.ops.rope import apply_rope
-
-
-def llama_encode(params: dict, cfg: LlamaConfig, token_ids, seq_len, cos, sin):
-    """Final hidden states [seq_pad, hidden] of the llama trunk."""
-    s = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)
-    positions = jnp.arange(s, dtype=jnp.int32)
-
-    def layer(x, w):
-        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q_proj = attn_in @ w["wq"]
-        k_proj = attn_in @ w["wk"]
-        v_proj = attn_in @ w["wv"]
-        if cfg.attention_bias:
-            q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
-        q = apply_rope(q_proj.reshape(s, cfg.num_heads, cfg.head_dim), positions, cos, sin)
-        k = apply_rope(k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim), positions, cos, sin)
-        v = v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
-        attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
-        x = x + attn.reshape(s, -1) @ w["wo"]
-        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + jax.nn.silu(mlp_in @ w["w_gate"]) * (mlp_in @ w["w_up"]) @ w["w_down"]
-        return x, None
-
-    x, _ = jax.lax.scan(layer, x, params["layers"])
-    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    llama_forward_trunk,
+    make_rope_tables,
+)
 
 
 @dataclass
@@ -69,26 +47,35 @@ class JaxEmbeddingEngine:
         self.cos, self.sin = make_rope_tables(cfg)
 
         def embed_fn(params, token_ids, seq_len):
-            hidden = llama_encode(params, cfg, token_ids, seq_len, self.cos, self.sin)
+            hidden = llama_forward_trunk(params, cfg, token_ids, seq_len, self.cos, self.sin)
             mask = (jnp.arange(hidden.shape[0]) < seq_len)[:, None]
             pooled = jnp.sum(hidden * mask, axis=0) / jnp.maximum(seq_len, 1)
             return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
 
         self._embed = jax.jit(embed_fn)
 
+    def _token_lists(self, request: EmbeddingRequest) -> list[list[int]]:
+        """Normalize the four accepted input shapes to token-id lists."""
+        inp = request.input
+        if isinstance(inp, str):
+            return [self.tokenizer.encode(inp)]
+        if not inp:
+            return []
+        if isinstance(inp[0], int):
+            return [list(inp)]  # a single pre-tokenized sequence
+        if isinstance(inp[0], list):
+            return [list(ids) for ids in inp]  # batch of pre-tokenized sequences
+        return [self.tokenizer.encode(text) for text in inp]
+
     async def embed(self, request: EmbeddingRequest) -> EmbeddingResponse:
-        texts: list[str]
-        if isinstance(request.input, str):
-            texts = [request.input]
-        elif request.input and isinstance(request.input[0], int):
-            texts = [self.tokenizer.decode(list(request.input))]
-        else:
-            texts = list(request.input)  # type: ignore[arg-type]
+        if request.encoding_format not in (None, "float", "base64"):
+            raise ValueError(f"unsupported encoding_format {request.encoding_format!r}")
+        token_lists = self._token_lists(request)
 
         data = []
         total_tokens = 0
-        for i, text in enumerate(texts):
-            ids = self.tokenizer.encode(text)[: self.config.max_length]
+        for i, ids in enumerate(token_lists):
+            ids = ids[: self.config.max_length]
             total_tokens += len(ids)
             padded = np.zeros((self.config.max_length,), np.int32)
             padded[: len(ids)] = ids
@@ -97,8 +84,15 @@ class JaxEmbeddingEngine:
                     self._embed(self.params, jnp.asarray(p), jnp.int32(n))
                 )
             )
-            data.append(EmbeddingData(index=i, embedding=[float(x) for x in vec]))
+            if request.encoding_format == "base64":
+                embedding: list[float] | str = base64.b64encode(
+                    vec.astype(np.float32).tobytes()
+                ).decode("ascii")
+            else:
+                embedding = [float(x) for x in vec]
+            data.append(EmbeddingData(index=i, embedding=embedding))
         return EmbeddingResponse(
+            model=request.model,
             data=data,
             usage=Usage(prompt_tokens=total_tokens, total_tokens=total_tokens),
         )

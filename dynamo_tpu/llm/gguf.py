@@ -1,0 +1,329 @@
+"""GGUF model-file support (reference: lib/llm/src/gguf/* — metadata/config
+parsing `ContentConfig`/`ModelConfigLike` and vocab extraction
+lib/llm/src/gguf/gguf_tokenizer.rs:587).
+
+Pure-python binary parser for GGUF v2/v3 plus:
+- :func:`config_from_gguf` — llama.* metadata → :class:`LlamaConfig`;
+- :func:`tokenizer_from_gguf` — ``tokenizer.ggml.*`` vocab/merges → a HF
+  ``tokenizers`` BPE tokenizer (gpt2-style byte-level);
+- :func:`load_gguf_weights` — F32/F16 tensors → the layer-stacked llama
+  param pytree (quantized GGML types are recognized but not dequantized);
+- :func:`write_gguf` — writer used by tests and for exporting small models.
+
+GGML stores dims fastest-varying-first; numpy shapes here are the reverse.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, BinaryIO
+
+import numpy as np
+
+GGUF_MAGIC = b"GGUF"
+DEFAULT_ALIGNMENT = 32
+
+# metadata value types
+T_UINT8, T_INT8, T_UINT16, T_INT16, T_UINT32, T_INT32 = range(6)
+T_FLOAT32, T_BOOL, T_STRING, T_ARRAY, T_UINT64, T_INT64, T_FLOAT64 = range(6, 13)
+
+_SCALAR_FMT = {
+    T_UINT8: "<B", T_INT8: "<b", T_UINT16: "<H", T_INT16: "<h",
+    T_UINT32: "<I", T_INT32: "<i", T_FLOAT32: "<f",
+    T_UINT64: "<Q", T_INT64: "<q", T_FLOAT64: "<d",
+}
+
+# GGML tensor dtypes (subset; quantized types listed for recognition only)
+GGML_F32, GGML_F16 = 0, 1
+GGML_Q4_0, GGML_Q4_1, GGML_Q8_0 = 2, 3, 8
+GGML_BF16 = 30
+_GGML_NUMPY = {GGML_F32: np.float32, GGML_F16: np.float16}
+GGML_TYPE_NAMES = {
+    GGML_F32: "F32", GGML_F16: "F16", GGML_Q4_0: "Q4_0", GGML_Q4_1: "Q4_1",
+    GGML_Q8_0: "Q8_0", GGML_BF16: "BF16",
+}
+
+
+@dataclass
+class GGUFTensorInfo:
+    name: str
+    shape: tuple[int, ...]       # numpy order (reversed from on-disk ggml dims)
+    ggml_type: int
+    offset: int                  # relative to data section start
+
+    @property
+    def type_name(self) -> str:
+        return GGML_TYPE_NAMES.get(self.ggml_type, f"ggml#{self.ggml_type}")
+
+
+def _read_str(f: BinaryIO) -> str:
+    (n,) = struct.unpack("<Q", f.read(8))
+    return f.read(n).decode("utf-8")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype in _SCALAR_FMT:
+        fmt = _SCALAR_FMT[vtype]
+        return struct.unpack(fmt, f.read(struct.calcsize(fmt)))[0]
+    if vtype == T_BOOL:
+        return f.read(1) != b"\x00"
+    if vtype == T_STRING:
+        return _read_str(f)
+    if vtype == T_ARRAY:
+        (item_type,) = struct.unpack("<I", f.read(4))
+        (count,) = struct.unpack("<Q", f.read(8))
+        return [_read_value(f, item_type) for _ in range(count)]
+    raise ValueError(f"unknown GGUF metadata value type {vtype}")
+
+
+class GGUFFile:
+    """Parsed GGUF container: ``metadata`` dict + tensor directory with lazy
+    data access (memmap)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.metadata: dict[str, Any] = {}
+        self.tensors: dict[str, GGUFTensorInfo] = {}
+        with open(self.path, "rb") as f:
+            if f.read(4) != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file")
+            (self.version,) = struct.unpack("<I", f.read(4))
+            if self.version not in (2, 3):
+                raise ValueError(f"{path}: unsupported GGUF version {self.version}")
+            n_tensors, n_kv = struct.unpack("<QQ", f.read(16))
+            for _ in range(n_kv):
+                key = _read_str(f)
+                (vtype,) = struct.unpack("<I", f.read(4))
+                self.metadata[key] = _read_value(f, vtype)
+            for _ in range(n_tensors):
+                name = _read_str(f)
+                (n_dims,) = struct.unpack("<I", f.read(4))
+                dims = struct.unpack(f"<{n_dims}Q", f.read(8 * n_dims))
+                ggml_type, offset = struct.unpack("<IQ", f.read(12))
+                self.tensors[name] = GGUFTensorInfo(
+                    name=name, shape=tuple(reversed(dims)), ggml_type=ggml_type,
+                    offset=offset,
+                )
+            alignment = int(self.metadata.get("general.alignment", DEFAULT_ALIGNMENT))
+            pos = f.tell()
+            self.data_start = (pos + alignment - 1) // alignment * alignment
+
+    def tensor_data(self, name: str) -> np.ndarray:
+        """Load one tensor (F32/F16/BF16 only)."""
+        info = self.tensors[name]
+        if info.ggml_type == GGML_BF16:
+            raw = np.memmap(self.path, np.uint16, "r", self.data_start + info.offset,
+                            int(np.prod(info.shape)))
+            return (raw.astype(np.uint32) << 16).view(np.float32).reshape(info.shape)
+        dtype = _GGML_NUMPY.get(info.ggml_type)
+        if dtype is None:
+            raise NotImplementedError(
+                f"tensor {name!r} has quantized type {info.type_name}; "
+                "dequantization is not supported — export F16/F32"
+            )
+        return np.array(
+            np.memmap(self.path, dtype, "r", self.data_start + info.offset,
+                      int(np.prod(info.shape))).reshape(info.shape)
+        )
+
+
+# ------------------------------------------------------------------ writer
+
+
+def _write_str(f: BinaryIO, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack("<Q", len(b)))
+    f.write(b)
+
+
+def _value_type(v: Any) -> int:
+    if isinstance(v, bool):
+        return T_BOOL
+    if isinstance(v, int):
+        return T_UINT32 if 0 <= v < 2**32 else T_INT64
+    if isinstance(v, float):
+        return T_FLOAT32
+    if isinstance(v, str):
+        return T_STRING
+    if isinstance(v, (list, tuple)):
+        return T_ARRAY
+    raise TypeError(f"cannot encode {type(v)} in GGUF metadata")
+
+
+def _write_value(f: BinaryIO, v: Any, vtype: int | None = None) -> None:
+    vtype = _value_type(v) if vtype is None else vtype
+    if vtype in _SCALAR_FMT:
+        f.write(struct.pack(_SCALAR_FMT[vtype], v))
+    elif vtype == T_BOOL:
+        f.write(b"\x01" if v else b"\x00")
+    elif vtype == T_STRING:
+        _write_str(f, v)
+    elif vtype == T_ARRAY:
+        item_type = _value_type(v[0]) if v else T_UINT32
+        f.write(struct.pack("<I", item_type))
+        f.write(struct.pack("<Q", len(v)))
+        for item in v:
+            _write_value(f, item, item_type)
+
+
+def write_gguf(
+    path: str | Path, metadata: dict[str, Any], tensors: dict[str, np.ndarray]
+) -> None:
+    """Write a GGUF v3 file with F32/F16 tensors (numpy-order shapes)."""
+    with open(path, "wb") as f:
+        f.write(GGUF_MAGIC)
+        f.write(struct.pack("<I", 3))
+        f.write(struct.pack("<QQ", len(tensors), len(metadata)))
+        for key, value in metadata.items():
+            _write_str(f, key)
+            vtype = _value_type(value)
+            f.write(struct.pack("<I", vtype))
+            _write_value(f, value, vtype)
+
+        offset = 0
+        arrays: list[np.ndarray] = []
+        for name, arr in tensors.items():
+            arr = np.ascontiguousarray(arr)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            ggml_type = {np.dtype(np.float32): GGML_F32, np.dtype(np.float16): GGML_F16}[arr.dtype]
+            _write_str(f, name)
+            f.write(struct.pack("<I", arr.ndim))
+            f.write(struct.pack(f"<{arr.ndim}Q", *reversed(arr.shape)))
+            f.write(struct.pack("<IQ", ggml_type, offset))
+            arrays.append(arr)
+            size = arr.nbytes
+            offset += (size + DEFAULT_ALIGNMENT - 1) // DEFAULT_ALIGNMENT * DEFAULT_ALIGNMENT
+
+        pos = f.tell()
+        f.write(b"\x00" * ((pos + DEFAULT_ALIGNMENT - 1) // DEFAULT_ALIGNMENT * DEFAULT_ALIGNMENT - pos))
+        for arr in arrays:
+            data = arr.tobytes()
+            f.write(data)
+            pad = (len(data) + DEFAULT_ALIGNMENT - 1) // DEFAULT_ALIGNMENT * DEFAULT_ALIGNMENT - len(data)
+            f.write(b"\x00" * pad)
+
+
+# ---------------------------------------------------------- config/tokenizer
+
+
+def config_from_gguf(gguf: "GGUFFile"):
+    """``llama.*`` metadata → LlamaConfig (reference: ContentConfig /
+    ModelConfigLike extraction)."""
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    meta = gguf.metadata
+    arch = meta.get("general.architecture", "llama")
+    if arch not in ("llama", "qwen2"):
+        raise ValueError(f"unsupported GGUF architecture {arch!r}")
+
+    def key(suffix: str, default=None):
+        return meta.get(f"{arch}.{suffix}", default)
+
+    hidden = int(key("embedding_length"))
+    heads = int(key("attention.head_count"))
+    vocab = int(key("vocab_size", 0)) or len(meta.get("tokenizer.ggml.tokens", []))
+    has_lm_head = "output.weight" in gguf.tensors
+    return LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=int(key("feed_forward_length")),
+        num_layers=int(key("block_count")),
+        num_heads=heads,
+        num_kv_heads=int(key("attention.head_count_kv", heads)),
+        head_dim=int(key("attention.key_length", hidden // heads)),
+        max_position_embeddings=int(key("context_length", 4096)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        rms_norm_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        tie_word_embeddings=not has_lm_head,
+        attention_bias=f"blk.0.attn_q.bias" in gguf.tensors,
+    )
+
+
+def tokenizer_from_gguf(gguf: "GGUFFile"):
+    """Build a HF ``tokenizers`` tokenizer from ``tokenizer.ggml.*`` vocab
+    (gpt2-style byte-level BPE; the common GGUF export format)."""
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE
+
+    meta = gguf.metadata
+    model_kind = meta.get("tokenizer.ggml.model", "gpt2")
+    if model_kind != "gpt2":
+        raise NotImplementedError(
+            f"GGUF tokenizer model {model_kind!r} not supported (gpt2 BPE only)"
+        )
+    tokens: list[str] = meta["tokenizer.ggml.tokens"]
+    merges_raw: list[str] = meta.get("tokenizer.ggml.merges", [])
+    vocab = {tok: i for i, tok in enumerate(tokens)}
+    merges = [tuple(m.split(" ", 1)) for m in merges_raw]
+    tok = Tokenizer(BPE(vocab, merges, fuse_unk=False))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    return tok
+
+
+def mdc_from_gguf(path: str | Path, name: str | None = None):
+    """GGUF file → ModelDeploymentCard (context length, eos, chat template)."""
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+
+    gguf = GGUFFile(path)
+    meta = gguf.metadata
+    arch = meta.get("general.architecture", "llama")
+    eos = meta.get("tokenizer.ggml.eos_token_id")
+    return ModelDeploymentCard(
+        name=name or meta.get("general.name", Path(path).stem),
+        path=str(path),
+        context_length=int(meta.get(f"{arch}.context_length", 4096)),
+        eos_token_ids=[int(eos)] if eos is not None else [],
+        chat_template=meta.get("tokenizer.chat_template"),
+        model_type=arch,
+    ).finalize()
+
+
+# ------------------------------------------------------------------ weights
+
+# llama.cpp tensor names → our layer-stacked pytree.  GGML stores
+# projections as numpy [out, in] after dim reversal → transpose like HF.
+_GGUF_LAYER_MAP = {
+    "attn_norm": "blk.{i}.attn_norm.weight",
+    "wq": "blk.{i}.attn_q.weight",
+    "wk": "blk.{i}.attn_k.weight",
+    "wv": "blk.{i}.attn_v.weight",
+    "wo": "blk.{i}.attn_output.weight",
+    "mlp_norm": "blk.{i}.ffn_norm.weight",
+    "w_gate": "blk.{i}.ffn_gate.weight",
+    "w_up": "blk.{i}.ffn_up.weight",
+    "w_down": "blk.{i}.ffn_down.weight",
+}
+
+
+def load_gguf_weights(cfg, gguf: "GGUFFile") -> dict:
+    """F32/F16 GGUF tensors → llama param pytree (same layout as
+    models.llama.load_hf_weights)."""
+    import jax.numpy as jnp
+
+    def get(name: str, transpose: bool = False):
+        t = gguf.tensor_data(name)
+        if transpose:
+            t = t.T
+        return jnp.asarray(t, cfg.dtype)
+
+    layer_map = dict(_GGUF_LAYER_MAP)
+    if cfg.attention_bias:
+        layer_map.update(
+            bq="blk.{i}.attn_q.bias", bk="blk.{i}.attn_k.bias", bv="blk.{i}.attn_v.bias"
+        )
+    layers: dict[str, list] = {k: [] for k in layer_map}
+    for i in range(cfg.num_layers):
+        for ours, theirs in layer_map.items():
+            layers[ours].append(get(theirs.format(i=i), transpose=ours.startswith("w")))
+    params = {
+        "embed": get("token_embd.weight"),
+        "final_norm": get("output_norm.weight"),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }
+    if not cfg.tie_word_embeddings and "output.weight" in gguf.tensors:
+        params["lm_head"] = get("output.weight", transpose=True)
+    return params

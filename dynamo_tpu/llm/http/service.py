@@ -138,6 +138,9 @@ class HttpService:
             return _error(404, f"model '{chat_request.model}' not found", "model_not_found")
 
         guard = self.metrics.guard(chat_request.model, "chat_completions", "stream" if chat_request.stream else "unary")
+        if not chat_request.stream:
+            # non-streaming responses always carry usage (OpenAI semantics)
+            chat_request.stream_options = {**(chat_request.stream_options or {}), "include_usage": True}
         try:
             ctx = Context(chat_request)
             try:
@@ -175,6 +178,8 @@ class HttpService:
         guard = self.metrics.guard(
             completion_request.model, "completions", "stream" if completion_request.stream else "unary"
         )
+        if not completion_request.stream:
+            completion_request.stream_options = {**(completion_request.stream_options or {}), "include_usage": True}
         try:
             ctx = Context(completion_request)
             try:
@@ -208,7 +213,10 @@ class HttpService:
             return _error(404, f"model '{embedding_request.model}' not found", "model_not_found")
         guard = self.metrics.guard(embedding_request.model, "embeddings", "unary")
         try:
-            response = await engine.embed(embedding_request)
+            try:
+                response = await engine.embed(embedding_request)
+            except ValueError as exc:
+                return _error(400, str(exc))
             guard.mark_ok()
             return web.json_response(response.model_dump(exclude_none=True))
         except Exception as exc:  # noqa: BLE001

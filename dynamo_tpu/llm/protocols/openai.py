@@ -225,7 +225,8 @@ class CompletionResponse(BaseModel):
 class EmbeddingData(BaseModel):
     object: Literal["embedding"] = "embedding"
     index: int
-    embedding: list[float]
+    # list of floats, or a base64-packed float32 buffer (encoding_format=base64)
+    embedding: list[float] | str
 
 
 class EmbeddingResponse(BaseModel):

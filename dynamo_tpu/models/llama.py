@@ -192,6 +192,50 @@ def _mlp(x, gate, up, down):
     return jax.nn.silu(x @ gate) * (x @ up) @ down
 
 
+def _qkv(attn_in, w, cfg: LlamaConfig):
+    """Project+bias+head-split; shared by prefill/decode/trunk."""
+    s = attn_in.shape[0]
+    q_proj = attn_in @ w["wq"]
+    k_proj = attn_in @ w["wk"]
+    v_proj = attn_in @ w["wv"]
+    if cfg.attention_bias:
+        q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
+    return (
+        q_proj.reshape(s, cfg.num_heads, cfg.head_dim),
+        k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim),
+        v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim),
+    )
+
+
+def llama_forward_trunk(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,  # [seq_pad] int32
+    seq_len: jnp.ndarray,    # scalar int32
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> jnp.ndarray:
+    """Trunk-only forward (no KV cache, no LM head): final hidden states
+    [seq_pad, hidden].  Used by the embedding engine."""
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.arange(s, dtype=jnp.int32)
+
+    def layer(x, w):
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+        x = x + attn.reshape(s, -1) @ w["wo"]
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+
+
 def _logits(params, cfg, x):
     if cfg.tie_word_embeddings:
         return x @ params["embed"].T.astype(x.dtype)
@@ -210,21 +254,34 @@ def llama_forward_prefill(
     sin: jnp.ndarray,
 ) -> tuple[jnp.ndarray, dict]:
     """Single-sequence prefill.  Returns (last-token logits [vocab], new cache)."""
-    s = token_ids.shape[0]
     x = params["embed"][token_ids].astype(cfg.dtype)  # [s, h]
+    return llama_forward_prefill_embeds(
+        params, cfg, x, kv_cache, block_ids, seq_len, start_pos, cos, sin
+    )
+
+
+def llama_forward_prefill_embeds(
+    params: dict,
+    cfg: LlamaConfig,
+    input_embeds: jnp.ndarray,  # [seq_pad, hidden] — e.g. image patches + text
+    kv_cache: dict,
+    block_ids: jnp.ndarray,
+    seq_len: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Prefill from pre-computed input embeddings (multimodal prompts:
+    vision-encoder patch embeddings concatenated with text token
+    embeddings, LLaVA-style)."""
+    s = input_embeds.shape[0]
+    x = input_embeds.astype(cfg.dtype)
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
         attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q_proj = attn_in @ w["wq"]
-        k_proj = attn_in @ w["wk"]
-        v_proj = attn_in @ w["wv"]
-        if cfg.attention_bias:
-            q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
-        q = q_proj.reshape(s, cfg.num_heads, cfg.head_dim)
-        k = k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
-        v = v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(attn_in, w, cfg)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
@@ -279,14 +336,7 @@ def llama_forward_decode(
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
         attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q_proj = attn_in @ w["wq"]
-        k_proj = attn_in @ w["wk"]
-        v_proj = attn_in @ w["wv"]
-        if cfg.attention_bias:
-            q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
-        q = q_proj.reshape(b, cfg.num_heads, cfg.head_dim)
-        k = k_proj.reshape(b, cfg.num_kv_heads, cfg.head_dim)
-        v = v_proj.reshape(b, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(attn_in, w, cfg)
         # apply_rope expects a seq axis: insert and drop it
         q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]

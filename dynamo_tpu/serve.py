@@ -124,9 +124,13 @@ async def serve_frontend(
     host: str = "0.0.0.0",
     port: int = 8080,
     router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    request_template: str | Path | None = None,
 ) -> tuple[HttpService, ModelWatcher]:
+    from dynamo_tpu.llm.request_template import RequestTemplate
+
+    template = RequestTemplate.load(request_template) if request_template else None
     manager = ModelManager()
-    service = HttpService(manager, host=host, port=port)
+    service = HttpService(manager, host=host, port=port, request_template=template)
     watcher = ModelWatcher(runtime, manager, router_mode=router_mode)
     await watcher.start()
     await service.start()

@@ -1,0 +1,67 @@
+"""Serve OpenAI ``/v1/embeddings`` with the JAX embedding engine
+(reference: the embedding model type in llmctl,
+launch/llmctl/src/main.rs:114-180, and /v1/embeddings
+lib/llm/src/http/service/openai.rs:572-577).
+
+    python -m examples.embeddings.serve_embeddings --model tests/data/tiny-chat-model --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+from pathlib import Path
+
+from dynamo_tpu.engine.embedding import EmbeddingEngineConfig, JaxEmbeddingEngine
+from dynamo_tpu.llm.http import HttpService, ModelManager
+from dynamo_tpu.llm.tokenizer import HfTokenizer
+from dynamo_tpu.models.registry import get_family
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger("examples.embeddings")
+
+
+async def amain(model_dir: str, model_name: str, port: int, max_length: int) -> int:
+    model_dir = Path(model_dir)
+    hf_config = json.loads((model_dir / "config.json").read_text())
+    family = get_family(hf_config.get("model_type", "llama"))
+    cfg = family.config_from_hf(hf_config)
+    tokenizer = HfTokenizer.from_file(model_dir / "tokenizer.json")
+
+    params = None
+    try:
+        from dynamo_tpu.models.llama import load_hf_weights
+
+        params = load_hf_weights(cfg, model_dir)
+    except FileNotFoundError:
+        logger.warning("no safetensors in %s — random-initializing", model_dir)
+
+    engine = JaxEmbeddingEngine(
+        EmbeddingEngineConfig(model=cfg, max_length=max_length), tokenizer, params=params
+    )
+    manager = ModelManager()
+    manager.add_embedding_model(model_name, engine)
+    service = HttpService(manager, host="127.0.0.1", port=port)
+    await service.start()
+    logger.info("embeddings: http://127.0.0.1:%d/v1/embeddings (model=%s)", service.port, model_name)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await service.stop()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--model-name", default="embed-model")
+    parser.add_argument("--port", type=int, default=8080)
+    parser.add_argument("--max-length", type=int, default=512)
+    args = parser.parse_args()
+    configure_logging()
+    return asyncio.run(amain(args.model, args.model_name, args.port, args.max_length))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,187 @@
+"""Shared launch helpers for the LLM example graphs (reference:
+examples/llm/components/{worker,prefill_worker,processor}.py).
+
+Each graph is a composition of:
+- a frontend (OpenAI HTTP + model watcher) with a router mode,
+- N workers (echo / mocker / JAX engine), and — for the disagg graphs —
+- a decode worker wrapping :class:`DisaggDecodeEngine` plus M prefill
+  workers pumping the shared prefill queue.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    DisaggRouter,
+    PrefillQueue,
+    PrefillWorker,
+)
+from dynamo_tpu.llm.discovery import register_llm
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.client import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.serve import build_jax_engine, serve_frontend, serve_worker
+from dynamo_tpu.utils.config import load_config
+
+
+@dataclass
+class LlmGraphConfig:
+    """Per-graph config; layered defaults < YAML file < DYN_EXAMPLE_* env."""
+
+    model_dir: str = ""
+    model_name: str = "example-model"
+    engine_kind: str = "jax"  # jax | mocker | echo
+    num_workers: int = 1
+    num_prefill_workers: int = 1
+    http_host: str = "127.0.0.1"
+    http_port: int = 8080
+    # engine sizing
+    num_blocks: int = 256
+    max_batch_size: int = 8
+    max_model_len: int = 1024
+    # disagg decision threshold (reference: lib/llm/src/disagg_router.rs:25-34)
+    max_local_prefill_length: int = 64
+    max_prefill_queue_size: int = 8
+    engine_overrides: dict = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, config_file: str | Path | None = None, **overrides) -> "LlmGraphConfig":
+        return load_config(
+            cls, env_prefix="DYN_EXAMPLE", config_file=config_file, overrides=overrides
+        )
+
+
+@dataclass
+class GraphHandle:
+    """Everything a graph launched; reverse-order teardown."""
+
+    frontend: object = None
+    watcher: object = None
+    workers: list = field(default_factory=list)
+    extras: list = field(default_factory=list)  # objects with async stop()
+
+    async def shutdown(self) -> None:
+        if self.watcher is not None:
+            await self.watcher.stop()
+        if self.frontend is not None:
+            await self.frontend.stop()
+        for extra in reversed(self.extras):
+            await extra.stop()
+        for worker in reversed(self.workers):
+            await worker.shutdown()
+
+
+async def launch_workers(
+    rt: DistributedRuntime, cfg: LlmGraphConfig, *, component: str = "backend"
+) -> list:
+    workers = []
+    for _ in range(cfg.num_workers):
+        workers.append(
+            await serve_worker(
+                rt,
+                cfg.model_dir,
+                model_name=cfg.model_name,
+                component=component,
+                engine_kind=cfg.engine_kind,
+                **(
+                    dict(
+                        num_blocks=cfg.num_blocks,
+                        max_batch_size=cfg.max_batch_size,
+                        max_model_len=cfg.max_model_len,
+                        **cfg.engine_overrides,
+                    )
+                    if cfg.engine_kind == "jax"
+                    else {}
+                ),
+            )
+        )
+    return workers
+
+
+async def launch_frontend(
+    rt: DistributedRuntime, cfg: LlmGraphConfig, router_mode: RouterMode
+) -> tuple:
+    return await serve_frontend(
+        rt, host=cfg.http_host, port=cfg.http_port, router_mode=router_mode
+    )
+
+
+@dataclass
+class _DisaggWorkerHandle:
+    service: object
+    engine: DisaggDecodeEngine
+    router: DisaggRouter
+
+    async def shutdown(self) -> None:
+        await self.service.shutdown()
+        await self.engine.stop()
+        await self.router.stop()
+        self.engine.engine.stop()
+
+
+@dataclass
+class _PrefillHandle:
+    pump: PrefillWorker
+    engine: object
+
+    async def stop(self) -> None:
+        await self.pump.stop()
+        self.engine.stop()
+
+
+async def launch_disagg_decode_worker(
+    rt: DistributedRuntime, cfg: LlmGraphConfig, queue: PrefillQueue
+) -> _DisaggWorkerHandle:
+    """Decode worker: JAX engine behind the remote-prefill decision wrapper
+    (reference: examples/llm/components/worker.py:187)."""
+    mdc = ModelDeploymentCard.from_local_path(cfg.model_dir, name=cfg.model_name)
+    engine = build_jax_engine(
+        cfg.model_dir,
+        mdc,
+        num_blocks=cfg.num_blocks,
+        max_batch_size=cfg.max_batch_size,
+        max_model_len=cfg.max_model_len,
+        **cfg.engine_overrides,
+    )
+    disagg_router = DisaggRouter(
+        rt,
+        cfg.model_name,
+        DisaggConfig(
+            max_local_prefill_length=cfg.max_local_prefill_length,
+            max_prefill_queue_size=cfg.max_prefill_queue_size,
+        ),
+    )
+    await disagg_router.start()
+    decode = DisaggDecodeEngine(rt, engine, disagg_router, queue)
+    await decode.start()
+    engine.start()
+    ep = rt.namespace(None).component("backend").endpoint("generate")
+    service = await ep.serve(decode, stats_handler=decode.stats)
+    await register_llm(service, mdc)
+    return _DisaggWorkerHandle(service=service, engine=decode, router=disagg_router)
+
+
+async def launch_prefill_workers(
+    rt: DistributedRuntime, cfg: LlmGraphConfig, queue: PrefillQueue
+) -> list[_PrefillHandle]:
+    """Prefill-side pumps (reference: examples/llm/components/prefill_worker.py:139)."""
+    mdc = ModelDeploymentCard.from_local_path(cfg.model_dir, name=cfg.model_name)
+    handles = []
+    for _ in range(cfg.num_prefill_workers):
+        engine = build_jax_engine(
+            cfg.model_dir,
+            mdc,
+            num_blocks=cfg.num_blocks,
+            max_batch_size=cfg.max_batch_size,
+            max_model_len=cfg.max_model_len,
+            **cfg.engine_overrides,
+        )
+        engine.start()
+        pump = PrefillWorker(rt, engine, queue)
+        pump.start()
+        handles.append(_PrefillHandle(pump=pump, engine=engine))
+    return handles

@@ -1,0 +1,14 @@
+"""Disaggregated prefill/decode + KV-aware routing at the frontend
+(reference: examples/llm/graphs/disagg_router.py:16-24)."""
+
+from __future__ import annotations
+
+from dynamo_tpu.runtime.client import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from examples.llm.common import GraphHandle, LlmGraphConfig
+from examples.llm.graphs import disagg
+
+
+async def launch(rt: DistributedRuntime, cfg: LlmGraphConfig) -> GraphHandle:
+    return await disagg.launch(rt, cfg, router_mode=RouterMode.KV)

@@ -1,0 +1,128 @@
+"""Multimodal serving pipeline: encode → prefill → decode (reference:
+examples/multimodal — encode_worker.py:61 produces image embeddings that the
+LLM worker consumes; there embeddings travel by NIXL RDMA descriptor, here
+they ride the same graph dependency channel as tensors).
+
+Components:
+- ``EncodeWorker``: JAX ViT encode + LLaVA-style projector.
+- ``MultimodalEngine``: wraps a JaxLlmEngine; requests carrying an
+  ``image`` (normalized [H, W, 3] floats) get their patch embeddings
+  spliced before the text tokens via ``generate_multimodal``.
+
+Run in-process:
+    python -m examples.multimodal.pipeline --model tests/data/tiny-chat-model
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import jax
+import numpy as np
+
+from dynamo_tpu.models.vision import VisionConfig, init_vit_params, vit_encode
+from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.utils.logging import configure_logging, get_logger
+
+logger = get_logger("examples.multimodal")
+
+
+class JaxVisionEncoder:
+    """The encode worker's engine: images → projected patch embeddings."""
+
+    def __init__(self, cfg: VisionConfig, params=None, seed: int = 0):
+        self.cfg = cfg
+        self.params = params if params is not None else init_vit_params(
+            cfg, jax.random.PRNGKey(seed)
+        )
+        self._encode = jax.jit(lambda p, imgs: vit_encode(p, cfg, imgs))
+
+    def encode(self, image: np.ndarray) -> np.ndarray:
+        """[H, W, 3] float image → [num_patches, projector_dim] float32."""
+        out = self._encode(self.params, jax.numpy.asarray(image[None], self.cfg.dtype))
+        return np.asarray(out[0], np.float32)
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        image = np.asarray(request.data["image"], np.float32)
+        embeds = await asyncio.to_thread(self.encode, image)
+
+        async def gen():
+            yield {"embeds": embeds.tolist()}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+class MultimodalEngine:
+    """AsyncEngine wrapper: routes image-carrying requests through the
+    encoder, text-only requests straight to the LLM engine."""
+
+    def __init__(self, llm_engine, encoder: JaxVisionEncoder):
+        self.llm = llm_engine
+        self.encoder = encoder
+
+    async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        data = dict(request.data)
+        image = data.pop("image", None)
+        inner = Context(data, request.ctx)
+        if image is None:
+            return await self.llm.generate(inner)
+        embeds = await asyncio.to_thread(self.encoder.encode, np.asarray(image, np.float32))
+        return await self.llm.generate_multimodal(inner, embeds)
+
+    def stats(self) -> dict:
+        return self.llm.stats()
+
+
+async def amain(model_dir: str) -> int:
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.serve import build_jax_engine
+
+    mdc = ModelDeploymentCard.from_local_path(model_dir, name="mm-demo")
+    llm = build_jax_engine(model_dir, mdc, num_blocks=64, max_batch_size=4,
+                           max_model_len=128, prefill_buckets=(32, 64))
+    llm.start()
+    vision_cfg = VisionConfig.tiny()
+    # the projector must land in the LLM hidden space
+    vision_cfg = VisionConfig(
+        **{**vision_cfg.__dict__, "projector_dim": llm.config.model.hidden_size}
+    )
+    engine = MultimodalEngine(llm, JaxVisionEncoder(vision_cfg))
+
+    rng = np.random.default_rng(0)
+    image = rng.random((vision_cfg.image_size, vision_cfg.image_size, 3), np.float32)
+    request = PreprocessedRequest(
+        token_ids=[5, 6, 7],
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=8),
+        eos_token_ids=[],
+    ).to_wire()
+    request["image"] = image.tolist()
+    stream = await engine.generate(Context(request))
+    tokens = []
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is not None:
+            tokens.extend(ann.data.token_ids)
+    print("generated (image-conditioned):", tokens)
+    llm.stop()
+    return 0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--model", default="tests/data/tiny-chat-model")
+    args = parser.parse_args()
+    configure_logging()
+    return asyncio.run(amain(args.model))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,7 +57,21 @@ def pytest_pyfunc_call(pyfuncitem):
             timeout = pyfuncitem.get_closest_marker("slow") and 300 or 60
             loop.run_until_complete(asyncio.wait_for(fn(**kwargs), timeout=timeout))
         finally:
-            loop.close()
+            # drain leaked tasks/async-gens before closing, so pending
+            # queue.get()s don't raise "Event loop is closed" at GC time
+            try:
+                pending = asyncio.all_tasks(loop)
+                for task in pending:
+                    task.cancel()
+                if pending:
+                    loop.run_until_complete(
+                        asyncio.wait_for(
+                            asyncio.gather(*pending, return_exceptions=True), timeout=10
+                        )
+                    )
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            finally:
+                loop.close()
         return True
     return None
 

@@ -1,0 +1,36 @@
+"""Graph artifact registry (api-store) HTTP tests."""
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.deploy.api_store import ArtifactStore, make_app
+
+
+async def test_api_store_crud(tmp_path):
+    client = TestClient(TestServer(make_app(ArtifactStore(tmp_path))))
+    await client.start_server()
+    try:
+        record = {
+            "name": "llama-disagg",
+            "version": "v1",
+            "manifest": {"kind": "DynamoGraphDeployment", "spec": {"services": {}}},
+        }
+        r = await client.post("/api/v1/graphs", json=record)
+        assert r.status == 201
+        # duplicate rejected
+        assert (await client.post("/api/v1/graphs", json=record)).status == 409
+        # bad names rejected
+        bad = dict(record, name="../../etc/passwd")
+        assert (await client.post("/api/v1/graphs", json=bad)).status == 400
+
+        r = await client.get("/api/v1/graphs")
+        assert await r.json() == [{"name": "llama-disagg", "versions": ["v1"]}]
+
+        r = await client.get("/api/v1/graphs/llama-disagg/v1")
+        body = await r.json()
+        assert body["manifest"]["kind"] == "DynamoGraphDeployment"
+        assert body["created_at"] > 0
+
+        assert (await client.delete("/api/v1/graphs/llama-disagg/v1")).status == 200
+        assert (await client.get("/api/v1/graphs/llama-disagg/v1")).status == 404
+    finally:
+        await client.close()

@@ -1,0 +1,133 @@
+"""Operator reconcile tests against FakeKube (reference analog:
+deploy/cloud/operator/internal/controller/*_test.go envtest tables)."""
+
+import pytest
+
+from dynamo_tpu.deploy import (
+    ComponentSpec,
+    DynamoComponentDeployment,
+    DynamoGraphDeployment,
+    FakeKube,
+    GraphReconciler,
+    render_component_manifests,
+)
+from dynamo_tpu.deploy.crds import Resources
+
+GRAPH_YAML = """
+apiVersion: dynamo.tpu/v1alpha1
+kind: DynamoGraphDeployment
+metadata:
+  name: llama-disagg
+  namespace: serving
+spec:
+  services:
+    frontend:
+      componentType: frontend
+      replicas: 1
+      port: 8080
+      envs: {DYN_LOG: info}
+    decode-worker:
+      componentType: worker
+      replicas: 2
+      resources: {tpu: 4, tpu_topology: 2x2, cpu: "8", memory: 32Gi}
+      config: {numBlocks: 4096, blockSize: 16}
+    prefill-worker:
+      componentType: prefill-worker
+      replicas: 4
+      resources: {tpu: 1}
+"""
+
+
+def test_graph_yaml_roundtrip():
+    graph = DynamoGraphDeployment.from_yaml(GRAPH_YAML)
+    assert graph.name == "llama-disagg"
+    assert set(graph.services) == {"frontend", "decode-worker", "prefill-worker"}
+    assert graph.services["decode-worker"].resources.tpu == 4
+    again = DynamoGraphDeployment.from_manifest(graph.to_manifest())
+    assert again.to_manifest() == graph.to_manifest()
+
+
+def test_graph_validation_rejects_bad_component_type():
+    graph = DynamoGraphDeployment(
+        name="x", services={"svc": ComponentSpec(component_type="gpu-worker")}
+    )
+    with pytest.raises(ValueError, match="componentType"):
+        graph.validate()
+
+
+def test_render_tpu_worker_manifests():
+    cd = DynamoComponentDeployment(
+        name="g-w", namespace="serving", graph="g", service_name="w",
+        spec=ComponentSpec(
+            component_type="worker", replicas=2,
+            resources=Resources(tpu=4, tpu_topology="2x2"),
+            config={"numBlocks": 128},
+        ),
+    )
+    manifests = {m["kind"]: m for m in render_component_manifests(cd)}
+    assert set(manifests) == {"ConfigMap", "Deployment"}
+    dep = manifests["Deployment"]
+    assert dep["spec"]["replicas"] == 2
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["resources"]["requests"]["google.com/tpu"] == "4"
+    assert (
+        dep["spec"]["template"]["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"]
+        == "2x2"
+    )
+    # config mounted + env pointing at it
+    assert any(e["name"] == "DYN_SERVICE_CONFIG" for e in container["env"])
+
+
+def test_render_frontend_has_service_and_probe():
+    cd = DynamoComponentDeployment(
+        name="g-fe", namespace="serving", graph="g", service_name="fe",
+        spec=ComponentSpec(component_type="frontend", port=8080),
+    )
+    manifests = {m["kind"]: m for m in render_component_manifests(cd)}
+    assert manifests["Service"]["spec"]["ports"][0]["port"] == 8080
+    container = manifests["Deployment"]["spec"]["template"]["spec"]["containers"][0]
+    assert container["readinessProbe"]["httpGet"]["port"] == 8080
+
+
+async def test_reconcile_and_prune():
+    kube = FakeKube()
+    reconciler = GraphReconciler(kube)
+    graph = DynamoGraphDeployment.from_yaml(GRAPH_YAML)
+
+    status = await reconciler.reconcile(graph)
+    assert status["components"] == [
+        "llama-disagg-decode-worker", "llama-disagg-frontend", "llama-disagg-prefill-worker",
+    ]
+    kinds = [k for (k, _, _) in kube.objects]
+    assert kinds.count("Deployment") == 3
+    assert kinds.count("Service") == 1           # only frontend exposes a port
+    assert kinds.count("ConfigMap") == 1         # only decode-worker has config
+    assert kinds.count("DynamoComponentDeployment") == 3
+
+    # drop a service → its objects are pruned
+    del graph.services["prefill-worker"]
+    status = await reconciler.reconcile(graph)
+    assert status["pruned"] == 2  # component CR + Deployment
+    assert ("Deployment", "serving", "llama-disagg-prefill-worker") not in kube.objects
+
+    removed = await reconciler.teardown(graph)
+    assert removed > 0
+    assert not [k for k in kube.objects if k[1] == "serving"]
+
+
+async def test_fake_kube_label_listing():
+    kube = FakeKube()
+    await kube.apply(
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "a", "namespace": "ns", "labels": {"dynamo.tpu/graph": "g1"}},
+        }
+    )
+    await kube.apply(
+        {
+            "kind": "Deployment",
+            "metadata": {"name": "b", "namespace": "ns", "labels": {"dynamo.tpu/graph": "g2"}},
+        }
+    )
+    got = await kube.list("Deployment", "ns", {"dynamo.tpu/graph": "g1"})
+    assert [o["metadata"]["name"] for o in got] == ["a"]

@@ -1,0 +1,51 @@
+"""Standalone router example: HTTP API routes to the prefix-overlap winner."""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics, KvCacheEvent, RouterEvent
+
+from examples.router_standalone.router import StandaloneRouter, make_app
+
+BLOCK = 4
+
+
+def stored_event(worker_id: int, token_ids: list[int]) -> RouterEvent:
+    return RouterEvent(
+        worker_id=worker_id,
+        event=KvCacheEvent(
+            kind="stored", block_hashes=compute_block_hashes(token_ids, BLOCK)
+        ),
+    )
+
+
+async def test_standalone_router_http():
+    router = StandaloneRouter(block_size=BLOCK)
+    router.indexer.start()
+    client = TestClient(TestServer(make_app(router)))
+    await client.start_server()
+    try:
+        # no workers yet → 503
+        r = await client.post("/route", json={"token_ids": [1, 2, 3, 4]})
+        assert r.status == 503
+
+        for wid in (0, 1):
+            assert (await client.post("/register", json={"worker_id": wid})).status == 200
+
+        prefix = list(range(16))
+        r = await client.post("/events", data=stored_event(1, prefix).to_json())
+        assert r.status == 200
+        for wid in (0, 1):
+            metrics = ForwardPassMetrics(worker_id=wid)
+            assert (await client.post("/metrics", data=metrics.to_json())).status == 200
+
+        await asyncio.sleep(0.05)  # indexer event loop applies pushes
+        r = await client.post("/route", json={"token_ids": prefix + [99, 100]})
+        body = await r.json()
+        assert body["worker_id"] == 1
+        assert body["overlap_blocks"] == len(prefix) // BLOCK
+    finally:
+        await client.close()
+        await router.indexer.stop()

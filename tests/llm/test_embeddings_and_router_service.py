@@ -57,6 +57,32 @@ async def test_embeddings_http():
                 np.asarray(r2.json()["data"][0]["embedding"]), v0, rtol=1e-5, atol=1e-6
             )
             assert not np.allclose(v0, v1)
+            assert r2.json()["model"] == "tiny-embed"
+
+            # pre-tokenized inputs: single list and batch-of-lists
+            r3 = await client.post(
+                "/v1/embeddings",
+                json={"model": "tiny-embed", "input": [[1, 2, 3], [4, 5]]},
+                timeout=60,
+            )
+            assert r3.status_code == 200
+            assert len(r3.json()["data"]) == 2
+
+            # base64 encoding round-trips to the same float vector
+            r4 = await client.post(
+                "/v1/embeddings",
+                json={
+                    "model": "tiny-embed",
+                    "input": "hello world",
+                    "encoding_format": "base64",
+                },
+                timeout=60,
+            )
+            import base64 as b64
+
+            packed = r4.json()["data"][0]["embedding"]
+            decoded = np.frombuffer(b64.b64decode(packed), np.float32)
+            np.testing.assert_allclose(decoded, v0.astype(np.float32), rtol=1e-5, atol=1e-6)
     finally:
         await service.stop()
 

@@ -1,0 +1,159 @@
+"""GGUF container: write→parse roundtrip, config/tokenizer/weight
+extraction, and forward-pass equivalence between GGUF-loaded and directly
+initialized params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.gguf import (
+    GGUFFile,
+    config_from_gguf,
+    load_gguf_weights,
+    mdc_from_gguf,
+    tokenizer_from_gguf,
+    write_gguf,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+CFG = LlamaConfig(
+    vocab_size=64, hidden_size=16, intermediate_size=32, num_layers=2,
+    num_heads=4, num_kv_heads=2, head_dim=4, max_position_embeddings=128,
+    rope_theta=10000.0, tie_word_embeddings=True, dtype=jnp.float32,
+)
+
+
+def export_params_to_gguf(path, cfg: LlamaConfig, params: dict) -> None:
+    """Inverse of load_gguf_weights for test fixtures."""
+    tensors = {
+        "token_embd.weight": np.asarray(params["embed"], np.float32),
+        "output_norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    name_map = {
+        "attn_norm": "attn_norm.weight", "wq": "attn_q.weight", "wk": "attn_k.weight",
+        "wv": "attn_v.weight", "wo": "attn_output.weight", "mlp_norm": "ffn_norm.weight",
+        "w_gate": "ffn_gate.weight", "w_up": "ffn_up.weight", "w_down": "ffn_down.weight",
+    }
+    for i in range(cfg.num_layers):
+        for ours, gguf_name in name_map.items():
+            t = np.asarray(params["layers"][ours][i], np.float32)
+            if ours.startswith("w"):
+                t = t.T  # ours [in,out] → gguf [out,in]
+            tensors[f"blk.{i}.{gguf_name}"] = t
+    metadata = {
+        "general.architecture": "llama",
+        "general.name": "tiny-test",
+        "llama.embedding_length": cfg.hidden_size,
+        "llama.feed_forward_length": cfg.intermediate_size,
+        "llama.block_count": cfg.num_layers,
+        "llama.attention.head_count": cfg.num_heads,
+        "llama.attention.head_count_kv": cfg.num_kv_heads,
+        "llama.attention.key_length": cfg.head_dim,
+        "llama.attention.layer_norm_rms_epsilon": cfg.rms_norm_eps,
+        "llama.context_length": cfg.max_position_embeddings,
+        "llama.rope.freq_base": cfg.rope_theta,
+        "llama.vocab_size": cfg.vocab_size,
+        "tokenizer.ggml.model": "gpt2",
+        "tokenizer.ggml.tokens": ["<pad>", "a", "b", "ab", "c"],
+        "tokenizer.ggml.merges": ["a b"],
+        "tokenizer.ggml.eos_token_id": 0,
+        "tokenizer.chat_template": "{{ messages }}",
+    }
+    write_gguf(path, metadata, tensors)
+
+
+@pytest.fixture(scope="module")
+def gguf_path(tmp_path_factory):
+    path = tmp_path_factory.mktemp("gguf") / "tiny.gguf"
+    params = init_params(CFG, jax.random.PRNGKey(7))
+    export_params_to_gguf(path, CFG, params)
+    return path, params
+
+
+def test_roundtrip_metadata_and_tensors(gguf_path):
+    path, params = gguf_path
+    gguf = GGUFFile(path)
+    assert gguf.version == 3
+    assert gguf.metadata["general.architecture"] == "llama"
+    assert gguf.metadata["llama.block_count"] == 2
+    assert gguf.metadata["tokenizer.ggml.merges"] == ["a b"]
+    assert gguf.metadata["llama.rope.freq_base"] == pytest.approx(10000.0)
+    # tensor data bit-exact through write→memmap
+    emb = gguf.tensor_data("token_embd.weight")
+    np.testing.assert_array_equal(emb, np.asarray(params["embed"], np.float32))
+    # ggml dim reversal: wq stored [out,in] on disk, shape reads back [out,in]
+    assert gguf.tensors["blk.0.attn_q.weight"].shape == (
+        CFG.num_heads * CFG.head_dim, CFG.hidden_size,
+    )
+
+
+def test_config_extraction(gguf_path):
+    path, _ = gguf_path
+    cfg = config_from_gguf(GGUFFile(path))
+    assert cfg.hidden_size == CFG.hidden_size
+    assert cfg.num_kv_heads == CFG.num_kv_heads
+    assert cfg.head_dim == CFG.head_dim
+    assert cfg.tie_word_embeddings  # no output.weight tensor
+    assert not cfg.attention_bias
+
+
+def test_mdc_extraction(gguf_path):
+    path, _ = gguf_path
+    mdc = mdc_from_gguf(path)
+    assert mdc.name == "tiny-test"
+    assert mdc.context_length == CFG.max_position_embeddings
+    assert mdc.eos_token_ids == [0]
+    assert mdc.chat_template == "{{ messages }}"
+
+
+def test_tokenizer_extraction(gguf_path):
+    path, _ = gguf_path
+    tok = tokenizer_from_gguf(GGUFFile(path))
+    ids = tok.encode("ab").ids
+    assert ids == [3]  # merge "a b" → "ab"
+    assert tok.decode([3]) == "ab"
+
+
+def test_weights_match_forward(gguf_path):
+    """GGUF-loaded params must produce the same logits as the originals."""
+    from dynamo_tpu.models.llama import llama_forward_prefill, init_kv_cache, make_rope_tables
+
+    path, params = gguf_path
+    gguf = GGUFFile(path)
+    loaded = load_gguf_weights(CFG, gguf)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6),
+        params, loaded,
+    )
+
+    cos, sin = make_rope_tables(CFG)
+    ids = jnp.arange(8, dtype=jnp.int32) % CFG.vocab_size
+    blocks = jnp.arange(4, dtype=jnp.int32)
+
+    def run(p):
+        cache = init_kv_cache(CFG, 16, 4)
+        logits, _ = llama_forward_prefill(
+            p, CFG, ids, cache, blocks, jnp.int32(8), jnp.int32(0), cos, sin
+        )
+        return np.asarray(logits)
+
+    np.testing.assert_allclose(run(params), run(loaded), rtol=1e-5, atol=1e-6)
+
+
+def test_quantized_tensor_rejected(tmp_path):
+    """Unknown/quantized GGML types are recognized and refused clearly."""
+    path = tmp_path / "q.gguf"
+    write_gguf(path, {"general.architecture": "llama"}, {"t": np.zeros((4, 4), np.float32)})
+    gguf = GGUFFile(path)
+    gguf.tensors["t"].ggml_type = 2  # pretend Q4_0
+    with pytest.raises(NotImplementedError, match="quantized"):
+        gguf.tensor_data("t")
+
+
+def test_bad_magic_rejected(tmp_path):
+    path = tmp_path / "bad.gguf"
+    path.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a GGUF"):
+        GGUFFile(path)
