@@ -52,7 +52,10 @@ async def serve_router(
     endpoint: str = "generate",
     block_size: int = 16,
 ):
-    """Start the router service; returns (EndpointService, KvRouter)."""
+    """Start the router service; returns (EndpointService, KvRouter, Client).
+
+    ``Client.start`` awaits the instance watch's initial snapshot, so by the
+    time the endpoint is served the worker view is populated."""
     backend_component = runtime.namespace(namespace).component(component)
     kv_router = KvRouter(backend_component, block_size=block_size)
     await kv_router.start()
@@ -61,19 +64,20 @@ async def serve_router(
     engine = RouterEngine(kv_router, client)
     router_ep = runtime.namespace(namespace).component("router").endpoint("generate")
     service = await router_ep.serve(engine)
-    return service, kv_router
+    return service, kv_router, client
 
 
 async def amain(args) -> int:
     configure_logging()
     runtime = await DistributedRuntime.create(RuntimeConfig(control_plane=args.control_plane))
-    service, kv_router = await serve_router(
+    service, kv_router, client = await serve_router(
         runtime, namespace=args.namespace, component=args.component,
         block_size=args.kv_block_size,
     )
     logger.info("router service up")
     await runtime.wait_for_shutdown()
     await service.shutdown()
+    await client.close()
     await kv_router.stop()
     await runtime.close()
     return 0

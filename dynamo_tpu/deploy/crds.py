@@ -97,6 +97,7 @@ class DynamoGraphDeployment:
     namespace: str = "default"
     services: dict[str, ComponentSpec] = field(default_factory=dict)
     labels: dict[str, str] = field(default_factory=dict)
+    uid: str = ""  # server-assigned metadata.uid (present once applied to a cluster)
 
     kind = "DynamoGraphDeployment"
 
@@ -133,6 +134,7 @@ class DynamoGraphDeployment:
                 n: ComponentSpec.from_dict(s) for n, s in spec.get("services", {}).items()
             },
             labels=dict(meta.get("labels", {})),
+            uid=meta.get("uid", ""),
         )
         obj.validate()
         return obj
@@ -153,29 +155,37 @@ class DynamoComponentDeployment:
     graph: str  # owning DynamoGraphDeployment name
     service_name: str
     spec: ComponentSpec
+    graph_uid: str = ""  # owner CR uid, when known (required for a valid ownerReference)
 
     kind = "DynamoComponentDeployment"
 
     def to_manifest(self) -> dict:
+        metadata: dict = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "labels": {
+                "dynamo.tpu/graph": self.graph,
+                "dynamo.tpu/service": self.service_name,
+                "dynamo.tpu/component-type": self.spec.component_type,
+            },
+        }
+        # The API server rejects ownerReferences without uid, so only emit
+        # one when the owning CR's uid is known (garbage collection); the
+        # reconciler's label-based prune covers the uid-less case.
+        if self.graph_uid:
+            metadata["ownerReferences"] = [
+                {
+                    "apiVersion": API_VERSION,
+                    "kind": DynamoGraphDeployment.kind,
+                    "name": self.graph,
+                    "uid": self.graph_uid,
+                    "controller": True,
+                }
+            ]
         return {
             "apiVersion": API_VERSION,
             "kind": self.kind,
-            "metadata": {
-                "name": self.name,
-                "namespace": self.namespace,
-                "labels": {
-                    "dynamo.tpu/graph": self.graph,
-                    "dynamo.tpu/service": self.service_name,
-                    "dynamo.tpu/component-type": self.spec.component_type,
-                },
-                "ownerReferences": [
-                    {
-                        "apiVersion": API_VERSION,
-                        "kind": DynamoGraphDeployment.kind,
-                        "name": self.graph,
-                    }
-                ],
-            },
+            "metadata": metadata,
             "spec": self.spec.to_dict(),
         }
 

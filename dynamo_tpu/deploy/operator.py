@@ -234,6 +234,7 @@ class GraphReconciler:
                 graph=graph.name,
                 service_name=svc_name,
                 spec=spec,
+                graph_uid=graph.uid,
             )
             for svc_name, spec in graph.services.items()
         ]
@@ -241,29 +242,34 @@ class GraphReconciler:
     async def reconcile(self, graph: DynamoGraphDeployment) -> dict:
         """Returns a status summary {applied: n, pruned: n, components: [...]}."""
         children = self.fan_out(graph)
-        desired_names = set()
+        desired: set[tuple[str, str]] = set()  # (kind, name) of every applied object
+        component_names = set()
         applied = 0
         for child in children:
-            desired_names.add(child.name)
+            component_names.add(child.name)
+            desired.add((DynamoComponentDeployment.kind, child.name))
             await self.kube.apply(child.to_manifest())
             for manifest in render_component_manifests(child):
+                desired.add((manifest["kind"], manifest["metadata"]["name"]))
                 await self.kube.apply(manifest)
                 applied += 1
 
+        # Prune by exact object identity: anything graph-labelled that this
+        # pass did not render is stale — including a ConfigMap/Service left
+        # behind when a service dropped its config/port.
         pruned = 0
         graph_selector = {"dynamo.tpu/graph": graph.name}
         for kind in (DynamoComponentDeployment.kind, "Deployment", "Service", "ConfigMap"):
             for obj in await self.kube.list(kind, graph.namespace, graph_selector):
                 name = obj["metadata"]["name"]
-                base = name[: -len("-config")] if name.endswith("-config") else name
-                if base not in desired_names:
+                if (kind, name) not in desired:
                     await self.kube.delete(kind, graph.namespace, name)
                     pruned += 1
 
         status = {
             "applied": applied,
             "pruned": pruned,
-            "components": sorted(desired_names),
+            "components": sorted(component_names),
         }
         logger.info("reconciled graph %s: %s", graph.name, status)
         return status

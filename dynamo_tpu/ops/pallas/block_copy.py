@@ -69,7 +69,7 @@ def scatter_blocks(
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, *block), lambda i, ids: (i, *rest)),
-            pl.BlockSpec(memory_space=pltpu.ANY),  # aliased pool, not loaded
+            pl.BlockSpec(memory_space=pl.ANY),  # aliased pool, not loaded
         ],
         out_specs=pl.BlockSpec((1, *block), lambda i, ids: (ids[i], *rest)),
     )

@@ -62,6 +62,10 @@ class Client:
         )
         self._watch = self.runtime.plane.kv.watch_prefix(prefix)
         self._watch_task = asyncio.ensure_future(self._watch_loop())
+        # Don't return until the watch's initial snapshot has been applied:
+        # a request served before this sees an empty instance view even
+        # though workers are registered (startup race).
+        await self._watch.ready()
 
     async def _watch_loop(self) -> None:
         assert self._watch is not None

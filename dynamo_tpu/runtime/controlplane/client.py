@@ -98,6 +98,8 @@ class RpcConnection:
             target._emit(
                 WatchEvent(WatchEventType(data["type"]), kv_entry_from_wire(data["entry"]))
             )
+        elif kind == "sync" and isinstance(target, Watch):
+            target._emit_sync()
         elif kind == "bus" and isinstance(target, Subscription):
             target._deliver(
                 Message(subject=data["subject"], payload=data["payload"], reply_to=data["reply_to"])

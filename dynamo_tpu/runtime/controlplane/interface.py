@@ -92,32 +92,57 @@ class KeyValueStore(ABC):
         """Watch a prefix: yields initial snapshot as PUTs, then live events."""
 
 
+# In-queue marker separating the initial snapshot from live events.  It is
+# swallowed by ``Watch.__anext__`` (consumers never see it); dequeueing it
+# sets the watch's ready event, so ``await watch.ready()`` means "the
+# consumer has drained the full snapshot" — not merely "it was enqueued".
+WATCH_SYNC = object()
+
+
 class Watch:
-    """Async stream of WatchEvents with a cancel handle."""
+    """Async stream of WatchEvents with a cancel handle and an
+    end-of-snapshot ``ready()`` signal."""
 
     def __init__(self) -> None:
-        self._queue: asyncio.Queue[WatchEvent | None] = asyncio.Queue()
+        self._queue: asyncio.Queue[object | None] = asyncio.Queue()
         self._cancelled = False
+        self._ready = asyncio.Event()
 
     def _emit(self, event: WatchEvent) -> None:
         if not self._cancelled:
             self._queue.put_nowait(event)
 
+    def _emit_sync(self) -> None:
+        if not self._cancelled:
+            self._queue.put_nowait(WATCH_SYNC)
+
     def _close(self) -> None:
+        self._ready.set()  # never leave ready() waiters hanging
         self._queue.put_nowait(None)
 
     def cancel(self) -> None:
         self._cancelled = True
+        self._ready.set()
         self._queue.put_nowait(None)
+
+    async def ready(self) -> None:
+        """Resolves once the initial snapshot has been consumed from this
+        watch (or the watch closed)."""
+        await self._ready.wait()
 
     def __aiter__(self) -> AsyncIterator[WatchEvent]:
         return self
 
     async def __anext__(self) -> WatchEvent:
-        event = await self._queue.get()
-        if event is None or self._cancelled:
-            raise StopAsyncIteration
-        return event
+        while True:
+            event = await self._queue.get()
+            if event is None or self._cancelled:
+                self._ready.set()
+                raise StopAsyncIteration
+            if event is WATCH_SYNC:
+                self._ready.set()
+                continue
+            return event  # type: ignore[return-value]
 
 
 # --------------------------------------------------------------------------

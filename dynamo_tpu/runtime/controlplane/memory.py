@@ -128,6 +128,7 @@ class MemoryKV(KeyValueStore):
         for entry in list(self._data.values()):
             if entry.key.startswith(prefix):
                 watch._emit(WatchEvent(WatchEventType.PUT, entry))
+        watch._emit_sync()  # snapshot boundary
         self._watches.append((prefix, watch))
         return watch
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 
-from dynamo_tpu.runtime.controlplane.interface import Subscription, Watch
+from dynamo_tpu.runtime.controlplane.interface import WATCH_SYNC, Subscription, Watch
 from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
 from dynamo_tpu.runtime.controlplane.wire import (
     kv_entry_to_wire,
@@ -62,9 +62,18 @@ class ControlPlaneServer:
                 await writer.drain()
 
         async def pump_watch(stream_id: int, watch: Watch) -> None:
-            async for event in watch:
+            # Reads the raw queue (not __anext__, which swallows the sync
+            # sentinel) so the end-of-snapshot boundary is forwarded on the
+            # wire and the remote watch's ready() has true snapshot semantics.
+            while True:
+                item = await watch._queue.get()
+                if item is None or watch._cancelled:
+                    break
+                if item is WATCH_SYNC:
+                    await send({"s": stream_id, "t": "sync", "d": None})
+                    continue
                 await send(
-                    {"s": stream_id, "t": "kv", "d": {"type": event.type.value, "entry": kv_entry_to_wire(event.entry)}}
+                    {"s": stream_id, "t": "kv", "d": {"type": item.type.value, "entry": kv_entry_to_wire(item.entry)}}
                 )
             await send({"s": stream_id, "t": "close", "d": None})
 

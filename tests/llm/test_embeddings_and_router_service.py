@@ -105,7 +105,10 @@ async def test_router_service_endpoint():
                 return ResponseStream(gen(), request.ctx)
 
         worker = await backend_ep.serve(Noop(), instance_id=42)
-        service, kv_router = await serve_router(rt, block_size=4)
+        service, kv_router, router_client = await serve_router(rt, block_size=4)
+        # the watch snapshot was applied before serve_router returned, so
+        # the already-registered worker is visible immediately
+        assert router_client.instance_ids == [42]
 
         # publish cached blocks for worker 42
         pub = KvEventPublisher(rt.namespace("dynamo").component("backend"), worker_id=42)

@@ -89,6 +89,33 @@ async def test_watch_snapshot_and_live(plane_factory):
         await teardown(plane, server)
 
 
+async def test_watch_ready_after_snapshot(plane_factory):
+    """watch.ready() resolves only once the initial snapshot has been
+    consumed, so a view primed in a consumer loop is complete by then."""
+    plane, server = await make_plane(plane_factory)
+    try:
+        await plane.kv.put("r/a", b"1")
+        await plane.kv.put("r/b", b"2")
+        watch = plane.kv.watch_prefix("r/")
+
+        seen: dict[str, bytes] = {}
+
+        async def consume():
+            async for ev in watch:
+                if ev.type == WatchEventType.PUT:
+                    seen[ev.entry.key] = ev.entry.value
+                else:
+                    seen.pop(ev.entry.key, None)
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.wait_for(watch.ready(), timeout=5)
+        assert seen == {"r/a": b"1", "r/b": b"2"}
+        watch.cancel()
+        await task
+    finally:
+        await teardown(plane, server)
+
+
 async def test_lease_expiry_deletes_and_notifies(plane_factory):
     plane, server = await make_plane(plane_factory)
     try:
