@@ -36,6 +36,7 @@ class Tier(str, enum.Enum):
     G1_DEVICE = "g1"
     G2_HOST = "g2"
     G3_DISK = "g3"
+    G4_REMOTE = "g4"
 
 
 @dataclass
@@ -49,6 +50,7 @@ class KvbmConfig:
     host_blocks: int = 128
     disk_blocks: int = 0            # 0 = no disk tier
     disk_path: str | None = None
+    remote_address: str | None = None  # "host:port" of a BlockStoreServer (G4)
     null_storage: bool = False      # metadata-only pools (fast logic tests)
 
 
@@ -81,16 +83,53 @@ class KvBlockManager:
             self.pools[Tier.G3_DISK] = BlockPool(
                 make_storage(config.disk_blocks, "disk"), tier_name="g3"
             )
+        if config.remote_address:
+            # G4: a BlockStoreServer mounted over DCN. The mounter owns the
+            # server's block-id space (one logical owner per store; shared
+            # read-only mounts would need a coordination layer on top).
+            # NOTE: mounting does blocking network IO — construct the manager
+            # off the event loop (see ``create_async``).
+            from dynamo_tpu.llm.block_manager.remote import RemoteStorage
+
+            remote = RemoteStorage(config.remote_address)
+            if remote.shape != shape:
+                raise ValueError(
+                    f"block store {config.remote_address} serves blocks of shape "
+                    f"{remote.shape}, but this manager is configured for {shape}"
+                )
+            if np.dtype(remote.dtype) != np.dtype(config.dtype):
+                raise ValueError(
+                    f"block store {config.remote_address} serves dtype "
+                    f"{remote.dtype}, but this manager is configured for "
+                    f"{np.dtype(config.dtype)}"
+                )
+            self.pools[Tier.G4_REMOTE] = BlockPool(remote, tier_name="g4")
         if not self.pools:
             raise ValueError("at least one tier required")
-        self.tier_order = [t for t in (Tier.G1_DEVICE, Tier.G2_HOST, Tier.G3_DISK) if t in self.pools]
-        self.offload = OffloadManager({t: p for t, p in self.pools.items()})
+        self.tier_order = [
+            t
+            for t in (Tier.G1_DEVICE, Tier.G2_HOST, Tier.G3_DISK, Tier.G4_REMOTE)
+            if t in self.pools
+        ]
+        self.offload = OffloadManager(
+            {t: p for t, p in self.pools.items()}, tier_order=list(self.tier_order)
+        )
+
+    @classmethod
+    async def create_async(cls, config: KvbmConfig) -> "KvBlockManager":
+        """Construct off the event loop: mounting a G4 store does blocking
+        TCP connect + info RPC in the constructor."""
+        import asyncio
+
+        return await asyncio.to_thread(cls, config)
 
     def start(self) -> None:
         self.offload.start()
 
     async def stop(self) -> None:
         await self.offload.stop()
+        for pool in self.pools.values():
+            pool.storage.close()
 
     # -- sequence ops --------------------------------------------------------
     @property

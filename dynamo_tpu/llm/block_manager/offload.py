@@ -40,11 +40,15 @@ class _Job:
 
 
 class OffloadManager:
-    def __init__(self, pools: dict[str, BlockPool]):
+    def __init__(self, pools: dict[str, BlockPool], tier_order: list | None = None):
         self.pools = pools
+        # when tier order is known, completed offloads cascade one tier
+        # further down (G1→G2→G3→G4 population, reference offload.rs)
+        self.tier_order = tier_order or []
         self._queue: list[_Job] = []
         self._seq = itertools.count()
         self._wake = asyncio.Event()
+        self._stopping = False
         self._workers: list[asyncio.Task] = []
         self._inflight = 0
         self.completed = 0
@@ -57,10 +61,23 @@ class OffloadManager:
                 asyncio.ensure_future(self._worker()) for _ in range(workers)
             ]
 
-    async def stop(self) -> None:
-        for w in self._workers:
+    async def stop(self, drain_timeout: float = 5.0) -> None:
+        """Drain in-flight transfers, then stop workers.
+
+        Cancelling a task blocked in ``to_thread`` abandons a still-running
+        OS thread that would race the storage close that follows — so ask
+        workers to exit between batches and only cancel stragglers after
+        the drain timeout."""
+        self._stopping = True
+        self._wake.set()
+        workers, self._workers = self._workers, []
+        if not workers:
+            return
+        done, pending = await asyncio.wait(workers, timeout=drain_timeout)
+        for w in pending:
             w.cancel()
-        self._workers = []
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
 
     # -- API -----------------------------------------------------------------
     def request_offload(
@@ -113,7 +130,11 @@ class OffloadManager:
     async def _worker(self) -> None:
         while True:
             while not self._queue:
+                if self._stopping:
+                    return
                 self._wake.clear()
+                if self._stopping:  # re-check: stop() may have set the (now
+                    return          # cleared) wake event in between
                 await self._wake.wait()
             # batch same src→dst pairs
             job = heapq.heappop(self._queue)
@@ -143,6 +164,9 @@ class OffloadManager:
             if dst.has_hash(job.seq_hash):
                 self.skipped += 1  # already down-tier (dedupe)
                 continue
+            if src.blocks[job.block_id].seq_hash != job.seq_hash:
+                self.skipped += 1  # stale: source block evicted/reused since queued
+                continue
             jobs.append(job)
         if not jobs:
             return
@@ -159,8 +183,18 @@ class OffloadManager:
             return
         data = await asyncio.to_thread(src.read, [j.block_id for j in kept])
         await asyncio.to_thread(dst.write, dst_ids, data)
+        next_tier = None
+        if batch[0].dst_tier in self.tier_order:
+            idx = self.tier_order.index(batch[0].dst_tier)
+            if idx + 1 < len(self.tier_order):
+                next_tier = self.tier_order[idx + 1]
         for job, bid in zip(kept, dst_ids):
             dst.complete(bid, src.blocks[job.block_id].token_count)
             dst.register(bid, job.seq_hash)
             dst.release(bid)  # parks in inactive LRU, discoverable
             self.completed += 1
+            if next_tier is not None:
+                self.request_offload(
+                    batch[0].dst_tier, next_tier, bid, job.seq_hash,
+                    priority=job.priority + 1,
+                )

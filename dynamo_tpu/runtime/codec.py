@@ -31,6 +31,34 @@ def encode_frame(msg: TwoPartMessage) -> bytes:
     return _PREFIX.pack(len(header), len(msg.payload)) + header + msg.payload
 
 
+def read_two_part_sync(sock) -> TwoPartMessage | None:
+    """Blocking-socket twin of ``read_two_part`` (used by sync Storage
+    clients that run under ``asyncio.to_thread``)."""
+
+    def recv_exact(n: int) -> bytes | None:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf.extend(chunk)
+        return bytes(buf)
+
+    prefix = recv_exact(_PREFIX.size)
+    if prefix is None:
+        return None
+    header_len, payload_len = _PREFIX.unpack(prefix)
+    if header_len > MAX_HEADER or payload_len > MAX_PAYLOAD:
+        raise ValueError(f"oversized frame: header={header_len} payload={payload_len}")
+    header = recv_exact(header_len)
+    if header is None:
+        return None
+    payload = recv_exact(payload_len) if payload_len else b""
+    if payload is None:
+        return None
+    return TwoPartMessage(header=msgpack.unpackb(header, raw=False), payload=payload)
+
+
 async def read_two_part(reader: asyncio.StreamReader) -> TwoPartMessage | None:
     try:
         prefix = await reader.readexactly(_PREFIX.size)

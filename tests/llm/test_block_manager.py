@@ -162,3 +162,103 @@ def test_stats_shape():
     stats = mgr.stats()
     assert stats["g2"]["total"] == 4
     assert "offload" in stats
+
+
+# ---------------------------------------------------------------- G4 remote
+
+
+async def test_remote_storage_roundtrip():
+    from dynamo_tpu.llm.block_manager.remote import BlockStoreServer, RemoteStorage
+
+    server = BlockStoreServer(HostStorage(16, SHAPE, np.float32))
+    await server.start()
+    try:
+        # construct off-loop: the sync client would block the event loop
+        # the in-process test server runs on (in production the server is a
+        # separate process)
+        remote = await asyncio.to_thread(RemoteStorage, server.address)
+        assert remote.num_blocks == 16
+        assert remote.shape == SHAPE
+        rng = np.random.default_rng(3)
+        data = rng.standard_normal((4, *SHAPE)).astype(np.float32)
+        await asyncio.to_thread(remote.write_batch, [3, 5, 7, 9], data)
+        got = await asyncio.to_thread(remote.read_batch, [3, 5, 7, 9])
+        np.testing.assert_allclose(got, data, rtol=0, atol=0)
+        # interleaved ids read back in request order
+        got2 = await asyncio.to_thread(remote.read_batch, [9, 3])
+        np.testing.assert_allclose(got2, data[[3, 0]], rtol=0, atol=0)
+        remote.close()
+    finally:
+        await server.stop()
+
+
+async def test_remote_tier_offload_and_onboard():
+    """G2 → G4 offload via cascade-free direct path, then onboard back."""
+    from dynamo_tpu.llm.block_manager.remote import BlockStoreServer
+
+    server = BlockStoreServer(HostStorage(32, SHAPE, np.float32))
+    await server.start()
+    mgr = None
+    try:
+        mgr = await asyncio.to_thread(KvBlockManager, KvbmConfig(
+            num_layers=2, block_size=4, kv_heads=2, head_dim=8,
+            host_blocks=8, remote_address=server.address,
+        ))
+        mgr.start()
+        rng = np.random.default_rng(4)
+        hashes = [201, 202, 203]
+        data = rng.standard_normal((3, *SHAPE)).astype(np.float32)
+        ids = mgr.store_sequence(hashes, data)
+        assert ids is not None
+        for _ in range(200):
+            if mgr.pools[Tier.G4_REMOTE].has_hash(203):
+                break
+            await asyncio.sleep(0.02)
+        assert all(mgr.pools[Tier.G4_REMOTE].has_hash(h) for h in hashes)
+
+        # drop from the host tier; the only copy is now remote
+        mgr.release_sequence(ids)
+        for h in hashes:
+            mgr.primary.drop_hash(h)
+
+        hit_ids, from_tier = await mgr.match_and_onboard(hashes)
+        assert from_tier == Tier.G4_REMOTE
+        assert len(hit_ids) == 3
+        got = mgr.primary.read(hit_ids)
+        np.testing.assert_allclose(got, data, rtol=0, atol=0)
+    finally:
+        if mgr is not None:
+            await mgr.stop()
+        await server.stop()
+
+
+async def test_cascade_populates_all_tiers(tmp_path):
+    """One store_sequence eventually lands the block in G2, G3 and G4."""
+    from dynamo_tpu.llm.block_manager.remote import BlockStoreServer
+
+    server = BlockStoreServer(HostStorage(16, SHAPE, np.float32))
+    await server.start()
+    mgr = None
+    try:
+        mgr = await asyncio.to_thread(KvBlockManager, KvbmConfig(
+            num_layers=2, block_size=4, kv_heads=2, head_dim=8,
+            device_blocks=4, host_blocks=8, disk_blocks=8,
+            disk_path=str(tmp_path / "kv.bin"), remote_address=server.address,
+        ))
+        mgr.start()
+        rng = np.random.default_rng(5)
+        data = rng.standard_normal((1, *SHAPE)).astype(np.float32)
+        assert mgr.store_sequence([77], data) is not None
+        for _ in range(300):
+            if mgr.pools[Tier.G4_REMOTE].has_hash(77):
+                break
+            await asyncio.sleep(0.02)
+        for tier in (Tier.G2_HOST, Tier.G3_DISK, Tier.G4_REMOTE):
+            assert mgr.pools[tier].has_hash(77), tier
+            pool = mgr.pools[tier]
+            got = await asyncio.to_thread(pool.read, [pool._by_hash[77]])
+            np.testing.assert_allclose(got, data, rtol=0, atol=0)
+    finally:
+        if mgr is not None:
+            await mgr.stop()
+        await server.stop()
