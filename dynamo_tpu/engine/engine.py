@@ -39,12 +39,7 @@ from dynamo_tpu.llm.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
-from dynamo_tpu.models.llama import (
-    LlamaConfig,
-    init_kv_cache,
-    kv_cache_spec,
-    make_rope_tables,
-)
+from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
 from dynamo_tpu.ops.sampling import sample_tokens
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -107,7 +102,7 @@ class JaxLlmEngine:
         rng = jax.random.PRNGKey(config.seed)
         self._rng = jax.random.fold_in(rng, 1)
         raw_params = params if params is not None else self.family.init_params(cfg, rng)
-        raw_cache = init_kv_cache(
+        raw_cache = self.family.cache_init(
             cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
         )
         if self.mesh is not None:
@@ -116,10 +111,9 @@ class JaxLlmEngine:
             self._param_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), self.family.param_specs(cfg)
             )
-            self._cache_sharding = {
-                "k": NamedSharding(self.mesh, kv_cache_spec()),
-                "v": NamedSharding(self.mesh, kv_cache_spec()),
-            }
+            self._cache_sharding = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), self.family.cache_specs(cfg)
+            )
             self.params = jax.tree.map(jax.device_put, raw_params, self._param_shardings)
             self.cache = jax.tree.map(jax.device_put, raw_cache, self._cache_sharding)
         else:
@@ -127,7 +121,7 @@ class JaxLlmEngine:
             self._cache_sharding = None
             self.params = jax.device_put(raw_params)
             self.cache = jax.device_put(raw_cache)
-        self.cos, self.sin = make_rope_tables(cfg)
+        self.cos, self.sin = self.family.rope_tables(cfg)
 
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event

@@ -1,0 +1,509 @@
+"""DeepSeek-class model: Multi-head Latent Attention (MLA) + fine-grained
+MoE (DeepSeek-V2/V3/R1 geometries).
+
+The reference's flagship wide-EP deployment is DeepSeek-R1 served through
+SGLang+DeepEP across 48+ GPUs (reference: examples/sglang/README.md:105,
+container/Dockerfile.sglang-deepep); here the model is native to the TPU
+engine and its parallelism is sharding annotations over mesh axes ``tp``
+(attention heads, shared-expert FFN) and ``ep`` (routed experts) — GSPMD
+emits the collectives.
+
+MLA, TPU-first:
+- The KV cache stores only the **compressed latent** per token: ``c_kv``
+  (kv_lora_rank wide) plus the shared rope key (qk_rope_head_dim wide) —
+  e.g. 512+64 floats/token vs 2*8*128 for Llama-70B-class GQA, a ~4.5x
+  HBM saving that directly raises achievable batch (decode on TPU is HBM
+  bandwidth-bound).
+- Decode attends **in latent space** ("absorbed" form): q_nope is folded
+  through the k up-projection once per step (one small einsum), scores are
+  taken against the latent cache directly, and the context is decompressed
+  through the v up-projection after the softmax — no per-token K/V
+  decompression, so the cache read stays at latent width.
+- Prefill decompresses K/V for the current chunk only (dense causal
+  attention on the MXU) while writing latents to the paged cache.
+
+Cache layout reuses the engine's {"k", "v"} pytree so paged bookkeeping,
+extract/inject and disagg KV shipping work unchanged:
+    k: [layers, num_blocks, block_size, 1, kv_lora_rank]   (latent)
+    v: [layers, num_blocks, block_size, 1, qk_rope_head_dim] (rope key)
+
+Routing is renormalized softmax top-k (V2 style) scaled by
+``routed_scaling_factor``; V3's sigmoid+aux-free bias routing maps onto the
+same dispatch path and can be added behind a config flag.  YaRN long-context
+rope scaling is not yet applied (plain rope tables at ``rope_theta``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.ops.attention import NEG_INF, write_decode_kv, write_prefill_kv
+from dynamo_tpu.ops.moe import moe_ffn
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+
+
+@dataclass(frozen=True)
+class DeepseekConfig:
+    vocab_size: int = 102400
+    hidden_size: int = 2048
+    num_layers: int = 27
+    num_heads: int = 16
+    # MLA geometry
+    q_lora_rank: int = 0              # 0 = direct q projection (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+    # FFN geometry
+    intermediate_size: int = 10944    # dense layers
+    first_k_dense: int = 1            # leading dense (non-MoE) layers
+    moe_intermediate_size: int = 1408  # per routed expert
+    num_experts: int = 64
+    experts_per_token: int = 6
+    n_shared_experts: int = 2
+    routed_scaling_factor: float = 1.0
+    capacity_factor: float = 2.0
+    # common
+    max_position_embeddings: int = 163840
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def num_moe_layers(self) -> int:
+        return self.num_layers - self.first_k_dense
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "DeepseekConfig":
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        return cls(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            num_layers=config["num_hidden_layers"],
+            num_heads=config["num_attention_heads"],
+            q_lora_rank=config.get("q_lora_rank") or 0,
+            kv_lora_rank=config["kv_lora_rank"],
+            qk_nope_head_dim=config["qk_nope_head_dim"],
+            qk_rope_head_dim=config["qk_rope_head_dim"],
+            v_head_dim=config["v_head_dim"],
+            intermediate_size=config["intermediate_size"],
+            first_k_dense=config.get("first_k_dense_replace", 0),
+            moe_intermediate_size=config.get("moe_intermediate_size", 0)
+            or config["intermediate_size"],
+            num_experts=config.get("n_routed_experts", 0) or 1,
+            experts_per_token=config.get("num_experts_per_tok", 1) or 1,
+            n_shared_experts=config.get("n_shared_experts", 0) or 0,
+            routed_scaling_factor=config.get("routed_scaling_factor", 1.0),
+            max_position_embeddings=config.get("max_position_embeddings", 4096),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-6),
+            rope_theta=config.get("rope_theta", 10000.0),
+            tie_word_embeddings=config.get("tie_word_embeddings", False),
+        )
+
+    # --- presets ----------------------------------------------------------
+    @classmethod
+    def deepseek_v2_lite(cls) -> "DeepseekConfig":
+        return cls()  # the defaults above are the 16B V2-Lite geometry
+
+    @classmethod
+    def deepseek_v3(cls) -> "DeepseekConfig":
+        """671B/R1 geometry (config shape only; serving it needs multi-host)."""
+        return cls(
+            vocab_size=129280, hidden_size=7168, num_layers=61, num_heads=128,
+            q_lora_rank=1536, kv_lora_rank=512, qk_nope_head_dim=128,
+            qk_rope_head_dim=64, v_head_dim=128, intermediate_size=18432,
+            first_k_dense=3, moe_intermediate_size=2048, num_experts=256,
+            experts_per_token=8, n_shared_experts=1, routed_scaling_factor=2.5,
+        )
+
+    @classmethod
+    def tiny_mla(cls, vocab_size: int = 512) -> "DeepseekConfig":
+        """Test geometry: runs on the CPU mesh; exercises q-lora, dense+MoE
+        layer mix, and ep/tp-shardable expert counts."""
+        return cls(
+            vocab_size=vocab_size, hidden_size=64, num_layers=3, num_heads=4,
+            q_lora_rank=32, kv_lora_rank=32, qk_nope_head_dim=16,
+            qk_rope_head_dim=8, v_head_dim=16, intermediate_size=128,
+            first_k_dense=1, moe_intermediate_size=48, num_experts=4,
+            experts_per_token=2, n_shared_experts=1, capacity_factor=4.0,
+            max_position_embeddings=2048, tie_word_embeddings=True,
+            dtype=jnp.float32,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def _attn_params(cfg: DeepseekConfig, keys, n: int) -> dict:
+    h = cfg.hidden_size
+    hd_q = cfg.num_heads * cfg.qk_head_dim
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    params = {
+        "attn_norm": jnp.ones((n, h), cfg.dtype),
+        "w_dkv": norm_init(keys[0], (n, h, cfg.kv_lora_rank + cfg.qk_rope_head_dim), h),
+        "kv_norm": jnp.ones((n, cfg.kv_lora_rank), cfg.dtype),
+        "w_uk": norm_init(
+            keys[1], (n, cfg.kv_lora_rank, cfg.num_heads * cfg.qk_nope_head_dim),
+            cfg.kv_lora_rank,
+        ),
+        "w_uv": norm_init(
+            keys[2], (n, cfg.kv_lora_rank, cfg.num_heads * cfg.v_head_dim),
+            cfg.kv_lora_rank,
+        ),
+        "wo": norm_init(keys[3], (n, cfg.num_heads * cfg.v_head_dim, h),
+                        cfg.num_heads * cfg.v_head_dim),
+    }
+    if cfg.q_lora_rank:
+        params["w_dq"] = norm_init(keys[4], (n, h, cfg.q_lora_rank), h)
+        params["q_norm"] = jnp.ones((n, cfg.q_lora_rank), cfg.dtype)
+        params["w_uq"] = norm_init(keys[5], (n, cfg.q_lora_rank, hd_q), cfg.q_lora_rank)
+    else:
+        params["wq"] = norm_init(keys[4], (n, h, hd_q), h)
+    return params
+
+
+def init_params(cfg: DeepseekConfig, rng: jax.Array) -> dict:
+    h = cfg.hidden_size
+    kd, km = cfg.first_k_dense, cfg.num_moe_layers
+    keys = jax.random.split(rng, 24)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    params: dict = {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), 1.0),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+    }
+    if kd:
+        i = cfg.intermediate_size
+        dense = _attn_params(cfg, keys[1:7], kd)
+        dense.update(
+            mlp_norm=jnp.ones((kd, h), cfg.dtype),
+            w_gate=norm_init(keys[7], (kd, h, i), h),
+            w_up=norm_init(keys[8], (kd, h, i), h),
+            w_down=norm_init(keys[9], (kd, i, h), i),
+        )
+        params["dense_layers"] = dense
+    if km:
+        mi, e = cfg.moe_intermediate_size, cfg.num_experts
+        si = cfg.n_shared_experts * mi
+        moe = _attn_params(cfg, keys[10:16], km)
+        moe.update(
+            mlp_norm=jnp.ones((km, h), cfg.dtype),
+            w_router=norm_init(keys[16], (km, h, e), h),
+            w_gate=norm_init(keys[17], (km, e, h, mi), h),
+            w_up=norm_init(keys[18], (km, e, h, mi), h),
+            w_down=norm_init(keys[19], (km, e, mi, h), mi),
+        )
+        if si:
+            moe.update(
+                ws_gate=norm_init(keys[20], (km, h, si), h),
+                ws_up=norm_init(keys[21], (km, h, si), h),
+                ws_down=norm_init(keys[22], (km, si, h), si),
+            )
+        params["moe_layers"] = moe
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm_init(keys[23], (h, cfg.vocab_size), h)
+    return params
+
+
+def _attn_specs(cfg: DeepseekConfig) -> dict:
+    specs = {
+        "attn_norm": P(None, None),
+        "w_dkv": P(None, None, None),   # latent path replicated (MQA-like)
+        "kv_norm": P(None, None),
+        "w_uk": P(None, None, "tp"),    # head-sharded up-projections
+        "w_uv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),      # row-parallel → all-reduce
+    }
+    if cfg.q_lora_rank:
+        specs["w_dq"] = P(None, None, None)
+        specs["q_norm"] = P(None, None)
+        specs["w_uq"] = P(None, None, "tp")
+    else:
+        specs["wq"] = P(None, None, "tp")
+    return specs
+
+
+def param_specs(cfg: DeepseekConfig) -> dict:
+    specs: dict = {
+        "embed": P(None, None),
+        "final_norm": P(None),
+    }
+    if cfg.first_k_dense:
+        dense = _attn_specs(cfg)
+        dense.update(
+            mlp_norm=P(None, None),
+            w_gate=P(None, None, "tp"),
+            w_up=P(None, None, "tp"),
+            w_down=P(None, "tp", None),
+        )
+        specs["dense_layers"] = dense
+    if cfg.num_moe_layers:
+        moe = _attn_specs(cfg)
+        moe.update(
+            mlp_norm=P(None, None),
+            w_router=P(None, None, None),
+            # routed experts over 'ep', within-expert FFN over 'tp'
+            w_gate=P(None, "ep", None, "tp"),
+            w_up=P(None, "ep", None, "tp"),
+            w_down=P(None, "ep", "tp", None),
+        )
+        if cfg.n_shared_experts:
+            moe.update(
+                ws_gate=P(None, None, "tp"),
+                ws_up=P(None, None, "tp"),
+                ws_down=P(None, "tp", None),
+            )
+        specs["moe_layers"] = moe
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# KV cache: latent + rope-key, tiny per token
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(cfg: DeepseekConfig, num_blocks: int, block_size: int, dtype=None):
+    dtype = dtype or cfg.dtype
+    return {
+        "k": jnp.zeros((cfg.num_layers, num_blocks, block_size, 1, cfg.kv_lora_rank), dtype),
+        "v": jnp.zeros((cfg.num_layers, num_blocks, block_size, 1, cfg.qk_rope_head_dim), dtype),
+    }
+
+
+def kv_cache_specs(cfg: DeepseekConfig) -> dict:
+    # the latent is shared across heads — replicate across tp (it is ~4x
+    # smaller than a GQA cache even unsharded)
+    return {"k": P(None, None, None, None, None), "v": P(None, None, None, None, None)}
+
+
+def make_rope_tables(cfg: DeepseekConfig):
+    return rope_table(cfg.max_position_embeddings, cfg.qk_rope_head_dim, cfg.rope_theta)
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+def _project_q(w, x, cfg: DeepseekConfig):
+    """x [t, h] → q [t, heads, qk_head_dim] (optionally through the q-lora
+    bottleneck)."""
+    t = x.shape[0]
+    if cfg.q_lora_rank:
+        q = rms_norm(x @ w["w_dq"], w["q_norm"], cfg.rms_norm_eps) @ w["w_uq"]
+    else:
+        q = x @ w["wq"]
+    return q.reshape(t, cfg.num_heads, cfg.qk_head_dim)
+
+
+def _latent_kv(w, x, cfg: DeepseekConfig):
+    """x [t, h] → (c_kv [t, r] normalized, k_rope [t, rope_dim] un-roped)."""
+    dkv = x @ w["w_dkv"]
+    c_kv = rms_norm(dkv[:, : cfg.kv_lora_rank], w["kv_norm"], cfg.rms_norm_eps)
+    k_rope = dkv[:, cfg.kv_lora_rank :]
+    return c_kv, k_rope
+
+
+def _mla_prefill_attn(w, x, cfg: DeepseekConfig, positions, seq_len, k_layer, v_layer,
+                      block_ids, cos, sin):
+    """Dense causal MLA attention for one prefill chunk; writes latents to
+    the paged cache.  Returns (attn_out [s, h], (k_layer, v_layer))."""
+    s = x.shape[0]
+    H = cfg.num_heads
+    q = _project_q(w, x, cfg)
+    q_nope, q_rope = q[..., : cfg.qk_nope_head_dim], q[..., cfg.qk_nope_head_dim :]
+    q_rope = apply_rope(q_rope, positions, cos, sin)
+
+    c_kv, k_rope = _latent_kv(w, x, cfg)
+    k_rope = apply_rope(k_rope[:, None, :], positions, cos, sin)[:, 0]
+
+    k_layer, v_layer = write_prefill_kv(
+        k_layer, v_layer, c_kv[:, None, :], k_rope[:, None, :], block_ids, seq_len
+    )
+
+    # decompress K/V for the in-chunk dense attention (prefill is
+    # compute-bound; this keeps the big matmuls on the MXU)
+    w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
+    w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
+    k_nope = jnp.einsum("tr,rhn->thn", c_kv, w_uk)
+    v = jnp.einsum("tr,rhv->thv", c_kv, w_uv)
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
+    logits = (
+        jnp.einsum("qhn,khn->hqk", q_nope.astype(jnp.float32), k_nope.astype(jnp.float32))
+        + jnp.einsum("qhp,kp->hqk", q_rope.astype(jnp.float32), k_rope.astype(jnp.float32))
+    ) * scale
+    pos = jnp.arange(s)
+    mask = (pos[None, :] <= pos[:, None]) & (pos[None, :] < seq_len)  # [q, k]
+    logits = jnp.where(mask[None], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hqk,khv->qhv", weights, v.astype(jnp.float32)).astype(cfg.dtype)
+    return out.reshape(s, -1) @ w["wo"], (k_layer, v_layer)
+
+
+def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
+                     block_tables, context_lens, slot_ids, cos, sin):
+    """Absorbed-form batched decode attention against the latent cache."""
+    b = x.shape[0]
+    H = cfg.num_heads
+    q = _project_q(w, x, cfg)
+    q_nope, q_rope = q[..., : cfg.qk_nope_head_dim], q[..., cfg.qk_nope_head_dim :]
+    q_rope = apply_rope(q_rope[:, None], positions[:, None], cos, sin)[:, 0]
+
+    c_kv_new, k_rope_new = _latent_kv(w, x, cfg)
+    k_rope_new = apply_rope(k_rope_new[:, None, None, :], positions[:, None], cos, sin)[:, 0]
+    k_layer, v_layer = write_decode_kv(
+        k_layer, v_layer, c_kv_new[:, None, :], k_rope_new, slot_ids
+    )
+
+    # absorb q through the k up-projection: scores live in latent space
+    w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
+    w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
+    q_lat = jnp.einsum("bhn,rhn->bhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32))
+
+    num_blocks, block_size = k_layer.shape[0], k_layer.shape[1]
+    max_blocks = block_tables.shape[1]
+    length = max_blocks * block_size
+    ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
+    kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
+    logits = (
+        jnp.einsum("bhr,btr->bht", q_lat, ck.astype(jnp.float32))
+        + jnp.einsum("bhp,btp->bht", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
+    ) * scale
+    valid = jnp.arange(length)[None, :] < context_lens[:, None]
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    # context in latent space, then decompress through the v up-projection
+    ctx = jnp.einsum("bht,btr->bhr", weights, ck.astype(jnp.float32))
+    out = jnp.einsum("bhr,rhv->bhv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
+    return out.reshape(b, -1) @ w["wo"], (k_layer, v_layer)
+
+
+def _dense_mlp(w, x):
+    return jax.nn.silu(x @ w["w_gate"]) * (x @ w["w_up"]) @ w["w_down"]
+
+
+def _moe_mlp(w, x, cfg: DeepseekConfig):
+    routed = moe_ffn(
+        x, w["w_router"], w["w_gate"], w["w_up"], w["w_down"],
+        top_k=cfg.experts_per_token, capacity_factor=cfg.capacity_factor,
+    )
+    out = routed * jnp.asarray(cfg.routed_scaling_factor, routed.dtype)
+    if cfg.n_shared_experts:
+        out = out + jax.nn.silu(x @ w["ws_gate"]) * (x @ w["ws_up"]) @ w["ws_down"]
+    return out
+
+
+def _run_stack(params_key, mlp_fn, x, cache_k, cache_v, attn_fn, cfg):
+    """Scan one homogeneous layer stack, threading its cache slice."""
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        attn_out, (k_layer, v_layer) = attn_fn(w, attn_in, k_layer, v_layer)
+        x = x + attn_out
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + mlp_fn(w, mlp_in)
+        return x, (k_layer, v_layer)
+
+    return jax.lax.scan(layer, x, (params_key, cache_k, cache_v))
+
+
+def _forward(params, cfg: DeepseekConfig, x, kv_cache, attn_fn):
+    """Shared trunk: dense stack then MoE stack, cache split on the layer
+    axis and re-concatenated."""
+    kd = cfg.first_k_dense
+    k_cache, v_cache = kv_cache["k"], kv_cache["v"]
+    new_k_parts, new_v_parts = [], []
+    if kd:
+        x, (nk, nv) = _run_stack(
+            params["dense_layers"], lambda w, t: _dense_mlp(w, t),
+            x, k_cache[:kd], v_cache[:kd], attn_fn, cfg,
+        )
+        new_k_parts.append(nk)
+        new_v_parts.append(nv)
+    if cfg.num_moe_layers:
+        x, (nk, nv) = _run_stack(
+            params["moe_layers"], lambda w, t: _moe_mlp(w, t, cfg),
+            x, k_cache[kd:], v_cache[kd:], attn_fn, cfg,
+        )
+        new_k_parts.append(nk)
+        new_v_parts.append(nv)
+    new_cache = {
+        "k": jnp.concatenate(new_k_parts) if len(new_k_parts) > 1 else new_k_parts[0],
+        "v": jnp.concatenate(new_v_parts) if len(new_v_parts) > 1 else new_v_parts[0],
+    }
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, new_cache
+
+
+def _logits(params, cfg, x):
+    if cfg.tie_word_embeddings:
+        return x @ params["embed"].T.astype(x.dtype)
+    return x @ params["lm_head"]
+
+
+def deepseek_forward_prefill(
+    params, cfg: DeepseekConfig, token_ids, kv_cache, block_ids, seq_len, start_pos,
+    cos, sin,
+):
+    """Single-sequence prefill → (last-token logits [vocab], new cache)."""
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def attn(w, attn_in, k_layer, v_layer):
+        return _mla_prefill_attn(
+            w, attn_in, cfg, positions, seq_len, k_layer, v_layer, block_ids, cos, sin
+        )
+
+    x, new_cache = _forward(params, cfg, x, kv_cache, attn)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _logits(params, cfg, last[None])[0]
+    return logits.astype(jnp.float32), new_cache
+
+
+def deepseek_forward_decode(
+    params, cfg: DeepseekConfig, token_ids, kv_cache, block_tables, context_lens,
+    slot_ids, cos, sin, *, attention: str = "jax",
+):
+    """Batched single-token decode → (logits [batch, vocab], new cache).
+    MLA decode always runs the absorbed latent path (the GQA Pallas kernel
+    does not apply); ``attention`` is accepted for engine interface parity."""
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(context_lens - 1, 0)
+
+    def attn(w, attn_in, k_layer, v_layer):
+        return _mla_decode_attn(
+            w, attn_in, cfg, positions, k_layer, v_layer,
+            block_tables, context_lens, slot_ids, cos, sin,
+        )
+
+    x, new_cache = _forward(params, cfg, x, kv_cache, attn)
+    logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), new_cache

@@ -21,6 +21,34 @@ class ModelFamily:
     param_specs: Callable
     forward_prefill: Callable
     forward_decode: Callable
+    # cache geometry hooks; None = the llama-family GQA paged cache
+    # (MLA families override: cache stores compressed latents)
+    init_kv_cache: Callable | None = None
+    kv_cache_specs: Callable | None = None
+    make_rope_tables: Callable | None = None
+
+    def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
+        if self.init_kv_cache is not None:
+            return self.init_kv_cache(cfg, num_blocks, block_size, dtype)
+        from dynamo_tpu.models import llama
+
+        return llama.init_kv_cache(cfg, num_blocks, block_size, dtype)
+
+    def cache_specs(self, cfg):
+        """Pytree of PartitionSpecs matching the cache pytree."""
+        if self.kv_cache_specs is not None:
+            return self.kv_cache_specs(cfg)
+        from dynamo_tpu.models import llama
+
+        spec = llama.kv_cache_spec()
+        return {"k": spec, "v": spec}
+
+    def rope_tables(self, cfg):
+        if self.make_rope_tables is not None:
+            return self.make_rope_tables(cfg)
+        from dynamo_tpu.models import llama
+
+        return llama.make_rope_tables(cfg)
 
 
 def _llama_family() -> ModelFamily:
@@ -73,12 +101,37 @@ def _mixtral_family() -> ModelFamily:
     )
 
 
+def _deepseek_family() -> ModelFamily:
+    from dynamo_tpu.models import deepseek
+
+    return ModelFamily(
+        name="deepseek",
+        config_from_hf=deepseek.DeepseekConfig.from_hf_config,
+        init_params=deepseek.init_params,
+        param_specs=deepseek.param_specs,
+        forward_prefill=deepseek.deepseek_forward_prefill,
+        forward_decode=deepseek.deepseek_forward_decode,
+        init_kv_cache=deepseek.init_kv_cache,
+        kv_cache_specs=deepseek.kv_cache_specs,
+        make_rope_tables=deepseek.make_rope_tables,
+    )
+
+
 _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "llama": _llama_family,
     "qwen2": _qwen2_family,
     "qwen3": _qwen2_family,
     "mixtral": _mixtral_family,
+    # HF model_type keys for the MLA architectures only — classic
+    # DeepSeek-MoE ("deepseek") uses conventional attention and would need
+    # its own family
+    "deepseek_v2": _deepseek_family,
+    "deepseek_v3": _deepseek_family,
 }
+
+
+def known_families() -> list[str]:
+    return sorted(_FAMILIES)
 
 
 def get_family(model_type: str) -> ModelFamily:

@@ -49,8 +49,10 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
 
     model_dir = Path(model_dir)
     hf_config = _json.loads((model_dir / "config.json").read_text())
+    from dynamo_tpu.models.registry import known_families
+
     model_type = hf_config.get("model_type", "llama")
-    family_name = model_type if model_type in ("llama", "qwen2", "qwen3", "mixtral") else "llama"
+    family_name = model_type if model_type in known_families() else "llama"
     family = get_family(family_name)
     cfg = family.config_from_hf(hf_config)
     defaults = dict(

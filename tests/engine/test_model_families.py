@@ -16,8 +16,13 @@ def test_registry_families():
     assert get_family("llama").name == "llama"
     assert get_family("qwen2").name == "qwen2"
     assert get_family("mixtral").name == "mixtral"
+    assert get_family("deepseek_v2").name == "deepseek"
+    assert get_family("deepseek_v3").name == "deepseek"
     with pytest.raises(ValueError, match="unknown model family"):
         get_family("gpt-oss")
+    # classic DeepSeek-MoE is conventional attention, not the MLA family
+    with pytest.raises(ValueError, match="unknown model family"):
+        get_family("deepseek")
 
 
 def test_qwen2_config_enables_bias():
@@ -64,5 +69,26 @@ async def test_qwen2_engine_generates():
     try:
         tokens, finish = await collect(engine, request(range(3, 10), max_tokens=4))
         assert len(tokens) == 4
+    finally:
+        engine.stop()
+
+
+async def test_deepseek_engine_generates():
+    """MLA family end-to-end: latent paged cache + absorbed decode served by
+    the unchanged engine/scheduler machinery."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    cfg = DeepseekConfig.tiny_mla()
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="deepseek_v2", num_blocks=32, block_size=4,
+            max_batch_size=2, prefill_buckets=(16,), max_model_len=32,
+        )
+    )
+    engine.start()
+    try:
+        tokens, finish = await collect(engine, request(range(3, 10), max_tokens=4))
+        assert len(tokens) == 4
+        assert finish is not None
     finally:
         engine.stop()
