@@ -42,6 +42,7 @@ async def run_bench() -> dict:
     prompt_len = int(os.environ.get("DYN_BENCH_ISL", "128"))
     output_len = int(os.environ.get("DYN_BENCH_OSL", "64"))
     max_batch = int(os.environ.get("DYN_BENCH_BATCH", "16"))
+    decode_steps = int(os.environ.get("DYN_BENCH_DECODE_STEPS", "4"))
 
     engine = JaxLlmEngine(
         EngineConfig(
@@ -51,6 +52,7 @@ async def run_bench() -> dict:
             max_batch_size=max_batch,
             max_model_len=prompt_len + output_len + 16,
             prefill_buckets=(prompt_len,),
+            decode_steps=decode_steps,
         )
     )
     engine.start()
@@ -115,6 +117,8 @@ async def run_bench() -> dict:
             "ttft_p50_ms": round(p50 * 1000, 1),
             "ttft_p99_ms": round(p99 * 1000, 1),
             "req_s": round(num_requests / wall, 3),
+            "decode_steps": decode_steps,
+            "batch": max_batch,
         },
     }
 

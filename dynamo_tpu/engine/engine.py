@@ -64,6 +64,12 @@ class EngineConfig:
     # "auto": Pallas paged-attention kernel on single-chip TPU, gather-based
     # XLA fallback otherwise.  "jax" | "pallas" | "pallas_interpret" force.
     attention_impl: str = "auto"
+    # Decode iterations fused into one jit launch (lax.scan with device-side
+    # token feedback + slot derivation).  >1 amortizes per-step dispatch and
+    # host↔device roundtrips — the dominant cost at small batch — at the
+    # price of emitting tokens in bursts of this size and wasting up to
+    # decode_steps-1 iterations on sequences that hit a stop mid-window.
+    decode_steps: int = 1
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -164,14 +170,7 @@ class JaxLlmEngine:
 
     def _build_decode(self):
         cfg = self.config.model
-
-        def step(params, cache, token_ids, block_tables, context_lens, slot_ids, rng, temp, top_k, top_p, greedy):
-            logits, cache = self.family.forward_decode(
-                params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
-                self.cos, self.sin, attention=self.attention_impl,
-            )
-            tokens = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
-            return tokens, cache
+        steps = self.config.decode_steps
 
         kwargs = {}
         if self.mesh is not None:
@@ -181,7 +180,51 @@ class JaxLlmEngine:
                 NamedSharding(self.mesh, PartitionSpec()),
                 self._cache_sharding,
             )
-        return jax.jit(step, donate_argnums=(1,), **kwargs)
+
+        if steps <= 1:
+            def step(params, cache, token_ids, block_tables, context_lens, slot_ids, rng, temp, top_k, top_p, greedy):
+                logits, cache = self.family.forward_decode(
+                    params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
+                    self.cos, self.sin, attention=self.attention_impl,
+                )
+                tokens = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
+                return tokens, cache
+
+            return jax.jit(step, donate_argnums=(1,), **kwargs)
+
+        # Fused multi-step decode: scan `steps` iterations on-device.  The
+        # sampled token feeds back without a host roundtrip; per-iteration
+        # cache slots are derived from the (pre-extended) block tables.
+        block_size = self.config.block_size
+        oob = self.config.num_blocks * block_size
+        max_pos = self.max_len - 1
+
+        def multi(params, cache, token_ids, block_tables, context_lens, rng, temp, top_k, top_p, greedy):
+            active = context_lens > 0
+
+            def body(carry, _):
+                tokens, cache, lens, rng = carry
+                rng, sub = jax.random.split(rng)
+                # block tables cover the window; overflow past max_len is
+                # clamped (garbage written to the final slot is discarded by
+                # the host's LENGTH finish)
+                pos = jnp.clip(lens - 1, 0, max_pos)
+                blk = jnp.take_along_axis(block_tables, (pos // block_size)[:, None], axis=1)[:, 0]
+                slots = jnp.where(active, blk * block_size + pos % block_size, oob)
+                logits, cache = self.family.forward_decode(
+                    params, cfg, tokens, cache, block_tables, lens, slots,
+                    self.cos, self.sin, attention=self.attention_impl,
+                )
+                tokens = sample_tokens(logits, sub, temp, top_k, top_p, greedy)
+                lens = jnp.where(active, lens + 1, lens)
+                return (tokens, cache, lens, rng), tokens
+
+            (_, cache, _, _), tokens_seq = jax.lax.scan(
+                body, (token_ids, cache, context_lens, rng), None, length=steps
+            )
+            return tokens_seq, cache  # [steps, lanes]
+
+        return jax.jit(multi, donate_argnums=(1,), **kwargs)
 
     def _build_extract(self):
         """Gather a sequence's KV blocks (padded to max_blocks_per_seq) for
@@ -485,39 +528,66 @@ class JaxLlmEngine:
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
         lanes = self.config.max_batch_size
+        steps = self.config.decode_steps
         token_ids = np.zeros((lanes,), np.int32)
         block_tables = np.zeros((lanes, self.max_blocks_per_seq), np.int32)
         context_lens = np.zeros((lanes,), np.int32)
         oob = self.config.num_blocks * self.config.block_size
         slot_ids = np.full((lanes,), oob, np.int32)
 
-        active: list[Sequence] = []
+        slots: dict[str, int] = {}
+        candidates: list[Sequence] = []
         for seq in list(seqs):
-            slot = self.scheduler.ensure_slot(seq)
+            if seq.status != SeqStatus.RUNNING:
+                continue  # preempted as a victim earlier in this loop
+            # pre-extend the block table to cover the whole decode window
+            # (when steps > 1 the device re-derives per-step slots from the
+            # block tables; the returned slot is then only an OOM signal)
+            slot = self.scheduler.ensure_slots(seq, steps, max_pos=self.max_len - 1)
             if slot is None:
                 # could not allocate even after preemption: preempt self
                 self.scheduler.preempt(seq)
                 continue
+            slots[seq.seq_id] = slot
+            candidates.append(seq)
+        # build arrays only after all allocations settled: a sequence
+        # preempted as a victim must not keep a live lane pointing at freed
+        # (possibly re-allocated) blocks
+        active = [s for s in candidates if s.status == SeqStatus.RUNNING]
+        for seq in active:
             lane = seq.lane
             token_ids[lane] = seq.all_token_ids[-1]
             blocks = self.allocator.block_ids(seq.seq_id)
             block_tables[lane, : len(blocks)] = blocks
             context_lens[lane] = seq.context_len
-            slot_ids[lane] = slot
-            active.append(seq)
+            if steps <= 1:
+                slot_ids[lane] = slots[seq.seq_id]
         if not active:
             return
 
         temp, top_k, top_p, greedy = self._sampling_arrays(active, lanes)
-        tokens, self.cache = self._jit_decode(
-            self.params, self.cache,
-            jnp.asarray(token_ids), jnp.asarray(block_tables),
-            jnp.asarray(context_lens), jnp.asarray(slot_ids), self._next_rng(),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
-        )
-        tokens_host = np.asarray(tokens)
-        for seq in active:
-            self._process_token(seq, int(tokens_host[seq.lane]))
+        if steps <= 1:
+            tokens, self.cache = self._jit_decode(
+                self.params, self.cache,
+                jnp.asarray(token_ids), jnp.asarray(block_tables),
+                jnp.asarray(context_lens), jnp.asarray(slot_ids), self._next_rng(),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
+            )
+            tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
+        else:
+            tokens, self.cache = self._jit_decode(
+                self.params, self.cache,
+                jnp.asarray(token_ids), jnp.asarray(block_tables),
+                jnp.asarray(context_lens), self._next_rng(),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
+            )
+            tokens_host = np.asarray(tokens)  # [steps, lanes]
+
+        for s in range(tokens_host.shape[0]):
+            for seq in active:
+                if seq.status != SeqStatus.RUNNING:
+                    continue  # finished at an earlier step in this window
+                self._process_token(seq, int(tokens_host[s, seq.lane]))
 
     def _process_token(self, seq: Sequence, token: int) -> None:
         seq.output_ids.append(token)

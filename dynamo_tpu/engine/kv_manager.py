@@ -83,14 +83,26 @@ class BlockAllocator:
     def append_slot(self, seq_id: str, context_len: int) -> int | None:
         """Slot (flat cache index) for token at position ``context_len - 1``,
         growing the block table if the token starts a new block.  None ⇒ OOM."""
+        return self.append_slots(seq_id, context_len, 1)
+
+    def append_slots(self, seq_id: str, context_len: int, steps: int,
+                     max_pos: int | None = None) -> int | None:
+        """Ensure the block table covers positions ``context_len - 1`` through
+        ``context_len - 2 + steps`` (multi-step decode pre-allocates the whole
+        window so the device can derive per-step slots from the block table).
+        Returns the first position's slot, or None on OOM (nothing grown
+        partially)."""
         seq = self._sequences[seq_id]
         pos = context_len - 1
-        block_idx = pos // self.block_size
-        if block_idx >= len(seq.block_ids):
-            if not self._free:
-                return None
+        last_pos = pos + steps - 1
+        if max_pos is not None:
+            last_pos = min(last_pos, max_pos)
+        needed = last_pos // self.block_size + 1 - len(seq.block_ids)
+        if needed > len(self._free):
+            return None
+        for _ in range(needed):
             seq.block_ids.append(self._free.popleft())
-        return seq.block_ids[block_idx] * self.block_size + pos % self.block_size
+        return seq.block_ids[pos // self.block_size] * self.block_size + pos % self.block_size
 
     def adopt_sequence(self, seq_id: str, block_ids: list[int]) -> None:
         """Register blocks reserved earlier (disagg: reserved before remote

@@ -117,8 +117,13 @@ class Scheduler:
     def ensure_slot(self, seq: Sequence) -> int | None:
         """Get the cache slot for this sequence's next token, preempting the
         youngest other running sequence if the pool is exhausted."""
+        return self.ensure_slots(seq, 1)
+
+    def ensure_slots(self, seq: Sequence, steps: int, max_pos: int | None = None) -> int | None:
+        """Like ensure_slot but pre-extends the block table to cover a
+        ``steps``-token decode window (positions capped at ``max_pos``)."""
         while True:
-            slot = self.allocator.append_slot(seq.seq_id, seq.context_len)
+            slot = self.allocator.append_slots(seq.seq_id, seq.context_len, steps, max_pos)
             if slot is not None:
                 return slot
             victim = self._youngest_other(seq)

@@ -182,3 +182,68 @@ async def test_pallas_attention_engine_matches_reference():
         assert tokens == greedy_reference(prompt, 5)
     finally:
         engine.stop()
+
+
+# ------------------------------------------------------------- multi-step
+
+
+async def test_multistep_decode_matches_single_step():
+    """decode_steps=4 (fused on-device loop) must produce exactly the same
+    greedy tokens as decode_steps=1."""
+    prompt = list(range(3, 10))
+    single = make_engine(decode_steps=1)
+    try:
+        tokens_1, finish_1 = await collect(single, request(prompt, max_tokens=11))
+    finally:
+        single.stop()
+    multi = make_engine(decode_steps=4)
+    try:
+        tokens_4, finish_4 = await collect(multi, request(prompt, max_tokens=11))
+    finally:
+        multi.stop()
+    assert tokens_4 == tokens_1
+    assert finish_4 == finish_1 == FinishReason.LENGTH
+
+
+async def test_multistep_decode_concurrent_and_stop_midwindow():
+    """Concurrent sequences with different lengths finish correctly even when
+    a stop lands mid-window; token counts are exact (no overshoot)."""
+    engine = make_engine(decode_steps=4, max_batch_size=4)
+    try:
+        results = await asyncio.gather(
+            collect(engine, request(range(3, 10), max_tokens=3)),   # mid-window
+            collect(engine, request(range(5, 14), max_tokens=9)),
+            collect(engine, request(range(2, 8), max_tokens=6)),
+        )
+        for (tokens, finish), expect in zip(results, (3, 9, 6)):
+            assert len(tokens) == expect
+            assert finish == FinishReason.LENGTH
+    finally:
+        engine.stop()
+
+
+async def test_multistep_greedy_matches_dense_reference():
+    """Fused decode must agree with dense full-recompute greedy decoding."""
+    prompt = list(range(3, 12))
+    engine = make_engine(decode_steps=4)
+    try:
+        tokens, _ = await collect(engine, request(prompt, max_tokens=8))
+    finally:
+        engine.stop()
+    assert tokens == greedy_reference(prompt, 8)
+
+
+async def test_multistep_decode_under_preemption():
+    """Tight block pool forces victim/self preemption mid-window; the
+    two-phase lane rebuild must keep output identical to dense greedy."""
+    engine = make_engine(decode_steps=4, max_batch_size=4, num_blocks=10, max_model_len=40)
+    try:
+        prompts = [list(range(3, 10)), list(range(5, 12)), list(range(2, 9))]
+        results = await asyncio.gather(
+            *[collect(engine, request(p, max_tokens=8)) for p in prompts]
+        )
+        for (tokens, finish), prompt in zip(results, prompts):
+            assert len(tokens) == 8
+            assert tokens == greedy_reference(prompt, 8)
+    finally:
+        engine.stop()
