@@ -28,6 +28,7 @@ from dynamo_tpu.runtime.controlplane.interface import (
     WatchEvent,
     WatchEventType,
 )
+from dynamo_tpu.runtime.controlplane.kv_cache import KvWatchCache
 from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
 from dynamo_tpu.runtime.controlplane.connect import connect_control_plane
 
@@ -35,6 +36,7 @@ __all__ = [
     "Bucket",
     "KVEntry",
     "KeyValueStore",
+    "KvWatchCache",
     "Lease",
     "Message",
     "MessageBus",

@@ -187,7 +187,27 @@ class RemoteKV(KeyValueStore):
             stream_id = await self._conn.call("kv.watch_prefix", prefix)
             self._conn.register_stream(stream_id, watch)
             watch._stream_id = stream_id  # type: ignore[attr-defined]
+            if watch._cancelled:  # cancelled before registration completed
+                await _release(stream_id)
 
+        async def _release(stream_id: int) -> None:
+            self._conn._streams.pop(stream_id, None)
+            try:
+                await self._conn.call("kv.cancel_watch", stream_id)
+            except ConnectionError:
+                pass
+
+        original_cancel = watch.cancel
+
+        def cancel() -> None:
+            # release the server-side registration too; otherwise the server
+            # keeps serializing and sending every matching event forever
+            original_cancel()
+            stream_id = getattr(watch, "_stream_id", None)
+            if stream_id is not None:
+                asyncio.ensure_future(_release(stream_id))
+
+        watch.cancel = cancel  # type: ignore[method-assign]
         asyncio.ensure_future(_start())
         return watch
 

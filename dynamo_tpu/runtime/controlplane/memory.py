@@ -45,9 +45,14 @@ class MemoryKV(KeyValueStore):
 
     # -- events ------------------------------------------------------------
     def _notify(self, event: WatchEvent) -> None:
-        for prefix, watch in list(self._watches):
+        live = []
+        for prefix, watch in self._watches:
+            if watch._cancelled:
+                continue  # prune dead registrations as we go
             if event.entry.key.startswith(prefix):
                 watch._emit(event)
+            live.append((prefix, watch))
+        self._watches = live
 
     def _ensure_reaper(self) -> None:
         if self._reaper is None or self._reaper.done():

@@ -214,3 +214,73 @@ async def test_object_store(plane_factory):
         assert await plane.bus.object_delete("models", "card.json") is False
     finally:
         await teardown(plane, server)
+
+
+async def test_kv_watch_cache(plane_factory):
+    """Snapshot-primed local reads, watch-driven updates, write-through."""
+    from dynamo_tpu.runtime.controlplane import KvWatchCache
+
+    plane, server = await make_plane(plane_factory)
+    cache = None
+    try:
+        await plane.kv.put("cfg/a", b"1")
+        await plane.kv.put("cfg/b", b"2")
+        await plane.kv.put("other/x", b"9")
+
+        cache = await KvWatchCache.create(plane.kv, "cfg/")
+        assert cache.get("a") == b"1" and cache.get("b") == b"2"
+        assert cache.get("x") is None  # outside the prefix
+        assert len(cache) == 2
+        assert not cache.stale
+
+        # external write lands via the watch
+        await plane.kv.put("cfg/c", b"3")
+        for _ in range(100):
+            if cache.get("c") == b"3":
+                break
+            await cache.wait_changed(timeout=0.05)
+        assert cache.get("c") == b"3"
+
+        # write-through visible locally at once and remotely
+        await cache.put("a", b"updated")
+        assert cache.get("a") == b"updated"
+        entry = await plane.kv.get("cfg/a")
+        assert entry.value == b"updated"
+
+        # external delete removes from the view
+        await plane.kv.delete("cfg/b")
+        for _ in range(100):
+            if cache.get("b") is None:
+                break
+            await cache.wait_changed(timeout=0.05)
+        assert cache.get("b") is None
+    finally:
+        if cache is not None:
+            await cache.close()
+        await teardown(plane, server)
+
+
+async def test_kv_watch_cache_goes_stale_on_watch_death(plane_factory):
+    """A dead backing watch flags the cache stale and wakes waiters instead
+    of serving silently-frozen data forever."""
+    from dynamo_tpu.runtime.controlplane import KvWatchCache
+
+    plane, server = await make_plane(plane_factory)
+    cache = None
+    try:
+        await plane.kv.put("cfg/a", b"1")
+        cache = await KvWatchCache.create(plane.kv, "cfg/")
+        assert not cache.stale
+        # kill the watch out from under the cache (connection-loss analog)
+        cache._watch.cancel()
+        for _ in range(100):
+            if cache.stale:
+                break
+            await cache.wait_changed(timeout=0.05)
+        assert cache.stale
+        # waiters are not stuck: wait_changed returns promptly
+        assert await cache.wait_changed(timeout=1) is not None
+    finally:
+        if cache is not None:
+            await cache.close()
+        await teardown(plane, server)
