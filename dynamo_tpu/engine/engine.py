@@ -41,7 +41,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
-from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.ops.sampling import apply_penalties, sample_tokens
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
@@ -129,6 +129,20 @@ class JaxLlmEngine:
             self.cache = jax.device_put(raw_cache)
         self.cos, self.sin = self.family.rope_tables(cfg)
 
+        # per-lane sampling state: generated-token counts (presence/frequency
+        # penalties), prompt-token counts (repetition penalty scope), and
+        # per-lane PRNG keys (OpenAI `seed` reproducibility)
+        lanes = config.max_batch_size
+        self._gen_counts = jax.device_put(jnp.zeros((lanes, cfg.vocab_size), jnp.int32))
+        self._prompt_counts = jax.device_put(jnp.zeros((lanes, cfg.vocab_size), jnp.int32))
+        self._lane_keys = np.zeros((lanes, 2), np.uint32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            self._gen_counts = jax.device_put(self._gen_counts, repl)
+            self._prompt_counts = jax.device_put(self._prompt_counts, repl)
+
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event
         )
@@ -145,52 +159,80 @@ class JaxLlmEngine:
         self._jit_decode = self._build_decode()
         self._jit_extract = self._build_extract()
         self._jit_inject = self._build_inject()
+        set_row_kwargs = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            set_row_kwargs["out_shardings"] = NamedSharding(self.mesh, PartitionSpec())
+        self._jit_set_row = jax.jit(
+            lambda counts, lane, row: counts.at[lane].set(row),
+            donate_argnums=(0,), **set_row_kwargs,
+        )
 
     # -- jitted steps ------------------------------------------------------
     def _build_prefill(self):
         cfg = self.config.model
+        vocab = cfg.vocab_size
 
-        def step(params, cache, token_ids, block_ids, seq_len, start_pos, rng, temp, top_k, top_p, greedy):
+        def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
+                 block_ids, seq_len, start_pos, key, temp, top_k, top_p, greedy,
+                 pres, freq, rep):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
                 self.cos, self.sin,
             )
-            token = sample_tokens(logits[None], rng, temp, top_k, top_p, greedy)[0]
-            return token, cache
+            # (re)seed this lane's sampling state from the prompt
+            seq_pad = token_ids.shape[0]
+            valid = (jnp.arange(seq_pad) < seq_len).astype(jnp.int32)
+            prompt_row = jnp.zeros((vocab,), jnp.int32).at[token_ids].add(valid, mode="drop")
+            prompt_counts = prompt_counts.at[lane].set(prompt_row)
+            gen_counts = gen_counts.at[lane].set(0)
+            plogits = apply_penalties(
+                logits[None], gen_counts[lane][None], prompt_row[None], pres, freq, rep
+            )
+            step_key = jax.random.fold_in(key, seq_len)
+            token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            gen_counts = gen_counts.at[lane, token].add(1)
+            return token, cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            kwargs["out_shardings"] = (
-                NamedSharding(self.mesh, PartitionSpec()),
-                self._cache_sharding,
-            )
-        return jax.jit(step, donate_argnums=(1,), **kwargs)
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+        return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_decode(self):
         cfg = self.config.model
         steps = self.config.decode_steps
 
+        lanes = self.config.max_batch_size
+        lane_idx = jnp.arange(lanes)
+
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            kwargs["out_shardings"] = (
-                NamedSharding(self.mesh, PartitionSpec()),
-                self._cache_sharding,
-            )
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kwargs["out_shardings"] = (repl, self._cache_sharding, repl)
 
         if steps <= 1:
-            def step(params, cache, token_ids, block_tables, context_lens, slot_ids, rng, temp, top_k, top_p, greedy):
+            def step(params, cache, gen_counts, prompt_counts, token_ids,
+                     block_tables, context_lens, slot_ids, keys, temp, top_k,
+                     top_p, greedy, pres, freq, rep):
                 logits, cache = self.family.forward_decode(
                     params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
                     self.cos, self.sin, attention=self.attention_impl,
                 )
-                tokens = sample_tokens(logits, rng, temp, top_k, top_p, greedy)
-                return tokens, cache
+                logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
+                step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
+                tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
+                active = (context_lens > 0).astype(jnp.int32)
+                gen_counts = gen_counts.at[lane_idx, tokens].add(active)
+                return tokens, cache, gen_counts
 
-            return jax.jit(step, donate_argnums=(1,), **kwargs)
+            return jax.jit(step, donate_argnums=(1, 2), **kwargs)
 
         # Fused multi-step decode: scan `steps` iterations on-device.  The
         # sampled token feeds back without a host roundtrip; per-iteration
@@ -199,12 +241,14 @@ class JaxLlmEngine:
         oob = self.config.num_blocks * block_size
         max_pos = self.max_len - 1
 
-        def multi(params, cache, token_ids, block_tables, context_lens, rng, temp, top_k, top_p, greedy):
+        def multi(params, cache, gen_counts, prompt_counts, token_ids,
+                  block_tables, context_lens, keys, temp, top_k, top_p, greedy,
+                  pres, freq, rep):
             active = context_lens > 0
+            active_i = active.astype(jnp.int32)
 
             def body(carry, _):
-                tokens, cache, lens, rng = carry
-                rng, sub = jax.random.split(rng)
+                tokens, cache, gen_counts, lens = carry
                 # block tables cover the window; overflow past max_len is
                 # clamped (garbage written to the final slot is discarded by
                 # the host's LENGTH finish)
@@ -215,16 +259,19 @@ class JaxLlmEngine:
                     params, cfg, tokens, cache, block_tables, lens, slots,
                     self.cos, self.sin, attention=self.attention_impl,
                 )
-                tokens = sample_tokens(logits, sub, temp, top_k, top_p, greedy)
+                logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
+                step_keys = jax.vmap(jax.random.fold_in)(keys, lens)
+                tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
+                gen_counts = gen_counts.at[lane_idx, tokens].add(active_i)
                 lens = jnp.where(active, lens + 1, lens)
-                return (tokens, cache, lens, rng), tokens
+                return (tokens, cache, gen_counts, lens), tokens
 
-            (_, cache, _, _), tokens_seq = jax.lax.scan(
-                body, (token_ids, cache, context_lens, rng), None, length=steps
+            (_, cache, gen_counts, _), tokens_seq = jax.lax.scan(
+                body, (token_ids, cache, gen_counts, context_lens), None, length=steps
             )
-            return tokens_seq, cache  # [steps, lanes]
+            return tokens_seq, cache, gen_counts  # [steps, lanes]
 
-        return jax.jit(multi, donate_argnums=(1,), **kwargs)
+        return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
 
     def _build_extract(self):
         """Gather a sequence's KV blocks (padded to max_blocks_per_seq) for
@@ -393,6 +440,19 @@ class JaxLlmEngine:
 
         return ResponseStream(gen(), ctx)
 
+    async def clear_kv_blocks(self) -> None:
+        """Admin flush: drop published prefix-cache state (runs on the device
+        thread to serialize with the allocator)."""
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def done() -> None:
+            loop.call_soon_threadsafe(lambda: fut.set_result(None) if not fut.done() else None)
+
+        self._submit_q.put(("clear_kv", done))
+        self._wake.set()
+        await fut
+
     # -- stats / events ----------------------------------------------------
     def _sink_event(self, event: KvEvent) -> None:
         if self._event_sink is not None:
@@ -444,6 +504,12 @@ class JaxLlmEngine:
                     seq.status = SeqStatus.FINISHED
                     if seq.emit:
                         seq.emit([], FinishReason.CANCELLED)
+            elif op == "clear_kv":
+                done = seq  # payload is the completion callback
+                cleared = self.allocator.clear_published()
+                logger.info("cleared %d published kv block hashes", cleared)
+                if done is not None:
+                    done()
             elif op == "inject":
                 block_ids, k_np, v_np, done = seq  # payload tuple
                 n = len(block_ids)
@@ -475,20 +541,57 @@ class JaxLlmEngine:
         top_k = np.zeros((lanes,), np.int32)
         top_p = np.ones((lanes,), np.float32)
         greedy = np.ones((lanes,), bool)
+        pres = np.zeros((lanes,), np.float32)
+        freq = np.zeros((lanes,), np.float32)
+        rep = np.ones((lanes,), np.float32)
         for i, seq in enumerate(seqs):
             s = seq.request.sampling
-            lane = seq.lane if lanes > 1 else 0
-            temp[lane if lanes > 1 else i] = s.temperature if s.temperature is not None else 0.0
-            top_k[lane if lanes > 1 else i] = s.top_k or 0
-            top_p[lane if lanes > 1 else i] = s.top_p if s.top_p is not None else 1.0
-            greedy[lane if lanes > 1 else i] = bool(
+            lane = seq.lane if lanes > 1 else i
+            temp[lane] = s.temperature if s.temperature is not None else 0.0
+            top_k[lane] = s.top_k or 0
+            top_p[lane] = s.top_p if s.top_p is not None else 1.0
+            greedy[lane] = bool(
                 s.use_greedy or s.temperature is None or s.temperature <= 0.0
             )
-        return temp, top_k, top_p, greedy
+            pres[lane] = s.presence_penalty or 0.0
+            freq[lane] = s.frequency_penalty or 0.0
+            rep[lane] = s.repetition_penalty if s.repetition_penalty else 1.0
+        return temp, top_k, top_p, greedy, pres, freq, rep
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _seed_lane_state(self, seq: Sequence) -> None:
+        """Initialize a lane's penalty counts + rng key for a sequence that
+        skipped local prefill (disagg decode side)."""
+        vocab = self.config.model.vocab_size
+        prompt_row = np.bincount(
+            np.asarray(seq.request.token_ids, np.int64) % vocab, minlength=vocab
+        ).astype(np.int32)
+        if seq.output_ids:
+            gen_row = np.bincount(
+                np.asarray(seq.output_ids, np.int64) % vocab, minlength=vocab
+            ).astype(np.int32)
+        else:
+            gen_row = np.zeros((vocab,), np.int32)
+        lane = jnp.int32(seq.lane)
+        self._prompt_counts = self._jit_set_row(self._prompt_counts, lane, jnp.asarray(prompt_row))
+        self._gen_counts = self._jit_set_row(self._gen_counts, lane, jnp.asarray(gen_row))
+        self._seed_lane_key(seq)
+        seq.sampling_seeded = True
+
+    def _seed_lane_key(self, seq: Sequence) -> np.ndarray:
+        """Per-lane PRNG key: derived from the request seed when given
+        (reproducible sampling), else from the engine stream."""
+        seed = seq.request.sampling.seed
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        else:
+            key = self._next_rng()
+        row = np.asarray(key, np.uint32)
+        self._lane_keys[seq.lane if seq.lane >= 0 else 0] = row
+        return row
 
     def _run_prefill(self, seq: Sequence) -> None:
         tokens = seq.all_token_ids
@@ -499,13 +602,17 @@ class JaxLlmEngine:
         block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
         blocks = self.allocator.block_ids(seq.seq_id)
         block_ids[: len(blocks)] = blocks
-        temp, top_k, top_p, greedy = self._sampling_arrays([seq], 1)
+        temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays([seq], 1)
+        key = self._seed_lane_key(seq)
+        seq.sampling_seeded = True
+        lane = max(seq.lane, 0)  # prefill_only sequences have no decode lane
 
-        token, self.cache = self._jit_prefill(
-            self.params, self.cache,
-            jnp.asarray(padded), jnp.asarray(block_ids),
-            jnp.int32(n), jnp.int32(0), self._next_rng(),
+        token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
+            self.params, self.cache, self._gen_counts, self._prompt_counts,
+            jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
+            jnp.int32(n), jnp.int32(0), jnp.asarray(key),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
+            jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep),
         )
         if seq.prefill_only:
             # disagg prefill worker: hand back first token + the KV blocks
@@ -555,6 +662,9 @@ class JaxLlmEngine:
         # (possibly re-allocated) blocks
         active = [s for s in candidates if s.status == SeqStatus.RUNNING]
         for seq in active:
+            if not seq.sampling_seeded:
+                # remotely-prefilled: entered decode without a local prefill
+                self._seed_lane_state(seq)
             lane = seq.lane
             token_ids[lane] = seq.all_token_ids[-1]
             blocks = self.allocator.block_ids(seq.seq_id)
@@ -565,21 +675,24 @@ class JaxLlmEngine:
         if not active:
             return
 
-        temp, top_k, top_p, greedy = self._sampling_arrays(active, lanes)
+        temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays(active, lanes)
+        sampling_tail = (
+            jnp.asarray(self._lane_keys), jnp.asarray(temp), jnp.asarray(top_k),
+            jnp.asarray(top_p), jnp.asarray(greedy), jnp.asarray(pres),
+            jnp.asarray(freq), jnp.asarray(rep),
+        )
         if steps <= 1:
-            tokens, self.cache = self._jit_decode(
-                self.params, self.cache,
+            tokens, self.cache, self._gen_counts = self._jit_decode(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
-                jnp.asarray(context_lens), jnp.asarray(slot_ids), self._next_rng(),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
+                jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
         else:
-            tokens, self.cache = self._jit_decode(
-                self.params, self.cache,
+            tokens, self.cache, self._gen_counts = self._jit_decode(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
-                jnp.asarray(context_lens), self._next_rng(),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
+                jnp.asarray(context_lens), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)  # [steps, lanes]
 

@@ -22,7 +22,7 @@ from dynamo_tpu.llm.kv_router.hashing import HASH_SEED, compute_block_hashes  # 
 
 @dataclass
 class KvEvent:
-    kind: str                    # "stored" | "removed"
+    kind: str                    # "stored" | "removed" | "cleared"
     block_hashes: list[int]
     parent_hash: int | None = None
     token_count: int = 0
@@ -132,6 +132,19 @@ class BlockAllocator:
             self._free.append(b)
         if seq.published_hashes and self.event_sink:
             self.event_sink(KvEvent(kind="removed", block_hashes=list(seq.published_hashes)))
+
+    def clear_published(self) -> int:
+        """Admin flush (reference: http clear_kv_blocks): forget every
+        published block hash and tell routers this worker's cache is gone.
+        Running sequences keep their blocks; their hashes simply re-publish
+        as future blocks complete."""
+        cleared = 0
+        for seq in self._sequences.values():
+            cleared += len(seq.published_hashes)
+            seq.published_hashes = []
+        if self.event_sink:
+            self.event_sink(KvEvent(kind="cleared", block_hashes=[]))
+        return cleared
 
     # -- events ------------------------------------------------------------
     def publish_stored(self, seq_id: str, token_ids: list[int]) -> None:

@@ -28,6 +28,8 @@ class Sequence:
     # disaggregation modes
     prefill_only: bool = False       # prefill worker: stop after first token
     remote_prefilled: bool = False   # decode worker: KV already injected
+    # per-lane sampling state (penalty counts, rng key) initialized?
+    sampling_seeded: bool = False
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only

@@ -98,6 +98,7 @@ class ModelWatcher:
         self._task: asyncio.Task | None = None
         # model name -> set of entry keys backing it
         self._backing: dict[str, set[str]] = {}
+        self._entries: dict[str, ModelEntry] = {}  # entry key -> entry
         self._pipelines: dict[str, dict] = {}  # model name -> {"router": ..., "kv": ...}
 
     async def start(self) -> None:

@@ -104,4 +104,5 @@ class KvHitRateEvent:
 
 KV_EVENT_SUBJECT = "kv_events"
 LOAD_METRICS_SUBJECT = "load_metrics"
+CLEAR_KV_SUBJECT = "clear_kv_blocks"
 KV_HIT_RATE_SUBJECT = "kv_hit_rate"

@@ -105,3 +105,39 @@ class WorkerMetricsPublisher:
             except Exception:  # noqa: BLE001
                 logger.exception("failed to publish metrics")
             await asyncio.sleep(self.period_s)
+
+
+class ClearKvListener:
+    """Worker-side subscriber for the admin cache-flush broadcast (reference:
+    clear_kv_blocks admin endpoint, lib/llm/src/http/service/clear_kv_blocks.rs).
+
+    The frontend publishes on the component's ``clear_kv_blocks`` event
+    subject; every worker of that component flushes its published prefix
+    state (which also emits a "cleared" RouterEvent to the indexers)."""
+
+    def __init__(self, component: Component, engine):
+        from dynamo_tpu.llm.kv_router.protocols import CLEAR_KV_SUBJECT
+
+        self.component = component
+        self.engine = engine
+        self.subject = component.event_subject(CLEAR_KV_SUBJECT)
+        self._task: asyncio.Task | None = None
+        self._sub = None
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._loop())
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        bus = self.component.runtime.plane.bus
+        self._sub = await bus.subscribe(self.subject)
+        async for _msg in self._sub:
+            try:
+                await self.engine.clear_kv_blocks()
+            except Exception:  # noqa: BLE001
+                logger.exception("clear_kv_blocks failed")

@@ -15,13 +15,17 @@ NEG_INF = -1e30
 
 def sample_tokens(
     logits: jnp.ndarray,        # [batch, vocab] (any float dtype)
-    rng: jax.Array,
+    rng: jax.Array,             # one key [2] (split per lane) or per-lane keys [batch, 2]
     temperature: jnp.ndarray,   # [batch] float32; <=0 treated as greedy
     top_k: jnp.ndarray,         # [batch] int32; <=0 disables
     top_p: jnp.ndarray,         # [batch] float32; >=1 disables
     greedy: jnp.ndarray,        # [batch] bool
 ) -> jnp.ndarray:
-    """Returns sampled token ids [batch] int32."""
+    """Returns sampled token ids [batch] int32.
+
+    Per-lane keys make sampling reproducible per request (OpenAI ``seed``):
+    lane i draws only from its own key stream regardless of batch
+    composition."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -44,7 +48,11 @@ def sample_tokens(
 
     filtered_sorted = jnp.where(keep, sorted_logits, NEG_INF)
     # sample in sorted space, map back through sort_idx
-    choice = jax.random.categorical(rng, filtered_sorted, axis=-1)
+    if rng.ndim == 1:
+        keys = jax.random.split(rng, b)
+    else:
+        keys = rng
+    choice = jax.vmap(lambda k, lg: jax.random.categorical(k, lg))(keys, filtered_sorted)
     sampled_ids = jnp.take_along_axis(sort_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
     return jnp.where(force_greedy, greedy_ids, sampled_ids)
@@ -52,16 +60,21 @@ def sample_tokens(
 
 def apply_penalties(
     logits: jnp.ndarray,            # [batch, vocab]
-    output_counts: jnp.ndarray,     # [batch, vocab] int32: tokens generated so far
+    gen_counts: jnp.ndarray,        # [batch, vocab] int32: tokens generated so far
+    prompt_counts: jnp.ndarray,     # [batch, vocab] int32: prompt token counts
     presence_penalty: jnp.ndarray,  # [batch]
     frequency_penalty: jnp.ndarray,  # [batch]
     repetition_penalty: jnp.ndarray,  # [batch]; 1.0 disables
 ) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties apply to *generated* tokens; the
+    HF-style repetition penalty applies to everything seen (prompt +
+    generated)."""
     logits = logits.astype(jnp.float32)
-    appeared = (output_counts > 0).astype(jnp.float32)
-    logits = logits - presence_penalty[:, None] * appeared
-    logits = logits - frequency_penalty[:, None] * output_counts.astype(jnp.float32)
+    generated = (gen_counts > 0).astype(jnp.float32)
+    logits = logits - presence_penalty[:, None] * generated
+    logits = logits - frequency_penalty[:, None] * gen_counts.astype(jnp.float32)
+    seen = (gen_counts > 0) | (prompt_counts > 0)
     rep = repetition_penalty[:, None]
     penalized = jnp.where(logits > 0, logits / rep, logits * rep)
-    logits = jnp.where(appeared > 0, penalized, logits)
+    logits = jnp.where(seen, penalized, logits)
     return logits
