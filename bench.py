@@ -1,11 +1,19 @@
-"""Offline serving throughput benchmark (single chip).
+"""Offline serving throughput benchmark (single chip) with MFU.
 
-Drives the native JAX engine with a continuous-batching workload (random
-prompts, fixed output budget, eos ignored) and reports decode throughput in
-generated tokens/s/chip.  ``vs_baseline`` compares against the reference's
-headline disaggregated H100 number (145 tok/s/GPU @45 tok/s/user,
-BASELINE.md) — not SLA-matched yet, but tracked consistently round over
-round.
+Geometry matches the reference's headline benchmark: 8B-class model,
+ISL 3000 / OSL 150 (reference: examples/llm/benchmarks/README.md:309-319,
+benchmarks/llm/perf.sh:23-29).  Reports generated tokens/s/chip, MFU
+against the chip's peak bf16 FLOPs, and TTFT percentiles.  ``vs_baseline``
+compares against the reference's 145 tok/s/GPU disaggregated H100 number
+(BASELINE.md).
+
+Robustness (the round-1/2 bench crashed in engine init on a flaky TPU
+tunnel): the parent process re-runs the measurement child with bounded
+retries, and falls back to a small CPU geometry if the accelerator never
+comes up — the bench always exits 0 with one parseable JSON line.
+
+If the 8B geometry does not fit the chip's HBM the child steps down the
+model ladder (8B → 3B → 1B) and reports which model actually ran.
 
 Prints exactly one JSON line on stdout.
 """
@@ -15,13 +23,41 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_TOK_S_PER_GPU = 145.0
 
+# peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
+PEAK_FLOPS = [
+    ("v6", 918e12),       # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),       # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-async def run_bench() -> dict:
+MODEL_LADDER = ["llama3_8b", "llama32_3b", "llama32_1b"]
+
+
+def _peak_flops(device_kind: str, platform: str) -> float | None:
+    kind = device_kind.lower()
+    if platform != "tpu":
+        return None
+    for key, flops in PEAK_FLOPS:
+        if key in kind:
+            return flops
+    return 197e12  # unknown TPU: assume v5e-class
+
+
+def _is_oom(err: BaseException) -> bool:
+    msg = str(err).lower()
+    return "resource_exhausted" in msg or "out of memory" in msg or "oom" in msg
+
+
+async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
     import jax
     import numpy as np
 
@@ -36,29 +72,47 @@ async def run_bench() -> dict:
     from dynamo_tpu.models.llama import LlamaConfig
     from dynamo_tpu.runtime.engine import Context
 
-    model_name = os.environ.get("DYN_BENCH_MODEL", "llama32_1b")
     cfg = getattr(LlamaConfig, model_name)()
-    num_requests = int(os.environ.get("DYN_BENCH_REQUESTS", "32"))
-    prompt_len = int(os.environ.get("DYN_BENCH_ISL", "128"))
-    output_len = int(os.environ.get("DYN_BENCH_OSL", "64"))
-    max_batch = int(os.environ.get("DYN_BENCH_BATCH", "16"))
-    decode_steps = int(os.environ.get("DYN_BENCH_DECODE_STEPS", "4"))
+    if fallback_cpu:
+        num_requests = int(os.environ.get("DYN_BENCH_REQUESTS", "8"))
+        prompt_len = int(os.environ.get("DYN_BENCH_ISL", "64"))
+        output_len = int(os.environ.get("DYN_BENCH_OSL", "32"))
+        max_batch = int(os.environ.get("DYN_BENCH_BATCH", "4"))
+        decode_steps = int(os.environ.get("DYN_BENCH_DECODE_STEPS", "4"))
+    else:
+        num_requests = int(os.environ.get("DYN_BENCH_REQUESTS", "24"))
+        prompt_len = int(os.environ.get("DYN_BENCH_ISL", "3000"))
+        output_len = int(os.environ.get("DYN_BENCH_OSL", "150"))
+        max_batch = int(os.environ.get("DYN_BENCH_BATCH", "8"))
+        decode_steps = int(os.environ.get("DYN_BENCH_DECODE_STEPS", "8"))
 
+    max_len = prompt_len + output_len + 16
+    block_size = 16
+    per_seq_blocks = (max_len + block_size - 1) // block_size
+    num_blocks = int(
+        os.environ.get("DYN_BENCH_BLOCKS", per_seq_blocks * max_batch + 32)
+    )
+
+    t_init = time.monotonic()
     engine = JaxLlmEngine(
         EngineConfig(
             model=cfg,
-            num_blocks=int(os.environ.get("DYN_BENCH_BLOCKS", "512")),
-            block_size=16,
+            num_blocks=num_blocks,
+            block_size=block_size,
             max_batch_size=max_batch,
-            max_model_len=prompt_len + output_len + 16,
+            max_model_len=max_len,
             prefill_buckets=(prompt_len,),
             decode_steps=decode_steps,
         )
     )
     engine.start()
+    print(
+        f"bench: engine up ({model_name}) in {time.monotonic()-t_init:.1f}s",
+        file=sys.stderr,
+    )
     rng = np.random.default_rng(0)
 
-    def make_request(i: int) -> dict:
+    def make_request() -> dict:
         tokens = rng.integers(10, cfg.vocab_size - 10, size=prompt_len).tolist()
         return PreprocessedRequest(
             token_ids=tokens,
@@ -80,15 +134,18 @@ async def run_bench() -> dict:
                 count += len(ann.data.token_ids)
         return count, ttft or 0.0
 
-    # warmup: trigger prefill + decode compiles
+    # warmup: trigger prefill + decode compiles (first device use — a crash
+    # here is retried by the parent)
     print("bench: warming up (compiles)...", file=sys.stderr)
     t0 = time.monotonic()
-    await drive(make_request(-1))
+    await drive(make_request())
     print(f"bench: warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
 
     t0 = time.monotonic()
-    results = await asyncio.gather(*[drive(make_request(i)) for i in range(num_requests)])
+    results = await asyncio.gather(*[drive(make_request()) for _ in range(num_requests)])
     wall = time.monotonic() - t0
+
+    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(engine.params))
     engine.stop()
 
     total_tokens = sum(c for c, _ in results)
@@ -96,35 +153,141 @@ async def run_bench() -> dict:
     tok_s = total_tokens / wall
     p50 = ttfts[len(ttfts) // 2]
     p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    # model FLOPs: 2*P per token (matmuls) + 4*L*H*D*ctx attention per token
+    # (QK^T and AV, 2 flops/MAC each); summed exactly over every position of
+    # every request.  MFU is total FLOPs over wall time at the chip's peak.
+    dev = jax.devices()[0]
+    total_len = prompt_len + output_len
+    attn_coeff = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim
+    flops_per_req = 2.0 * n_params * total_len + attn_coeff * total_len * (total_len - 1) / 2.0
+    total_flops = flops_per_req * num_requests
+    peak = _peak_flops(dev.device_kind, dev.platform)
+    mfu = (total_flops / wall / peak) if peak else None
+
     print(
         f"bench: {num_requests} reqs isl={prompt_len} osl={output_len} "
         f"wall={wall:.2f}s tokens={total_tokens} tok/s={tok_s:.1f} "
+        f"mfu={mfu if mfu is None else round(mfu, 4)} "
         f"ttft p50={p50*1000:.0f}ms p99={p99*1000:.0f}ms "
-        f"req/s={num_requests/wall:.2f} platform={jax.devices()[0].platform}",
+        f"req/s={num_requests/wall:.2f} platform={dev.platform} kind={dev.device_kind}",
         file=sys.stderr,
     )
     return {
         "metric": "decode_tok_s_per_chip",
         "value": round(tok_s, 2),
         "unit": "tok/s/chip",
-        "vs_baseline": round(tok_s / BASELINE_TOK_S_PER_GPU, 3),
+        "vs_baseline": 0.0 if fallback_cpu else round(tok_s / BASELINE_TOK_S_PER_GPU, 3),
         "detail": {
             "model": model_name,
+            "n_params": n_params,
             "num_requests": num_requests,
             "isl": prompt_len,
             "osl": output_len,
             "wall_s": round(wall, 2),
+            "mfu": None if mfu is None else round(mfu, 4),
+            "total_tflops": round(total_flops / 1e12, 1),
             "ttft_p50_ms": round(p50 * 1000, 1),
             "ttft_p99_ms": round(p99 * 1000, 1),
             "req_s": round(num_requests / wall, 3),
             "decode_steps": decode_steps,
             "batch": max_batch,
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "cpu_fallback": fallback_cpu,
         },
     }
 
 
-def main() -> None:
+async def run_bench() -> dict:
+    fallback_cpu = os.environ.get("DYN_BENCH_FALLBACK_CPU") == "1"
+    forced = os.environ.get("DYN_BENCH_MODEL")
+    if fallback_cpu:
+        ladder = [forced or "tiny"]
+    else:
+        ladder = [forced] if forced else list(MODEL_LADDER)
+    last_err: BaseException | None = None
+    for model_name in ladder:
+        try:
+            return await _run_model(model_name, fallback_cpu=fallback_cpu)
+        except Exception as err:  # OOM: step down the ladder; else re-raise
+            if _is_oom(err) and model_name != ladder[-1]:
+                print(
+                    f"bench: {model_name} does not fit ({err!r:.200}); stepping down",
+                    file=sys.stderr,
+                )
+                last_err = err
+                continue
+            raise
+    raise last_err  # pragma: no cover
+
+
+def child_main() -> None:
     result = asyncio.run(run_bench())
+    print(json.dumps(result))
+    sys.stdout.flush()
+
+
+def _try_child(env: dict, timeout: float) -> dict | None:
+    """Run one measurement child; return its parsed JSON line or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: child timed out", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in parsed:
+                return parsed
+    print(f"bench: child exited rc={proc.returncode} with no result", file=sys.stderr)
+    return None
+
+
+def main() -> None:
+    if "--child" in sys.argv:
+        child_main()
+        return
+
+    attempt_timeout = float(os.environ.get("DYN_BENCH_ATTEMPT_TIMEOUT", "1500"))
+    tpu_attempts = int(os.environ.get("DYN_BENCH_ATTEMPTS", "2"))
+    for attempt in range(tpu_attempts):
+        print(f"bench: attempt {attempt + 1}/{tpu_attempts}", file=sys.stderr)
+        result = _try_child(dict(os.environ), attempt_timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        time.sleep(20)
+
+    # accelerator never produced a result: CPU fallback so the round still
+    # records a parseable (clearly-marked) data point instead of rc=1
+    print("bench: falling back to CPU geometry", file=sys.stderr)
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        DYN_BENCH_FALLBACK_CPU="1",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS=env.get("XLA_FLAGS", ""),
+    )
+    result = _try_child(env, min(attempt_timeout, 900.0))
+    if result is None:
+        result = {
+            "metric": "decode_tok_s_per_chip",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+            "detail": {"error": "all bench attempts failed"},
+        }
     print(json.dumps(result))
 
 

@@ -18,6 +18,7 @@ Architecture:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import queue as thread_queue
 import threading
 import time
@@ -99,20 +100,39 @@ class JaxLlmEngine:
             self.mesh = make_mesh(config.mesh)
 
         if config.attention_impl == "auto":
-            self.attention_impl = (
-                "pallas" if (jax.default_backend() == "tpu" and self.mesh is None) else "jax"
-            )
+            # a wedged accelerator plugin must not crash engine construction
+            # (this probe was the round-1 bench crash site): fall back to the
+            # portable path and let first device use surface the real error
+            try:
+                backend = jax.default_backend()
+            except Exception:  # RuntimeError: unable to initialize backend
+                logger.warning("backend probe failed; using gather-based attention")
+                backend = "unknown"
+            self.attention_impl = "pallas" if (backend == "tpu" and self.mesh is None) else "jax"
         else:
             self.attention_impl = config.attention_impl
 
-        rng = jax.random.PRNGKey(config.seed)
-        self._rng = jax.random.fold_in(rng, 1)
-        raw_params = params if params is not None else self.family.init_params(cfg, rng)
-        raw_cache = self.family.cache_init(
-            cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
-        )
+        # All eager init work (param RNG, cache zeros, rope tables) runs on
+        # the host CPU backend, then moves to the accelerator with one
+        # device_put per leaf.  Eager on-device init was the round-2 bench
+        # crash site: every jax.random.normal became a remote-compile RPC.
+        try:
+            cpu0 = jax.local_devices(backend="cpu")[0]
+            host_ctx = jax.default_device(cpu0)
+        except Exception:
+            host_ctx = contextlib.nullcontext()
+        with host_ctx:
+            rng = jax.random.PRNGKey(config.seed)
+            raw_params = params if params is not None else self.family.init_params(cfg, rng)
+            raw_cache = self.family.cache_init(
+                cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
+            )
+            cos, sin = self.family.rope_tables(cfg)
+            lanes = config.max_batch_size
+            gen_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
+            prompt_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
         if self.mesh is not None:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec
 
             self._param_shardings = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), self.family.param_specs(cfg)
@@ -122,26 +142,29 @@ class JaxLlmEngine:
             )
             self.params = jax.tree.map(jax.device_put, raw_params, self._param_shardings)
             self.cache = jax.tree.map(jax.device_put, raw_cache, self._cache_sharding)
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            self.cos = jax.device_put(cos, repl)
+            self.sin = jax.device_put(sin, repl)
         else:
             self._param_shardings = None
             self._cache_sharding = None
-            self.params = jax.device_put(raw_params)
-            self.cache = jax.device_put(raw_cache)
-        self.cos, self.sin = self.family.rope_tables(cfg)
+            self.params = jax.tree.map(jax.device_put, raw_params)
+            self.cache = jax.tree.map(jax.device_put, raw_cache)
+            self.cos = jax.device_put(cos)
+            self.sin = jax.device_put(sin)
 
         # per-lane sampling state: generated-token counts (presence/frequency
         # penalties), prompt-token counts (repetition penalty scope), and
-        # per-lane PRNG keys (OpenAI `seed` reproducibility)
-        lanes = config.max_batch_size
-        self._gen_counts = jax.device_put(jnp.zeros((lanes, cfg.vocab_size), jnp.int32))
-        self._prompt_counts = jax.device_put(jnp.zeros((lanes, cfg.vocab_size), jnp.int32))
+        # per-lane PRNG keys (OpenAI `seed` reproducibility).  Lane keys are
+        # produced host-side (no device RNG in the request path).
+        self._host_rng = np.random.Generator(np.random.PCG64(config.seed))
         self._lane_keys = np.zeros((lanes, 2), np.uint32)
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            repl = NamedSharding(self.mesh, PartitionSpec())
-            self._gen_counts = jax.device_put(self._gen_counts, repl)
-            self._prompt_counts = jax.device_put(self._prompt_counts, repl)
+            self._gen_counts = jax.device_put(gen_counts, repl)
+            self._prompt_counts = jax.device_put(prompt_counts, repl)
+        else:
+            self._gen_counts = jax.device_put(gen_counts)
+            self._prompt_counts = jax.device_put(prompt_counts)
 
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event
@@ -558,9 +581,8 @@ class JaxLlmEngine:
             rep[lane] = s.repetition_penalty if s.repetition_penalty else 1.0
         return temp, top_k, top_p, greedy, pres, freq, rep
 
-    def _next_rng(self):
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
+    def _next_rng(self) -> np.ndarray:
+        return self._host_rng.integers(0, 2**32, size=2, dtype=np.uint32)
 
     def _seed_lane_state(self, seq: Sequence) -> None:
         """Initialize a lane's penalty counts + rng key for a sequence that
@@ -586,10 +608,11 @@ class JaxLlmEngine:
         (reproducible sampling), else from the engine stream."""
         seed = seq.request.sampling.seed
         if seed is not None:
-            key = jax.random.PRNGKey(int(seed))
+            # same packing as jax.random.PRNGKey(seed): [hi32, lo32]
+            s = int(seed) & ((1 << 64) - 1)
+            row = np.array([s >> 32, s & 0xFFFFFFFF], np.uint32)
         else:
-            key = self._next_rng()
-        row = np.asarray(key, np.uint32)
+            row = self._next_rng()
         self._lane_keys[seq.lane if seq.lane >= 0 else 0] = row
         return row
 
