@@ -58,19 +58,8 @@ def _is_oom(err: BaseException) -> bool:
 
 
 async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
-    import jax
-    import numpy as np
-
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
-    from dynamo_tpu.llm.protocols.common import (
-        Annotated,
-        LLMEngineOutput,
-        PreprocessedRequest,
-        SamplingOptions,
-        StopConditions,
-    )
     from dynamo_tpu.models.llama import LlamaConfig
-    from dynamo_tpu.runtime.engine import Context
 
     cfg = getattr(LlamaConfig, model_name)()
     if fallback_cpu:
@@ -105,6 +94,29 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             decode_steps=decode_steps,
         )
     )
+    try:
+        return await _measure(engine, cfg, model_name, num_requests, prompt_len,
+                              output_len, max_batch, decode_steps, fallback_cpu, t_init)
+    finally:
+        # release HBM before a ladder step-down retries in this process
+        engine.stop()
+        engine.params = engine.cache = None
+
+
+async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len,
+                   max_batch, decode_steps, fallback_cpu, t_init) -> dict:
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
     engine.start()
     print(
         f"bench: engine up ({model_name}) in {time.monotonic()-t_init:.1f}s",
@@ -146,7 +158,6 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
     wall = time.monotonic() - t0
 
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(engine.params))
-    engine.stop()
 
     total_tokens = sum(c for c, _ in results)
     ttfts = sorted(t for _, t in results)
@@ -267,7 +278,8 @@ def main() -> None:
         if result is not None:
             print(json.dumps(result))
             return
-        time.sleep(20)
+        if attempt + 1 < tpu_attempts:
+            time.sleep(20)
 
     # accelerator never produced a result: CPU fallback so the round still
     # records a parseable (clearly-marked) data point instead of rc=1
@@ -277,7 +289,6 @@ def main() -> None:
         JAX_PLATFORMS="cpu",
         DYN_BENCH_FALLBACK_CPU="1",
         PALLAS_AXON_POOL_IPS="",
-        XLA_FLAGS=env.get("XLA_FLAGS", ""),
     )
     result = _try_child(env, min(attempt_timeout, 900.0))
     if result is None:

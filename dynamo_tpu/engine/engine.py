@@ -198,20 +198,25 @@ class JaxLlmEngine:
         vocab = cfg.vocab_size
 
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
-                 block_ids, seq_len, start_pos, key, temp, top_k, top_p, greedy,
-                 pres, freq, rep):
+                 block_ids, seq_len, start_pos, gen_row, key, temp, top_k, top_p,
+                 greedy, pres, freq, rep):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
                 self.cos, self.sin,
             )
-            # (re)seed this lane's sampling state from the prompt
+            # (re)seed this lane's sampling state.  ``gen_row`` is the count
+            # of already-generated tokens (nonzero only on preemption
+            # recompute, where token_ids = prompt + generated): subtracting
+            # it keeps prompt vs generated counts exact, so presence/
+            # frequency penalties and seeded sampling survive preemption.
             seq_pad = token_ids.shape[0]
             valid = (jnp.arange(seq_pad) < seq_len).astype(jnp.int32)
-            prompt_row = jnp.zeros((vocab,), jnp.int32).at[token_ids].add(valid, mode="drop")
+            full_row = jnp.zeros((vocab,), jnp.int32).at[token_ids].add(valid, mode="drop")
+            prompt_row = full_row - gen_row
             prompt_counts = prompt_counts.at[lane].set(prompt_row)
-            gen_counts = gen_counts.at[lane].set(0)
+            gen_counts = gen_counts.at[lane].set(gen_row)
             plogits = apply_penalties(
-                logits[None], gen_counts[lane][None], prompt_row[None], pres, freq, rep
+                logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
@@ -299,23 +304,26 @@ class JaxLlmEngine:
     def _build_extract(self):
         """Gather a sequence's KV blocks (padded to max_blocks_per_seq) for
         cross-worker transfer — the TPU-native replacement for NIXL reads
-        (SURVEY.md §2.5 KV transfer plane)."""
+        (SURVEY.md §2.5 KV transfer plane).  Generic over the family's cache
+        pytree (llama {"k","v"} symmetric; DeepSeek MLA latent + rope-key
+        leaves with different widths)."""
 
         def fn(cache, block_ids):
-            return cache["k"][:, block_ids], cache["v"][:, block_ids]
+            return jax.tree.map(lambda c: c[:, block_ids], cache)
 
         return jax.jit(fn)
 
     def _build_inject(self):
-        """Scatter transferred KV blocks into this engine's cache."""
+        """Scatter transferred KV blocks into this engine's cache, per cache
+        leaf (so asymmetric-layout families inject correctly)."""
         num_blocks = self.config.num_blocks
 
-        def fn(cache, k_new, v_new, block_ids, n):
+        def fn(cache, new, block_ids, n):
             maxb = block_ids.shape[0]
             ids = jnp.where(jnp.arange(maxb) < n, block_ids, num_blocks)
-            k = cache["k"].at[:, ids].set(k_new.astype(cache["k"].dtype), mode="drop")
-            v = cache["v"].at[:, ids].set(v_new.astype(cache["v"].dtype), mode="drop")
-            return {"k": k, "v": v}
+            return jax.tree.map(
+                lambda c, x: c.at[:, ids].set(x.astype(c.dtype), mode="drop"), cache, new
+            )
 
         kwargs = {}
         if self.mesh is not None:
@@ -380,10 +388,11 @@ class JaxLlmEngine:
         self._wake.set()
 
     # -- disaggregation API ------------------------------------------------
-    async def prefill_extract(self, pre: PreprocessedRequest) -> tuple[int, "np.ndarray", "np.ndarray", int]:
+    async def prefill_extract(self, pre: PreprocessedRequest) -> tuple[int, dict, int]:
         """Prefill-worker side: run prefill only, return (first_token,
-        k_blocks, v_blocks, n_blocks).  KV arrays are host numpy
-        [layers, n_blocks, block_size, kv_heads, head_dim]."""
+        blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
+        sequence's blocks as host numpy, e.g. llama
+        ``{"k": [L, n, bs, kvh, d], "v": ...}``."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         seq = Sequence(seq_id=uuid.uuid4().hex, request=pre, prefill_only=True)
@@ -404,16 +413,17 @@ class JaxLlmEngine:
     def release_blocks(self, block_ids: list[int]) -> None:
         self.allocator.release_blocks(block_ids)
 
-    async def inject_blocks(self, block_ids: list[int], k_blocks, v_blocks) -> None:
-        """Decode-worker side: write transferred KV blocks into the cache
-        (runs on the device thread to serialize with step functions)."""
+    async def inject_blocks(self, block_ids: list[int], blocks: dict) -> None:
+        """Decode-worker side: write transferred KV blocks (cache pytree of
+        host arrays) into the cache (runs on the device thread to serialize
+        with step functions)."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
         def done() -> None:
             loop.call_soon_threadsafe(lambda: fut.set_result(None) if not fut.done() else None)
 
-        self._submit_q.put(("inject", (list(block_ids), k_blocks, v_blocks, done)))
+        self._submit_q.put(("inject", (list(block_ids), blocks, done)))
         self._wake.set()
         await fut
 
@@ -534,22 +544,23 @@ class JaxLlmEngine:
                 if done is not None:
                     done()
             elif op == "inject":
-                block_ids, k_np, v_np, done = seq  # payload tuple
+                block_ids, blocks, done = seq  # payload tuple
                 n = len(block_ids)
                 ids = np.zeros((self.max_blocks_per_seq,), np.int32)
                 ids[:n] = block_ids
-                shape = (
-                    self.config.model.num_layers, self.max_blocks_per_seq,
-                    self.config.block_size, self.config.model.num_kv_heads,
-                    self.config.model.head_dim,
-                )
-                k_pad = np.zeros(shape, np.asarray(k_np).dtype)
-                v_pad = np.zeros(shape, np.asarray(v_np).dtype)
-                k_pad[:, :n] = k_np
-                v_pad[:, :n] = v_np
+                # pad each leaf to the static max_blocks_per_seq shape; leaf
+                # geometry comes from the live cache pytree, so asymmetric
+                # layouts (DeepSeek MLA latent/rope widths) shape correctly
+                def pad(leaf, incoming):
+                    incoming = np.asarray(incoming)
+                    shape = (leaf.shape[0], self.max_blocks_per_seq, *leaf.shape[2:])
+                    out = np.zeros(shape, incoming.dtype)
+                    out[:, :n] = incoming
+                    return jnp.asarray(out)
+
+                padded = jax.tree.map(pad, self.cache, blocks)
                 self.cache = self._jit_inject(
-                    self.cache, jnp.asarray(k_pad), jnp.asarray(v_pad),
-                    jnp.asarray(ids), jnp.int32(n),
+                    self.cache, padded, jnp.asarray(ids), jnp.int32(n)
                 )
                 done()
 
@@ -584,19 +595,20 @@ class JaxLlmEngine:
     def _next_rng(self) -> np.ndarray:
         return self._host_rng.integers(0, 2**32, size=2, dtype=np.uint32)
 
+    def _count_row(self, token_ids: list[int]) -> np.ndarray:
+        """Per-vocab token counts [vocab] int32 (penalty bookkeeping)."""
+        vocab = self.config.model.vocab_size
+        if not token_ids:
+            return np.zeros((vocab,), np.int32)
+        return np.bincount(
+            np.asarray(token_ids, np.int64) % vocab, minlength=vocab
+        ).astype(np.int32)
+
     def _seed_lane_state(self, seq: Sequence) -> None:
         """Initialize a lane's penalty counts + rng key for a sequence that
         skipped local prefill (disagg decode side)."""
-        vocab = self.config.model.vocab_size
-        prompt_row = np.bincount(
-            np.asarray(seq.request.token_ids, np.int64) % vocab, minlength=vocab
-        ).astype(np.int32)
-        if seq.output_ids:
-            gen_row = np.bincount(
-                np.asarray(seq.output_ids, np.int64) % vocab, minlength=vocab
-            ).astype(np.int32)
-        else:
-            gen_row = np.zeros((vocab,), np.int32)
+        prompt_row = self._count_row(seq.request.token_ids)
+        gen_row = self._count_row(seq.output_ids)
         lane = jnp.int32(seq.lane)
         self._prompt_counts = self._jit_set_row(self._prompt_counts, lane, jnp.asarray(prompt_row))
         self._gen_counts = self._jit_set_row(self._gen_counts, lane, jnp.asarray(gen_row))
@@ -629,11 +641,13 @@ class JaxLlmEngine:
         key = self._seed_lane_key(seq)
         seq.sampling_seeded = True
         lane = max(seq.lane, 0)  # prefill_only sequences have no decode lane
+        # nonzero only on preemption recompute (token_ids include generated)
+        gen_row = self._count_row(seq.output_ids)
 
         token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
             self.params, self.cache, self._gen_counts, self._prompt_counts,
             jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
-            jnp.int32(n), jnp.int32(0), jnp.asarray(key),
+            jnp.int32(n), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
             jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep),
         )
@@ -641,12 +655,11 @@ class JaxLlmEngine:
             # disagg prefill worker: hand back first token + the KV blocks
             ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             ids[: len(blocks)] = blocks
-            k_all, v_all = self._jit_extract(self.cache, jnp.asarray(ids))
+            gathered = self._jit_extract(self.cache, jnp.asarray(ids))
             n_used = self.allocator.blocks_needed(n)
             result = (
                 int(token),
-                np.asarray(k_all)[:, :n_used],
-                np.asarray(v_all)[:, :n_used],
+                jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered),
                 n_used,
             )
             self.scheduler.finish(seq)

@@ -69,6 +69,12 @@ class DisaggRouter:
             self._task.cancel()
 
     async def _loop(self) -> None:
+        try:
+            await self._config_loop()
+        except ConnectionError as exc:
+            logger.warning("disagg config watch lost (keeping last config): %s", exc)
+
+    async def _config_loop(self) -> None:
         async for event in self._watch:
             if event.type != WatchEventType.PUT:
                 continue
@@ -143,7 +149,7 @@ class DisaggDecodeEngine:
         await self.transfer_server.stop()
 
     async def _on_transfer(self, payload: KvTransferPayload) -> None:
-        await self.engine.inject_blocks(payload.block_ids, payload.k_blocks, payload.v_blocks)
+        await self.engine.inject_blocks(payload.block_ids, payload.blocks)
         fut = self._pending.pop(payload.seq_id, None)
         if fut is not None and not fut.done():
             fut.set_result(payload.first_token)
@@ -232,14 +238,13 @@ class PrefillWorker:
 
     async def _handle(self, item: dict) -> None:
         pre = PreprocessedRequest.from_wire(item["request"])
-        first_token, k_blocks, v_blocks, n = await self.engine.prefill_extract(pre)
+        first_token, blocks, n = await self.engine.prefill_extract(pre)
         await self.client.send(
             item["transfer_address"],
             KvTransferPayload(
                 seq_id=item["seq_id"],
                 first_token=first_token,
                 block_ids=item["dst_block_ids"][:n],
-                k_blocks=k_blocks,
-                v_blocks=v_blocks,
+                blocks=blocks,
             ),
         )

@@ -116,15 +116,19 @@ class ModelWatcher:
                 await kv_router.stop()
 
     async def _loop(self) -> None:
-        async for event in self._watch:
-            try:
-                entry = ModelEntry.from_json(event.entry.value)
-            except Exception:  # noqa: BLE001
-                continue
-            if event.type == WatchEventType.PUT:
-                await self._handle_put(event.entry.key, entry)
-            else:
-                await self._handle_delete(event.entry.key, entry)
+        try:
+            async for event in self._watch:
+                try:
+                    entry = ModelEntry.from_json(event.entry.value)
+                except Exception:  # noqa: BLE001
+                    continue
+                if event.type == WatchEventType.PUT:
+                    await self._handle_put(event.entry.key, entry)
+                else:
+                    await self._handle_delete(event.entry.key, entry)
+        except ConnectionError as exc:
+            # keep serving the pipelines we already built on a lost watch
+            logger.warning("model discovery watch lost: %s", exc)
 
     async def _handle_put(self, key: str, entry: ModelEntry) -> None:
         backing = self._backing.setdefault(entry.name, set())

@@ -45,8 +45,10 @@ class KvTransferPayload:
     seq_id: str
     first_token: int
     block_ids: list[int]          # destination (decode-side) block ids
-    k_blocks: np.ndarray          # [layers, n, block_size, kv_heads, head_dim]
-    v_blocks: np.ndarray
+    # cache pytree restricted to the sequence's blocks, one named host array
+    # per cache leaf — llama: {"k": [L, n, bs, kvh, d], "v": ...}; DeepSeek
+    # MLA: latent + rope-key leaves with different trailing shapes
+    blocks: dict[str, np.ndarray]
 
 
 class KvTransferServer:
@@ -85,17 +87,21 @@ class KvTransferServer:
                 if frame is None:
                     return
                 h = frame.header
-                dtype = resolve_dtype(h["dtype"])
-                shape = tuple(h["shape"])
-                k_size = int(np.prod(shape)) * dtype.itemsize
-                k = np.frombuffer(frame.payload[:k_size], dtype).reshape(shape)
-                v = np.frombuffer(frame.payload[k_size:], dtype).reshape(shape)
+                blocks: dict[str, np.ndarray] = {}
+                offset = 0
+                for part in h["parts"]:
+                    dtype = resolve_dtype(part["dtype"])
+                    shape = tuple(part["shape"])
+                    size = int(np.prod(shape)) * dtype.itemsize
+                    blocks[part["name"]] = np.frombuffer(
+                        frame.payload[offset : offset + size], dtype
+                    ).reshape(shape)
+                    offset += size
                 payload = KvTransferPayload(
                     seq_id=h["seq_id"],
                     first_token=h["first_token"],
                     block_ids=list(h["block_ids"]),
-                    k_blocks=k,
-                    v_blocks=v,
+                    blocks=blocks,
                 )
                 await self.sink(payload)
                 writer.write(encode_frame(TwoPartMessage(header={"ok": True, "seq_id": h["seq_id"]})))
@@ -124,18 +130,21 @@ class KvTransferClient:
 
     async def send(self, address: str, payload: KvTransferPayload) -> None:
         reader, writer, lock = await self._conn(address)
-        k = np.ascontiguousarray(payload.k_blocks)
-        v = np.ascontiguousarray(payload.v_blocks)
+        names = sorted(payload.blocks)
+        arrays = [np.ascontiguousarray(payload.blocks[n]) for n in names]
         # bf16 numpy: ml_dtypes dtype name round-trips through np.dtype
         header = {
             "seq_id": payload.seq_id,
             "first_token": payload.first_token,
             "block_ids": payload.block_ids,
-            "dtype": k.dtype.name,
-            "shape": list(k.shape),
+            "parts": [
+                {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}
+                for n, a in zip(names, arrays)
+            ],
         }
+        body = b"".join(a.tobytes() for a in arrays)
         async with lock:
-            writer.write(encode_frame(TwoPartMessage(header=header, payload=k.tobytes() + v.tobytes())))
+            writer.write(encode_frame(TwoPartMessage(header=header, payload=body)))
             await writer.drain()
             ack = await read_two_part(reader)
             if ack is None or not ack.header.get("ok"):

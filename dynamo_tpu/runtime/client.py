@@ -69,17 +69,22 @@ class Client:
 
     async def _watch_loop(self) -> None:
         assert self._watch is not None
-        async for event in self._watch:
-            try:
-                inst = Instance.from_json(event.entry.value)
-            except Exception:  # noqa: BLE001
-                continue
-            if event.type == WatchEventType.PUT:
-                self._instances[inst.instance_id] = inst
-            else:
-                self._instances.pop(inst.instance_id, None)
-            self._changed.set()
-            self._changed = asyncio.Event()
+        try:
+            async for event in self._watch:
+                try:
+                    inst = Instance.from_json(event.entry.value)
+                except Exception:  # noqa: BLE001
+                    continue
+                if event.type == WatchEventType.PUT:
+                    self._instances[inst.instance_id] = inst
+                else:
+                    self._instances.pop(inst.instance_id, None)
+                self._changed.set()
+                self._changed = asyncio.Event()
+        except ConnectionError as exc:
+            # instance view is stale from here on; requests keep flowing to
+            # the last-known instances rather than failing hard
+            logger.warning("%s instance watch lost: %s", self.endpoint.path, exc)
 
     async def close(self) -> None:
         if self._watch is not None:

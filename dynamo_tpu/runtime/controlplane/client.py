@@ -48,34 +48,49 @@ class RpcConnection:
 
     async def _read_loop(self) -> None:
         assert self._reader is not None
-        while True:
-            frame = await read_frame(self._reader)
-            if frame is None:
-                break
-            if "i" in frame:  # rpc response
-                fut = self._pending.pop(frame["i"], None)
-                if fut is not None and not fut.done():
-                    if frame["ok"]:
-                        fut.set_result(frame.get("r"))
-                    else:
-                        fut.set_exception(RuntimeError(frame.get("e", "rpc error")))
-            elif "s" in frame:  # stream push
-                self._route_push(frame)
-        self._closed = True
-        for fut in self._pending.values():
-            if not fut.done():
-                fut.set_exception(ConnectionError("control plane connection lost"))
-        self._pending.clear()
-        for target in self._streams.values():
-            if isinstance(target, Watch):
-                target.cancel()
-            elif isinstance(target, Subscription):
-                target._closed = True
-                target._queue.put_nowait(None)
-        self._streams.clear()
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                if "i" in frame:  # rpc response
+                    fut = self._pending.pop(frame["i"], None)
+                    if fut is not None and not fut.done():
+                        if frame["ok"]:
+                            fut.set_result(frame.get("r"))
+                        else:
+                            fut.set_exception(RuntimeError(frame.get("e", "rpc error")))
+                elif "s" in frame:  # stream push
+                    self._route_push(frame)
+        finally:
+            # cleanup must run on ANY exit (clean EOF, socket errors read_frame
+            # doesn't catch, corrupt frames) or pending calls and watches hang
+            self._closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("control plane connection lost"))
+            self._pending.clear()
+            for target in self._streams.values():
+                if isinstance(target, Watch):
+                    # surface the loss to ready() waiters and iterators
+                    # instead of ending the stream silently
+                    target._fail(ConnectionError("control plane connection lost"))
+                elif isinstance(target, Subscription):
+                    target._closed = True
+                    target._queue.put_nowait(None)
+            self._streams.clear()
 
     def register_stream(self, stream_id: int, target: object) -> None:
         """Attach a local stream handle; flush any pushes that raced it."""
+        if self._closed:
+            # the read loop already died (its cleanup ran before we got
+            # here): fail the target now or it would hang forever
+            if isinstance(target, Watch):
+                target._fail(ConnectionError("control plane connection lost"))
+            elif isinstance(target, Subscription):
+                target._closed = True
+                target._queue.put_nowait(None)
+            return
         self._streams[stream_id] = target
         for frame in self._unrouted.pop(stream_id, []):
             self._route_push(frame)
@@ -184,7 +199,13 @@ class RemoteKV(KeyValueStore):
         watch = Watch()
 
         async def _start() -> None:
-            stream_id = await self._conn.call("kv.watch_prefix", prefix)
+            try:
+                stream_id = await self._conn.call("kv.watch_prefix", prefix)
+            except Exception as exc:  # noqa: BLE001 — a dropped connection
+                # here must not leave ready() waiters hanging forever
+                logger.warning("watch_prefix(%s) failed to start: %s", prefix, exc)
+                watch._fail(exc)
+                return
             self._conn.register_stream(stream_id, watch)
             watch._stream_id = stream_id  # type: ignore[attr-defined]
             if watch._cancelled:  # cancelled before registration completed

@@ -107,6 +107,7 @@ class Watch:
         self._queue: asyncio.Queue[object | None] = asyncio.Queue()
         self._cancelled = False
         self._ready = asyncio.Event()
+        self._error: BaseException | None = None
 
     def _emit(self, event: WatchEvent) -> None:
         if not self._cancelled:
@@ -120,6 +121,19 @@ class Watch:
         self._ready.set()  # never leave ready() waiters hanging
         self._queue.put_nowait(None)
 
+    def _fail(self, exc: BaseException) -> None:
+        """Mark the watch broken (e.g. the connection dropped during
+        startup): ``ready()`` waiters and iterators re-raise instead of
+        hanging forever.  Non-connection causes (rpc errors, timeouts) are
+        normalized to ConnectionError so consumers handle one type."""
+        if self._cancelled:
+            return
+        if not isinstance(exc, ConnectionError):
+            exc = ConnectionError(f"watch failed: {exc!r}")
+        self._error = exc
+        self._ready.set()
+        self._queue.put_nowait(None)
+
     def cancel(self) -> None:
         self._cancelled = True
         self._ready.set()
@@ -127,8 +141,10 @@ class Watch:
 
     async def ready(self) -> None:
         """Resolves once the initial snapshot has been consumed from this
-        watch (or the watch closed)."""
+        watch (or the watch closed); raises if the watch failed to start."""
         await self._ready.wait()
+        if self._error is not None:
+            raise self._error
 
     def __aiter__(self) -> AsyncIterator[WatchEvent]:
         return self
@@ -138,6 +154,8 @@ class Watch:
             event = await self._queue.get()
             if event is None or self._cancelled:
                 self._ready.set()
+                if self._error is not None and not self._cancelled:
+                    raise self._error
                 raise StopAsyncIteration
             if event is WATCH_SYNC:
                 self._ready.set()

@@ -64,6 +64,8 @@ class KvWatchCache:
                     self._data.pop(short, None)
                 self._changed.set()
                 self._changed = asyncio.Event()
+        except ConnectionError:
+            pass  # handled below: the finally marks the view stale
         finally:
             # watch ended (connection lost / server close / cancel): the
             # view stops updating — flag it and wake any waiters so callers

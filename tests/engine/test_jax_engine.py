@@ -315,3 +315,44 @@ async def test_penalties_with_multistep_decode():
     finally:
         engine.stop()
     assert len(set(penalized)) == len(penalized)
+
+
+async def test_preemption_preserves_penalty_state():
+    """Preemption recompute must keep prompt vs generated token counts exact:
+    a frequency-penalized request that gets preempted still emits the same
+    tokens as on an uncontended engine (the gen_row re-seed defect)."""
+    prompts = [list(range(3, 10)), list(range(5, 12)), list(range(2, 9))]
+
+    refs = []
+    for p in prompts:
+        engine = make_engine()  # roomy: no preemption
+        try:
+            tokens, _ = await collect(
+                engine,
+                sampled_request(p, max_tokens=12, use_greedy=True, frequency_penalty=100.0),
+            )
+        finally:
+            engine.stop()
+        refs.append(tokens)
+
+    # tight pool: 3 seqs × ceil(19/4)=5 blocks > 10 blocks → preemption
+    engine = make_engine(max_batch_size=4, num_blocks=10, max_model_len=40)
+    preempts = []
+    orig_preempt = engine.scheduler.preempt
+    engine.scheduler.preempt = lambda seq: (preempts.append(seq.seq_id), orig_preempt(seq))[1]
+    try:
+        results = await asyncio.gather(
+            *[
+                collect(
+                    engine,
+                    sampled_request(p, max_tokens=12, use_greedy=True, frequency_penalty=100.0),
+                )
+                for p in prompts
+            ]
+        )
+    finally:
+        engine.stop()
+    assert preempts, "test geometry failed to force preemption"
+    for (tokens, _), ref in zip(results, refs):
+        assert tokens == ref
+        assert len(set(tokens)) == len(tokens)  # penalty still blocks repeats

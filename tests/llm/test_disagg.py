@@ -191,3 +191,60 @@ async def test_concurrent_disagg_requests():
         decode_engine.stop()
         prefill_engine.stop()
         await rt.close()
+
+
+async def test_deepseek_remote_prefill_exactness():
+    """Disagg with the MLA family: the cache pytree has asymmetric leaf
+    shapes (latent vs rope-key widths), which the extract/transfer/inject
+    path must carry through (the DeepSeek inject-shape defect)."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+    from dynamo_tpu.models.registry import get_family
+
+    cfg = DeepseekConfig.tiny_mla()
+    params = get_family("deepseek_v2").init_params(cfg, jax.random.PRNGKey(0))
+
+    def make_ds_engine():
+        engine = JaxLlmEngine(
+            EngineConfig(
+                model=cfg, model_family="deepseek_v2", num_blocks=64, block_size=4,
+                max_batch_size=4, prefill_buckets=(16, 32), max_model_len=64,
+            ),
+            params=params,
+        )
+        engine.start()
+        return engine
+
+    prompt = list(range(3, 13))
+    # reference: single uncontended engine, local prefill
+    ref_engine = make_ds_engine()
+    try:
+        ref_tokens = await collect(await ref_engine.generate(Context(request(prompt, max_tokens=6))))
+    finally:
+        ref_engine.stop()
+
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-ds"))
+    decode_engine = make_ds_engine()
+    prefill_engine = make_ds_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "ds", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "ds_backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        tokens = await collect(stream)
+        assert tokens == ref_tokens, f"disagg {tokens} != single-engine {ref_tokens}"
+        assert disagg.remote_prefills == 1
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()

@@ -284,3 +284,46 @@ async def test_kv_watch_cache_goes_stale_on_watch_death(plane_factory):
         if cache is not None:
             await cache.close()
         await teardown(plane, server)
+
+
+async def test_watch_ready_fails_fast_on_dead_connection():
+    """A watch started over a broken connection must surface the error to
+    ``ready()`` waiters and iterators instead of hanging forever (the
+    Client.start startup-hang defect)."""
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", server.port)
+    await plane.connect()
+    try:
+        # sever the transport under the client, then start a watch
+        plane._conn._writer.close()
+        await asyncio.sleep(0.1)  # let the read loop observe EOF
+        watch = plane.kv.watch_prefix("some/prefix")
+        with pytest.raises((ConnectionError, RuntimeError)):
+            await asyncio.wait_for(watch.ready(), timeout=10)
+        # iterating the failed watch raises too (no silent empty stream)
+        with pytest.raises((ConnectionError, RuntimeError, StopAsyncIteration)):
+            await asyncio.wait_for(watch.__anext__(), timeout=10)
+    finally:
+        await plane.close()
+        await server.stop()
+
+
+async def test_live_watch_fails_when_connection_drops():
+    """An established watch whose connection dies mid-stream raises to the
+    consumer instead of ending silently."""
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", server.port)
+    await plane.connect()
+    try:
+        await plane.kv.put("w/a", b"1")
+        watch = plane.kv.watch_prefix("w/")
+        first = await asyncio.wait_for(watch.__anext__(), timeout=10)
+        assert first.entry.key == "w/a"
+        plane._conn._writer.close()
+        with pytest.raises((ConnectionError, RuntimeError)):
+            await asyncio.wait_for(watch.__anext__(), timeout=10)
+    finally:
+        await plane.close()
+        await server.stop()
