@@ -65,6 +65,10 @@ class EngineConfig:
     # "auto": Pallas paged-attention kernel on single-chip TPU, gather-based
     # XLA fallback otherwise.  "jax" | "pallas" | "pallas_interpret" force.
     attention_impl: str = "auto"
+    # Prefix-cache reuse: completed KV blocks stay resident and matching
+    # prompts prefill only the uncached tail (auto-disabled for families
+    # without a continued-prefill forward).
+    enable_prefix_caching: bool = True
     # Decode iterations fused into one jit launch (lax.scan with device-side
     # token feedback + slot derivation).  >1 amortizes per-step dispatch and
     # host↔device roundtrips — the dominant cost at small batch — at the
@@ -166,8 +170,13 @@ class JaxLlmEngine:
             self._gen_counts = jax.device_put(gen_counts)
             self._prompt_counts = jax.device_put(prompt_counts)
 
+        self.prefix_caching = (
+            config.enable_prefix_caching
+            and self.family.forward_prefill_with_prefix is not None
+        )
         self.allocator = BlockAllocator(
-            config.num_blocks, config.block_size, event_sink=self._sink_event
+            config.num_blocks, config.block_size, event_sink=self._sink_event,
+            enable_prefix_caching=self.prefix_caching,
         )
         self.scheduler = Scheduler(self.allocator, max_batch_size=config.max_batch_size)
         self._event_sink = event_sink
@@ -179,6 +188,9 @@ class JaxLlmEngine:
         self._stop = False
         self._thread: threading.Thread | None = None
         self._jit_prefill = self._build_prefill()
+        self._jit_prefill_prefix = (
+            self._build_prefill_prefix() if self.prefix_caching else None
+        )
         self._jit_decode = self._build_decode()
         self._jit_extract = self._build_extract()
         self._jit_inject = self._build_inject()
@@ -221,6 +233,42 @@ class JaxLlmEngine:
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             gen_counts = gen_counts.at[lane, token].add(1)
+            return token, cache, gen_counts, prompt_counts
+
+        kwargs = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+        return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
+
+    def _build_prefill_prefix(self):
+        """Continued prefill over a resident prefix (prefix-cache hit or a
+        later chunk of a chunked prefill).  Penalty rows come in from the
+        host (the full prompt is not on device here) and the sampling key
+        folds with the total context length so seeded sampling matches the
+        uncached path exactly."""
+        cfg = self.config.model
+
+        def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
+                 full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
+                 prompt_row, gen_row, sample_gate, key, temp, top_k, top_p,
+                 greedy, pres, freq, rep):
+            logits, cache = self.family.forward_prefill_with_prefix(
+                params, cfg, token_ids, cache, full_block_ids, tail_block_ids,
+                tail_len, start_pos, self.cos, self.sin,
+            )
+            prompt_counts = prompt_counts.at[lane].set(prompt_row)
+            gen_counts = gen_counts.at[lane].set(gen_row)
+            plogits = apply_penalties(
+                logits[None], gen_row[None], prompt_row[None], pres, freq, rep
+            )
+            step_key = jax.random.fold_in(key, total_len)
+            token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            # sample_gate=0 for non-final chunks of a chunked prefill: the
+            # logits are discarded and no generated count is recorded
+            gen_counts = gen_counts.at[lane, token].add(sample_gate)
             return token, cache, gen_counts, prompt_counts
 
         kwargs = {}
@@ -496,11 +544,14 @@ class JaxLlmEngine:
         return {
             "kv_active_blocks": self.allocator.used_blocks,
             "kv_total_blocks": self.allocator.num_blocks,
+            "kv_cached_blocks": self.allocator.cached_blocks,
             "gpu_cache_usage_perc": self.allocator.usage,
             "num_requests_waiting": self.scheduler.num_waiting,
             "num_requests_running": self.scheduler.num_running,
             "request_total_slots": self.config.max_batch_size,
             "iterations_total": self._iterations,
+            "prefix_hits_total": self.allocator.prefix_hits_total,
+            "prefix_cached_tokens_total": self.allocator.prefix_cached_tokens_total,
         }
 
     # -- device thread -----------------------------------------------------
@@ -631,26 +682,56 @@ class JaxLlmEngine:
     def _run_prefill(self, seq: Sequence) -> None:
         tokens = seq.all_token_ids
         n = len(tokens)
-        bucket = self._bucket_len(n)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:n] = tokens
-        block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
         blocks = self.allocator.block_ids(seq.seq_id)
-        block_ids[: len(blocks)] = blocks
         temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays([seq], 1)
+        sampling_tail = (
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
+            jnp.asarray(rep),
+        )
         key = self._seed_lane_key(seq)
         seq.sampling_seeded = True
         lane = max(seq.lane, 0)  # prefill_only sequences have no decode lane
         # nonzero only on preemption recompute (token_ids include generated)
         gen_row = self._count_row(seq.output_ids)
+        cached = seq.cached_tokens
 
-        token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
-            self.params, self.cache, self._gen_counts, self._prompt_counts,
-            jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
-            jnp.int32(n), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(greedy),
-            jnp.asarray(pres), jnp.asarray(freq), jnp.asarray(rep),
-        )
+        if cached and self._jit_prefill_prefix is not None:
+            # prefix-cache hit: prefill only the uncached tail; queries
+            # attend to the resident prefix blocks.  The block table is
+            # bucketed like token lengths so the per-layer prefix gather
+            # scales with the actual context, not max_blocks_per_seq
+            cached_blocks = cached // self.config.block_size
+            tail = tokens[cached:]
+            t = len(tail)
+            padded = np.zeros((self._bucket_len(t),), np.int32)
+            padded[:t] = tail
+            table_len = self.allocator.blocks_needed(
+                self._bucket_len(min(n + 1, self.max_len))
+            )
+            full_ids = np.zeros((table_len,), np.int32)
+            full_ids[: len(blocks)] = blocks
+            tail_ids = np.zeros((table_len,), np.int32)
+            tail_ids[: len(blocks) - cached_blocks] = blocks[cached_blocks:]
+            prompt_row = self._count_row(seq.request.token_ids)
+            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                jnp.int32(lane), jnp.asarray(padded), jnp.asarray(full_ids),
+                jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(cached),
+                jnp.int32(n), jnp.asarray(prompt_row), jnp.asarray(gen_row),
+                jnp.int32(1), jnp.asarray(key), *sampling_tail,
+            )
+        else:
+            padded = np.zeros((self._bucket_len(n),), np.int32)
+            padded[:n] = tokens
+            block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
+            block_ids[: len(blocks)] = blocks
+            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
+                jnp.int32(n), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
+                *sampling_tail,
+            )
         if seq.prefill_only:
             # disagg prefill worker: hand back first token + the KV blocks
             ids = np.zeros((self.max_blocks_per_seq,), np.int32)

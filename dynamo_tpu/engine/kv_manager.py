@@ -1,10 +1,19 @@
-"""Host-side paged KV block allocator.
+"""Host-side paged KV block allocator with prefix-cache reuse.
 
 Manages the block pool that lives in device HBM: free list, per-sequence
-block tables, and content hashes of full blocks.  Emits stored/removed KV
-events (the contract the KV-aware router indexes on — reference: vLLM
-KVEvents ingested via lib/llm/src/kv_router/publisher.rs; here the engine is
-native so events come straight from the allocator).
+block tables, content hashes of full blocks, and a **reuse registry**:
+completed blocks stay resident after their sequence finishes (refcount 0,
+LRU-ordered) and incoming prompts are matched block-by-block against the
+registry so a shared prefix skips prefill compute (reference: vLLM prefix
+caching on the engine side + sequence-hash block reuse in
+lib/llm/src/block_manager/pool.rs:447-466 ``match_sequence_hashes``).
+
+Emits stored/removed KV events (the contract the KV-aware router indexes
+on — reference: vLLM KVEvents ingested via lib/llm/src/kv_router/
+publisher.rs; here the engine is native so events come straight from the
+allocator).  ``stored`` fires when a block completes; ``removed`` fires when
+a cached block is *evicted* (not when its sequence finishes — the content is
+still resident and discoverable until then).
 
 Block hashing matches the router's scheme: xxh3_64 over
 (parent_hash, block token ids) with seed 1337 (reference:
@@ -13,7 +22,7 @@ lib/llm/src/kv_router/indexer.rs:64,122).
 
 from __future__ import annotations
 
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable
 
@@ -32,10 +41,18 @@ class KvEvent:
 class SequenceBlocks:
     block_ids: list[int] = field(default_factory=list)
     published_hashes: list[int] = field(default_factory=list)
+    cached_tokens: int = 0       # prefix tokens reused from the registry
 
 
 class BlockAllocator:
-    """Free-list allocator over ``num_blocks`` fixed-size blocks."""
+    """Free-list allocator over ``num_blocks`` fixed-size blocks with an
+    LRU prefix-cache reuse tier.
+
+    Block states: **free** (no content) → **in use** (refcount ≥ 1, owned by
+    one or more sequences) → **cached** (refcount 0, content retained,
+    evictable LRU) → free again on eviction.  Only *complete* blocks (hash
+    registered via ``publish_stored``) enter the cached state.
+    """
 
     def __init__(
         self,
@@ -44,22 +61,36 @@ class BlockAllocator:
         *,
         event_sink: Callable[[KvEvent], None] | None = None,
         watermark: float = 0.01,
+        enable_prefix_caching: bool = True,
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.event_sink = event_sink
+        self.enable_prefix_caching = enable_prefix_caching
         self.watermark_blocks = max(1, int(num_blocks * watermark))
         self._free: deque[int] = deque(range(num_blocks))
+        self._cached: OrderedDict[int, None] = OrderedDict()  # block -> None, LRU
+        self._ref: dict[int, int] = {}            # block -> refcount (in-use only)
+        self._block_hash: dict[int, int] = {}     # block -> registered hash
+        self._hash_to_block: dict[int, int] = {}  # hash -> resident block
         self._sequences: dict[str, SequenceBlocks] = {}
+        # observability
+        self.prefix_cached_tokens_total = 0
+        self.prefix_hits_total = 0
 
     # -- capacity ----------------------------------------------------------
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable capacity: truly-free plus evictable cached blocks."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
 
     @property
     def used_blocks(self) -> int:
-        return self.num_blocks - len(self._free)
+        return self.num_blocks - self.free_blocks
 
     @property
     def usage(self) -> float:
@@ -71,14 +102,100 @@ class BlockAllocator:
     def can_allocate(self, num_tokens: int) -> bool:
         return self.free_blocks - self.blocks_needed(num_tokens) >= self.watermark_blocks
 
+    # -- block lifecycle helpers ------------------------------------------
+    def _take_block(self, evicted_hashes: list[int]) -> int | None:
+        """Pop a free block, evicting the LRU cached block if needed."""
+        if self._free:
+            return self._free.popleft()
+        if self._cached:
+            bid, _ = self._cached.popitem(last=False)
+            h = self._block_hash.pop(bid, None)
+            if h is not None and self._hash_to_block.get(h) == bid:
+                del self._hash_to_block[h]
+                evicted_hashes.append(h)
+            return bid
+        return None
+
+    def _incref(self, bid: int) -> None:
+        if bid in self._cached:  # cached → in use (content kept)
+            del self._cached[bid]
+        self._ref[bid] = self._ref.get(bid, 0) + 1
+
+    def _decref(self, bid: int) -> None:
+        ref = self._ref.get(bid, 0) - 1
+        if ref > 0:
+            self._ref[bid] = ref
+            return
+        self._ref.pop(bid, None)
+        if bid in self._block_hash:
+            # complete + registered: retain content for future prefix hits
+            self._cached[bid] = None
+        else:
+            self._free.append(bid)
+
+    def _emit_removed(self, hashes: list[int]) -> None:
+        if hashes and self.event_sink:
+            self.event_sink(KvEvent(kind="removed", block_hashes=hashes))
+
     # -- allocation --------------------------------------------------------
-    def allocate_sequence(self, seq_id: str, num_tokens: int) -> list[int] | None:
-        needed = self.blocks_needed(num_tokens)
+    def _match(self, token_ids: list[int] | None) -> list[tuple[int, int]]:
+        """Leading (hash, block) pairs resident in the registry, capped so at
+        least one prompt token is left to prefill (the model must still run
+        to produce next-token logits)."""
+        if not self.enable_prefix_caching or not token_ids:
+            return []
+        matched: list[tuple[int, int]] = []
+        for h in compute_block_hashes(token_ids, self.block_size):
+            bid = self._hash_to_block.get(h)
+            if bid is None:
+                break
+            matched.append((h, bid))
+        while matched and len(matched) * self.block_size >= len(token_ids):
+            matched.pop()
+        return matched
+
+    def match_prefix(self, token_ids: list[int]) -> int:
+        """Number of prompt tokens resident in the registry."""
+        return len(self._match(token_ids)) * self.block_size
+
+    def allocate_sequence(
+        self, seq_id: str, num_tokens: int, token_ids: list[int] | None = None
+    ) -> tuple[list[int], int] | None:
+        """Allocate the block table for a new sequence of ``num_tokens``
+        positions.  When ``token_ids`` (the known prompt) is given, leading
+        complete blocks already resident are *shared* instead of allocated:
+        returns (block_ids, cached_tokens) where the first
+        ``cached_tokens // block_size`` entries are reused blocks the caller
+        must not write.  None ⇒ OOM (nothing claimed)."""
+        matched = self._match(token_ids)
+        needed = self.blocks_needed(num_tokens) - len(matched)
+        # claim matched blocks FIRST (removes them from the evictable set),
+        # then check capacity against what is genuinely left — a matched
+        # block sitting in the cached LRU must not be counted as allocatable
+        for _, bid in matched:
+            self._incref(bid)
         if needed > self.free_blocks:
+            for _, bid in matched:  # roll back: nothing claimed on OOM
+                self._decref(bid)
             return None
-        blocks = [self._free.popleft() for _ in range(needed)]
-        self._sequences[seq_id] = SequenceBlocks(block_ids=blocks)
-        return list(blocks)
+        evicted: list[int] = []
+        fresh: list[int] = []
+        for _ in range(max(needed, 0)):
+            bid = self._take_block(evicted)
+            assert bid is not None  # guaranteed by the capacity check
+            self._ref[bid] = 1
+            fresh.append(bid)
+        self._emit_removed(evicted)
+        cached_tokens = len(matched) * self.block_size
+        self._sequences[seq_id] = SequenceBlocks(
+            block_ids=[bid for _, bid in matched] + fresh,
+            published_hashes=[h for h, _ in matched],
+            cached_tokens=cached_tokens,
+        )
+        if cached_tokens:
+            self.prefix_hits_total += 1
+            self.prefix_cached_tokens_total += cached_tokens
+        return self._sequences[seq_id].block_ids[:], cached_tokens
 
     def append_slot(self, seq_id: str, context_len: int) -> int | None:
         """Slot (flat cache index) for token at position ``context_len - 1``,
@@ -98,10 +215,15 @@ class BlockAllocator:
         if max_pos is not None:
             last_pos = min(last_pos, max_pos)
         needed = last_pos // self.block_size + 1 - len(seq.block_ids)
-        if needed > len(self._free):
+        if needed > self.free_blocks:
             return None
+        evicted: list[int] = []
         for _ in range(needed):
-            seq.block_ids.append(self._free.popleft())
+            bid = self._take_block(evicted)
+            assert bid is not None
+            self._ref[bid] = 1
+            seq.block_ids.append(bid)
+        self._emit_removed(evicted)
         return seq.block_ids[pos // self.block_size] * self.block_size + pos % self.block_size
 
     def adopt_sequence(self, seq_id: str, block_ids: list[int]) -> None:
@@ -115,42 +237,61 @@ class BlockAllocator:
         needed = self.blocks_needed(num_tokens)
         if needed > self.free_blocks:
             return None
-        return [self._free.popleft() for _ in range(needed)]
+        evicted: list[int] = []
+        out = []
+        for _ in range(needed):
+            bid = self._take_block(evicted)
+            assert bid is not None
+            self._ref[bid] = 1
+            out.append(bid)
+        self._emit_removed(evicted)
+        return out
 
     def release_blocks(self, block_ids: list[int]) -> None:
         for b in block_ids:
-            self._free.append(b)
+            self._decref(b)
 
     def block_ids(self, seq_id: str) -> list[int]:
         return list(self._sequences[seq_id].block_ids)
 
+    def cached_tokens(self, seq_id: str) -> int:
+        seq = self._sequences.get(seq_id)
+        return seq.cached_tokens if seq else 0
+
     def free_sequence(self, seq_id: str) -> None:
+        """Sequence finished: decref its blocks.  Registered (complete)
+        blocks whose refcount hits zero stay resident in the LRU cache for
+        future prefix hits; ``removed`` events fire only on eviction."""
         seq = self._sequences.pop(seq_id, None)
         if seq is None:
             return
         for b in seq.block_ids:
-            self._free.append(b)
-        if seq.published_hashes and self.event_sink:
-            self.event_sink(KvEvent(kind="removed", block_hashes=list(seq.published_hashes)))
+            self._decref(b)
 
     def clear_published(self) -> int:
-        """Admin flush (reference: http clear_kv_blocks): forget every
-        published block hash and tell routers this worker's cache is gone.
-        Running sequences keep their blocks; their hashes simply re-publish
-        as future blocks complete."""
-        cleared = 0
+        """Admin flush (reference: http clear_kv_blocks): drop the whole
+        reuse registry — cached blocks are freed, in-use registered blocks
+        unregister — and tell routers this worker's cache is gone.  Running
+        sequences keep their blocks; their hashes simply re-publish as
+        future blocks complete."""
+        forgotten = set(self._hash_to_block)
         for seq in self._sequences.values():
-            cleared += len(seq.published_hashes)
+            forgotten.update(seq.published_hashes)
             seq.published_hashes = []
+        cleared = len(forgotten)
+        self._hash_to_block.clear()
+        self._block_hash.clear()
+        while self._cached:
+            bid, _ = self._cached.popitem(last=False)
+            self._free.append(bid)
         if self.event_sink:
             self.event_sink(KvEvent(kind="cleared", block_hashes=[]))
         return cleared
 
     # -- events ------------------------------------------------------------
     def publish_stored(self, seq_id: str, token_ids: list[int]) -> None:
-        """Emit stored events for newly-completed full blocks of ``seq_id``."""
-        if self.event_sink is None:
-            return
+        """Emit stored events for newly-completed full blocks of ``seq_id``
+        and register them for prefix reuse."""
         seq = self._sequences.get(seq_id)
         if seq is None:
             return
@@ -159,12 +300,23 @@ class BlockAllocator:
         if not new:
             return
         parent = seq.published_hashes[-1] if seq.published_hashes else None
+        if self.enable_prefix_caching:
+            for idx in range(len(seq.published_hashes), len(hashes)):
+                if idx >= len(seq.block_ids):
+                    break
+                h, bid = hashes[idx], seq.block_ids[idx]
+                # first writer wins: a hash already resident elsewhere keeps
+                # its mapping; this block simply stays unregistered
+                if h not in self._hash_to_block and bid not in self._block_hash:
+                    self._hash_to_block[h] = bid
+                    self._block_hash[bid] = h
         seq.published_hashes = hashes
-        self.event_sink(
-            KvEvent(
-                kind="stored",
-                block_hashes=new,
-                parent_hash=parent,
-                token_count=len(new) * self.block_size,
+        if self.event_sink:
+            self.event_sink(
+                KvEvent(
+                    kind="stored",
+                    block_hashes=new,
+                    parent_hash=parent,
+                    token_count=len(new) * self.block_size,
+                )
             )
-        )

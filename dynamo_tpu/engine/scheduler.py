@@ -102,10 +102,12 @@ class Scheduler:
             if not self.allocator.can_allocate(candidate.context_len + 1):
                 break
             self.waiting.popleft()
-            blocks = self.allocator.allocate_sequence(
-                candidate.seq_id, candidate.context_len + 1
+            alloc = self.allocator.allocate_sequence(
+                candidate.seq_id, candidate.context_len + 1,
+                token_ids=candidate.all_token_ids,
             )
-            assert blocks is not None
+            assert alloc is not None
+            _, candidate.cached_tokens = alloc
             candidate.status = SeqStatus.RUNNING
             candidate.lane = self._free_lanes.pop()
             prefills.append(candidate)

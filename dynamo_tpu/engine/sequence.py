@@ -30,6 +30,9 @@ class Sequence:
     remote_prefilled: bool = False   # decode worker: KV already injected
     # per-lane sampling state (penalty counts, rng key) initialized?
     sampling_seeded: bool = False
+    # prompt tokens reused from the prefix cache at allocation (the engine
+    # prefills only the tail past this point)
+    cached_tokens: int = 0
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only

@@ -28,7 +28,9 @@ from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.ops.attention import (
     dense_causal_attention,
+    gather_prefix_kv,
     paged_decode_attention,
+    prefill_attention_with_prefix,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -296,6 +298,54 @@ def llama_forward_prefill_embeds(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _logits(params, cfg, last[None])[0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_forward_prefill_with_prefix(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,       # [tail_pad] int32 — the uncached tail
+    kv_cache: dict,
+    full_block_ids: jnp.ndarray,  # [max_blocks] int32 — whole table (prefix+tail)
+    tail_block_ids: jnp.ndarray,  # [max_blocks] int32 — table from the first tail block
+    tail_len: jnp.ndarray,        # scalar int32: valid tail tokens
+    start_pos: jnp.ndarray,       # scalar int32: cached prefix length (block-aligned)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Continued prefill over a reused prefix: the tail's queries attend to
+    the resident prefix KV (gathered from the paged cache) plus themselves,
+    and only the tail's K/V are written.  Serves both prefix-cache hits and
+    chunked prefill (reference intent: vLLM prefix caching / chunked
+    prefill; block reuse lib/llm/src/block_manager/pool.rs:447-466)."""
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        # gather the resident prefix BEFORE writing the tail (the mask in
+        # the attention op drops everything past start_pos anyway)
+        k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
+        k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, tail_block_ids, tail_len)
+        attn = prefill_attention_with_prefix(
+            q, k, v, k_prefix, v_prefix, start_pos, tail_len
+        )
+        x = x + attn.reshape(s, -1) @ w["wo"]
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(tail_len - 1, 0)]
     logits = _logits(params, cfg, last[None])[0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 

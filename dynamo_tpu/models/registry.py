@@ -26,6 +26,9 @@ class ModelFamily:
     init_kv_cache: Callable | None = None
     kv_cache_specs: Callable | None = None
     make_rope_tables: Callable | None = None
+    # continued prefill over a resident prefix (prefix-cache reuse, chunked
+    # prefill); None = the engine disables prefix caching for this family
+    forward_prefill_with_prefix: Callable | None = None
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -61,6 +64,7 @@ def _llama_family() -> ModelFamily:
         param_specs=llama.param_specs,
         forward_prefill=llama.llama_forward_prefill,
         forward_decode=llama.llama_forward_decode,
+        forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
     )
 
 
@@ -85,6 +89,7 @@ def _qwen2_family() -> ModelFamily:
         param_specs=llama.param_specs,
         forward_prefill=llama.llama_forward_prefill,
         forward_decode=llama.llama_forward_decode,
+        forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
     )
 
 

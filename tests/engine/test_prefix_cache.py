@@ -1,0 +1,192 @@
+"""Prefix-cache reuse: allocator registry semantics and engine tail-only
+prefill (reference: vLLM engine-side prefix caching;
+lib/llm/src/block_manager/pool.rs:447-466 match_sequence_hashes)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.engine.kv_manager import BlockAllocator
+from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+
+from tests.engine.test_jax_engine import collect, greedy_reference, make_engine, request
+
+BS = 4
+
+
+# ---------------------------------------------------------------------------
+# allocator registry
+# ---------------------------------------------------------------------------
+
+
+def test_match_after_free_and_refcount_sharing():
+    alloc = BlockAllocator(16, BS)
+    tokens = list(range(10, 23))  # 3 full blocks + tail
+    blocks_a, cached = alloc.allocate_sequence("a", len(tokens) + 1, token_ids=tokens)
+    assert cached == 0
+    alloc.publish_stored("a", tokens)
+
+    # same prompt while A is alive: shares A's complete blocks
+    blocks_b, cached_b = alloc.allocate_sequence("b", len(tokens) + 1, token_ids=tokens)
+    assert cached_b == 3 * BS
+    assert blocks_b[:3] == blocks_a[:3]
+    assert blocks_b[3:] != blocks_a[3:]
+
+    # A finishes: shared blocks still owned by B, nothing freed twice
+    alloc.free_sequence("a")
+    assert alloc.block_ids("b")[:3] == blocks_a[:3]
+
+    # B finishes: complete blocks go to the cached LRU, match still works
+    alloc.free_sequence("b")
+    assert alloc.cached_blocks == 3
+    assert alloc.match_prefix(tokens) == 3 * BS
+
+
+def test_match_caps_below_full_prompt():
+    """A fully-cached prompt still leaves ≥1 token to prefill (the model
+    must run to produce next-token logits)."""
+    alloc = BlockAllocator(16, BS)
+    tokens = list(range(10, 22))  # exactly 3 blocks
+    alloc.allocate_sequence("a", len(tokens) + 1, token_ids=tokens)
+    alloc.publish_stored("a", tokens)
+    alloc.free_sequence("a")
+    assert alloc.match_prefix(tokens) == 2 * BS  # last block recomputed
+
+
+def test_eviction_is_lru_and_emits_removed():
+    events = []
+    alloc = BlockAllocator(8, BS, event_sink=events.append)
+    old = list(range(10, 18))   # 2 blocks
+    new = list(range(50, 58))   # 2 blocks
+    alloc.allocate_sequence("old", len(old), token_ids=old)
+    alloc.publish_stored("old", old)
+    alloc.free_sequence("old")
+    alloc.allocate_sequence("new", len(new), token_ids=new)
+    alloc.publish_stored("new", new)
+    alloc.free_sequence("new")
+    assert alloc.cached_blocks == 4
+    # claim 6 of 8 blocks: evicts the 2 LRU ("old") blocks, keeps "new"
+    alloc.allocate_sequence("big", 6 * BS)
+    removed = [h for e in events if e.kind == "removed" for h in e.block_hashes]
+    assert set(removed) == set(compute_block_hashes(old, BS))
+    assert alloc.match_prefix(new) == BS  # capped below full prompt
+
+
+def test_clear_drops_registry():
+    alloc = BlockAllocator(16, BS)
+    tokens = list(range(10, 23))
+    alloc.allocate_sequence("a", len(tokens) + 1, token_ids=tokens)
+    alloc.publish_stored("a", tokens)
+    alloc.free_sequence("a")
+    assert alloc.match_prefix(tokens) > 0
+    alloc.clear_published()
+    assert alloc.match_prefix(tokens) == 0
+    assert alloc.cached_blocks == 0
+    assert alloc.free_blocks == 16
+
+
+def test_disabled_prefix_caching_frees_immediately():
+    alloc = BlockAllocator(16, BS, enable_prefix_caching=False)
+    tokens = list(range(10, 23))
+    alloc.allocate_sequence("a", len(tokens) + 1, token_ids=tokens)
+    alloc.publish_stored("a", tokens)
+    alloc.free_sequence("a")
+    assert alloc.cached_blocks == 0
+    assert alloc.match_prefix(tokens) == 0
+    _, cached = alloc.allocate_sequence("b", len(tokens) + 1, token_ids=tokens)
+    assert cached == 0
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+
+async def test_repeat_prompt_reuses_prefix_with_identical_output():
+    """Second request with the same multi-block prompt performs a tail-only
+    prefill yet emits exactly the greedy-reference tokens."""
+    engine = make_engine()
+    try:
+        prompt = list(range(3, 17))  # 14 tokens → 3 full blocks at bs=4
+        ref = greedy_reference(prompt, 6)
+        first, _ = await collect(engine, request(prompt, max_tokens=6))
+        assert first == ref
+        assert engine.stats()["prefix_hits_total"] == 0
+
+        second, _ = await collect(engine, request(prompt, max_tokens=6))
+        assert second == ref
+        stats = engine.stats()
+        assert stats["prefix_hits_total"] == 1
+        # prompt blocks (3 full) were reused — only the tail prefilled
+        assert stats["prefix_cached_tokens_total"] == 12
+    finally:
+        engine.stop()
+
+
+async def test_shared_prefix_different_tails():
+    """Requests sharing a prefix but diverging afterwards reuse only the
+    shared complete blocks and still match their references."""
+    engine = make_engine()
+    try:
+        base = list(range(3, 15))  # 12 tokens = 3 full blocks
+        p1 = base + [40, 41, 42]
+        p2 = base + [50, 51]
+        ref1 = greedy_reference(p1, 5)
+        ref2 = greedy_reference(p2, 5)
+        out1, _ = await collect(engine, request(p1, max_tokens=5))
+        assert out1 == ref1
+        out2, _ = await collect(engine, request(p2, max_tokens=5))
+        assert out2 == ref2
+        stats = engine.stats()
+        assert stats["prefix_hits_total"] == 1
+        assert stats["prefix_cached_tokens_total"] == 12
+    finally:
+        engine.stop()
+
+
+async def test_generated_blocks_become_reusable():
+    """Blocks completed during decode register too: a follow-up prompt that
+    extends (prompt + generated) hits them."""
+    engine = make_engine()
+    try:
+        prompt = list(range(3, 11))  # 8 tokens = 2 blocks
+        out, _ = await collect(engine, request(prompt, max_tokens=8, ignore_eos=True))
+        follow = prompt + out  # 16 tokens = 4 full blocks
+        ref = greedy_reference(follow, 4)
+        out2, _ = await collect(engine, request(follow, max_tokens=4))
+        assert out2 == ref
+        assert engine.stats()["prefix_cached_tokens_total"] >= 12
+    finally:
+        engine.stop()
+
+
+async def test_clear_kv_blocks_disables_hit():
+    engine = make_engine()
+    try:
+        prompt = list(range(3, 17))
+        await collect(engine, request(prompt, max_tokens=4))
+        await engine.clear_kv_blocks()
+        await collect(engine, request(prompt, max_tokens=4))
+        assert engine.stats()["prefix_hits_total"] == 0
+    finally:
+        engine.stop()
+
+
+async def test_seeded_sampling_identical_with_and_without_prefix_hit():
+    """Seeded sampling must not diverge between the uncached and tail-only
+    prefill paths (key folds with total context length in both)."""
+    from tests.engine.test_jax_engine import sampled_request
+
+    prompt = list(range(3, 17))
+    engine = make_engine()
+    try:
+        first, _ = await collect(
+            engine, sampled_request(prompt, temperature=8.0, seed=77)
+        )
+        second, _ = await collect(
+            engine, sampled_request(prompt, temperature=8.0, seed=77)
+        )
+        assert engine.stats()["prefix_hits_total"] == 1
+        assert first == second
+    finally:
+        engine.stop()
