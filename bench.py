@@ -82,6 +82,7 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
         os.environ.get("DYN_BENCH_BLOCKS", per_seq_blocks * max_batch + 32)
     )
 
+    chunk = int(os.environ.get("DYN_BENCH_CHUNK", "0")) or None
     t_init = time.monotonic()
     engine = JaxLlmEngine(
         EngineConfig(
@@ -92,6 +93,7 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             max_model_len=max_len,
             prefill_buckets=(prompt_len,),
             decode_steps=decode_steps,
+            prefill_chunk_tokens=chunk,
         )
     )
     try:

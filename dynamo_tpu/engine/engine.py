@@ -69,6 +69,12 @@ class EngineConfig:
     # prompts prefill only the uncached tail (auto-disabled for families
     # without a continued-prefill forward).
     enable_prefix_caching: bool = True
+    # Chunked prefill: prompts longer than this prefill in chunks of this
+    # many tokens, interleaved with decode steps (None = whole-prompt
+    # prefill; rounded up to a block multiple; needs a continued-prefill
+    # forward).  Keeps ITL bounded under long-ISL load — the reference
+    # relies on engine chunked prefill + disagg offload (SURVEY.md §5).
+    prefill_chunk_tokens: int | None = None
     # Decode iterations fused into one jit launch (lax.scan with device-side
     # token feedback + slot derivation).  >1 amortizes per-step dispatch and
     # host↔device roundtrips — the dominant cost at small batch — at the
@@ -174,11 +180,25 @@ class JaxLlmEngine:
             config.enable_prefix_caching
             and self.family.forward_prefill_with_prefix is not None
         )
+        self.chunk_tokens = None
+        if (
+            config.prefill_chunk_tokens is not None
+            and self.family.forward_prefill_with_prefix is not None
+        ):
+            bs = config.block_size
+            self.chunk_tokens = max(1, (config.prefill_chunk_tokens + bs - 1) // bs) * bs
+            # chunks run as their own compile bucket (otherwise every chunk
+            # pads up to the next full-prompt bucket)
+            if self.chunk_tokens < self.max_len:
+                self.buckets = sorted(set(self.buckets) | {self.chunk_tokens})
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event,
             enable_prefix_caching=self.prefix_caching,
         )
-        self.scheduler = Scheduler(self.allocator, max_batch_size=config.max_batch_size)
+        self.scheduler = Scheduler(
+            self.allocator, max_batch_size=config.max_batch_size,
+            prefill_chunk_tokens=self.chunk_tokens,
+        )
         self._event_sink = event_sink
         self._iterations = 0
 
@@ -189,7 +209,9 @@ class JaxLlmEngine:
         self._thread: threading.Thread | None = None
         self._jit_prefill = self._build_prefill()
         self._jit_prefill_prefix = (
-            self._build_prefill_prefix() if self.prefix_caching else None
+            self._build_prefill_prefix()
+            if (self.prefix_caching or self.chunk_tokens is not None)
+            else None
         )
         self._jit_decode = self._build_decode()
         self._jit_extract = self._build_extract()
@@ -694,15 +716,26 @@ class JaxLlmEngine:
         lane = max(seq.lane, 0)  # prefill_only sequences have no decode lane
         # nonzero only on preemption recompute (token_ids include generated)
         gen_row = self._count_row(seq.output_ids)
-        cached = seq.cached_tokens
 
-        if cached and self._jit_prefill_prefix is not None:
-            # prefix-cache hit: prefill only the uncached tail; queries
-            # attend to the resident prefix blocks.  The block table is
+        # window for this call: everything past the already-written prefix
+        # (cached blocks and/or completed chunks) up to the scheduler's
+        # budgeted chunk target
+        start = max(seq.prefilled_tokens, seq.cached_tokens)
+        end = min(seq.chunk_target, n) if (
+            self.chunk_tokens is not None and seq.chunk_target
+        ) else n
+        final = end >= n
+
+        # the continued-prefill jit serves prefix hits AND every chunk (an
+        # intermediate first chunk needs its sample gate; start_pos=0 masks
+        # the prefix away entirely)
+        if self._jit_prefill_prefix is not None and (start > 0 or not final):
+            # continued prefill: queries attend to the resident prefix
+            # blocks (none when start == 0).  The block table is
             # bucketed like token lengths so the per-layer prefix gather
             # scales with the actual context, not max_blocks_per_seq
-            cached_blocks = cached // self.config.block_size
-            tail = tokens[cached:]
+            start_blocks = start // self.config.block_size
+            tail = tokens[start:end]
             t = len(tail)
             padded = np.zeros((self._bucket_len(t),), np.int32)
             padded[:t] = tail
@@ -712,26 +745,34 @@ class JaxLlmEngine:
             full_ids = np.zeros((table_len,), np.int32)
             full_ids[: len(blocks)] = blocks
             tail_ids = np.zeros((table_len,), np.int32)
-            tail_ids[: len(blocks) - cached_blocks] = blocks[cached_blocks:]
+            tail_ids[: len(blocks) - start_blocks] = blocks[start_blocks:]
             prompt_row = self._count_row(seq.request.token_ids)
             token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(full_ids),
-                jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(cached),
+                jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(start),
                 jnp.int32(n), jnp.asarray(prompt_row), jnp.asarray(gen_row),
-                jnp.int32(1), jnp.asarray(key), *sampling_tail,
+                jnp.int32(1 if final else 0), jnp.asarray(key), *sampling_tail,
             )
         else:
-            padded = np.zeros((self._bucket_len(n),), np.int32)
-            padded[:n] = tokens
+            padded = np.zeros((self._bucket_len(end),), np.int32)
+            padded[:end] = tokens[:end]
             block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             block_ids[: len(blocks)] = blocks
             token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
-                jnp.int32(n), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
+                jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
                 *sampling_tail,
             )
+        seq.prefilled_tokens = end
+        if not final:
+            # intermediate chunk: KV written, no token sampled; publish the
+            # completed blocks so routers (and future prompts) can hit them
+            self.allocator.publish_stored(seq.seq_id, tokens[:end])
+            return
+        if seq.status == SeqStatus.PREFILLING:
+            seq.status = SeqStatus.RUNNING  # last chunk done → decode
         if seq.prefill_only:
             # disagg prefill worker: hand back first token + the KV blocks
             ids = np.zeros((self.max_blocks_per_seq,), np.int32)

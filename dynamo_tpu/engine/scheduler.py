@@ -39,10 +39,14 @@ class Scheduler:
         *,
         max_batch_size: int,
         max_prefills_per_step: int = 1,
+        prefill_chunk_tokens: int | None = None,
     ):
         self.allocator = allocator
         self.max_batch_size = max_batch_size
         self.max_prefills_per_step = max_prefills_per_step
+        # chunked prefill: prompts longer than this prefill in chunks
+        # interleaved with decode steps (None = whole-prompt prefill)
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         self._free_lanes = list(range(max_batch_size - 1, -1, -1))
@@ -80,13 +84,36 @@ class Scheduler:
         # (growth happens in the engine when it asks for append slots; the
         # preemption hook is exposed via ensure_slot below)
 
-        # 2) admit prefills while blocks + lanes allow
+        # 2) continue in-flight chunked prefills, oldest first, under a
+        # SHARED per-step token budget (prefill_chunk_tokens): total prefill
+        # work per iteration is bounded regardless of how many prefills are
+        # in flight, so decode ITL stays bounded (vLLM-style budget)
+        bs = self.allocator.block_size
+        budget = self.prefill_chunk_tokens  # None = unlimited
         prefills: list[Sequence] = []
+        continuing = sorted(
+            (s for s in self.running if s.status == SeqStatus.PREFILLING),
+            key=lambda s: s.arrival_time,
+        )
+        for seq in continuing:
+            if budget is not None and budget < bs:
+                break
+            take = self._plan_chunk(seq, seq.prefilled_tokens, budget)
+            if take <= 0:
+                break
+            if budget is not None:
+                budget -= take
+            prefills.append(seq)
+
+        # 3) admit new prefills with the leftover budget while blocks +
+        # lanes allow
+        admitted = 0
         while (
             self.waiting
-            and len(prefills) < self.max_prefills_per_step
-            and len(self.running) + len(prefills) < self.max_batch_size
+            and admitted < self.max_prefills_per_step
+            and len(self.running) < self.max_batch_size
             and self._free_lanes
+            and (budget is None or budget >= bs)
         ):
             candidate = self.waiting[0]
             if candidate.remote_prefilled:
@@ -108,13 +135,35 @@ class Scheduler:
             )
             assert alloc is not None
             _, candidate.cached_tokens = alloc
-            candidate.status = SeqStatus.RUNNING
+            candidate.prefilled_tokens = candidate.cached_tokens
+            take = self._plan_chunk(candidate, candidate.cached_tokens, budget)
+            if budget is not None:
+                budget -= take
+            candidate.status = (
+                SeqStatus.PREFILLING
+                if candidate.chunk_target < candidate.context_len
+                else SeqStatus.RUNNING
+            )
             candidate.lane = self._free_lanes.pop()
             prefills.append(candidate)
             self.running.append(candidate)
+            admitted += 1
 
         decodes = [s for s in self.running if s not in prefills]
         return ScheduleDecision(prefills=prefills, decodes=decodes, preempted=preempted)
+
+    def _plan_chunk(self, seq: Sequence, start: int, budget: int | None) -> int:
+        """Set ``seq.chunk_target`` for this step's prefill window starting
+        at ``start`` within ``budget`` tokens; intermediate chunk ends stay
+        block-aligned.  Returns tokens taken (0 = budget too small)."""
+        remaining = seq.context_len - start
+        take = remaining if budget is None else min(remaining, budget)
+        if take < remaining:  # intermediate end must be block-aligned
+            take = (take // self.allocator.block_size) * self.allocator.block_size
+            if take <= 0:
+                return 0
+        seq.chunk_target = start + take
+        return take
 
     def ensure_slot(self, seq: Sequence) -> int | None:
         """Get the cache slot for this sequence's next token, preempting the
@@ -145,6 +194,7 @@ class Scheduler:
         seq.status = SeqStatus.PREEMPTED
         # remotely-prefilled KV is gone once blocks are freed: recompute locally
         seq.remote_prefilled = False
+        seq.prefilled_tokens = 0
         # re-queue at the front: preempted sequences restart first (their
         # prompt now includes generated tokens, so recompute is exact)
         self.waiting.appendleft(seq)

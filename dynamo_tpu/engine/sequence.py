@@ -10,9 +10,10 @@ from dynamo_tpu.llm.protocols.common import FinishReason, PreprocessedRequest
 
 
 class SeqStatus(enum.Enum):
-    WAITING = "waiting"       # queued for prefill
-    RUNNING = "running"       # decoding
-    PREEMPTED = "preempted"   # evicted; will re-prefill
+    WAITING = "waiting"         # queued for prefill
+    PREFILLING = "prefilling"   # chunked prefill in progress (holds a lane)
+    RUNNING = "running"         # decoding
+    PREEMPTED = "preempted"     # evicted; will re-prefill
     FINISHED = "finished"
 
 
@@ -33,6 +34,11 @@ class Sequence:
     # prompt tokens reused from the prefix cache at allocation (the engine
     # prefills only the tail past this point)
     cached_tokens: int = 0
+    # tokens whose KV is already written (cached prefix + completed chunks)
+    prefilled_tokens: int = 0
+    # end of the prefill window the scheduler planned for this step
+    # (0 = whole prompt)
+    chunk_target: int = 0
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only

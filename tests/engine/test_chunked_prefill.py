@@ -1,0 +1,144 @@
+"""Chunked prefill: long prompts prefill in block-aligned chunks interleaved
+with decode steps, keeping ITL bounded under long-ISL load (reference
+long-input strategy: SURVEY.md §5; disagg threshold
+lib/llm/src/disagg_router.rs:25-34)."""
+
+import asyncio
+import time
+
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+from tests.engine.test_jax_engine import (
+    collect,
+    greedy_reference,
+    make_engine,
+    request,
+    sampled_request,
+)
+
+
+async def test_chunked_prefill_matches_dense_reference():
+    """Output is bit-identical whether the prompt prefilled whole or in
+    chunks (chunk boundaries cross block and bucket edges)."""
+    prompt = list(range(3, 33))  # 30 tokens, not chunk- or block-aligned
+    ref = greedy_reference(prompt, 6)
+    engine = make_engine(prefill_chunk_tokens=8)
+    try:
+        tokens, _ = await collect(engine, request(prompt, max_tokens=6))
+        assert tokens == ref
+    finally:
+        engine.stop()
+
+
+async def test_decode_proceeds_between_chunks():
+    """A running short request keeps decoding while a long prompt chunk-
+    prefills under the shared per-step token budget: the short request
+    finishes before the long prompt's first token."""
+    long_prompt = list(range(3, 99))   # 96 tokens → many chunks of ≤16
+    short_prompt = list(range(5, 12))  # 7 tokens: fits one step's budget
+    engine = make_engine(prefill_chunk_tokens=16, max_model_len=128, num_blocks=64)
+
+    events: list[tuple[str, float]] = []
+
+    async def drive(tag, req_wire):
+        stream = await engine.generate(Context(req_wire))
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None and ann.data.token_ids:
+                events.append((tag, time.monotonic()))
+        return tag
+
+    try:
+        # short first (earlier arrival → prefills whole in step 1), long
+        # follows and chunk-prefills while short decodes
+        t_short = asyncio.ensure_future(
+            drive("short", request(short_prompt, max_tokens=6, ignore_eos=True))
+        )
+        await asyncio.sleep(0.01)
+        t_long = asyncio.ensure_future(
+            drive("long", request(long_prompt, max_tokens=2, ignore_eos=True))
+        )
+        await asyncio.gather(t_short, t_long)
+    finally:
+        engine.stop()
+
+    long_first = min(t for tag, t in events if tag == "long")
+    short_last = max(t for tag, t in events if tag == "short")
+    assert short_last < long_first, (
+        "short request should finish while the long prompt is still prefilling"
+    )
+    # and the long prompt still decodes correctly after its chunks
+    assert sum(1 for tag, _ in events if tag == "long") == 2
+
+
+async def test_chunked_prefill_with_prefix_hit():
+    """Chunking composes with prefix reuse: a repeated long prompt reuses
+    cached blocks and chunk-prefills only the remainder, same output."""
+    prompt = list(range(3, 51))  # 48 tokens
+    engine = make_engine(prefill_chunk_tokens=8, max_model_len=128, num_blocks=64)
+    try:
+        ref, _ = await collect(engine, request(prompt, max_tokens=5))
+        out, _ = await collect(engine, request(prompt, max_tokens=5))
+        assert out == ref
+        assert engine.stats()["prefix_hits_total"] == 1
+    finally:
+        engine.stop()
+
+
+async def test_chunked_prefill_penalties_and_seed():
+    """Sampling state (penalties, seeded RNG) is exact through the chunked
+    path: outputs equal the unchunked engine's."""
+    prompt = list(range(3, 40))
+    unchunked = make_engine()
+    try:
+        ref, _ = await collect(
+            unchunked,
+            sampled_request(prompt, max_tokens=10, temperature=8.0, seed=42,
+                            frequency_penalty=2.0),
+        )
+    finally:
+        unchunked.stop()
+    chunked = make_engine(prefill_chunk_tokens=8)
+    try:
+        out, _ = await collect(
+            chunked,
+            sampled_request(prompt, max_tokens=10, temperature=8.0, seed=42,
+                            frequency_penalty=2.0),
+        )
+    finally:
+        chunked.stop()
+    assert out == ref
+
+
+async def test_chunked_prefill_extract_for_disagg():
+    """prefill_extract (disagg prefill worker) produces the same first token
+    through the chunked path."""
+    prompt = list(range(3, 40))
+
+    def pre():
+        return PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=4),
+            eos_token_ids=[1],
+        )
+
+    plain = make_engine()
+    try:
+        tok_ref, _, n_ref = await plain.prefill_extract(pre())
+    finally:
+        plain.stop()
+    chunked = make_engine(prefill_chunk_tokens=8)
+    try:
+        tok, blocks, n = await chunked.prefill_extract(pre())
+    finally:
+        chunked.stop()
+    assert tok == tok_ref
+    assert n == n_ref
