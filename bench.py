@@ -159,6 +159,8 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
     results = await asyncio.gather(*[drive(make_request()) for _ in range(num_requests)])
     wall = time.monotonic() - t0
 
+    xfer = await _measure_kv_xfer(engine)
+
     n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(engine.params))
 
     total_tokens = sum(c for c, _ in results)
@@ -208,8 +210,81 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
             "platform": dev.platform,
             "device_kind": dev.device_kind,
             "cpu_fallback": fallback_cpu,
+            **xfer,
         },
     }
+
+
+async def _measure_kv_xfer(engine, n_blocks: int = 64, iters: int = 5) -> dict:
+    """Prefill→decode KV block transfer bandwidth through the real transfer
+    stack (BASELINE.json headline metric), both strategies:
+    - device: same-process path, blocks stay as device arrays end-to-end
+    - host_tcp: device→host staging + two-part codec over TCP loopback +
+      host→device scatter (the DCN path's per-process cost floor)
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.parallel.kv_transfer import (
+        LOCAL_SERVERS,
+        KvTransferClient,
+        KvTransferPayload,
+        KvTransferServer,
+    )
+
+    n_blocks = min(n_blocks, engine.config.num_blocks // 2)
+    if n_blocks < 1:
+        return {}
+    ids = jnp.asarray(np.arange(n_blocks, dtype=np.int32))
+    dst = list(range(n_blocks, 2 * n_blocks))
+    payload_bytes = sum(
+        int(np.prod((x.shape[0], n_blocks, *x.shape[2:]))) * x.dtype.itemsize
+        for x in jax.tree.leaves(dict(engine.cache))
+    )
+
+    server = KvTransferServer(lambda p: engine.inject_blocks(p.block_ids, p.blocks))
+    await server.start()
+    client = KvTransferClient()
+    out = {}
+    try:
+        for strategy in ("device", "host_tcp"):
+            if strategy == "host_tcp":
+                LOCAL_SERVERS.pop(server.address, None)  # force TCP
+            gathered = engine._jit_extract(engine.cache, ids)
+            if strategy == "host_tcp":
+                blocks = jax.tree.map(np.asarray, gathered)
+            else:
+                blocks = dict(gathered)
+            payload = KvTransferPayload(
+                seq_id="bench", first_token=0, block_ids=dst, blocks=blocks
+            )
+            await client.send(server.address, payload)  # warm (compiles)
+            t0 = time.monotonic()
+            for _ in range(iters):
+                gathered = engine._jit_extract(engine.cache, ids)
+                if strategy == "host_tcp":
+                    blocks = jax.tree.map(np.asarray, gathered)
+                else:
+                    blocks = dict(gathered)
+                await client.send(
+                    server.address,
+                    KvTransferPayload(
+                        seq_id="bench", first_token=0, block_ids=dst, blocks=blocks
+                    ),
+                )
+            # the device-strategy scatter is async-dispatched: synchronize
+            # before stopping the clock or GB/s reads high
+            jax.block_until_ready(jax.tree.leaves(dict(engine.cache)))
+            elapsed = time.monotonic() - t0
+            out[f"kv_xfer_gbps_{strategy}"] = round(
+                payload_bytes * iters / elapsed / 1e9, 3
+            )
+        out["kv_xfer_block_mb"] = round(payload_bytes / n_blocks / 1e6, 3)
+    finally:
+        await client.close()
+        await server.stop()
+    return out
 
 
 async def run_bench() -> dict:

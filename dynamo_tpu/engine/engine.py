@@ -75,6 +75,11 @@ class EngineConfig:
     # forward).  Keeps ITL bounded under long-ISL load — the reference
     # relies on engine chunked prefill + disagg offload (SURVEY.md §5).
     prefill_chunk_tokens: int | None = None
+    # G2 host-DRAM tier: registered blocks evicted from HBM offload here and
+    # restore on a later prefix hit instead of recomputing (0 = off).
+    # Reference: block manager G1→G2 offload, lib/llm/src/block_manager/
+    # offload.rs:77-80.
+    host_offload_blocks: int = 0
     # Decode iterations fused into one jit launch (lax.scan with device-side
     # token feedback + slot derivation).  >1 amortizes per-step dispatch and
     # host↔device roundtrips — the dominant cost at small batch — at the
@@ -191,13 +196,31 @@ class JaxLlmEngine:
             # pads up to the next full-prompt bucket)
             if self.chunk_tokens < self.max_len:
                 self.buckets = sorted(set(self.buckets) | {self.chunk_tokens})
+        self.host_tier = None
+        self._host_evictions: list[int] | None = None
+        offload_sink = None
+        if config.host_offload_blocks and self.prefix_caching:
+            from dynamo_tpu.engine.offload import HostOffloadTier
+
+            leaves = dict(self.cache)
+            self.host_tier = HostOffloadTier(
+                config.host_offload_blocks,
+                {k: (v.shape[0], *v.shape[2:]) for k, v in leaves.items()},
+                {k: np.dtype(v.dtype) for k, v in leaves.items()},
+            )
+            offload_sink = self._offload_blocks
+            # a hash evicted from the host LRU while no longer device-
+            # resident exists in no tier: routers must forget it
+            self.host_tier.pool.evict_sink = self._host_evicted
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event,
             enable_prefix_caching=self.prefix_caching,
+            offload_sink=offload_sink, host_tier=self.host_tier,
         )
         self.scheduler = Scheduler(
             self.allocator, max_batch_size=config.max_batch_size,
             prefill_chunk_tokens=self.chunk_tokens,
+            bucket_cost=self._bucket_len,
         )
         self._event_sink = event_sink
         self._iterations = 0
@@ -213,8 +236,19 @@ class JaxLlmEngine:
             if (self.prefix_caching or self.chunk_tokens is not None)
             else None
         )
+        self._jit_prefill_mm = (
+            self._build_prefill_mm()
+            if self.family.forward_prefill_embeds is not None
+            else None
+        )
         self._jit_decode = self._build_decode()
         self._jit_extract = self._build_extract()
+        # block-table compile buckets (id-array lengths for extract/inject/
+        # restore/prefix paths — no full-size pad buffers)
+        self._table_buckets = sorted(
+            {self.allocator.blocks_needed(b) for b in self.buckets}
+            | {self.max_blocks_per_seq}
+        )
         self._jit_inject = self._build_inject()
         set_row_kwargs = {}
         if self.mesh is not None:
@@ -291,6 +325,47 @@ class JaxLlmEngine:
             # sample_gate=0 for non-final chunks of a chunked prefill: the
             # logits are discarded and no generated count is recorded
             gen_counts = gen_counts.at[lane, token].add(sample_gate)
+            return token, cache, gen_counts, prompt_counts
+
+        kwargs = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+        return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
+
+    def _build_prefill_mm(self):
+        """Multimodal prefill: input embeddings are vision patch embeddings
+        (positions < n_patch) spliced before text token embeddings looked up
+        in-jit.  (Reference: multimodal encode→prefill flow,
+        examples/multimodal/components/encode_worker.py:61.)"""
+        cfg = self.config.model
+        vocab = cfg.vocab_size
+
+        def step(params, cache, gen_counts, prompt_counts, lane, embeds,
+                 token_ids, n_patch, block_ids, seq_len, gen_row, key, temp,
+                 top_k, top_p, greedy, pres, freq, rep):
+            s = token_ids.shape[0]
+            pos = jnp.arange(s)
+            x_text = params["embed"][token_ids].astype(cfg.dtype)
+            x = jnp.where((pos < n_patch)[:, None], embeds.astype(cfg.dtype), x_text)
+            logits, cache = self.family.forward_prefill_embeds(
+                params, cfg, x, cache, block_ids, seq_len, jnp.int32(0),
+                self.cos, self.sin,
+            )
+            # penalty rows count TEXT tokens only (patch positions masked)
+            valid = ((pos >= n_patch) & (pos < seq_len)).astype(jnp.int32)
+            full_row = jnp.zeros((vocab,), jnp.int32).at[token_ids].add(valid, mode="drop")
+            prompt_row = full_row - gen_row
+            prompt_counts = prompt_counts.at[lane].set(prompt_row)
+            gen_counts = gen_counts.at[lane].set(gen_row)
+            plogits = apply_penalties(
+                logits[None], gen_row[None], prompt_row[None], pres, freq, rep
+            )
+            step_key = jax.random.fold_in(key, seq_len)
+            token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            gen_counts = gen_counts.at[lane, token].add(1)
             return token, cache, gen_counts, prompt_counts
 
         kwargs = {}
@@ -423,9 +498,14 @@ class JaxLlmEngine:
             raise ValueError(
                 f"prompt length {len(pre.token_ids)} exceeds engine max length {self.max_len}"
             )
+        seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
+        return self._start_sequence(seq, ctx)
+
+    def _start_sequence(self, seq: Sequence, ctx) -> ResponseStream[dict]:
+        """Shared streaming tail for every entry point: wire the emit
+        callback, submit to the device thread, watch for cancellation."""
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
-        seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
 
         def emit(tokens: list[int], finish: FinishReason | None) -> None:
             out = LLMEngineOutput(token_ids=tokens, finish_reason=finish)
@@ -452,25 +532,62 @@ class JaxLlmEngine:
 
         return ResponseStream(gen(), ctx)
 
+    async def generate_multimodal(
+        self, request: Context[dict], embeds
+    ) -> ResponseStream[dict]:
+        """Generate with vision patch embeddings spliced before the text
+        prompt (LLaVA-style).  ``embeds``: [n_patches, hidden] float array
+        from the vision encoder's projector."""
+        if self._jit_prefill_mm is None:
+            raise ValueError(
+                f"model family {self.config.model_family!r} has no multimodal prefill"
+            )
+        pre = PreprocessedRequest.from_wire(request.data)
+        ctx = request.ctx
+        embeds = np.asarray(embeds, np.float32)
+        if embeds.ndim != 2 or embeds.shape[1] != self.config.model.hidden_size:
+            raise ValueError(
+                f"embeds shape {embeds.shape} != [n, {self.config.model.hidden_size}]"
+            )
+        if len(pre.token_ids) + len(embeds) >= self.max_len:
+            raise ValueError(
+                f"prompt ({len(pre.token_ids)} text + {len(embeds)} patches) "
+                f"exceeds engine max length {self.max_len}"
+            )
+        seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, mm_embeds=embeds)
+        return self._start_sequence(seq, ctx)
+
     async def _watch_cancel(self, ctx, seq: Sequence) -> None:
         await ctx.stopped()
         self._submit_q.put(("abort", seq))
         self._wake.set()
 
     # -- disaggregation API ------------------------------------------------
-    async def prefill_extract(self, pre: PreprocessedRequest) -> tuple[int, dict, int]:
+    async def prefill_extract(
+        self, pre: PreprocessedRequest, *, device: bool = False
+    ) -> tuple[int, dict, int]:
         """Prefill-worker side: run prefill only, return (first_token,
         blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
-        sequence's blocks as host numpy, e.g. llama
-        ``{"k": [L, n, bs, kvh, d], "v": ...}``."""
+        sequence's blocks, e.g. llama ``{"k": [L, n, bs, kvh, d], "v": ...}``
+        — host numpy by default, device arrays with ``device=True`` (the
+        same-process/ICI transfer path: no host staging)."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
-        seq = Sequence(seq_id=uuid.uuid4().hex, request=pre, prefill_only=True)
+        seq = Sequence(
+            seq_id=uuid.uuid4().hex, request=pre, prefill_only=True,
+            extract_device=device,
+        )
 
         def on_done(result) -> None:
-            loop.call_soon_threadsafe(
-                lambda: fut.set_result(result) if not fut.done() else None
-            )
+            def resolve() -> None:
+                if fut.done():
+                    return
+                if isinstance(result, BaseException):
+                    fut.set_exception(result)
+                else:
+                    fut.set_result(result)
+
+            loop.call_soon_threadsafe(resolve)
 
         seq.on_prefill_done = on_done
         self._submit_q.put(("add", seq))
@@ -485,13 +602,21 @@ class JaxLlmEngine:
 
     async def inject_blocks(self, block_ids: list[int], blocks: dict) -> None:
         """Decode-worker side: write transferred KV blocks (cache pytree of
-        host arrays) into the cache (runs on the device thread to serialize
-        with step functions)."""
+        host or device arrays) into the cache (runs on the device thread to
+        serialize with step functions)."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
 
-        def done() -> None:
-            loop.call_soon_threadsafe(lambda: fut.set_result(None) if not fut.done() else None)
+        def done(exc: BaseException | None = None) -> None:
+            def resolve() -> None:
+                if fut.done():
+                    return
+                if exc is not None:
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(None)
+
+            loop.call_soon_threadsafe(resolve)
 
         self._submit_q.put(("inject", (list(block_ids), blocks, done)))
         self._wake.set()
@@ -563,7 +688,7 @@ class JaxLlmEngine:
 
     def stats(self) -> dict:
         """ForwardPassMetrics (reference: lib/llm/src/kv_router/protocols.rs:43-59)."""
-        return {
+        out = {
             "kv_active_blocks": self.allocator.used_blocks,
             "kv_total_blocks": self.allocator.num_blocks,
             "kv_cached_blocks": self.allocator.cached_blocks,
@@ -575,6 +700,9 @@ class JaxLlmEngine:
             "prefix_hits_total": self.allocator.prefix_hits_total,
             "prefix_cached_tokens_total": self.allocator.prefix_cached_tokens_total,
         }
+        if self.host_tier is not None:
+            out.update(self.host_tier.stats())
+        return out
 
     # -- device thread -----------------------------------------------------
     def _device_loop(self) -> None:
@@ -583,18 +711,52 @@ class JaxLlmEngine:
             self.max_len, self.config.num_blocks, self.config.max_batch_size, self.buckets,
         )
         while not self._stop:
-            self._drain_submissions()
-            if not self.scheduler.has_work():
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
-                continue
-            decision = self.scheduler.schedule()
-            for seq in decision.prefills:
-                self._run_prefill(seq)
-            decodes = [s for s in self.scheduler.running if s.status == SeqStatus.RUNNING]
-            if decodes:
-                self._run_decode(decodes)
-            self._iterations += 1
+            try:
+                # evictions queued by asyncio-thread mutators (disagg
+                # reserve_blocks) offload here, before anything can write
+                # into the evicted blocks
+                self.allocator.flush_offloads()
+                self._drain_submissions()
+                if not self.scheduler.has_work():
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                decision = self.scheduler.schedule()
+                for seq in decision.prefills:
+                    try:
+                        self._run_prefill(seq)
+                    except Exception as exc:  # noqa: BLE001 — fail THIS
+                        # sequence (free blocks, resolve its caller) and
+                        # keep serving; retrying would hot-spin on
+                        # deterministic failures and skipping the rest of
+                        # the batch would leave restore plans unexecuted
+                        logger.exception("prefill failed for %s", seq.seq_id)
+                        self._fail_sequence(seq, exc)
+                decodes = [
+                    s for s in self.scheduler.running if s.status == SeqStatus.RUNNING
+                ]
+                if decodes:
+                    try:
+                        self._run_decode(decodes)
+                    except Exception as exc:  # noqa: BLE001
+                        logger.exception("decode step failed")
+                        for seq in decodes:
+                            if seq.status == SeqStatus.RUNNING:
+                                self._fail_sequence(seq, exc)
+                self._iterations += 1
+            except Exception:  # noqa: BLE001 — scheduler-level bug: keep the
+                # thread alive (callers would hang forever), don't hot-spin
+                logger.exception("engine step failed")
+                time.sleep(0.1)
+
+    def _fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
+        """Terminate one sequence on an engine-side error: free its
+        resources and resolve its caller with the failure."""
+        self.scheduler.finish(seq)
+        if seq.on_prefill_done:
+            seq.on_prefill_done(exc)
+        elif seq.emit:
+            seq.emit([], FinishReason.ERROR)
 
     def _drain_submissions(self) -> None:
         while True:
@@ -613,35 +775,133 @@ class JaxLlmEngine:
             elif op == "clear_kv":
                 done = seq  # payload is the completion callback
                 cleared = self.allocator.clear_published()
+                if self.host_tier is not None:
+                    self.host_tier.clear()
                 logger.info("cleared %d published kv block hashes", cleared)
                 if done is not None:
                     done()
             elif op == "inject":
+                # evictions queued by the reservation for THIS inject (or any
+                # other asyncio-thread mutator) must offload before the
+                # inject overwrites their blocks — the loop-top flush does
+                # not cover reservations racing into the same drain pass
+                self.allocator.flush_offloads()
                 block_ids, blocks, done = seq  # payload tuple
                 n = len(block_ids)
-                ids = np.zeros((self.max_blocks_per_seq,), np.int32)
+                nb = self._table_len(n)  # bucketed, not max-padded
+                ids = np.zeros((nb,), np.int32)
                 ids[:n] = block_ids
-                # pad each leaf to the static max_blocks_per_seq shape; leaf
-                # geometry comes from the live cache pytree, so asymmetric
-                # layouts (DeepSeek MLA latent/rope widths) shape correctly
+                # pad each leaf to the bucketed id length; leaf geometry
+                # comes from the live cache pytree, so asymmetric layouts
+                # (DeepSeek MLA latent/rope widths) shape correctly.  Device
+                # arrays (same-process transfer) pad on device — no host hop
                 def pad(leaf, incoming):
+                    if isinstance(incoming, jax.Array):
+                        out = jnp.zeros(
+                            (leaf.shape[0], nb, *leaf.shape[2:]), incoming.dtype
+                        )
+                        return out.at[:, :n].set(incoming)
                     incoming = np.asarray(incoming)
-                    shape = (leaf.shape[0], self.max_blocks_per_seq, *leaf.shape[2:])
-                    out = np.zeros(shape, incoming.dtype)
+                    out = np.zeros((leaf.shape[0], nb, *leaf.shape[2:]), incoming.dtype)
                     out[:, :n] = incoming
                     return jnp.asarray(out)
 
-                padded = jax.tree.map(pad, self.cache, blocks)
-                self.cache = self._jit_inject(
-                    self.cache, padded, jnp.asarray(ids), jnp.int32(n)
-                )
-                done()
+                try:
+                    padded = jax.tree.map(pad, self.cache, blocks)
+                    self.cache = self._jit_inject(
+                        self.cache, padded, jnp.asarray(ids), jnp.int32(n)
+                    )
+                except Exception as exc:  # noqa: BLE001 — fail the caller,
+                    # don't leave its future hanging
+                    logger.exception("kv inject failed")
+                    done(exc)
+                else:
+                    done()
 
     def _bucket_len(self, n: int) -> int:
         for b in self.buckets:
             if b >= n:
                 return b
         return self.buckets[-1]
+
+    def _table_len(self, nblocks: int) -> int:
+        """Smallest block-table compile bucket covering ``nblocks``.
+        Batched ops (offload flush, transfer benchmarks) can exceed one
+        sequence's table — those bucket to the next power of two."""
+        for b in self._table_buckets:
+            if b >= nblocks:
+                return b
+        n = self.max_blocks_per_seq
+        while n < nblocks:
+            n <<= 1
+        return min(n, self.config.num_blocks)
+
+    # -- G2 host offload ---------------------------------------------------
+    def _offload_blocks(self, pairs: list[tuple[int, int]]) -> list[int]:
+        """Allocator eviction hook: copy the evicted blocks' cache slices to
+        the host tier in ONE bucketed gather + device→host transfer (device
+        thread, before the new owners write).  Returns hashes that failed to
+        offload (host tier full of pins) — those must be announced removed."""
+        n = len(pairs)
+        nb = self._table_len(n)
+        ids = np.zeros((nb,), np.int32)
+        for i, (bid, _) in enumerate(pairs):
+            ids[i] = bid
+        gathered = jax.tree.map(
+            np.asarray, self._jit_extract(self.cache, jnp.asarray(ids))
+        )
+        failed: list[int] = []
+        # host-LRU evictions triggered by these puts are judged AFTER the
+        # whole batch: a hash evicted mid-batch may be re-inserted by a
+        # later put (no event), or end up in no tier (removed event)
+        self._host_evictions = []
+        try:
+            for i, (_, h) in enumerate(pairs):
+                content = jax.tree.map(lambda a, i=i: a[:, i], gathered)
+                if not self.host_tier.put(h, content):
+                    failed.append(h)
+            for h in self._host_evictions:
+                if (
+                    not self.host_tier.has(h)
+                    and h not in self.allocator._hash_to_block
+                    and h not in failed
+                ):
+                    failed.append(h)
+        finally:
+            self._host_evictions = None
+        return failed
+
+    def _host_evicted(self, seq_hash: int) -> None:
+        """Host-tier LRU eviction observer.  During an offload batch the
+        verdict is deferred to the end of the batch (a later put may
+        re-insert the hash); host puts only happen inside batches, but keep
+        a direct-emit fallback for any other path."""
+        if self._host_evictions is not None:
+            self._host_evictions.append(seq_hash)
+            return
+        if seq_hash not in self.allocator._hash_to_block:
+            self.allocator._emit_removed([seq_hash])
+
+    def _restore_blocks(self, plan: list[tuple[int, int]]) -> None:
+        """Scatter pinned host blocks into their device landing blocks (one
+        batched inject, id array bucketed)."""
+        n = len(plan)
+        nb = self._table_len(n)
+        ids = np.full((nb,), self.config.num_blocks, np.int32)
+        staged = {
+            k: np.zeros((v.shape[0], nb, *v.shape[2:]), np.dtype(v.dtype))
+            for k, v in dict(self.cache).items()
+        }
+        for i, (h, bid) in enumerate(plan):
+            content = self.host_tier.read_pinned(h)
+            assert content is not None, "pinned host block vanished"
+            ids[i] = bid
+            for name, arr in content.items():
+                staged[name][:, i] = arr
+        self.cache = self._jit_inject(
+            self.cache, jax.tree.map(jnp.asarray, staged),
+            jnp.asarray(ids), jnp.int32(n),
+        )
 
     def _sampling_arrays(self, seqs: list[Sequence], lanes: int):
         temp = np.zeros((lanes,), np.float32)
@@ -704,6 +964,9 @@ class JaxLlmEngine:
     def _run_prefill(self, seq: Sequence) -> None:
         tokens = seq.all_token_ids
         n = len(tokens)
+        restore = self.allocator.take_restore_plan(seq.seq_id)
+        if restore:
+            self._restore_blocks(restore)
         blocks = self.allocator.block_ids(seq.seq_id)
         temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays([seq], 1)
         sampling_tail = (
@@ -726,6 +989,27 @@ class JaxLlmEngine:
         ) else n
         final = end >= n
 
+        if seq.mm_embeds is not None:
+            # multimodal: patch embeddings occupy positions [0, mm_len),
+            # text tokens follow; embeddings splice in-jit
+            total = seq.context_len
+            bucket = self._bucket_len(total)
+            tok_arr = np.zeros((bucket,), np.int32)
+            text = seq.request.token_ids + seq.output_ids
+            tok_arr[seq.mm_len : seq.mm_len + len(text)] = text
+            emb_pad = np.zeros((bucket, self.config.model.hidden_size), np.float32)
+            emb_pad[: seq.mm_len] = seq.mm_embeds
+            block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
+            block_ids[: len(blocks)] = blocks
+            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_mm(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                jnp.int32(lane), jnp.asarray(emb_pad), jnp.asarray(tok_arr),
+                jnp.int32(seq.mm_len), jnp.asarray(block_ids), jnp.int32(total),
+                jnp.asarray(gen_row), jnp.asarray(key), *sampling_tail,
+            )
+            seq.prefilled_tokens = total
+            self._process_token(seq, int(token))
+            return
         # the continued-prefill jit serves prefix hits AND every chunk (an
         # intermediate first chunk needs its sample gate; start_pos=0 masks
         # the prefix away entirely)
@@ -775,20 +1059,21 @@ class JaxLlmEngine:
             seq.status = SeqStatus.RUNNING  # last chunk done → decode
         if seq.prefill_only:
             # disagg prefill worker: hand back first token + the KV blocks
-            ids = np.zeros((self.max_blocks_per_seq,), np.int32)
-            ids[: len(blocks)] = blocks
-            gathered = self._jit_extract(self.cache, jnp.asarray(ids))
             n_used = self.allocator.blocks_needed(n)
-            result = (
-                int(token),
-                jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered),
-                n_used,
-            )
+            ids = np.zeros((self._table_len(n_used),), np.int32)
+            ids[: len(blocks)] = blocks[: len(ids)]
+            gathered = self._jit_extract(self.cache, jnp.asarray(ids))
+            if seq.extract_device:
+                blocks_out = jax.tree.map(lambda x: x[:, :n_used], gathered)
+            else:
+                blocks_out = jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered)
+            result = (int(token), blocks_out, n_used)
             self.scheduler.finish(seq)
             if seq.on_prefill_done:
                 seq.on_prefill_done(result)
             return
-        self.allocator.publish_stored(seq.seq_id, tokens)
+        if seq.mm_embeds is None:
+            self.allocator.publish_stored(seq.seq_id, tokens)
         self._process_token(seq, int(token))
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
@@ -869,5 +1154,7 @@ class JaxLlmEngine:
             seq.emit([token], finish)
         if finish is not None:
             self.scheduler.finish(seq)
-        elif seq.context_len % self.config.block_size == 0:
+        elif seq.context_len % self.config.block_size == 0 and seq.mm_embeds is None:
+            # (multimodal blocks never publish: text-token hashes cannot
+            # describe patch-embedding content)
             self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)

@@ -42,6 +42,9 @@ class SequenceBlocks:
     block_ids: list[int] = field(default_factory=list)
     published_hashes: list[int] = field(default_factory=list)
     cached_tokens: int = 0       # prefix tokens reused from the registry
+    # (hash, device block) pairs whose content must be restored from the
+    # host tier before this sequence prefills
+    restore_plan: list[tuple[int, int]] = field(default_factory=list)
 
 
 class BlockAllocator:
@@ -62,11 +65,22 @@ class BlockAllocator:
         event_sink: Callable[[KvEvent], None] | None = None,
         watermark: float = 0.01,
         enable_prefix_caching: bool = True,
+        # G2 host tier hooks (engine/offload.py HostOffloadTier): evicted
+        # registered blocks offload their content; prompt matching extends
+        # into the host tier with pin-until-restore semantics
+        offload_sink: Callable[[int, int], None] | None = None,
+        host_tier=None,
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.event_sink = event_sink
         self.enable_prefix_caching = enable_prefix_caching
+        self.offload_sink = offload_sink
+        self.host_tier = host_tier
+        # evictions collected per public call, offloaded in ONE batched
+        # device read (the new owners don't write until the engine runs its
+        # step functions, strictly after the mutator returns)
+        self._pending_offload: list[tuple[int, int]] = []
         self.watermark_blocks = max(1, int(num_blocks * watermark))
         self._free: deque[int] = deque(range(num_blocks))
         self._cached: OrderedDict[int, None] = OrderedDict()  # block -> None, LRU
@@ -103,8 +117,11 @@ class BlockAllocator:
         return self.free_blocks - self.blocks_needed(num_tokens) >= self.watermark_blocks
 
     # -- block lifecycle helpers ------------------------------------------
-    def _take_block(self, evicted_hashes: list[int]) -> int | None:
-        """Pop a free block, evicting the LRU cached block if needed."""
+    def _take_block(self) -> int | None:
+        """Pop a free block, evicting the LRU cached block if needed.  The
+        evicted block's content offloads to the host tier (G2) in a batch at
+        the end of the current mutator (before the new owner can write);
+        hashes that fail to offload are announced ``removed``."""
         if self._free:
             return self._free.popleft()
         if self._cached:
@@ -112,9 +129,31 @@ class BlockAllocator:
             h = self._block_hash.pop(bid, None)
             if h is not None and self._hash_to_block.get(h) == bid:
                 del self._hash_to_block[h]
-                evicted_hashes.append(h)
+                self._pending_offload.append((bid, h))
             return bid
         return None
+
+    def flush_offloads(self) -> None:
+        """Batched G1→G2 offload of pending evictions; any hash that is now
+        resident in NO tier emits a removed event so routers forget it.
+        MUST run on the device thread (the sink reads the device cache) and
+        before any step function writes into the evicted blocks."""
+        if not self._pending_offload:
+            return
+        pairs, self._pending_offload = self._pending_offload, []
+        if self.offload_sink is None:
+            self._emit_removed([h for _, h in pairs])
+            return
+        try:
+            failed = list(self.offload_sink(pairs) or [])
+        except Exception:  # noqa: BLE001 — eviction must proceed
+            import logging
+
+            logging.getLogger("dynamo_tpu.engine").exception(
+                "block offload failed; dropping %d blocks", len(pairs)
+            )
+            failed = [h for _, h in pairs]
+        self._emit_removed(failed)
 
     def _incref(self, bid: int) -> None:
         if bid in self._cached:  # cached → in use (content kept)
@@ -138,24 +177,38 @@ class BlockAllocator:
             self.event_sink(KvEvent(kind="removed", block_hashes=hashes))
 
     # -- allocation --------------------------------------------------------
-    def _match(self, token_ids: list[int] | None) -> list[tuple[int, int]]:
-        """Leading (hash, block) pairs resident in the registry, capped so at
-        least one prompt token is left to prefill (the model must still run
-        to produce next-token logits)."""
+    def _match(
+        self, token_ids: list[int] | None, *, pin_host: bool = False
+    ) -> list[tuple[int, int | None]]:
+        """Leading (hash, block-or-None) pairs resident in the device
+        registry or the host tier (None ⇒ host hit needing a restore),
+        capped so at least one prompt token is left to prefill (the model
+        must still run to produce next-token logits).
+
+        ``pin_host=True`` pins host hits against eviction until restore;
+        the caller owns unpinning on rollback."""
         if not self.enable_prefix_caching or not token_ids:
             return []
-        matched: list[tuple[int, int]] = []
+        matched: list[tuple[int, int | None]] = []
         for h in compute_block_hashes(token_ids, self.block_size):
             bid = self._hash_to_block.get(h)
-            if bid is None:
+            if bid is None and self.host_tier is not None:
+                if pin_host:
+                    if not self.host_tier.pin(h):
+                        break
+                elif not self.host_tier.has(h):
+                    break
+            elif bid is None:
                 break
             matched.append((h, bid))
         while matched and len(matched) * self.block_size >= len(token_ids):
-            matched.pop()
+            h, bid = matched.pop()
+            if bid is None and pin_host:
+                self.host_tier.unpin(h)
         return matched
 
     def match_prefix(self, token_ids: list[int]) -> int:
-        """Number of prompt tokens resident in the registry."""
+        """Number of prompt tokens resident across device + host tiers."""
         return len(self._match(token_ids)) * self.block_size
 
     def allocate_sequence(
@@ -167,35 +220,56 @@ class BlockAllocator:
         returns (block_ids, cached_tokens) where the first
         ``cached_tokens // block_size`` entries are reused blocks the caller
         must not write.  None ⇒ OOM (nothing claimed)."""
-        matched = self._match(token_ids)
-        needed = self.blocks_needed(num_tokens) - len(matched)
-        # claim matched blocks FIRST (removes them from the evictable set),
-        # then check capacity against what is genuinely left — a matched
-        # block sitting in the cached LRU must not be counted as allocatable
-        for _, bid in matched:
+        matched = self._match(token_ids, pin_host=True)
+        device_hits = [(h, bid) for h, bid in matched if bid is not None]
+        host_hits = [h for h, bid in matched if bid is None]
+        # host hits need a fresh device block each (restored before prefill)
+        needed = self.blocks_needed(num_tokens) - len(device_hits)
+        # claim matched device blocks FIRST (removes them from the evictable
+        # set), then check capacity against what is genuinely left — a
+        # matched block in the cached LRU must not be counted as allocatable
+        for _, bid in device_hits:
             self._incref(bid)
         if needed > self.free_blocks:
-            for _, bid in matched:  # roll back: nothing claimed on OOM
+            for _, bid in device_hits:  # roll back: nothing claimed on OOM
                 self._decref(bid)
+            for h in host_hits:
+                self.host_tier.unpin(h)
             return None
-        evicted: list[int] = []
         fresh: list[int] = []
         for _ in range(max(needed, 0)):
-            bid = self._take_block(evicted)
+            bid = self._take_block()
             assert bid is not None  # guaranteed by the capacity check
             self._ref[bid] = 1
             fresh.append(bid)
-        self._emit_removed(evicted)
+        self.flush_offloads()
+        # matched blocks keep prompt order (device and host hits can
+        # interleave); host hits take fresh blocks as restore landing zones,
+        # registered now — content arrives before the prefill runs, and the
+        # single-threaded device loop orders any other matcher after it
+        restore_plan: list[tuple[int, int]] = []
+        block_ids: list[int] = []
+        fresh_iter = iter(fresh)
+        for h, bid in matched:
+            if bid is None:
+                bid = next(fresh_iter)
+                restore_plan.append((h, bid))
+                if h not in self._hash_to_block:
+                    self._hash_to_block[h] = bid
+                    self._block_hash[bid] = h
+            block_ids.append(bid)
+        block_ids.extend(fresh_iter)
         cached_tokens = len(matched) * self.block_size
         self._sequences[seq_id] = SequenceBlocks(
-            block_ids=[bid for _, bid in matched] + fresh,
+            block_ids=block_ids,
             published_hashes=[h for h, _ in matched],
             cached_tokens=cached_tokens,
+            restore_plan=restore_plan,
         )
         if cached_tokens:
             self.prefix_hits_total += 1
             self.prefix_cached_tokens_total += cached_tokens
-        return self._sequences[seq_id].block_ids[:], cached_tokens
+        return block_ids[:], cached_tokens
 
     def append_slot(self, seq_id: str, context_len: int) -> int | None:
         """Slot (flat cache index) for token at position ``context_len - 1``,
@@ -217,13 +291,12 @@ class BlockAllocator:
         needed = last_pos // self.block_size + 1 - len(seq.block_ids)
         if needed > self.free_blocks:
             return None
-        evicted: list[int] = []
         for _ in range(needed):
-            bid = self._take_block(evicted)
+            bid = self._take_block()
             assert bid is not None
             self._ref[bid] = 1
             seq.block_ids.append(bid)
-        self._emit_removed(evicted)
+        self.flush_offloads()
         return seq.block_ids[pos // self.block_size] * self.block_size + pos % self.block_size
 
     def adopt_sequence(self, seq_id: str, block_ids: list[int]) -> None:
@@ -233,18 +306,20 @@ class BlockAllocator:
 
     def reserve_blocks(self, num_tokens: int) -> list[int] | None:
         """Take blocks off the free list without a sequence (disagg decode
-        side reserves the landing zone for remotely-prefilled KV)."""
+        side reserves the landing zone for remotely-prefilled KV).
+
+        Called from the asyncio thread — evictions are NOT flushed here
+        (the offload copy reads the device cache, which only the device
+        thread may touch); the engine loop flushes them before any write."""
         needed = self.blocks_needed(num_tokens)
         if needed > self.free_blocks:
             return None
-        evicted: list[int] = []
         out = []
         for _ in range(needed):
-            bid = self._take_block(evicted)
+            bid = self._take_block()
             assert bid is not None
             self._ref[bid] = 1
             out.append(bid)
-        self._emit_removed(evicted)
         return out
 
     def release_blocks(self, block_ids: list[int]) -> None:
@@ -258,6 +333,15 @@ class BlockAllocator:
         seq = self._sequences.get(seq_id)
         return seq.cached_tokens if seq else 0
 
+    def take_restore_plan(self, seq_id: str) -> list[tuple[int, int]]:
+        """Hand the engine the pending host→device restores for a sequence
+        (cleared so aborts after restore don't double-handle)."""
+        seq = self._sequences.get(seq_id)
+        if seq is None:
+            return []
+        plan, seq.restore_plan = seq.restore_plan, []
+        return plan
+
     def free_sequence(self, seq_id: str) -> None:
         """Sequence finished: decref its blocks.  Registered (complete)
         blocks whose refcount hits zero stay resident in the LRU cache for
@@ -265,6 +349,15 @@ class BlockAllocator:
         seq = self._sequences.pop(seq_id, None)
         if seq is None:
             return
+        for h, bid in seq.restore_plan:
+            # aborted before its restore ran: the landing block holds no
+            # content — unregister it and release the host pin
+            if self._hash_to_block.get(h) == bid:
+                del self._hash_to_block[h]
+            self._block_hash.pop(bid, None)
+            if self.host_tier is not None:
+                self.host_tier.unpin(h)
+        seq.restore_plan = []
         for b in seq.block_ids:
             self._decref(b)
 

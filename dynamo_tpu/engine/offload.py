@@ -1,0 +1,116 @@
+"""G2 host-DRAM offload tier for the serving engine.
+
+Built on the KV block manager's pool machinery (``llm/block_manager``:
+BlockPool lifecycle/LRU/registry + HostStorage) — the reference's engine
+cache IS its block manager (lib/llm/src/block_manager.rs:90, G1→G2 offload
+offload.rs:77-80); here the device tier is the engine's paged cache and this
+tier catches blocks evicted from it:
+
+- **offload**: when the allocator evicts a registered block from device HBM,
+  the engine serializes that block's cache-pytree slice (works for any
+  family layout, llama k/v or DeepSeek latent/rope) into one host block;
+- **restore**: prompt matching extends past device-resident blocks into this
+  tier; hits are pinned at match time and scattered into freshly-allocated
+  device blocks right before the tail prefill.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dynamo_tpu.llm.block_manager.pool import BlockPool
+from dynamo_tpu.llm.block_manager.storage import HostStorage
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("engine.offload")
+
+
+class HostOffloadTier:
+    """Hash-addressed host pool of serialized KV blocks (G2).
+
+    Payload layout: per block, the concatenated raw bytes of each cache leaf
+    slice ``leaf[:, block_id]`` in sorted leaf-name order.
+    """
+
+    def __init__(self, num_blocks: int, leaf_shapes: dict, leaf_dtypes: dict):
+        self._names = sorted(leaf_shapes)
+        self._shapes = {n: tuple(leaf_shapes[n]) for n in self._names}
+        self._dtypes = {n: np.dtype(leaf_dtypes[n]) for n in self._names}
+        self._sizes = {
+            n: int(np.prod(self._shapes[n])) * self._dtypes[n].itemsize
+            for n in self._names
+        }
+        self.block_nbytes = sum(self._sizes.values())
+        self.pool = BlockPool(
+            HostStorage(num_blocks, (self.block_nbytes,), np.uint8), tier_name="g2"
+        )
+        self.offloads = 0
+        self.restores = 0
+
+    # -- offload (device eviction → host) -----------------------------------
+    def put(self, seq_hash: int, leaves: dict) -> bool:
+        """Store one evicted block's content; dedupes by hash.  False when
+        the tier is full of pinned blocks (offload skipped)."""
+        if self.pool.has_hash(seq_hash):
+            return True
+        bid = self.pool.allocate()  # evicts host LRU if needed
+        if bid is None:
+            return False
+        buf = np.concatenate(
+            [
+                np.ascontiguousarray(np.asarray(leaves[n])).view(np.uint8).ravel()
+                for n in self._names
+            ]
+        )
+        self.pool.write([bid], buf[None])
+        self.pool.complete(bid, 0)
+        self.pool.register(bid, seq_hash)
+        self.pool.release(bid)  # park in the inactive LRU (evictable)
+        self.offloads += 1
+        return True
+
+    # -- restore (host → device) ---------------------------------------------
+    def has(self, seq_hash: int) -> bool:
+        return self.pool.has_hash(seq_hash)
+
+    def pin(self, seq_hash: int) -> bool:
+        """Claim a block for an upcoming restore so interleaved offloads
+        can't evict it between match and prefill."""
+        return self.pool.match_hash(seq_hash) is not None
+
+    def unpin(self, seq_hash: int) -> None:
+        bid = self.pool._by_hash.get(seq_hash)
+        if bid is not None:
+            self.pool.release(bid)
+
+    def read_pinned(self, seq_hash: int) -> dict | None:
+        """Deserialize a pinned block's leaves and release the pin."""
+        bid = self.pool._by_hash.get(seq_hash)
+        if bid is None:
+            return None
+        buf = self.pool.read([bid])[0]
+        out = {}
+        offset = 0
+        for n in self._names:
+            size = self._sizes[n]
+            out[n] = (
+                buf[offset : offset + size].view(self._dtypes[n]).reshape(self._shapes[n])
+            )
+            offset += size
+        self.pool.release(bid)
+        self.restores += 1
+        return out
+
+    def clear(self) -> None:
+        """Admin flush: forget everything (clear_kv_blocks covers all tiers)."""
+        for h in list(self.pool._by_hash):
+            self.pool.drop_hash(h)
+
+    def stats(self) -> dict:
+        return {
+            "host_blocks_total": self.pool.num_blocks,
+            "host_blocks_used": self.pool.num_blocks - self.pool.free_count,
+            "host_offloads_total": self.offloads,
+            "host_restores_total": self.restores,
+            "host_evictions": self.pool.evictions,
+        }

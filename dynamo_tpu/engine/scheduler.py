@@ -40,6 +40,7 @@ class Scheduler:
         max_batch_size: int,
         max_prefills_per_step: int = 1,
         prefill_chunk_tokens: int | None = None,
+        bucket_cost=None,
     ):
         self.allocator = allocator
         self.max_batch_size = max_batch_size
@@ -47,6 +48,10 @@ class Scheduler:
         # chunked prefill: prompts longer than this prefill in chunks
         # interleaved with decode steps (None = whole-prompt prefill)
         self.prefill_chunk_tokens = prefill_chunk_tokens
+        # budget accounting charges the PADDED compute of a window (the
+        # engine's compile-bucket length), not raw tokens — otherwise a
+        # split budget multiplies real per-step prefill work
+        self.bucket_cost = bucket_cost or (lambda t: t)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         self._free_lanes = list(range(max_batch_size - 1, -1, -1))
@@ -98,11 +103,11 @@ class Scheduler:
         for seq in continuing:
             if budget is not None and budget < bs:
                 break
-            take = self._plan_chunk(seq, seq.prefilled_tokens, budget)
-            if take <= 0:
+            cost = self._plan_chunk(seq, seq.prefilled_tokens, budget)
+            if cost is None:
                 break
             if budget is not None:
-                budget -= take
+                budget -= cost
             prefills.append(seq)
 
         # 3) admit new prefills with the leftover budget while blocks +
@@ -113,7 +118,9 @@ class Scheduler:
             and admitted < self.max_prefills_per_step
             and len(self.running) < self.max_batch_size
             and self._free_lanes
-            and (budget is None or budget >= bs)
+            # enough budget for the smallest possible padded window — this
+            # is what makes the post-allocation plan assert hold
+            and (budget is None or budget >= self.bucket_cost(bs))
         ):
             candidate = self.waiting[0]
             if candidate.remote_prefilled:
@@ -129,16 +136,24 @@ class Scheduler:
             if not self.allocator.can_allocate(candidate.context_len + 1):
                 break
             self.waiting.popleft()
+            # multimodal prompts: block hashes cover text tokens only, so
+            # they neither match nor publish into the prefix registry, and
+            # they prefill whole (embeds don't chunk)
+            mm = candidate.mm_embeds is not None
             alloc = self.allocator.allocate_sequence(
                 candidate.seq_id, candidate.context_len + 1,
-                token_ids=candidate.all_token_ids,
+                token_ids=None if mm else candidate.all_token_ids,
             )
             assert alloc is not None
             _, candidate.cached_tokens = alloc
             candidate.prefilled_tokens = candidate.cached_tokens
-            take = self._plan_chunk(candidate, candidate.cached_tokens, budget)
-            if budget is not None:
-                budget -= take
+            if mm:
+                candidate.chunk_target = candidate.context_len
+            else:
+                cost = self._plan_chunk(candidate, candidate.cached_tokens, budget)
+                assert cost is not None  # budget >= bs guarantees a plan
+                if budget is not None:
+                    budget -= cost
             candidate.status = (
                 SeqStatus.PREFILLING
                 if candidate.chunk_target < candidate.context_len
@@ -152,18 +167,26 @@ class Scheduler:
         decodes = [s for s in self.running if s not in prefills]
         return ScheduleDecision(prefills=prefills, decodes=decodes, preempted=preempted)
 
-    def _plan_chunk(self, seq: Sequence, start: int, budget: int | None) -> int:
+    def _plan_chunk(self, seq: Sequence, start: int, budget: int | None) -> int | None:
         """Set ``seq.chunk_target`` for this step's prefill window starting
-        at ``start`` within ``budget`` tokens; intermediate chunk ends stay
-        block-aligned.  Returns tokens taken (0 = budget too small)."""
+        at ``start``; intermediate chunk ends stay block-aligned and the
+        window's PADDED compute (bucket_cost) must fit ``budget``.  Returns
+        the budget cost charged, or None when nothing affordable fits."""
         remaining = seq.context_len - start
-        take = remaining if budget is None else min(remaining, budget)
+        if budget is None:
+            seq.chunk_target = seq.context_len
+            return 0
+        bs = self.allocator.block_size
+        take = min(remaining, budget)
         if take < remaining:  # intermediate end must be block-aligned
-            take = (take // self.allocator.block_size) * self.allocator.block_size
-            if take <= 0:
-                return 0
+            take = (take // bs) * bs
+        # shrink until the padded window fits the budget
+        while take > 0 and self.bucket_cost(take) > budget:
+            take = ((take - 1) // bs) * bs
+        if take <= 0:
+            return None
         seq.chunk_target = start + take
-        return take
+        return self.bucket_cost(take)
 
     def ensure_slot(self, seq: Sequence) -> int | None:
         """Get the cache slot for this sequence's next token, preempting the

@@ -29,6 +29,11 @@ class Sequence:
     # disaggregation modes
     prefill_only: bool = False       # prefill worker: stop after first token
     remote_prefilled: bool = False   # decode worker: KV already injected
+    # prefill_only result stays as device arrays (same-process/ICI transfer)
+    extract_device: bool = False
+    # multimodal: projected vision patch embeddings [n_patches, hidden]
+    # spliced BEFORE the text tokens at prefill (None = text-only)
+    mm_embeds: object = None
     # per-lane sampling state (penalty counts, rng key) initialized?
     sampling_seeded: bool = False
     # prompt tokens reused from the prefix cache at allocation (the engine
@@ -44,8 +49,12 @@ class Sequence:
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only
 
     @property
+    def mm_len(self) -> int:
+        return 0 if self.mm_embeds is None else len(self.mm_embeds)
+
+    @property
     def prompt_len(self) -> int:
-        return len(self.request.token_ids)
+        return self.mm_len + len(self.request.token_ids)
 
     @property
     def context_len(self) -> int:

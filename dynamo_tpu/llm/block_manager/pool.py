@@ -50,6 +50,9 @@ class BlockPool:
         # inactive registered blocks: seq_hash -> block_id in LRU order
         self._inactive: OrderedDict[int, int] = OrderedDict()
         self._by_hash: dict[int, int] = {}
+        # optional observer: called with the seq_hash of each block evicted
+        # by allocate() (tier owners propagate removed events from it)
+        self.evict_sink = None
         # stats
         self.evictions = 0
         self.reuse_hits = 0
@@ -81,6 +84,8 @@ class BlockPool:
             meta = self.blocks[bid]
             if meta.seq_hash is not None:
                 self._by_hash.pop(meta.seq_hash, None)
+                if self.evict_sink is not None:
+                    self.evict_sink(meta.seq_hash)
             self.evictions += 1
         else:
             return None

@@ -122,11 +122,28 @@ class DeviceStorage(Storage):
             self._data = jax.device_put(self._data, sharding)
         elif device is not None:
             self._data = jax.device_put(self._data, device)
-        self._write = jax.jit(
-            lambda pool, ids, blocks: pool.at[ids].set(blocks.astype(pool.dtype)),
-            donate_argnums=(0,),
-        )
-        self._read = jax.jit(lambda pool, ids: pool[ids])
+        # on TPU the Pallas block-copy kernels move blocks with pipelined
+        # HBM↔VMEM DMAs (the block_copy.cu replacement, SURVEY.md §2.2);
+        # XLA gather/scatter is the portable fallback
+        use_pallas = False
+        if sharding is None:
+            try:
+                use_pallas = jax.default_backend() == "tpu"
+            except Exception:  # wedged plugin: portable path
+                use_pallas = False
+        if use_pallas:
+            from dynamo_tpu.ops.pallas.block_copy import gather_blocks, scatter_blocks
+
+            self._write = lambda pool, ids, blocks: scatter_blocks(
+                pool, blocks.astype(pool.dtype), ids
+            )
+            self._read = gather_blocks
+        else:
+            self._write = jax.jit(
+                lambda pool, ids, blocks: pool.at[ids].set(blocks.astype(pool.dtype)),
+                donate_argnums=(0,),
+            )
+            self._read = jax.jit(lambda pool, ids: pool[ids])
 
     @property
     def array(self):

@@ -237,8 +237,14 @@ class PrefillWorker:
                 logger.exception("remote prefill failed for %s", item.get("seq_id"))
 
     async def _handle(self, item: dict) -> None:
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
         pre = PreprocessedRequest.from_wire(item["request"])
-        first_token, blocks, n = await self.engine.prefill_extract(pre)
+        # strategy selection by destination locality (reference:
+        # block/transfer/strategy.rs:345): same-process destinations keep
+        # blocks on device (ICI-class copy), remote ones stage to host
+        local = item["transfer_address"] in LOCAL_SERVERS
+        first_token, blocks, n = await self.engine.prefill_extract(pre, device=local)
         await self.client.send(
             item["transfer_address"],
             KvTransferPayload(

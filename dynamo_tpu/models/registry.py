@@ -29,6 +29,9 @@ class ModelFamily:
     # continued prefill over a resident prefix (prefix-cache reuse, chunked
     # prefill); None = the engine disables prefix caching for this family
     forward_prefill_with_prefix: Callable | None = None
+    # prefill from precomputed input embeddings (multimodal: vision patches
+    # spliced before text); None = no multimodal support for this family
+    forward_prefill_embeds: Callable | None = None
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -65,6 +68,7 @@ def _llama_family() -> ModelFamily:
         forward_prefill=llama.llama_forward_prefill,
         forward_decode=llama.llama_forward_decode,
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
+        forward_prefill_embeds=llama.llama_forward_prefill_embeds,
     )
 
 
@@ -90,6 +94,7 @@ def _qwen2_family() -> ModelFamily:
         forward_prefill=llama.llama_forward_prefill,
         forward_decode=llama.llama_forward_decode,
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
+        forward_prefill_embeds=llama.llama_forward_prefill_embeds,
     )
 
 

@@ -1,0 +1,142 @@
+"""Vision encoder (ViT) + LLaVA-style projector for multimodal serving.
+
+The encode-worker model behind ``examples/multimodal`` (reference:
+examples/multimodal/components/encode_worker.py:61 — there a HF CLIP/SigLIP
+encoder inside the engine; here a native JAX ViT, TPU-first):
+
+- patchify as reshape + one big matmul (the conv-as-matmul form the MXU
+  wants — no image-space convolution loops);
+- layer weights stacked on a leading axis and iterated with ``lax.scan``
+  (one compiled block body, like the llama trunk);
+- pre-LN transformer blocks, fp32 softmax/norms, GELU MLP;
+- 2-layer GELU projector into the LLM hidden space (LLaVA-style), so the
+  output splices directly into ``llama_forward_prefill_embeds``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 336
+    patch_size: int = 14
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    mlp_dim: int = 4096
+    projector_dim: int = 4096       # LLM hidden size
+    layer_norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "VisionConfig":
+        """Accepts a CLIP/SigLIP-style vision_config dict."""
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        config = config.get("vision_config", config)
+        return cls(
+            image_size=config.get("image_size", 336),
+            patch_size=config.get("patch_size", 14),
+            hidden_size=config.get("hidden_size", 1024),
+            num_layers=config.get("num_hidden_layers", 24),
+            num_heads=config.get("num_attention_heads", 16),
+            mlp_dim=config.get("intermediate_size", 4096),
+            projector_dim=config.get("projection_dim", 4096),
+        )
+
+    @classmethod
+    def tiny(cls) -> "VisionConfig":
+        """Test geometry (runs on CPU meshes)."""
+        return cls(
+            image_size=16, patch_size=8, hidden_size=32, num_layers=2,
+            num_heads=2, mlp_dim=64, projector_dim=64, dtype=jnp.float32,
+        )
+
+
+def init_vit_params(cfg: VisionConfig, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 10)
+    h, m, l_ = cfg.hidden_size, cfg.mlp_dim, cfg.num_layers
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(cfg.dtype)
+
+    return {
+        "patch_proj": norm_init(keys[0], (patch_dim, h), patch_dim),
+        "pos_embed": norm_init(keys[1], (cfg.num_patches, h), h),
+        "layers": {
+            "ln1_w": jnp.ones((l_, h), cfg.dtype),
+            "ln1_b": jnp.zeros((l_, h), cfg.dtype),
+            "wq": norm_init(keys[2], (l_, h, h), h),
+            "wk": norm_init(keys[3], (l_, h, h), h),
+            "wv": norm_init(keys[4], (l_, h, h), h),
+            "wo": norm_init(keys[5], (l_, h, h), h),
+            "ln2_w": jnp.ones((l_, h), cfg.dtype),
+            "ln2_b": jnp.zeros((l_, h), cfg.dtype),
+            "w1": norm_init(keys[6], (l_, h, m), h),
+            "b1": jnp.zeros((l_, m), cfg.dtype),
+            "w2": norm_init(keys[7], (l_, m, h), m),
+            "b2": jnp.zeros((l_, h), cfg.dtype),
+        },
+        "final_ln_w": jnp.ones((h,), cfg.dtype),
+        "final_ln_b": jnp.zeros((h,), cfg.dtype),
+        "proj_w1": norm_init(keys[8], (h, cfg.projector_dim), h),
+        "proj_b1": jnp.zeros((cfg.projector_dim,), cfg.dtype),
+        "proj_w2": norm_init(keys[9], (cfg.projector_dim, cfg.projector_dim), cfg.projector_dim),
+        "proj_b2": jnp.zeros((cfg.projector_dim,), cfg.dtype),
+    }
+
+
+def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, H, W, 3] → [B, num_patches, patch*patch*3] (reshape only)."""
+    b, hgt, wid, c = images.shape
+    gh, gw = hgt // patch, wid // patch
+    x = images.reshape(b, gh, patch, gw, patch, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, gh * gw, patch * patch * c)
+
+
+def vit_encode(params: dict, cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, 3] images → [B, num_patches, projector_dim] embeddings."""
+    b = images.shape[0]
+    x = patchify(images.astype(cfg.dtype), cfg.patch_size) @ params["patch_proj"]
+    x = x + params["pos_embed"]
+    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.head_dim))
+
+    def block(x, w):
+        attn_in = layer_norm(x, w["ln1_w"], w["ln1_b"], cfg.layer_norm_eps)
+        q = (attn_in @ w["wq"]).reshape(b, -1, cfg.num_heads, cfg.head_dim)
+        k = (attn_in @ w["wk"]).reshape(b, -1, cfg.num_heads, cfg.head_dim)
+        v = (attn_in @ w["wv"]).reshape(b, -1, cfg.num_heads, cfg.head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        weights = jax.nn.softmax(logits * scale, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+        x = x + attn.reshape(b, -1, cfg.hidden_size).astype(cfg.dtype) @ w["wo"]
+        mlp_in = layer_norm(x, w["ln2_w"], w["ln2_b"], cfg.layer_norm_eps)
+        x = x + jax.nn.gelu(mlp_in @ w["w1"] + w["b1"]) @ w["w2"] + w["b2"]
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["layers"])
+    x = layer_norm(x, params["final_ln_w"], params["final_ln_b"], cfg.layer_norm_eps)
+    # LLaVA-style 2-layer GELU projector into the LLM hidden space
+    x = jax.nn.gelu(x @ params["proj_w1"] + params["proj_b1"])
+    x = x @ params["proj_w2"] + params["proj_b2"]
+    return x.astype(jnp.float32)

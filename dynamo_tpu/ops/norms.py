@@ -5,6 +5,18 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-6
+) -> jnp.ndarray:
+    """LayerNorm with float32 accumulation (ViT-family numerics)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    normed = (x32 - mean) / jnp.sqrt(var + eps)
+    return (normed * weight.astype(jnp.float32) + bias.astype(jnp.float32)).astype(dtype)
+
+
 def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
     """RMSNorm with float32 accumulation, cast back to input dtype (standard
     llama-family numerics: normalize in fp32 even for bf16 activations)."""

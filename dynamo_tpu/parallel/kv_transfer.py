@@ -1,19 +1,22 @@
 """Cross-worker KV block transfer.
 
 The TPU-native replacement for the reference's NIXL/RDMA plane (SURVEY.md
-§2.5): prefill and decode engines live on separate mesh partitions/processes,
-so prefilled KV blocks are shipped prefill→decode.
+§2.5; strategy selection by src/dst locality mirrors
+lib/llm/src/block_manager/block/transfer/strategy.rs:345): prefill and
+decode engines live on separate mesh partitions/processes, so prefilled KV
+blocks are shipped prefill→decode.
 
-Paths:
-- **DCN/TCP (implemented)**: device→host staging (``jax.device_get``), raw
-  bf16 bytes over a TCP stream with the two-part codec, host→device scatter
-  on the receiver.  Works across hosts and processes.
-- **ICI (same-slice)**: when both engines share a mesh, ``jax.device_put``
-  between shardings moves blocks over ICI without host staging (used
-  automatically when the engines are in-process; cross-process ICI transfer
-  lands with multi-host support).
+Paths, selected automatically per destination:
+- **local/ICI (same process)**: the destination server is found in the
+  process-local registry; blocks stay as device arrays end-to-end — the
+  receiving engine's scatter moves them device-to-device (HBM copy on one
+  chip, ICI when the engines sit on different chips of the slice).  No
+  serialization, no host staging.
+- **DCN/TCP**: device→host staging (``jax.device_get``), raw bf16 bytes over
+  a TCP stream with the two-part codec, host→device scatter on the receiver.
+  Works across hosts and processes.
 
-Wire: header {seq_id, dtype, shape, first_token, block_ids} + payload bytes.
+Wire: header {seq_id, first_token, block_ids, parts} + payload bytes.
 """
 
 from __future__ import annotations
@@ -38,6 +41,11 @@ def resolve_dtype(name: str) -> np.dtype:
         import ml_dtypes
 
         return np.dtype(getattr(ml_dtypes, name))
+
+
+# process-local transfer servers by address: same-process sends short-cut
+# TCP entirely and hand device arrays straight to the sink
+LOCAL_SERVERS: dict[str, "KvTransferServer"] = {}
 
 
 @dataclass
@@ -74,11 +82,18 @@ class KvTransferServer:
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
+        LOCAL_SERVERS[self.address] = self
 
     async def stop(self) -> None:
+        LOCAL_SERVERS.pop(self.address, None)
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+
+    async def deliver_local(self, payload: KvTransferPayload) -> None:
+        """Same-process fast path: blocks arrive as device arrays and skip
+        the codec entirely (the ICI-class transfer)."""
+        await self.sink(payload)
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         try:
@@ -129,6 +144,10 @@ class KvTransferClient:
         return entry
 
     async def send(self, address: str, payload: KvTransferPayload) -> None:
+        local = LOCAL_SERVERS.get(address)
+        if local is not None:
+            await local.deliver_local(payload)
+            return
         reader, writer, lock = await self._conn(address)
         names = sorted(payload.blocks)
         arrays = [np.ascontiguousarray(payload.blocks[n]) for n in names]

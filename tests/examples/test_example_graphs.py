@@ -106,3 +106,13 @@ async def test_hello_world_graph():
 
     words = await run("tpu serving")
     assert words == ["Middle(Backend[TPU])", "Middle(Backend[SERVING])"]
+
+
+async def test_multimodal_pipeline_example():
+    """examples/multimodal: encode → prefill → decode in-process (the
+    reference's encode_worker flow, examples/multimodal/components/
+    encode_worker.py:61)."""
+    from examples.multimodal.pipeline import amain
+
+    rc = await amain("tests/data/tiny-chat-model")
+    assert rc == 0

@@ -109,6 +109,11 @@ async def test_remote_prefill_exactness():
         queue = PrefillQueue(rt, "ns", "backend")
         disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
         await disagg.start()
+        # force the TCP/DCN path (serialize + codec + host staging) — the
+        # same-process device path is covered by the DeepSeek variant below
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS.pop(disagg.transfer_server.address, None)
         prefill_worker = PrefillWorker(rt, prefill_engine, queue)
         prefill_worker.start()
 

@@ -1,0 +1,53 @@
+"""ViT vision encoder + projector (dynamo_tpu/models/vision.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.vision import (
+    VisionConfig,
+    init_vit_params,
+    patchify,
+    vit_encode,
+)
+
+
+def test_patchify_is_exact_reshape():
+    cfg = VisionConfig.tiny()
+    img = np.arange(cfg.image_size * cfg.image_size * 3, dtype=np.float32).reshape(
+        1, cfg.image_size, cfg.image_size, 3
+    )
+    patches = np.asarray(patchify(jnp.asarray(img), cfg.patch_size))
+    assert patches.shape == (1, cfg.num_patches, cfg.patch_size * cfg.patch_size * 3)
+    # first patch = top-left patch_size × patch_size crop, row-major
+    expect = img[0, : cfg.patch_size, : cfg.patch_size, :].reshape(-1)
+    np.testing.assert_array_equal(patches[0, 0], expect)
+
+
+def test_vit_encode_shape_and_determinism():
+    cfg = VisionConfig.tiny()
+    params = init_vit_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.random((2, cfg.image_size, cfg.image_size, 3), np.float32))
+    out1 = np.asarray(vit_encode(params, cfg, imgs))
+    out2 = np.asarray(vit_encode(params, cfg, imgs))
+    assert out1.shape == (2, cfg.num_patches, cfg.projector_dim)
+    np.testing.assert_array_equal(out1, out2)
+    assert np.isfinite(out1).all()
+    # different images produce different embeddings
+    assert not np.allclose(out1[0], out1[1])
+
+
+def test_from_hf_config_vision_section():
+    cfg = VisionConfig.from_hf_config(
+        {
+            "vision_config": {
+                "image_size": 112, "patch_size": 16, "hidden_size": 64,
+                "num_hidden_layers": 3, "num_attention_heads": 4,
+                "intermediate_size": 128, "projection_dim": 96,
+            }
+        }
+    )
+    assert cfg.image_size == 112 and cfg.num_layers == 3
+    assert cfg.num_patches == (112 // 16) ** 2
+    assert cfg.projector_dim == 96
