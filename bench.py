@@ -57,9 +57,17 @@ def _is_oom(err: BaseException) -> bool:
     return "resource_exhausted" in msg or "out of memory" in msg or "oom" in msg
 
 
+class DoesNotFit(Exception):
+    """Pre-flight estimate: params+cache exceed this chip's HBM."""
+
+
 async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
+    import jax
+    import numpy as np
+
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
     from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.models.registry import get_family
 
     cfg = getattr(LlamaConfig, model_name)()
     if fallback_cpu:
@@ -84,6 +92,37 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
 
     chunk = int(os.environ.get("DYN_BENCH_CHUNK", "0")) or None
     t_init = time.monotonic()
+
+    family = get_family("llama")
+    param_shapes = jax.eval_shape(lambda k: family.init_params(cfg, k), jax.random.PRNGKey(0))
+    cache_shapes = jax.eval_shape(
+        lambda: family.cache_init(cfg, num_blocks, block_size, None)
+    )
+    tree_bytes = lambda t: sum(  # noqa: E731
+        int(np.prod(x.shape)) * x.dtype.itemsize for x in jax.tree.leaves(t)
+    )
+    need = tree_bytes(param_shapes) + tree_bytes(cache_shapes)
+    # pre-flight HBM check: don't spend minutes initializing a model the
+    # chip cannot hold (observed: 8B @ ISL3000 needs ~4.5G of HLO temps on
+    # top of params+cache)
+    try:
+        limit = jax.devices()[0].memory_stats().get("bytes_limit")
+    except Exception:  # noqa: BLE001 — CPU/backends without stats
+        limit = None
+    if limit and need + 4.5e9 > limit:
+        raise DoesNotFit(
+            f"{model_name}: params+cache {need/1e9:.1f}GB + ~4.5GB temps "
+            f"> HBM {limit/1e9:.1f}GB"
+        )
+
+    # constant-fill init: throughput/MFU are weight-agnostic, and real RNG
+    # init of 8B params on host cost ~15 min of the round-2/3 bench budget
+    params = None
+    if os.environ.get("DYN_BENCH_INIT", "const") == "const":
+        params = jax.tree.map(
+            lambda s: np.full(s.shape, 0.01, dtype=s.dtype), param_shapes
+        )
+
     engine = JaxLlmEngine(
         EngineConfig(
             model=cfg,
@@ -94,7 +133,8 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             prefill_buckets=(prompt_len,),
             decode_steps=decode_steps,
             prefill_chunk_tokens=chunk,
-        )
+        ),
+        params=params,
     )
     try:
         return await _measure(engine, cfg, model_name, num_requests, prompt_len,
@@ -112,6 +152,7 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
 
     from dynamo_tpu.llm.protocols.common import (
         Annotated,
+        FinishReason,
         LLMEngineOutput,
         PreprocessedRequest,
         SamplingOptions,
@@ -142,7 +183,13 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
         stream = await engine.generate(Context(req))
         async for item in stream:
             ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
-            if ann.data is not None and ann.data.token_ids:
+            if ann.data is None:
+                continue
+            if ann.data.finish_reason is FinishReason.ERROR:
+                # surface engine-side failures (OOM → ladder step-down)
+                # instead of recording a 0-token "measurement"
+                raise RuntimeError(ann.data.error or "sequence failed in engine")
+            if ann.data.token_ids:
                 if ttft is None:
                     ttft = time.monotonic() - t0
                 count += len(ann.data.token_ids)
@@ -299,7 +346,7 @@ async def run_bench() -> dict:
         try:
             return await _run_model(model_name, fallback_cpu=fallback_cpu)
         except Exception as err:  # OOM: step down the ladder; else re-raise
-            if _is_oom(err) and model_name != ladder[-1]:
+            if (isinstance(err, DoesNotFit) or _is_oom(err)) and model_name != ladder[-1]:
                 print(
                     f"bench: {model_name} does not fit ({err!r:.200}); stepping down",
                     file=sys.stderr,

@@ -507,8 +507,9 @@ class JaxLlmEngine:
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
 
-        def emit(tokens: list[int], finish: FinishReason | None) -> None:
-            out = LLMEngineOutput(token_ids=tokens, finish_reason=finish)
+        def emit(tokens: list[int], finish: FinishReason | None,
+                 error: str | None = None) -> None:
+            out = LLMEngineOutput(token_ids=tokens, finish_reason=finish, error=error)
             wire = Annotated.from_data(out).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
             if finish is not None:
@@ -636,9 +637,10 @@ class JaxLlmEngine:
         seq.output_ids.append(first_token)
         self.allocator.adopt_sequence(seq.seq_id, block_ids)
 
-        def emit(tokens: list[int], finish: FinishReason | None) -> None:
+        def emit(tokens: list[int], finish: FinishReason | None,
+                 error: str | None = None) -> None:
             wire = Annotated.from_data(
-                LLMEngineOutput(token_ids=tokens, finish_reason=finish)
+                LLMEngineOutput(token_ids=tokens, finish_reason=finish, error=error)
             ).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
             if finish is not None:
@@ -724,7 +726,12 @@ class JaxLlmEngine:
                 decision = self.scheduler.schedule()
                 for seq in decision.prefills:
                     try:
-                        self._run_prefill(seq)
+                        try:
+                            self._run_prefill(seq)
+                        except Exception as exc:  # noqa: BLE001
+                            if not self._attention_fallback(exc):
+                                raise
+                            self._run_prefill(seq)
                     except Exception as exc:  # noqa: BLE001 — fail THIS
                         # sequence (free blocks, resolve its caller) and
                         # keep serving; retrying would hot-spin on
@@ -737,7 +744,12 @@ class JaxLlmEngine:
                 ]
                 if decodes:
                     try:
-                        self._run_decode(decodes)
+                        try:
+                            self._run_decode(decodes)
+                        except Exception as exc:  # noqa: BLE001
+                            if not self._attention_fallback(exc):
+                                raise
+                            self._run_decode(decodes)
                     except Exception as exc:  # noqa: BLE001
                         logger.exception("decode step failed")
                         for seq in decodes:
@@ -749,6 +761,42 @@ class JaxLlmEngine:
                 logger.exception("engine step failed")
                 time.sleep(0.1)
 
+    def _attention_fallback(self, exc: BaseException) -> bool:
+        """If the Pallas attention kernel is active and a step failed,
+        rebuild every attention-bearing jit with the portable XLA
+        implementation and report True so the caller retries once.
+
+        Mosaic rejects geometries the XLA path handles fine (e.g. "batch
+        dims must be equal" on sub-tile head counts), and a remote-compile
+        service can 500 transiently; either way a kernel-compile failure
+        must degrade the engine, not kill every in-flight sequence.
+
+        Only COMPILE-class failures are retried: they surface before
+        execution, so donated buffers are still intact and the retry sees
+        consistent state.  A post-dispatch runtime error may have consumed
+        the donated cache — retrying against it would poison every
+        subsequent step, so those still fail the batch."""
+        if self.attention_impl != "pallas":
+            return False
+        msg = f"{type(exc).__name__}: {exc}".lower()
+        compile_markers = (
+            "mosaic", "interpret mode", "compile", "lowering",
+            "unimplemented", "not implemented", "unsupported",
+        )
+        if not any(m in msg for m in compile_markers):
+            return False
+        logger.warning(
+            "pallas attention failed (%s); falling back to XLA attention", exc
+        )
+        self.attention_impl = "jax"
+        self._jit_prefill = self._build_prefill()
+        if self._jit_prefill_prefix is not None:
+            self._jit_prefill_prefix = self._build_prefill_prefix()
+        if self._jit_prefill_mm is not None:
+            self._jit_prefill_mm = self._build_prefill_mm()
+        self._jit_decode = self._build_decode()
+        return True
+
     def _fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
         """Terminate one sequence on an engine-side error: free its
         resources and resolve its caller with the failure."""
@@ -756,7 +804,7 @@ class JaxLlmEngine:
         if seq.on_prefill_done:
             seq.on_prefill_done(exc)
         elif seq.emit:
-            seq.emit([], FinishReason.ERROR)
+            seq.emit([], FinishReason.ERROR, f"{type(exc).__name__}: {exc}")
 
     def _drain_submissions(self) -> None:
         while True:
@@ -863,7 +911,7 @@ class JaxLlmEngine:
             for h in self._host_evictions:
                 if (
                     not self.host_tier.has(h)
-                    and h not in self.allocator._hash_to_block
+                    and not self.allocator.is_registered(h)
                     and h not in failed
                 ):
                     failed.append(h)
@@ -879,8 +927,8 @@ class JaxLlmEngine:
         if self._host_evictions is not None:
             self._host_evictions.append(seq_hash)
             return
-        if seq_hash not in self.allocator._hash_to_block:
-            self.allocator._emit_removed([seq_hash])
+        if not self.allocator.is_registered(seq_hash):
+            self.allocator.emit_removed([seq_hash])
 
     def _restore_blocks(self, plan: list[tuple[int, int]]) -> None:
         """Scatter pinned host blocks into their device landing blocks (one
@@ -966,7 +1014,15 @@ class JaxLlmEngine:
         n = len(tokens)
         restore = self.allocator.take_restore_plan(seq.seq_id)
         if restore:
-            self._restore_blocks(restore)
+            try:
+                self._restore_blocks(restore)
+            except BaseException:
+                # the plan must survive a failed restore: a retry (pallas
+                # fallback) re-executes it, and _fail_sequence → free_sequence
+                # needs it to unregister the garbage landing blocks and
+                # release the host pins
+                self.allocator.put_back_restore_plan(seq.seq_id, restore)
+                raise
         blocks = self.allocator.block_ids(seq.seq_id)
         temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays([seq], 1)
         sampling_tail = (

@@ -27,6 +27,9 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from dynamo_tpu.llm.kv_router.hashing import HASH_SEED, compute_block_hashes  # noqa: F401
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("engine.kv_manager")
 
 
 @dataclass
@@ -147,11 +150,7 @@ class BlockAllocator:
         try:
             failed = list(self.offload_sink(pairs) or [])
         except Exception:  # noqa: BLE001 — eviction must proceed
-            import logging
-
-            logging.getLogger("dynamo_tpu.engine").exception(
-                "block offload failed; dropping %d blocks", len(pairs)
-            )
+            logger.exception("block offload failed; dropping %d blocks", len(pairs))
             failed = [h for _, h in pairs]
         self._emit_removed(failed)
 
@@ -332,6 +331,22 @@ class BlockAllocator:
     def cached_tokens(self, seq_id: str) -> int:
         seq = self._sequences.get(seq_id)
         return seq.cached_tokens if seq else 0
+
+    def is_registered(self, seq_hash: int) -> bool:
+        """Whether a block with this content hash is resident on device."""
+        return seq_hash in self._hash_to_block
+
+    def emit_removed(self, hashes: list[int]) -> None:
+        """Tell routers these hashes left every tier (offload-tier eviction
+        with no device copy)."""
+        self._emit_removed(hashes)
+
+    def put_back_restore_plan(self, seq_id: str, plan: list[tuple[int, int]]) -> None:
+        """Re-arm a taken restore plan after a failed restore so a retry
+        re-executes it and sequence teardown cleans up the landing blocks."""
+        seq = self._sequences.get(seq_id)
+        if seq is not None:
+            seq.restore_plan = plan + seq.restore_plan
 
     def take_restore_plan(self, seq_id: str) -> list[tuple[int, int]]:
         """Hand the engine the pending host→device restores for a sequence

@@ -79,13 +79,13 @@ class HostOffloadTier:
         return self.pool.match_hash(seq_hash) is not None
 
     def unpin(self, seq_hash: int) -> None:
-        bid = self.pool._by_hash.get(seq_hash)
+        bid = self.pool.peek_hash(seq_hash)
         if bid is not None:
             self.pool.release(bid)
 
     def read_pinned(self, seq_hash: int) -> dict | None:
         """Deserialize a pinned block's leaves and release the pin."""
-        bid = self.pool._by_hash.get(seq_hash)
+        bid = self.pool.peek_hash(seq_hash)
         if bid is None:
             return None
         buf = self.pool.read([bid])[0]
@@ -102,8 +102,12 @@ class HostOffloadTier:
         return out
 
     def clear(self) -> None:
-        """Admin flush: forget everything (clear_kv_blocks covers all tiers)."""
-        for h in list(self.pool._by_hash):
+        """Admin flush: forget everything except blocks pinned for an
+        in-flight restore (clear_kv_blocks keeps running sequences' state,
+        mirroring the allocator's clear_published)."""
+        for h in self.pool.registered_hashes():
+            if self.pool.ref_count(h) > 0:
+                continue
             self.pool.drop_hash(h)
 
     def stats(self) -> dict:

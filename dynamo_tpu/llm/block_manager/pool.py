@@ -129,6 +129,19 @@ class BlockPool:
     def has_hash(self, seq_hash: int) -> bool:
         return seq_hash in self._by_hash
 
+    def peek_hash(self, seq_hash: int) -> int | None:
+        """Non-reviving lookup: the block id registered under this hash
+        without touching refcounts or the inactive LRU (for callers that
+        already hold a pin from ``match_hash``)."""
+        return self._by_hash.get(seq_hash)
+
+    def registered_hashes(self) -> list[int]:
+        return list(self._by_hash)
+
+    def ref_count(self, seq_hash: int) -> int:
+        bid = self._by_hash.get(seq_hash)
+        return 0 if bid is None else self.blocks[bid].ref_count
+
     def release(self, block_id: int) -> None:
         """Sequence done with the block: registered blocks park in the
         inactive LRU (still reusable); others return to the free list."""

@@ -75,6 +75,13 @@ async def register_llm(
         model_types=model_types or ["chat", "completions"],
         mdc=json.loads(mdc.to_json()),
     )
+    # artifacts first, registration second: a frontend that sees the entry
+    # can always complete the fetch (reference: transports/nats.rs:123-211)
+    try:
+        n = await mdc.publish_artifacts(service.runtime.plane.bus)
+        logger.info("published %d artifact(s) for %s", n, mdc.name)
+    except Exception:  # noqa: BLE001 — same-filesystem serving still works
+        logger.exception("artifact publish failed for %s", mdc.name)
     # registered under the instance's lease: model entries vanish with the worker
     await service.runtime.plane.kv.put(entry.key(), entry.to_json(), service._lease.id)
     logger.info("registered model %s on %s", mdc.name, instance.subject)
@@ -130,9 +137,30 @@ class ModelWatcher:
             # keep serving the pipelines we already built on a lost watch
             logger.warning("model discovery watch lost: %s", exc)
 
+    async def clear_kv_blocks(self) -> list[str]:
+        """Broadcast a KV-cache flush to every worker component backing a
+        registered model; each worker's ClearKvListener flushes its engine
+        and re-announces the cleared state to the indexers (reference:
+        lib/llm/src/http/service/clear_kv_blocks.rs)."""
+        from dynamo_tpu.llm.kv_router.protocols import CLEAR_KV_SUBJECT
+
+        subjects = sorted(
+            {
+                self.runtime.namespace(e.namespace)
+                .component(e.component)
+                .event_subject(CLEAR_KV_SUBJECT)
+                for e in self._entries.values()
+            }
+        )
+        bus = self.runtime.plane.bus
+        for subject in subjects:
+            await bus.publish(subject, b"{}")
+        return subjects
+
     async def _handle_put(self, key: str, entry: ModelEntry) -> None:
         backing = self._backing.setdefault(entry.name, set())
         backing.add(key)
+        self._entries[key] = entry
         if entry.name in self._pipelines:
             return
         try:
@@ -142,6 +170,7 @@ class ModelWatcher:
             backing.discard(key)
 
     async def _handle_delete(self, key: str, entry: ModelEntry) -> None:
+        self._entries.pop(key, None)
         backing = self._backing.get(entry.name)
         if backing is None:
             return
@@ -159,7 +188,12 @@ class ModelWatcher:
     async def _build_pipeline(self, entry: ModelEntry) -> None:
         mdc = ModelDeploymentCard(**entry.mdc)
         if not mdc.path or not Path(mdc.path, "tokenizer.json").exists():
-            raise FileNotFoundError(f"model artifacts not found at {mdc.path}")
+            # no shared filesystem with the worker: pull the tokenizer/config
+            # artifacts the worker published to the object store
+            fetched = await mdc.fetch_artifacts(self.runtime.plane.bus)
+            if fetched is None or not (fetched / "tokenizer.json").exists():
+                raise FileNotFoundError(f"model artifacts not found at {mdc.path}")
+            logger.info("fetched artifacts for %s into %s", entry.name, fetched)
         tokenizer = HfTokenizer.from_file(Path(mdc.path) / "tokenizer.json")
 
         ns = self.runtime.namespace(entry.namespace)

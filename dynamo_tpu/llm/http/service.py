@@ -82,12 +82,16 @@ class HttpService:
         port: int = 8080,
         metrics: FrontendMetrics | None = None,
         request_template=None,
+        clear_kv=None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
         self.request_template = request_template
+        # async () -> list[str]: broadcast a cache flush to every backing
+        # worker component (reference: lib/llm/src/http/service/clear_kv_blocks.rs)
+        self.clear_kv = clear_kv
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
@@ -96,6 +100,7 @@ class HttpService:
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_health)
         self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
         self._runner: web.AppRunner | None = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -120,6 +125,18 @@ class HttpService:
 
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def handle_clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin: flush every worker's published KV-cache state (reference:
+        lib/llm/src/http/service/clear_kv_blocks.rs — frontend route that
+        fans the flush out to all workers)."""
+        if self.clear_kv is None:
+            return _error(501, "clear_kv_blocks not wired on this frontend")
+        try:
+            cleared = await self.clear_kv()
+        except Exception as exc:  # noqa: BLE001
+            return _error(500, f"clear_kv_blocks failed: {exc}", "internal_error")
+        return web.json_response({"status": "ok", "cleared": cleared})
 
     async def handle_models(self, request: web.Request) -> web.Response:
         models = ModelList(data=[ModelInfo(id=name) for name in self.manager.model_names()])

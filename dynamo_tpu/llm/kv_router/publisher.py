@@ -135,9 +135,18 @@ class ClearKvListener:
 
     async def _loop(self) -> None:
         bus = self.component.runtime.plane.bus
-        self._sub = await bus.subscribe(self.subject)
-        async for _msg in self._sub:
+        while True:
+            # a transient bus failure must not silently disable flush
+            # handling for the worker's lifetime: resubscribe and keep going
             try:
-                await self.engine.clear_kv_blocks()
+                self._sub = await bus.subscribe(self.subject)
+                async for _msg in self._sub:
+                    try:
+                        await self.engine.clear_kv_blocks()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("clear_kv_blocks failed")
+            except asyncio.CancelledError:
+                raise
             except Exception:  # noqa: BLE001
-                logger.exception("clear_kv_blocks failed")
+                logger.exception("clear_kv listener lost its subscription; retrying")
+            await asyncio.sleep(1.0)

@@ -118,6 +118,8 @@ class LLMEngineOutput:
     finish_reason: FinishReason | None = None
     # kv-cache stats piggybacked for metrics annotations
     completion_tokens: int | None = None
+    # engine-side failure detail (finish_reason == ERROR)
+    error: str | None = None
 
     def to_wire(self) -> dict:
         d: dict[str, Any] = {"token_ids": self.token_ids}
@@ -129,6 +131,8 @@ class LLMEngineOutput:
             d["finish_reason"] = self.finish_reason.value
         if self.completion_tokens is not None:
             d["completion_tokens"] = self.completion_tokens
+        if self.error is not None:
+            d["error"] = self.error
         return d
 
     @classmethod
@@ -140,6 +144,7 @@ class LLMEngineOutput:
             cum_log_probs=d.get("cum_log_probs"),
             finish_reason=FinishReason(fr) if fr else None,
             completion_tokens=d.get("completion_tokens"),
+            error=d.get("error"),
         )
 
 

@@ -47,19 +47,38 @@ class VisionConfig:
         return self.hidden_size // self.num_heads
 
     @classmethod
-    def from_hf_config(cls, config: dict | str | Path) -> "VisionConfig":
-        """Accepts a CLIP/SigLIP-style vision_config dict."""
+    def from_hf_config(
+        cls, config: dict | str | Path, *, llm_hidden_size: int | None = None
+    ) -> "VisionConfig":
+        """Accepts a LLaVA-style multimodal config (``vision_config`` +
+        ``text_config``) or a bare CLIP/SigLIP vision_config dict.
+
+        ``projector_dim`` is the LLM's hidden size (the projector output
+        must splice into the text model's embedding stream), so it comes
+        from ``text_config.hidden_size`` — NOT the vision tower's
+        ``projection_dim``, which is CLIP's contrastive embedding width.
+        Pass ``llm_hidden_size`` explicitly when supplying a bare
+        vision_config."""
         if not isinstance(config, dict):
             config = json.loads(Path(config).read_text())
-        config = config.get("vision_config", config)
+        vision = config.get("vision_config", config)
+        if llm_hidden_size is None:
+            text = config.get("text_config")
+            if isinstance(text, dict) and "hidden_size" in text:
+                llm_hidden_size = text["hidden_size"]
+            elif "vision_config" in config and "hidden_size" in config:
+                # older LLaVA layout: the top level IS the LM config
+                llm_hidden_size = config["hidden_size"]
+            else:
+                llm_hidden_size = 4096
         return cls(
-            image_size=config.get("image_size", 336),
-            patch_size=config.get("patch_size", 14),
-            hidden_size=config.get("hidden_size", 1024),
-            num_layers=config.get("num_hidden_layers", 24),
-            num_heads=config.get("num_attention_heads", 16),
-            mlp_dim=config.get("intermediate_size", 4096),
-            projector_dim=config.get("projection_dim", 4096),
+            image_size=vision.get("image_size", 336),
+            patch_size=vision.get("patch_size", 14),
+            hidden_size=vision.get("hidden_size", 1024),
+            num_layers=vision.get("num_hidden_layers", 24),
+            num_heads=vision.get("num_attention_heads", 16),
+            mlp_dim=vision.get("intermediate_size", 4096),
+            projector_dim=llm_hidden_size,
         )
 
     @classmethod

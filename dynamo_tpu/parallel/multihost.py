@@ -47,27 +47,39 @@ async def bootstrap_multihost(
 
     import jax
 
+    import asyncio
+    import functools
+
+    loop = asyncio.get_running_loop()
+
+    def initialize(addr: str, process_id: int) -> None:
+        # jax.distributed.initialize blocks until every process connects to
+        # the coordinator.  It must NOT run on the event loop: the leader's
+        # barrier publish (and the runtime's lease keepalives) need the loop
+        # while initialize waits for the other ranks.
+        fn = functools.partial(
+            jax.distributed.initialize,
+            coordinator_address=addr,
+            num_processes=config.num_nodes,
+            process_id=process_id,
+        )
+        return fn()
+
     if config.is_leader:
         addr = config.leader_addr or f"{socket.gethostbyname(socket.gethostname())}:{coordinator_port}"
         leader = LeaderBarrier(kv, barrier_id, num_workers=config.num_nodes - 1)
         # publish before initialize so workers can join while the leader blocks
-        import asyncio
-
         sync_task = asyncio.ensure_future(leader.sync({"coordinator": addr}, timeout=timeout))
-        jax.distributed.initialize(
-            coordinator_address=addr,
-            num_processes=config.num_nodes,
-            process_id=0,
-        )
+        try:
+            await loop.run_in_executor(None, initialize, addr, 0)
+        except BaseException:
+            sync_task.cancel()  # don't leave the barrier task dangling
+            raise
         await sync_task
     else:
         worker = WorkerBarrier(kv, barrier_id, worker_id=str(config.node_rank))
         data = await worker.sync(timeout=timeout)
-        jax.distributed.initialize(
-            coordinator_address=data["coordinator"],
-            num_processes=config.num_nodes,
-            process_id=config.node_rank,
-        )
+        await loop.run_in_executor(None, initialize, data["coordinator"], config.node_rank)
     logger.info(
         "multihost up: rank %d/%d, %d global devices",
         config.node_rank, config.num_nodes, jax.device_count(),

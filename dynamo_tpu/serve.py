@@ -15,7 +15,11 @@ from pathlib import Path
 from dynamo_tpu.llm.discovery import ModelWatcher, register_llm
 from dynamo_tpu.llm.engines import EchoEngineCore
 from dynamo_tpu.llm.http import HttpService, ModelManager
-from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.kv_router.publisher import (
+    ClearKvListener,
+    KvEventPublisher,
+    WorkerMetricsPublisher,
+)
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
 from dynamo_tpu.runtime.client import RouterMode
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -111,7 +115,9 @@ async def serve_worker(
             ep.component, service.instance.instance_id, engine.stats
         )
         metrics_pub.start()
-        publishers = [kv_pub, metrics_pub]
+        clear_listener = ClearKvListener(ep.component, engine)
+        clear_listener.start()
+        publishers = [kv_pub, metrics_pub, clear_listener]
         engine.start()
     else:
         raise ValueError(f"unknown engine kind {engine_kind!r}")
@@ -132,8 +138,11 @@ async def serve_frontend(
 
     template = RequestTemplate.load(request_template) if request_template else None
     manager = ModelManager()
-    service = HttpService(manager, host=host, port=port, request_template=template)
     watcher = ModelWatcher(runtime, manager, router_mode=router_mode)
+    service = HttpService(
+        manager, host=host, port=port, request_template=template,
+        clear_kv=watcher.clear_kv_blocks,
+    )
     await watcher.start()
     await service.start()
     return service, watcher

@@ -356,3 +356,20 @@ async def test_preemption_preserves_penalty_state():
     for (tokens, _), ref in zip(results, refs):
         assert tokens == ref
         assert len(set(tokens)) == len(tokens)  # penalty still blocks repeats
+
+
+async def test_pallas_failure_falls_back_to_xla_attention():
+    """A Pallas attention kernel that cannot compile (Mosaic geometry
+    limits, remote-compile 500s) must degrade the engine to the portable
+    XLA attention path, not fail every in-flight sequence.  On CPU the
+    TPU pallas kernel always fails to lower, so forcing
+    ``attention_impl="pallas"`` exercises exactly that recovery."""
+    engine = make_engine(attention_impl="pallas")
+    try:
+        prompt = [5, 6, 7, 8, 9, 10]
+        tokens, finish = await collect(engine, request(prompt, max_tokens=6))
+        assert engine.attention_impl == "jax"  # fallback happened
+        assert finish in (FinishReason.LENGTH, FinishReason.STOP)
+        assert tokens == greedy_reference(prompt, len(tokens))
+    finally:
+        engine.stop()

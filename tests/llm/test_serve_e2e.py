@@ -226,3 +226,105 @@ async def test_kv_router_cache_hit_skips_prefill_compute():
         for w in workers:
             await w.shutdown()
         await rt.close()
+
+
+async def test_clear_kv_blocks_end_to_end():
+    """Admin cache flush (reference lib/llm/src/http/service/clear_kv_blocks.rs):
+    POST /clear_kv_blocks on the frontend → bus broadcast on the component's
+    clear_kv_blocks subject → worker ClearKvListener → engine flush → removal
+    events drain the KV router's index."""
+    rt = await make_runtime()
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(
+            rt, MODEL_DIR, model_name="tiny", engine_kind="jax",
+            num_blocks=64, max_batch_size=4, max_model_len=128,
+            prefill_buckets=(32, 64),
+        )
+        service, watcher = await serve_frontend(
+            rt, host="127.0.0.1", port=0, router_mode=RouterMode.KV
+        )
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            await wait_for_model(client, "tiny")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [
+                        {"role": "user", "content": "the quick brown fox jumps over the lazy dog " * 4}
+                    ],
+                    "max_tokens": 4,
+                },
+                timeout=120,
+            )
+            assert r.status_code == 200
+
+            kv_router = watcher._pipelines["tiny"]["kv"]
+            for _ in range(100):  # stored-block events reach the index
+                if kv_router.indexer.tree.size() > 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert kv_router.indexer.tree.size() > 0
+
+            r = await client.post("/clear_kv_blocks")
+            assert r.status_code == 200
+            body = r.json()
+            assert body["status"] == "ok" and len(body["cleared"]) == 1
+
+            for _ in range(100):  # flush + removal events drain the index
+                if kv_router.indexer.tree.size() == 0:
+                    break
+                await asyncio.sleep(0.1)
+            assert kv_router.indexer.tree.size() == 0
+            assert not worker.engine.allocator._hash_to_block  # registry flushed
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()
+
+
+async def test_artifact_distribution_via_object_store(tmp_path, monkeypatch):
+    """A frontend with no shared filesystem with the worker still builds its
+    tokenizer pipeline: register_llm publishes the MDC's tokenizer/config
+    artifacts to the control-plane object store and the ModelWatcher fetches
+    them on a local-path miss (reference: lib/runtime/src/transports/nats.rs:
+    123-211)."""
+    monkeypatch.setenv("DYN_CACHE_DIR", str(tmp_path))
+    rt = await make_runtime()
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        # simulate the cross-machine case: the registered entry's local path
+        # is unreadable on the frontend's machine
+        from dynamo_tpu.llm.discovery import MODELS_PREFIX
+
+        for entry in await rt.plane.kv.get_prefix(MODELS_PREFIX):
+            doc = json.loads(entry.value)
+            doc["mdc"]["path"] = "/nonexistent/elsewhere"
+            await rt.plane.kv.put(entry.key, json.dumps(doc).encode())
+
+        service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            await wait_for_model(client, "tiny")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "hello world"}]},
+                timeout=30,
+            )
+            assert r.status_code == 200
+            assert "hello world" in r.json()["choices"][0]["message"]["content"]
+        # the tokenizer really came through the store into the cache dir
+        fetched = list(tmp_path.glob("mdc/*/tokenizer.json"))
+        assert len(fetched) == 1
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()

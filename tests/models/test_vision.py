@@ -39,15 +39,24 @@ def test_vit_encode_shape_and_determinism():
 
 
 def test_from_hf_config_vision_section():
+    vision_section = {
+        "image_size": 112, "patch_size": 16, "hidden_size": 64,
+        "num_hidden_layers": 3, "num_attention_heads": 4,
+        "intermediate_size": 128, "projection_dim": 96,
+    }
+    # LLaVA-style: projector width comes from the TEXT model's hidden size,
+    # never from CLIP's contrastive projection_dim
     cfg = VisionConfig.from_hf_config(
-        {
-            "vision_config": {
-                "image_size": 112, "patch_size": 16, "hidden_size": 64,
-                "num_hidden_layers": 3, "num_attention_heads": 4,
-                "intermediate_size": 128, "projection_dim": 96,
-            }
-        }
+        {"vision_config": vision_section, "text_config": {"hidden_size": 256}}
     )
     assert cfg.image_size == 112 and cfg.num_layers == 3
     assert cfg.num_patches == (112 // 16) ** 2
-    assert cfg.projector_dim == 96
+    assert cfg.projector_dim == 256
+    # older LLaVA layout: top level IS the LM config
+    cfg = VisionConfig.from_hf_config(
+        {"vision_config": vision_section, "hidden_size": 512}
+    )
+    assert cfg.projector_dim == 512
+    # bare vision_config: caller supplies the LLM width
+    cfg = VisionConfig.from_hf_config(vision_section, llm_hidden_size=320)
+    assert cfg.projector_dim == 320
