@@ -113,6 +113,29 @@ class JaxLlmEngine:
         self.mesh = None
         if config.mesh is not None and config.mesh.total() > 1:
             self.mesh = make_mesh(config.mesh)
+            # static-shape divisibility: fail at init, not at first jit
+            # trace mid-serving
+            pp = config.mesh.pp
+            if pp > 1:
+                if config.max_batch_size % pp:
+                    raise ValueError(
+                        f"max_batch_size={config.max_batch_size} must be divisible "
+                        f"by the pp axis ({pp}): pipeline microbatches split the "
+                        "decode batch evenly"
+                    )
+                if cfg.num_layers % pp:
+                    raise ValueError(
+                        f"num_layers={cfg.num_layers} must be divisible by the "
+                        f"pp axis ({pp}): layers split evenly into stages"
+                    )
+            sp = config.mesh.sp
+            if sp > 1:
+                bad = [b for b in self.buckets if b % sp]
+                if bad:
+                    raise ValueError(
+                        f"prefill buckets {bad} not divisible by the sp axis "
+                        f"({sp}): ring attention shards the sequence evenly"
+                    )
 
         if config.attention_impl == "auto":
             # a wedged accelerator plugin must not crash engine construction
@@ -265,12 +288,22 @@ class JaxLlmEngine:
         cfg = self.config.model
         vocab = cfg.vocab_size
 
+        # sequence parallelism: prefill attention rides the ring kernel when
+        # the mesh has an sp axis and the family supports it
+        prefill_kwargs = {}
+        if (
+            self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and self.family.supports_sp
+        ):
+            prefill_kwargs["sp_mesh"] = self.mesh
+
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  block_ids, seq_len, start_pos, gen_row, key, temp, top_k, top_p,
                  greedy, pres, freq, rep):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
-                self.cos, self.sin,
+                self.cos, self.sin, **prefill_kwargs,
             )
             # (re)seed this lane's sampling state.  ``gen_row`` is the count
             # of already-generated tokens (nonzero only on preemption
@@ -380,6 +413,26 @@ class JaxLlmEngine:
         cfg = self.config.model
         steps = self.config.decode_steps
 
+        # pipeline parallelism: when the mesh has a pp axis and the family
+        # ships a pipelined decode, the layer stack runs as GPipe-style
+        # stages over ICI instead of a plain scan (parallel/pipeline.py)
+        use_pp = (
+            self.mesh is not None
+            and self.mesh.shape.get("pp", 1) > 1
+            and self.family.forward_decode_pp is not None
+        )
+
+        def fwd_decode(params, cache, tokens, tables, lens, slots):
+            if use_pp:
+                return self.family.forward_decode_pp(
+                    params, cfg, tokens, cache, tables, lens, slots,
+                    self.cos, self.sin, pp_mesh=self.mesh,
+                )
+            return self.family.forward_decode(
+                params, cfg, tokens, cache, tables, lens, slots,
+                self.cos, self.sin, attention=self.attention_impl,
+            )
+
         lanes = self.config.max_batch_size
         lane_idx = jnp.arange(lanes)
 
@@ -394,9 +447,8 @@ class JaxLlmEngine:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
                      block_tables, context_lens, slot_ids, keys, temp, top_k,
                      top_p, greedy, pres, freq, rep):
-                logits, cache = self.family.forward_decode(
-                    params, cfg, token_ids, cache, block_tables, context_lens, slot_ids,
-                    self.cos, self.sin, attention=self.attention_impl,
+                logits, cache = fwd_decode(
+                    params, cache, token_ids, block_tables, context_lens, slot_ids
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
@@ -428,9 +480,8 @@ class JaxLlmEngine:
                 pos = jnp.clip(lens - 1, 0, max_pos)
                 blk = jnp.take_along_axis(block_tables, (pos // block_size)[:, None], axis=1)[:, 0]
                 slots = jnp.where(active, blk * block_size + pos % block_size, oob)
-                logits, cache = self.family.forward_decode(
-                    params, cfg, tokens, cache, block_tables, lens, slots,
-                    self.cos, self.sin, attention=self.attention_impl,
+                logits, cache = fwd_decode(
+                    params, cache, tokens, block_tables, lens, slots
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, lens)

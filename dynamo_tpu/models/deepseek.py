@@ -42,6 +42,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from dynamo_tpu.ops.attention import NEG_INF, write_decode_kv, write_prefill_kv
@@ -365,8 +366,15 @@ def _mla_prefill_attn(w, x, cfg: DeepseekConfig, positions, seq_len, k_layer, v_
 
 
 def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
-                     block_tables, context_lens, slot_ids, cos, sin):
-    """Absorbed-form batched decode attention against the latent cache."""
+                     block_tables, context_lens, slot_ids, cos, sin,
+                     attention: str = "jax"):
+    """Absorbed-form batched decode attention against the latent cache.
+
+    ``attention="pallas"`` runs the MLA paged-attention kernel
+    (ops/pallas/mla_attention.py): page latents stream VMEM-ward via the
+    block table with online softmax — no [B, maxb*bs, R] gather
+    materialized in HBM.  The XLA gather path is the portable fallback.
+    """
     b = x.shape[0]
     H = cfg.num_heads
     q = _project_q(w, x, cfg)
@@ -385,21 +393,33 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
     q_lat = jnp.einsum("bhn,rhn->bhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32))
 
     num_blocks, block_size = k_layer.shape[0], k_layer.shape[1]
-    max_blocks = block_tables.shape[1]
-    length = max_blocks * block_size
-    ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
-    kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
+    scale = 1.0 / float(np.sqrt(cfg.qk_head_dim))
 
-    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
-    logits = (
-        jnp.einsum("bhr,btr->bht", q_lat, ck.astype(jnp.float32))
-        + jnp.einsum("bhp,btp->bht", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
-    ) * scale
-    valid = jnp.arange(length)[None, :] < context_lens[:, None]
-    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
-    weights = jax.nn.softmax(logits, axis=-1)
-    # context in latent space, then decompress through the v up-projection
-    ctx = jnp.einsum("bht,btr->bhr", weights, ck.astype(jnp.float32))
+    if attention in ("pallas", "pallas_interpret"):
+        from dynamo_tpu.ops.pallas.mla_attention import mla_paged_attention_decode
+
+        ctx = mla_paged_attention_decode(
+            q_lat, q_rope,
+            k_layer.reshape(num_blocks, block_size, cfg.kv_lora_rank),
+            v_layer.reshape(num_blocks, block_size, cfg.qk_rope_head_dim),
+            block_tables, context_lens,
+            scale=scale, interpret=attention == "pallas_interpret",
+        )
+    else:
+        max_blocks = block_tables.shape[1]
+        length = max_blocks * block_size
+        ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
+        kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
+        logits = (
+            jnp.einsum("bhr,btr->bht", q_lat, ck.astype(jnp.float32))
+            + jnp.einsum("bhp,btp->bht", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
+        ) * scale
+        valid = jnp.arange(length)[None, :] < context_lens[:, None]
+        logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+        weights = jax.nn.softmax(logits, axis=-1)
+        # context in latent space
+        ctx = jnp.einsum("bht,btr->bhr", weights, ck.astype(jnp.float32))
+    # decompress through the v up-projection
     out = jnp.einsum("bhr,rhv->bhv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
     return out.reshape(b, -1) @ w["wo"], (k_layer, v_layer)
 
@@ -493,8 +513,9 @@ def deepseek_forward_decode(
     slot_ids, cos, sin, *, attention: str = "jax",
 ):
     """Batched single-token decode → (logits [batch, vocab], new cache).
-    MLA decode always runs the absorbed latent path (the GQA Pallas kernel
-    does not apply); ``attention`` is accepted for engine interface parity."""
+    MLA decode runs the absorbed latent path; ``attention="pallas"``
+    dispatches the MLA paged-attention kernel, anything else the XLA
+    gather fallback."""
     x = params["embed"][token_ids].astype(cfg.dtype)
     positions = jnp.maximum(context_lens - 1, 0)
 
@@ -502,6 +523,7 @@ def deepseek_forward_decode(
         return _mla_decode_attn(
             w, attn_in, cfg, positions, k_layer, v_layer,
             block_tables, context_lens, slot_ids, cos, sin,
+            attention=attention,
         )
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)

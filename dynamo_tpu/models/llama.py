@@ -148,35 +148,38 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
 
 
 def param_specs(cfg: LlamaConfig) -> dict:
-    """PartitionSpecs over mesh axes ('tp' for tensor parallel).  GSPMD
-    derives the collectives; this is the whole TP implementation."""
+    """PartitionSpecs over mesh axes: 'tp' shards heads/vocab within a
+    layer, 'pp' shards the stacked layer axis into pipeline stages (a no-op
+    on pp=1 meshes).  GSPMD derives the collectives; this is the whole
+    TP implementation, and the pipeline runner consumes the same pp-sharded
+    leaves via shard_map (parallel/pipeline.py)."""
     specs = {
         "embed": P("tp", None),          # vocab-sharded
         "final_norm": P(None),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),   # head-sharded
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),   # row-parallel → all-reduce
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
+            "attn_norm": P("pp", None),
+            "wq": P("pp", None, "tp"),   # head-sharded
+            "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"),
+            "wo": P("pp", "tp", None),   # row-parallel → all-reduce
+            "mlp_norm": P("pp", None),
+            "w_gate": P("pp", None, "tp"),
+            "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
         },
     }
     if cfg.attention_bias:
-        specs["layers"]["bq"] = P(None, "tp")
-        specs["layers"]["bk"] = P(None, "tp")
-        specs["layers"]["bv"] = P(None, "tp")
+        specs["layers"]["bq"] = P("pp", "tp")
+        specs["layers"]["bk"] = P("pp", "tp")
+        specs["layers"]["bv"] = P("pp", "tp")
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")  # vocab-sharded logits
     return specs
 
 
 def kv_cache_spec() -> P:
-    """KV cache sharded over kv heads on 'tp'."""
-    return P(None, None, None, "tp", None)
+    """KV cache: layer axis on 'pp' (pipeline stages), kv heads on 'tp'."""
+    return P("pp", None, None, "tp", None)
 
 
 def init_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int, dtype=None):
@@ -254,11 +257,19 @@ def llama_forward_prefill(
     start_pos: jnp.ndarray,   # scalar int32: absolute position offset (chunked prefill)
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    *,
+    sp_mesh=None,
 ) -> tuple[jnp.ndarray, dict]:
-    """Single-sequence prefill.  Returns (last-token logits [vocab], new cache)."""
+    """Single-sequence prefill.  Returns (last-token logits [vocab], new cache).
+
+    ``sp_mesh``: a mesh whose ``sp`` axis shards the sequence — prefill
+    attention runs as ring attention (ops/ring_attention.py), K/V chunks
+    rotating over ICI, enabling prompts beyond one chip's activation memory
+    (sequence/context parallelism; the reference has none, SURVEY.md §2.5)."""
     x = params["embed"][token_ids].astype(cfg.dtype)  # [s, h]
     return llama_forward_prefill_embeds(
-        params, cfg, x, kv_cache, block_ids, seq_len, start_pos, cos, sin
+        params, cfg, x, kv_cache, block_ids, seq_len, start_pos, cos, sin,
+        sp_mesh=sp_mesh,
     )
 
 
@@ -272,13 +283,18 @@ def llama_forward_prefill_embeds(
     start_pos: jnp.ndarray,
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    *,
+    sp_mesh=None,
 ) -> tuple[jnp.ndarray, dict]:
     """Prefill from pre-computed input embeddings (multimodal prompts:
     vision-encoder patch embeddings concatenated with text token
-    embeddings, LLaVA-style)."""
+    embeddings, LLaVA-style).  ``sp_mesh``: see llama_forward_prefill."""
     s = input_embeds.shape[0]
     x = input_embeds.astype(cfg.dtype)
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    if sp_mesh is not None:
+        from dynamo_tpu.ops.ring_attention import ring_attention
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
@@ -287,7 +303,10 @@ def llama_forward_prefill_embeds(
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
-        attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+        if sp_mesh is not None:
+            attn = ring_attention(q[None], k[None], v[None], seq_len, sp_mesh)[0]
+        else:
+            attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
         x = x + attn.reshape(s, -1) @ w["wo"]
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
@@ -399,6 +418,55 @@ def llama_forward_decode(
 
     x, (new_k, new_v) = jax.lax.scan(
         layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_forward_decode_pp(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,
+    kv_cache: dict,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    slot_ids: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    pp_mesh,
+    microbatches: int | None = None,
+) -> tuple[jnp.ndarray, dict]:
+    """Batched decode with the layer stack pipelined over the ``pp`` mesh
+    axis (parallel/pipeline.py): stage s holds layers [s*L/S, (s+1)*L/S)
+    and their KV-cache slice; microbatches stream through the stages over
+    ICI.  Embedding and the LM head run replicated outside the pipeline.
+    Matches llama_forward_decode exactly (same layer body)."""
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(context_lens - 1, 0)
+
+    def body(x_mb, aux_mb, w, layer_cache):
+        k_layer, v_layer = layer_cache
+        pos_mb, slots_mb, tables_mb, lens_mb = aux_mb
+        attn_in = rms_norm(x_mb, w["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q[:, None], pos_mb[:, None], cos, sin)[:, 0]
+        k = apply_rope(k[:, None], pos_mb[:, None], cos, sin)[:, 0]
+        k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slots_mb)
+        attn = paged_decode_attention(q, k_layer, v_layer, tables_mb, lens_mb)
+        x_mb = x_mb + attn.reshape(x_mb.shape[0], -1) @ w["wo"]
+        mlp_in = rms_norm(x_mb, w["mlp_norm"], cfg.rms_norm_eps)
+        x_mb = x_mb + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x_mb, (k_layer, v_layer)
+
+    from dynamo_tpu.parallel.pipeline import pipeline_layer_stack
+
+    x, (new_k, new_v) = pipeline_layer_stack(
+        body, x, (positions, slot_ids, block_tables, context_lens),
+        params["layers"], (kv_cache["k"], kv_cache["v"]), pp_mesh,
+        microbatches=microbatches,
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)

@@ -32,6 +32,10 @@ class ModelFamily:
     # prefill from precomputed input embeddings (multimodal: vision patches
     # spliced before text); None = no multimodal support for this family
     forward_prefill_embeds: Callable | None = None
+    # forward_prefill accepts sp_mesh= (ring-attention sequence parallelism)
+    supports_sp: bool = False
+    # pipelined decode over the pp mesh axis (parallel/pipeline.py)
+    forward_decode_pp: Callable | None = None
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -69,6 +73,8 @@ def _llama_family() -> ModelFamily:
         forward_decode=llama.llama_forward_decode,
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
         forward_prefill_embeds=llama.llama_forward_prefill_embeds,
+        supports_sp=True,
+        forward_decode_pp=llama.llama_forward_decode_pp,
     )
 
 
@@ -95,6 +101,8 @@ def _qwen2_family() -> ModelFamily:
         forward_decode=llama.llama_forward_decode,
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
         forward_prefill_embeds=llama.llama_forward_prefill_embeds,
+        supports_sp=True,
+        forward_decode_pp=llama.llama_forward_decode_pp,
     )
 
 

@@ -1,0 +1,142 @@
+"""MLA (multi-head latent attention) paged decode — Pallas TPU kernel.
+
+DeepSeek's absorbed-form decode attends in latent space: per sequence the
+queries are ``q_lat [H, R]`` (nope-part absorbed through the K up-projection)
+and ``q_rope [H, P]``; the paged cache stores compressed latents ``ck [bs, R]``
+(doubling as the values) and rope keys ``kr [bs, P]`` per page.  Scores are
+the two-part sum ``q_lat·ck + q_rope·kr`` and the context is accumulated in
+latent space (decompression through the V up-projection happens outside).
+
+Same pipelining scheme as ``paged_attention.py``: one grid step =
+(sequence, page), page tiles DMA'd via the scalar-prefetched block table,
+online-softmax accumulation in VMEM scratch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    block_tables_ref,   # [B, maxb] int32
+    context_lens_ref,   # [B] int32
+    # inputs
+    q_lat_ref,          # [1, H, R]
+    q_rope_ref,         # [1, H, P]
+    ck_page_ref,        # [1, bs, R]   latents (keys AND values)
+    kr_page_ref,        # [1, bs, P]   rope keys
+    # output
+    out_ref,            # [1, H, R]    latent-space context
+    # scratch
+    m_ref,              # [H, 128] f32 running max
+    l_ref,              # [H, 128] f32 running denom
+    acc_ref,            # [H, R]  f32 running numerator
+    *,
+    block_size: int,
+    scale: float,
+    max_blocks: int,
+):
+    seq = pl.program_id(0)
+    page = pl.program_id(1)
+    ctx = context_lens_ref[seq]
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_start = page * block_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        q_lat = q_lat_ref[0].astype(jnp.float32)    # [H, R]
+        q_rope = q_rope_ref[0].astype(jnp.float32)  # [H, P]
+        ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
+        kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
+        # [H, bs] two-part scores, both contractions on the MXU
+        s = (
+            jax.lax.dot_general(
+                q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * scale
+        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                           # [H, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                          # [H, bs]
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # [H, R] context in latent space: values ARE the latents
+        pv = jax.lax.dot_general(
+            p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(page == max_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def mla_paged_attention_decode(
+    q_lat: jnp.ndarray,         # [B, H, R] f32/bf16
+    q_rope: jnp.ndarray,        # [B, H, P]
+    ck_cache: jnp.ndarray,      # [N, bs, R] latent cache
+    kr_cache: jnp.ndarray,      # [N, bs, P] rope-key cache
+    block_tables: jnp.ndarray,  # [B, maxb] int32
+    context_lens: jnp.ndarray,  # [B] int32
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Returns the latent-space context [B, H, R] (float32)."""
+    b, h, r = q_lat.shape
+    p_dim = q_rope.shape[-1]
+    bs = ck_cache.shape[1]
+    maxb = block_tables.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, h, r), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, h, p_dim), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, bs, r), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+            pl.BlockSpec((1, bs, p_dim), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, r), lambda s, p, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, 128), jnp.float32),
+            pltpu.VMEM((h, r), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, block_size=bs, scale=scale, max_blocks=maxb
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
+        interpret=interpret,
+    )(block_tables, context_lens, q_lat, q_rope, ck_cache, kr_cache)

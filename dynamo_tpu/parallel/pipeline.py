@@ -1,0 +1,117 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The model families stack per-layer weights on a leading layer axis
+(``[L, ...]`` leaves), so pipeline stages fall out of sharding that axis:
+stage ``s`` holds layers ``[s*L/S, (s+1)*L/S)`` and its slice of the
+layer-stacked KV cache.  Execution is GPipe-style inference (no backward):
+the batch splits into microbatches that stream through the stages, and
+activations hop stage→stage with ``jax.lax.ppermute`` (ICI neighbor
+exchange).  Total ticks = S + M - 1; the (S-1)-tick bubble amortizes as
+M grows.
+
+The reference's multi-node engine splits layers across nodes through the
+serving engine (SURVEY.md §2.5 marks PP reserved); here PP is a mesh axis
+like every other, composed by GSPMD outside the shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_layer_stack(
+    body: Callable,
+    x: jnp.ndarray,             # [B, ...] activations entering layer 0
+    aux,                        # pytree of [B, ...] per-row side inputs
+    layer_params,               # pytree, leading axis L, sharded P("pp", ...)
+    layer_cache,                # pytree, leading axis L, sharded P("pp", ...)
+    mesh: Mesh,
+    *,
+    axis: str = "pp",
+    microbatches: int | None = None,
+):
+    """Run ``x`` through all L stacked layers, pipelined over ``axis``.
+
+    ``body(x_mb, aux_mb, w, cache_layer) -> (x_mb, cache_layer)`` applies ONE
+    layer (single-layer slices of params/cache) to one microbatch.
+
+    Returns ``(x_out [B, ...], layer_cache')`` with the cache's layer axis
+    reassembled across stages.
+    """
+    stages = mesh.shape[axis]
+    batch = x.shape[0]
+    m_count = microbatches or stages
+    if batch % m_count:
+        raise ValueError(f"batch {batch} not divisible by {m_count} microbatches")
+    mb = batch // m_count
+
+    def stage_fn(x_full, aux_full, w_local, cache_local):
+        stage = jax.lax.axis_index(axis)
+        last = stages - 1
+        perm = [(i, (i + 1) % stages) for i in range(stages)]
+
+        x_chunks = x_full.reshape(m_count, mb, *x_full.shape[1:])
+        aux_chunks = jax.tree.map(
+            lambda a: a.reshape(m_count, mb, *a.shape[1:]), aux_full
+        )
+
+        def run_local_layers(x_in, aux_in, cache_loc):
+            def one_layer(carry, layer_in):
+                xc = carry
+                w, c = layer_in
+                xc, c = body(xc, aux_in, w, c)
+                return xc, c
+
+            return jax.lax.scan(one_layer, x_in, (w_local, cache_loc))
+
+        cur0 = jnp.zeros((mb, *x_full.shape[1:]), x_full.dtype)
+        ys0 = jnp.zeros((m_count, mb, *x_full.shape[1:]), x_full.dtype)
+
+        def tick(t, state):
+            cur, ys, cache_loc = state
+            m = t - stage                      # this stage's microbatch index
+            active = jnp.logical_and(m >= 0, m < m_count)
+            mc = jnp.clip(m, 0, m_count - 1)
+            x_in = jnp.where(stage == 0, x_chunks[jnp.clip(t, 0, m_count - 1)], cur)
+            aux_in = jax.tree.map(lambda a: a[mc], aux_chunks)
+            y, cache_new = run_local_layers(x_in, aux_in, cache_loc)
+            # only active ticks commit cache writes (bubble ticks chew on
+            # stale/garbage activations by design)
+            cache_loc = jax.tree.map(
+                lambda n, o: jnp.where(active, n, o), cache_new, cache_loc
+            )
+            ys = jnp.where(
+                jnp.logical_and(active, stage == last), ys.at[mc].set(y), ys
+            )
+            cur = jax.lax.ppermute(y, axis, perm)
+            return cur, ys, cache_loc
+
+        cur, ys, cache_local = jax.lax.fori_loop(
+            0, stages + m_count - 1, tick, (cur0, ys0, cache_local)
+        )
+        # the last stage holds the outputs; replicate them to every stage
+        ys = jax.lax.psum(
+            jnp.where(stage == last, ys, jnp.zeros_like(ys)), axis
+        )
+        return ys.reshape(batch, *x_full.shape[1:]), cache_local
+
+    layer_spec = P(axis)
+    fn = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            jax.tree.map(lambda _: P(), aux),
+            jax.tree.map(lambda _: layer_spec, layer_params),
+            jax.tree.map(lambda _: layer_spec, layer_cache),
+        ),
+        out_specs=(P(), jax.tree.map(lambda _: layer_spec, layer_cache)),
+        check_vma=False,
+    )
+    return fn(x, aux, layer_params, layer_cache)

@@ -373,3 +373,18 @@ async def test_pallas_failure_falls_back_to_xla_attention():
         assert tokens == greedy_reference(prompt, len(tokens))
     finally:
         engine.stop()
+
+
+async def test_pp_mesh_engine_matches_dense_reference():
+    """Serving through a pp=2 mesh: the pipelined decode (GPipe stages over
+    ppermute) produces exactly the single-device greedy output."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    engine = make_engine(mesh=MeshConfig(pp=2), attention_impl="jax")
+    try:
+        prompt = [5, 6, 7, 8, 9, 10]
+        tokens, finish = await collect(engine, request(prompt, max_tokens=6))
+        assert finish in (FinishReason.LENGTH, FinishReason.STOP)
+        assert tokens == greedy_reference(prompt, len(tokens))
+    finally:
+        engine.stop()

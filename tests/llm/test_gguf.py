@@ -147,8 +147,8 @@ def test_quantized_tensor_rejected(tmp_path):
     path = tmp_path / "q.gguf"
     write_gguf(path, {"general.architecture": "llama"}, {"t": np.zeros((4, 4), np.float32)})
     gguf = GGUFFile(path)
-    gguf.tensors["t"].ggml_type = 2  # pretend Q4_0
-    with pytest.raises(NotImplementedError, match="quantized"):
+    gguf.tensors["t"].ggml_type = 11  # Q3_K: recognized, not implemented
+    with pytest.raises(NotImplementedError, match="Q3_K"):
         gguf.tensor_data("t")
 
 

@@ -169,4 +169,4 @@ def test_tp_sharded_matches_single_device(params):
     np.testing.assert_allclose(logits_tp, logits_single, rtol=2e-3, atol=2e-3)
     # cache must remain sharded over kv heads
     assert isinstance(new_cache["k"].sharding, NamedSharding)
-    assert new_cache["k"].sharding.spec == P(None, None, None, "tp", None)
+    assert new_cache["k"].sharding.spec == P("pp", None, None, "tp", None)

@@ -62,3 +62,37 @@ def test_gather_scatter_blocks_roundtrip():
     np.testing.assert_allclose(out[dst_ids], pool[src_ids])
     # untouched slots stay zero
     np.testing.assert_allclose(out[0], jnp.zeros_like(pool[0]))
+
+
+def test_mla_paged_attention_matches_reference():
+    """MLA kernel vs a dense latent-space softmax reference."""
+    from dynamo_tpu.ops.pallas.mla_attention import mla_paged_attention_decode
+
+    rng = jax.random.PRNGKey(3)
+    b, h, r, p, bs, maxb, nblocks = 3, 4, 32, 16, 8, 4, 16
+    keys = jax.random.split(rng, 4)
+    q_lat = jax.random.normal(keys[0], (b, h, r), jnp.float32)
+    q_rope = jax.random.normal(keys[1], (b, h, p), jnp.float32)
+    ck = jax.random.normal(keys[2], (nblocks, bs, r), jnp.float32)
+    kr = jax.random.normal(keys[3], (nblocks, bs, p), jnp.float32)
+    tables = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]], jnp.int32)
+    ctx = jnp.asarray([5, 17, 29], jnp.int32)
+    scale = 0.17
+
+    out = mla_paged_attention_decode(
+        q_lat, q_rope, ck, kr, tables, ctx, scale=scale, interpret=True
+    )
+
+    # dense reference
+    length = maxb * bs
+    ck_g = ck[tables].reshape(b, length, r)
+    kr_g = kr[tables].reshape(b, length, p)
+    logits = (
+        jnp.einsum("bhr,btr->bht", q_lat, ck_g)
+        + jnp.einsum("bhp,btp->bht", q_rope, kr_g)
+    ) * scale
+    valid = jnp.arange(length)[None, :] < ctx[:, None]
+    logits = jnp.where(valid[:, None, :], logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    ref = jnp.einsum("bht,btr->bhr", weights, ck_g)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
