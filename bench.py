@@ -90,7 +90,13 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
         os.environ.get("DYN_BENCH_BLOCKS", per_seq_blocks * max_batch + 32)
     )
 
-    chunk = int(os.environ.get("DYN_BENCH_CHUNK", "0")) or None
+    # Chunked prefill by default on the accelerator geometry: the monolithic
+    # ISL-3000 prefill program is the biggest single compile in the serving
+    # path (and compile-service hangs on it zeroed two rounds of bench); a
+    # 512-token continued-prefill window compiles small and is reused for
+    # every chunk of every request.  DYN_BENCH_CHUNK=0 forces whole-prompt.
+    default_chunk = "0" if fallback_cpu else "512"
+    chunk = int(os.environ.get("DYN_BENCH_CHUNK", default_chunk)) or None
     t_init = time.monotonic()
 
     family = get_family("llama")
@@ -130,7 +136,7 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             block_size=block_size,
             max_batch_size=max_batch,
             max_model_len=max_len,
-            prefill_buckets=(prompt_len,),
+            prefill_buckets=(chunk,) if chunk else (prompt_len,),
             decode_steps=decode_steps,
             prefill_chunk_tokens=chunk,
         ),

@@ -59,6 +59,11 @@ class ComponentSpec:
     resources: Resources = field(default_factory=Resources)
     config: dict[str, Any] = field(default_factory=dict)  # service YAML payload
     port: int = 0  # exposed service port (frontend/router/metrics)
+    # ingress: {"host": "...", "path": "/", "className": "..."} — renders a
+    # networking.k8s.io/v1 Ingress in front of the Service (reference:
+    # operator VirtualService/Ingress wiring,
+    # deploy/cloud/operator/internal/controller/dynamocomponentdeployment_controller.go)
+    ingress: dict[str, Any] = field(default_factory=dict)
 
     def validate(self, name: str) -> None:
         if self.component_type not in COMPONENT_KINDS:
@@ -86,6 +91,7 @@ class ComponentSpec:
             resources=Resources.from_dict(d.get("resources")),
             config=dict(d.get("config", {})),
             port=int(d.get("port", 0)),
+            ingress=dict(d.get("ingress", {})),
         )
 
 

@@ -23,6 +23,7 @@ import queue as thread_queue
 import threading
 import time
 import uuid
+import dataclasses
 from dataclasses import dataclass, field
 from functools import partial
 from typing import AsyncIterator, Callable
@@ -113,10 +114,23 @@ class JaxLlmEngine:
         self.mesh = None
         if config.mesh is not None and config.mesh.total() > 1:
             self.mesh = make_mesh(config.mesh)
-            # static-shape divisibility: fail at init, not at first jit
+            # static-shape constraints: fail at init, not at first jit
             # trace mid-serving
             pp = config.mesh.pp
             if pp > 1:
+                others = {
+                    a: getattr(config.mesh, a)
+                    for a in ("dp", "tp", "ep", "sp")
+                    if getattr(config.mesh, a) > 1
+                }
+                if others:
+                    # the pipeline's shard_map specs carry only the pp axis;
+                    # composing with tp/ep would silently all-gather every
+                    # weight shard inside the stages
+                    raise ValueError(
+                        f"pp={pp} must be the only >1 mesh axis for now "
+                        f"(got {others}); run tp/ep via GSPMD without pp"
+                    )
                 if config.max_batch_size % pp:
                     raise ValueError(
                         f"max_batch_size={config.max_batch_size} must be divisible "
@@ -130,6 +144,23 @@ class JaxLlmEngine:
                     )
             sp = config.mesh.sp
             if sp > 1:
+                # ring attention covers whole-prompt prefill only: the
+                # continued-prefill jit (chunked prefill, prefix hits) runs
+                # dense attention, so those modes must not silently bypass
+                # the sequence parallelism the mesh was configured for
+                if config.prefill_chunk_tokens is not None:
+                    raise ValueError(
+                        "prefill_chunk_tokens is incompatible with an sp mesh: "
+                        "chunked prefill bypasses ring attention"
+                    )
+                if config.enable_prefix_caching:
+                    logger.warning(
+                        "sp mesh: disabling prefix caching (the continued-"
+                        "prefill path does not run ring attention)"
+                    )
+                    config = self.config = dataclasses.replace(
+                        config, enable_prefix_caching=False
+                    )
                 bad = [b for b in self.buckets if b % sp]
                 if bad:
                     raise ValueError(

@@ -1,0 +1,108 @@
+"""Model resolution: local path | cache | HuggingFace Hub download.
+
+The reference resolves model names through the HF hub with a local content
+cache (lib/llm/src/hub.rs:32 ``from_hf`` — volume-mounted cache keyed by
+repo, skip-if-present download of config/tokenizer/weights).  Same contract
+here:
+
+- an existing local directory (or GGUF file) is used as-is;
+- otherwise ``{cache}/hub/{org}--{repo}`` is checked;
+- otherwise the repo is downloaded into the cache via ``huggingface_hub``
+  (offline/air-gapped environments get a clear error instead of a hang —
+  pass ``allow_download=False`` or set ``DYN_OFFLINE=1`` to skip the
+  network entirely).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Callable
+
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("llm.hub")
+
+# files a serving worker needs: model card + tokenizer + weights
+DOWNLOAD_PATTERNS = [
+    "config.json",
+    "generation_config.json",
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "*.safetensors",
+    "*.safetensors.index.json",
+]
+
+
+def _cache_base(cache_dir: str | Path | None) -> Path:
+    return Path(
+        cache_dir
+        or os.environ.get("DYN_CACHE_DIR")
+        or Path.home() / ".cache" / "dynamo_tpu"
+    )
+
+
+def _hf_download(repo_id: str, dest: Path) -> None:
+    """Default downloader: huggingface_hub snapshot into ``dest``."""
+    from huggingface_hub import snapshot_download
+
+    snapshot_download(
+        repo_id=repo_id,
+        local_dir=str(dest),
+        allow_patterns=DOWNLOAD_PATTERNS,
+    )
+
+
+def is_complete(path: Path) -> bool:
+    """A usable model dir has at least a config and a tokenizer."""
+    return (path / "config.json").exists() and (path / "tokenizer.json").exists()
+
+
+def resolve_model(
+    name_or_path: str | Path,
+    *,
+    cache_dir: str | Path | None = None,
+    downloader: Callable[[str, Path], None] | None = None,
+    allow_download: bool = True,
+) -> Path:
+    """Resolve a model reference to a local directory (or GGUF file).
+
+    ``downloader(repo_id, dest)`` is injectable for tests and air-gapped
+    mirrors; the default uses ``huggingface_hub``.
+    """
+    p = Path(name_or_path)
+    if p.exists():
+        return p
+
+    name = str(name_or_path)
+    if name.startswith((".", "/")) or "/" not in name:
+        raise FileNotFoundError(f"model path {name!r} does not exist")
+
+    dest = _cache_base(cache_dir) / "hub" / name.replace("/", "--")
+    if is_complete(dest):
+        logger.info("model %s served from cache %s", name, dest)
+        return dest
+
+    if not allow_download or os.environ.get("DYN_OFFLINE") == "1":
+        raise FileNotFoundError(
+            f"model {name!r} is not cached at {dest} and downloads are "
+            "disabled (DYN_OFFLINE=1 / allow_download=False)"
+        )
+
+    dest.mkdir(parents=True, exist_ok=True)
+    fetch = downloader or _hf_download
+    try:
+        logger.info("downloading %s into %s", name, dest)
+        fetch(name, dest)
+    except Exception as exc:  # noqa: BLE001 — surface a usable error
+        raise FileNotFoundError(
+            f"model {name!r}: hub download failed ({exc}); provide a local "
+            "path, pre-populate the cache, or fix network access"
+        ) from exc
+    if not is_complete(dest):
+        raise FileNotFoundError(
+            f"model {name!r}: download completed but {dest} lacks "
+            "config.json/tokenizer.json"
+        )
+    return dest

@@ -1,10 +1,14 @@
 """Load predictors (reference: components/planner/.../utils/load_predictor.py
-— constant / ARIMA / Prophet; here: constant, EWMA, and linear-trend, which
-cover the same roles without heavyweight deps)."""
+— constant / ARIMA / Prophet).  Here: constant, EWMA, linear-trend, an
+AR(p)-with-differencing forecaster fitted by least squares (the ARIMA(p,d,0)
+role), and a seasonal trend decomposition (the Prophet role) — numpy-only,
+no pandas/pmdarima/Prophet runtime."""
 
 from __future__ import annotations
 
 from collections import deque
+
+import numpy as np
 
 
 class ConstantPredictor:
@@ -61,9 +65,106 @@ class LinearTrendPredictor:
         return max(0.0, mean_y + slope * (n - mean_x))
 
 
+class ArPredictor:
+    """ARIMA(p, d, 0) by ordinary least squares over a sliding window.
+
+    The series is differenced ``d`` times, an order-``p`` autoregression is
+    fitted with ``np.linalg.lstsq`` (with intercept), the one-step forecast
+    is produced in the differenced domain and integrated back.  Falls back
+    to last-value while the window is shorter than ``2p + d + 1``."""
+
+    def __init__(self, p: int = 3, d: int = 1, window: int = 64, **_):
+        if p < 1 or d < 0:
+            raise ValueError("ArPredictor needs p >= 1, d >= 0")
+        self.p = p
+        self.d = d
+        self._obs: deque[float] = deque(maxlen=max(window, 2 * p + d + 4))
+
+    def observe(self, value: float) -> None:
+        self._obs.append(float(value))
+
+    def predict(self) -> float:
+        y = np.asarray(self._obs, np.float64)
+        if y.size == 0:
+            return 0.0
+        z = y.copy()
+        for _ in range(self.d):
+            if z.size < 2:
+                return float(y[-1])
+            z = np.diff(z)
+        if z.size < 2 * self.p + 1:
+            return float(y[-1])
+        # lagged design matrix: z[t] ~ c + sum_i phi_i * z[t-i]
+        rows = z.size - self.p
+        X = np.ones((rows, self.p + 1))
+        for i in range(1, self.p + 1):
+            X[:, i] = z[self.p - i : self.p - i + rows]
+        target = z[self.p :]
+        coef, *_ = np.linalg.lstsq(X, target, rcond=None)
+        z_next = coef[0] + coef[1:] @ z[-1 : -self.p - 1 : -1]
+        # integrate the differencing back: forecast = last level(s) + z_next
+        forecast = z_next
+        tail = y.copy()
+        for _ in range(self.d):
+            forecast = forecast + tail[-1]
+            tail = np.diff(tail) if tail.size > 1 else tail
+        return float(max(0.0, forecast))
+
+
+class SeasonalPredictor:
+    """Seasonal-trend decomposition forecast (the Prophet role): a linear
+    trend is fitted on the window, per-phase seasonal offsets (period ``m``)
+    are averaged over the detrended series, and the one-step forecast is
+    trend(t+1) + season((t+1) mod m).  Falls back to last-value until two
+    full periods are observed."""
+
+    def __init__(self, period: int = 12, window: int = 96, **_):
+        if period < 2:
+            raise ValueError("SeasonalPredictor needs period >= 2")
+        self.period = period
+        self._obs: deque[float] = deque(maxlen=max(window, 4 * period))
+        self._t = 0  # absolute index of the NEXT observation (phase anchor)
+
+    def observe(self, value: float) -> None:
+        self._obs.append(float(value))
+        self._t += 1
+
+    def predict(self) -> float:
+        y = np.asarray(self._obs, np.float64)
+        n = y.size
+        if n == 0:
+            return 0.0
+        if n < 2 * self.period:
+            return float(y[-1])
+        m = self.period
+        # JOINT least squares of trend + seasonal phase dummies: fitting
+        # trend first then averaging residuals leaks (a sinusoid correlates
+        # with t even over whole periods), so solve them together
+        xs = np.arange(n, dtype=np.float64)
+        start = self._t - n  # absolute index of window position 0
+        phases = ((start + np.arange(n)) % m).astype(int)
+        X = np.zeros((n, m + 1))
+        X[:, 0] = xs
+        X[:, 1] = 1.0
+        for ph in range(m - 1):  # last phase is the baseline
+            X[:, 2 + ph] = phases == ph
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        x_next = np.zeros(m + 1)
+        x_next[0] = n
+        x_next[1] = 1.0
+        next_phase = self._t % m
+        if next_phase < m - 1:
+            x_next[2 + next_phase] = 1.0
+        return float(max(0.0, coef @ x_next))
+
+
 def make_predictor(kind: str = "constant", **kwargs):
     return {
         "constant": ConstantPredictor,
         "ewma": EwmaPredictor,
         "linear": LinearTrendPredictor,
+        "ar": ArPredictor,
+        "arima": ArPredictor,
+        "seasonal": SeasonalPredictor,
+        "prophet": SeasonalPredictor,
     }[kind](**kwargs)

@@ -91,6 +91,13 @@ async def serve_worker(
     model_types: list[str] | None = None,
     **engine_overrides,
 ) -> WorkerHandle:
+    import asyncio
+
+    from dynamo_tpu.llm.hub import resolve_model
+
+    # snapshot downloads take minutes: never block the event loop (other
+    # endpoints/heartbeats on this runtime must keep running)
+    model_dir = await asyncio.to_thread(resolve_model, model_dir)
     mdc = ModelDeploymentCard.from_local_path(model_dir, name=model_name)
     ep = runtime.namespace(namespace).component(component).endpoint(endpoint)
 

@@ -131,3 +131,120 @@ async def test_fake_kube_label_listing():
     )
     got = await kube.list("Deployment", "ns", {"dynamo.tpu/graph": "g1"})
     assert [o["metadata"]["name"] for o in got] == ["a"]
+
+
+# ------------------------------------------------------- watch-driven operator
+
+
+async def _wait(predicate, timeout=5.0):
+    import asyncio
+
+    for _ in range(int(timeout / 0.02)):
+        if predicate():
+            return True
+        await asyncio.sleep(0.02)
+    return predicate()
+
+
+def _graph(name="g1", ingress=None):
+    from dynamo_tpu.deploy.crds import ComponentSpec, DynamoGraphDeployment
+
+    services = {
+        "frontend": ComponentSpec(
+            component_type="frontend", port=8080, ingress=ingress or {}
+        ),
+        "worker": ComponentSpec(component_type="worker", replicas=2),
+    }
+    return DynamoGraphDeployment(name=name, services=services)
+
+
+async def test_operator_watch_reconciles_and_sets_conditions():
+    """CR applied → operator reconciles via its watch, writes status with
+    observedGeneration + Progressing/Ready conditions; Ready flips once the
+    child Deployments report replicas ready (reference: controller-runtime
+    conditions in dynamographdeployment_controller.go)."""
+    from dynamo_tpu.deploy.operator import FakeKube, Operator
+
+    kube = FakeKube()
+    op = Operator(kube, resync_s=600)
+    op.start()
+    try:
+        await kube.apply(_graph().to_manifest())
+        assert await _wait(
+            lambda: ("Deployment", "default", "g1-worker") in kube.objects
+        )
+        assert await _wait(
+            lambda: (kube.objects[("DynamoGraphDeployment", "default", "g1")]
+                     .get("status", {}).get("conditions"))
+        )
+        status = kube.objects[("DynamoGraphDeployment", "default", "g1")]["status"]
+        conds = {c["type"]: c for c in status["conditions"]}
+        assert conds["Ready"]["status"] == "False"
+        assert conds["Progressing"]["status"] == "True"
+        assert status["components"] == ["g1-frontend", "g1-worker"]
+
+        # kubelet brings replicas up → child watch re-reconciles → Ready
+        kube.set_deployment_ready("default", "g1-frontend", 1)
+        kube.set_deployment_ready("default", "g1-worker", 2)
+
+        def is_ready():
+            conds = {
+                c["type"]: c
+                for c in kube.objects[("DynamoGraphDeployment", "default", "g1")]
+                .get("status", {}).get("conditions", [])
+            }
+            return conds.get("Ready", {}).get("status") == "True"
+
+        assert await _wait(is_ready)
+    finally:
+        await op.stop()
+
+
+async def test_operator_teardown_on_delete():
+    from dynamo_tpu.deploy.operator import FakeKube, Operator
+
+    kube = FakeKube()
+    op = Operator(kube, resync_s=600)
+    op.start()
+    try:
+        await kube.apply(_graph().to_manifest())
+        assert await _wait(
+            lambda: ("Deployment", "default", "g1-worker") in kube.objects
+        )
+        await kube.delete("DynamoGraphDeployment", "default", "g1")
+        assert await _wait(
+            lambda: not any(k == "Deployment" for (k, _, _) in kube.objects)
+        )
+    finally:
+        await op.stop()
+
+
+async def test_ingress_rendered_and_pruned():
+    from dynamo_tpu.deploy.operator import FakeKube, GraphReconciler
+
+    kube = FakeKube()
+    rec = GraphReconciler(kube)
+    graph = _graph(ingress={"host": "llm.example.com", "className": "nginx"})
+    await rec.reconcile(graph)
+    ing = kube.objects.get(("Ingress", "default", "g1-frontend"))
+    assert ing is not None
+    rule = ing["spec"]["rules"][0]
+    assert rule["host"] == "llm.example.com"
+    assert rule["http"]["paths"][0]["backend"]["service"]["port"]["number"] == 8080
+    assert ing["spec"]["ingressClassName"] == "nginx"
+
+    # dropping the ingress prunes the object
+    graph.services["frontend"].ingress = {}
+    await rec.reconcile(graph)
+    assert ("Ingress", "default", "g1-frontend") not in kube.objects
+
+
+async def test_condition_transition_time_stable():
+    from dynamo_tpu.deploy.operator import _condition, merge_conditions
+
+    old = [_condition("Ready", False, "Pending", "0/2")]
+    old[0]["lastTransitionTime"] = "2020-01-01T00:00:00Z"
+    merged = merge_conditions(old, [_condition("Ready", False, "Pending", "1/2")])
+    assert merged[0]["lastTransitionTime"] == "2020-01-01T00:00:00Z"  # no flip
+    merged = merge_conditions(old, [_condition("Ready", True, "AllReady", "2/2")])
+    assert merged[0]["lastTransitionTime"] != "2020-01-01T00:00:00Z"  # flipped

@@ -1,0 +1,71 @@
+"""Model resolution through the hub cache (reference: lib/llm/src/hub.rs:32
+from_hf — cache keyed by repo, skip-if-present download)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.llm.hub import resolve_model
+
+
+def fake_downloader(files: dict[str, str]):
+    calls = []
+
+    def fetch(repo_id: str, dest: Path) -> None:
+        calls.append(repo_id)
+        for fname, content in files.items():
+            (dest / fname).write_text(content)
+
+    fetch.calls = calls
+    return fetch
+
+
+COMPLETE = {"config.json": json.dumps({"model_type": "llama"}), "tokenizer.json": "{}"}
+
+
+def test_local_path_passthrough(tmp_path):
+    assert resolve_model(tmp_path) == tmp_path
+
+
+def test_download_then_cache_hit(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_CACHE_DIR", str(tmp_path))
+    fetch = fake_downloader(COMPLETE)
+    p1 = resolve_model("org/model-7b", downloader=fetch)
+    assert p1 == tmp_path / "hub" / "org--model-7b"
+    assert (p1 / "config.json").exists()
+    assert fetch.calls == ["org/model-7b"]
+    # second resolution: cache hit, no download
+    p2 = resolve_model("org/model-7b", downloader=fetch)
+    assert p2 == p1
+    assert fetch.calls == ["org/model-7b"]
+
+
+def test_offline_mode_refuses_download(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("DYN_OFFLINE", "1")
+    with pytest.raises(FileNotFoundError, match="downloads are disabled"):
+        resolve_model("org/model-7b", downloader=fake_downloader(COMPLETE))
+
+
+def test_incomplete_download_rejected(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_CACHE_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="lacks"):
+        resolve_model(
+            "org/broken", downloader=fake_downloader({"config.json": "{}"})
+        )
+
+
+def test_failed_download_surfaces_cause(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_CACHE_DIR", str(tmp_path))
+
+    def boom(repo_id, dest):
+        raise ConnectionError("no egress")
+
+    with pytest.raises(FileNotFoundError, match="no egress"):
+        resolve_model("org/model", downloader=boom)
+
+
+def test_bare_name_rejected():
+    with pytest.raises(FileNotFoundError, match="does not exist"):
+        resolve_model("not-a-repo-or-path")
