@@ -474,6 +474,7 @@ class Operator:
         self.errors = 0
         self._queue: asyncio.Queue = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
+        self._requeues: set[asyncio.Task] = set()
         self._failures: dict[tuple[str, str], int] = {}
 
     # -- lifecycle ---------------------------------------------------------
@@ -487,14 +488,15 @@ class Operator:
         ]
 
     async def stop(self) -> None:
-        for t in self._tasks:
+        for t in [*self._tasks, *self._requeues]:
             t.cancel()
-        for t in self._tasks:
+        for t in [*self._tasks, *self._requeues]:
             try:
                 await t
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
         self._tasks = []
+        self._requeues.clear()
 
     # -- event sources -----------------------------------------------------
     async def _watch_loop(self, kind: str) -> None:
@@ -557,7 +559,9 @@ class Operator:
                     await asyncio.sleep(d)
                     self._queue.put_nowait((ev, ns_, nm))
 
-                asyncio.ensure_future(requeue())
+                task = asyncio.ensure_future(requeue())
+                self._requeues.add(task)
+                task.add_done_callback(self._requeues.discard)
 
     async def _reconcile_one(self, ns: str, name: str) -> None:
         manifest = await self.kube.get(DynamoGraphDeployment.kind, ns, name)

@@ -28,6 +28,7 @@ DOWNLOAD_PATTERNS = [
     "config.json",
     "generation_config.json",
     "tokenizer.json",
+    "tokenizer.model",  # SPM-only repos ship this instead of tokenizer.json
     "tokenizer_config.json",
     "special_tokens_map.json",
     "*.safetensors",
@@ -55,8 +56,11 @@ def _hf_download(repo_id: str, dest: Path) -> None:
 
 
 def is_complete(path: Path) -> bool:
-    """A usable model dir has at least a config and a tokenizer."""
-    return (path / "config.json").exists() and (path / "tokenizer.json").exists()
+    """A usable model dir has at least a config and a tokenizer (either
+    the fast tokenizer.json or an SPM tokenizer.model)."""
+    return (path / "config.json").exists() and (
+        (path / "tokenizer.json").exists() or (path / "tokenizer.model").exists()
+    )
 
 
 def resolve_model(
@@ -103,6 +107,6 @@ def resolve_model(
     if not is_complete(dest):
         raise FileNotFoundError(
             f"model {name!r}: download completed but {dest} lacks "
-            "config.json/tokenizer.json"
+            "config.json or a tokenizer (tokenizer.json/tokenizer.model)"
         )
     return dest

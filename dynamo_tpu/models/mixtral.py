@@ -177,6 +177,52 @@ def mixtral_forward_prefill(
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
+def mixtral_forward_prefill_with_prefix(
+    params, cfg: MixtralConfig, token_ids, kv_cache, full_block_ids,
+    tail_block_ids, tail_len, start_pos, cos, sin
+):
+    """Continued prefill over a reused prefix for the MoE family: tail
+    queries attend to the resident prefix KV plus themselves, MoE FFN on the
+    tail activations only.  Enables prefix-cache reuse and chunked prefill
+    for Mixtral-class models (same contract as
+    llama_forward_prefill_with_prefix)."""
+    from dynamo_tpu.ops.attention import gather_prefix_kv, prefill_attention_with_prefix
+
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        state = {}
+
+        def attn(attn_in):
+            q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+            k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
+            state["kv"] = write_prefill_kv(k_layer, v_layer, k, v, tail_block_ids, tail_len)
+            attn_out = prefill_attention_with_prefix(
+                q, k, v, k_prefix, v_prefix, start_pos, tail_len
+            )
+            return attn_out.reshape(s, -1) @ w["wo"]
+
+        x = _block(cfg, w, x, attn)
+        return x, state["kv"]
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(tail_len - 1, 0)]
+    logits = (
+        last[None] @ params["embed"].T.astype(x.dtype)
+        if cfg.tie_word_embeddings
+        else last[None] @ params["lm_head"]
+    )[0]
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
 def mixtral_forward_decode(
     params, cfg: MixtralConfig, token_ids, kv_cache, block_tables, context_lens, slot_ids,
     cos, sin, *, attention: str = "jax",

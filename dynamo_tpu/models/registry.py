@@ -116,6 +116,7 @@ def _mixtral_family() -> ModelFamily:
         param_specs=mixtral.param_specs,
         forward_prefill=mixtral.mixtral_forward_prefill,
         forward_decode=mixtral.mixtral_forward_decode,
+        forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
     )
 
 

@@ -40,12 +40,27 @@ class PerfProfile:
     def save(self, path: str | Path) -> None:
         Path(path).write_text(json.dumps({"points": [asdict(p) for p in self.points]}))
 
-    def _interp(self, isl: float, osl: float, field: str) -> float:
+    def concurrencies(self) -> list[int]:
+        return sorted({p.concurrency for p in self.points})
+
+    def _interp(
+        self, isl: float, osl: float, field: str, concurrency: int | None = None
+    ) -> float:
         """Inverse-distance-weighted interpolation over the (isl, osl) grid —
-        robust to irregular profile grids."""
+        robust to irregular profile grids.  Interpolation is always within
+        ONE concurrency level (blending single-stream and saturated numbers
+        would be meaningless); default = the lowest profiled level."""
+        if concurrency is None:
+            concurrency = self.concurrencies()[0]
+        pts = [p for p in self.points if p.concurrency == concurrency]
+        if not pts:
+            raise ValueError(
+                f"no profiled points at concurrency={concurrency} "
+                f"(have {self.concurrencies()})"
+            )
         weights = 0.0
         acc = 0.0
-        for p in self.points:
+        for p in pts:
             d2 = ((p.isl - isl) / 512.0) ** 2 + ((p.osl - osl) / 128.0) ** 2
             if d2 < 1e-12:
                 return getattr(p, field)
@@ -54,14 +69,14 @@ class PerfProfile:
             acc += w * getattr(p, field)
         return acc / weights
 
-    def prefill_tok_s(self, isl: float, osl: float) -> float:
-        return self._interp(isl, osl, "prefill_tok_s")
+    def prefill_tok_s(self, isl: float, osl: float, concurrency: int | None = None) -> float:
+        return self._interp(isl, osl, "prefill_tok_s", concurrency)
 
-    def decode_tok_s(self, isl: float, osl: float) -> float:
-        return self._interp(isl, osl, "decode_tok_s")
+    def decode_tok_s(self, isl: float, osl: float, concurrency: int | None = None) -> float:
+        return self._interp(isl, osl, "decode_tok_s", concurrency)
 
-    def ttft_s(self, isl: float, osl: float) -> float:
-        return self._interp(isl, osl, "ttft_s")
+    def ttft_s(self, isl: float, osl: float, concurrency: int | None = None) -> float:
+        return self._interp(isl, osl, "ttft_s", concurrency)
 
-    def itl_s(self, isl: float, osl: float) -> float:
-        return self._interp(isl, osl, "itl_s")
+    def itl_s(self, isl: float, osl: float, concurrency: int | None = None) -> float:
+        return self._interp(isl, osl, "itl_s", concurrency)

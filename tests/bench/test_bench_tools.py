@@ -59,3 +59,40 @@ async def test_profile_sla_over_mocker():
         assert profile.decode_tok_s(64, 8) > 0
     finally:
         engine.stop()
+
+
+async def test_profile_concurrency_grid_and_sla_planner():
+    """Concurrency sweep + SLA-driven fleet sizing (reference: profiler →
+    SLA planner chain): higher concurrency raises throughput until latency
+    SLAs bind; plan_deployment picks the best compliant point and sizes
+    replicas for the target load."""
+    from dynamo_tpu.bench.profile_sla import plan_deployment, profile_engine
+
+    engine = MockerEngine(
+        MockerConfig(speedup=1000.0, num_blocks=2048, max_batch_size=64)
+    )
+    engine.start()
+    try:
+        profile = await profile_engine(
+            engine, isl_grid=(64,), osl_grid=(8,),
+            concurrency_grid=(1, 4), requests_per_point=4,
+        )
+        assert len(profile.points) == 2
+        by_conc = {p.concurrency: p for p in profile.points}
+        assert by_conc[4].decode_tok_s > by_conc[1].decode_tok_s  # batching helps
+
+        plan = plan_deployment(
+            profile, isl=64, osl=8, target_rps=10 * by_conc[4].decode_tok_s / 8,
+            ttft_sla_s=60.0, itl_sla_s=60.0,  # loose SLA: best point wins
+        )
+        assert plan["concurrency"] == 4
+        assert plan["replicas"] >= 10
+
+        # infeasible SLA → explicit signal, not a bogus plan
+        plan = plan_deployment(
+            profile, isl=64, osl=8, target_rps=1.0,
+            ttft_sla_s=1e-9, itl_sla_s=1e-9,
+        )
+        assert plan["concurrency"] == 0 and plan["replicas"] == 0
+    finally:
+        engine.stop()

@@ -190,3 +190,34 @@ async def test_seeded_sampling_identical_with_and_without_prefix_hit():
         assert first == second
     finally:
         engine.stop()
+
+
+async def test_mixtral_prefix_reuse_identical_output():
+    """Continued prefill works for the MoE family too: a repeated Mixtral
+    prompt reuses its prefix blocks and emits identical greedy output."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.models.mixtral import MixtralConfig, init_params
+
+    cfg = MixtralConfig.tiny_moe()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="mixtral", num_blocks=64, block_size=4,
+            max_batch_size=4, prefill_buckets=(16, 32), max_model_len=64,
+        ),
+        params=params,
+    )
+    engine.start()
+    try:
+        assert engine.prefix_caching  # the MoE family supports reuse now
+        prompt = list(range(3, 17))  # 14 tokens → 3 full blocks at bs=4
+        first, _ = await collect(engine, request(prompt, max_tokens=6))
+        second, _ = await collect(engine, request(prompt, max_tokens=6))
+        assert second == first
+        stats = engine.stats()
+        assert stats["prefix_hits_total"] == 1
+        assert stats["prefix_cached_tokens_total"] == 12
+    finally:
+        engine.stop()
