@@ -21,24 +21,23 @@ async def _profile_point(
     ttfts, itls, prefill_rates = [], [], []
     total_tokens = 0
     t0 = time.monotonic()
-    pending = requests
+    sem = asyncio.Semaphore(concurrency)
 
     async def one() -> None:
         nonlocal total_tokens
-        tokens = [rng.randrange(10, vocab_size) for _ in range(isl)]
-        count, ttft, stamps = await _drive_one(engine, tokens, osl)
-        total_tokens += count
-        if ttft > 0:
-            ttfts.append(ttft)
-            prefill_rates.append(isl / ttft)
-        itls.extend(b - a for a, b in zip(stamps, stamps[1:]))
+        async with sem:
+            tokens = [rng.randrange(10, vocab_size) for _ in range(isl)]
+            count, ttft, stamps = await _drive_one(engine, tokens, osl)
+            total_tokens += count
+            if ttft > 0:
+                ttfts.append(ttft)
+                prefill_rates.append(isl / ttft)
+            itls.extend(b - a for a, b in zip(stamps, stamps[1:]))
 
-    # closed-loop load at the target concurrency (the reference's profiler
-    # sweeps concurrency the same way to find the SLA knee)
-    while pending > 0:
-        batch = min(concurrency, pending)
-        await asyncio.gather(*[one() for _ in range(batch)])
-        pending -= batch
+    # closed-loop load HELD at the target concurrency: a finished request's
+    # slot is immediately refilled (batching into gather waves would decay
+    # to concurrency 1 as stragglers finish; same pattern as sweep.py)
+    await asyncio.gather(*[one() for _ in range(requests)])
     wall = time.monotonic() - t0
     return ProfilePoint(
         isl=isl,
@@ -104,7 +103,9 @@ def plan_deployment(
         )
     candidates = [
         p for p in shape_points
-        if p.ttft_s <= ttft_sla_s and p.itl_s <= itl_sla_s
+        # decode_tok_s > 0 also excludes dead points whose zero-sentinel
+        # latencies would trivially "meet" any SLA
+        if p.decode_tok_s > 0 and p.ttft_s <= ttft_sla_s and p.itl_s <= itl_sla_s
     ]
     if not candidates:
         return {"status": "infeasible", "concurrency": 0, "per_worker_rps": 0.0,

@@ -187,14 +187,17 @@ class ModelWatcher:
 
     async def _build_pipeline(self, entry: ModelEntry) -> None:
         mdc = ModelDeploymentCard(**entry.mdc)
-        if not mdc.path or not Path(mdc.path, "tokenizer.json").exists():
+        if not mdc.path or not (
+            Path(mdc.path, "tokenizer.json").exists()
+            or Path(mdc.path, "tokenizer.model").exists()
+        ):
             # no shared filesystem with the worker: pull the tokenizer/config
             # artifacts the worker published to the object store
             fetched = await mdc.fetch_artifacts(self.runtime.plane.bus)
-            if fetched is None or not (fetched / "tokenizer.json").exists():
+            if fetched is None:
                 raise FileNotFoundError(f"model artifacts not found at {mdc.path}")
             logger.info("fetched artifacts for %s into %s", entry.name, fetched)
-        tokenizer = HfTokenizer.from_file(Path(mdc.path) / "tokenizer.json")
+        tokenizer = HfTokenizer.from_model_dir(mdc.path)
 
         ns = self.runtime.namespace(entry.namespace)
         endpoint = ns.component(entry.component).endpoint(entry.endpoint)

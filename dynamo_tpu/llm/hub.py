@@ -56,11 +56,17 @@ def _hf_download(repo_id: str, dest: Path) -> None:
 
 
 def is_complete(path: Path) -> bool:
-    """A usable model dir has at least a config and a tokenizer (either
-    the fast tokenizer.json or an SPM tokenizer.model)."""
-    return (path / "config.json").exists() and (
-        (path / "tokenizer.json").exists() or (path / "tokenizer.model").exists()
-    )
+    """A usable model dir has at least a config and a LOADABLE tokenizer:
+    tokenizer.json always, tokenizer.model only when the SPM conversion
+    path is available (else resolution must fail early, not at pipeline
+    build)."""
+    from dynamo_tpu.llm.tokenizer import spm_conversion_available
+
+    if not (path / "config.json").exists():
+        return False
+    if (path / "tokenizer.json").exists():
+        return True
+    return (path / "tokenizer.model").exists() and spm_conversion_available()
 
 
 def resolve_model(

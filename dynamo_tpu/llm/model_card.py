@@ -149,6 +149,7 @@ class ModelDeploymentCard:
 ARTIFACT_BUCKET = "mdc-artifacts"
 ARTIFACT_FILES = (
     "tokenizer.json",
+    "tokenizer.model",
     "tokenizer_config.json",
     "config.json",
     "special_tokens_map.json",

@@ -17,6 +17,14 @@ from tokenizers import Tokenizer
 REPLACEMENT_CHAR = "�"
 
 
+def spm_conversion_available() -> bool:
+    """Whether a SentencePiece tokenizer.model can be converted to a fast
+    tokenizer (transformers' converter needs the sentencepiece package)."""
+    import importlib.util
+
+    return importlib.util.find_spec("sentencepiece") is not None
+
+
 class HfTokenizer:
     def __init__(self, tokenizer: Tokenizer, *, eos_token_ids: list[int] | None = None):
         self._tk = tokenizer
@@ -38,6 +46,28 @@ class HfTokenizer:
                 if eos_id is not None:
                     eos_ids.append(eos_id)
         return cls(tk, eos_token_ids=eos_ids)
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str | Path) -> "HfTokenizer":
+        """Load from a model directory: the fast ``tokenizer.json`` when
+        present, else convert a SentencePiece ``tokenizer.model`` through
+        transformers (needs the ``sentencepiece`` package)."""
+        model_dir = Path(model_dir)
+        if (model_dir / "tokenizer.json").exists():
+            return cls.from_file(model_dir / "tokenizer.json")
+        if (model_dir / "tokenizer.model").exists():
+            if not spm_conversion_available():
+                raise FileNotFoundError(
+                    f"{model_dir} ships only a SentencePiece tokenizer.model "
+                    "and the 'sentencepiece' package is not installed; "
+                    "provide tokenizer.json or install sentencepiece"
+                )
+            from transformers import AutoTokenizer
+
+            fast = AutoTokenizer.from_pretrained(str(model_dir), use_fast=True)
+            eos_ids = [fast.eos_token_id] if fast.eos_token_id is not None else []
+            return cls(fast.backend_tokenizer, eos_token_ids=eos_ids)
+        raise FileNotFoundError(f"no tokenizer.json/tokenizer.model in {model_dir}")
 
     def encode(self, text: str, *, add_special_tokens: bool = False) -> list[int]:
         return self._tk.encode(text, add_special_tokens=add_special_tokens).ids

@@ -53,6 +53,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     # qkv projection biases (Qwen2-family geometry; llama proper has none)
     attention_bias: bool = False
+    # per-head RMSNorm on q/k after projection, before rope (Qwen3 geometry)
+    qk_norm: bool = False
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -73,6 +75,7 @@ class LlamaConfig:
             rope_theta=config.get("rope_theta", 10000.0),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
             attention_bias=config.get("attention_bias", False),
+            qk_norm=config.get("model_type") == "qwen3",
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or
@@ -142,6 +145,9 @@ def init_params(cfg: LlamaConfig, rng: jax.Array) -> dict:
         params["layers"]["bq"] = jnp.zeros((l_, qd), cfg.dtype)
         params["layers"]["bk"] = jnp.zeros((l_, kvd), cfg.dtype)
         params["layers"]["bv"] = jnp.zeros((l_, kvd), cfg.dtype)
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((l_, cfg.head_dim), cfg.dtype)
+        params["layers"]["k_norm"] = jnp.ones((l_, cfg.head_dim), cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm_init(keys[8], (h, cfg.vocab_size), h)
     return params
@@ -172,6 +178,9 @@ def param_specs(cfg: LlamaConfig) -> dict:
         specs["layers"]["bq"] = P("pp", "tp")
         specs["layers"]["bk"] = P("pp", "tp")
         specs["layers"]["bv"] = P("pp", "tp")
+    if cfg.qk_norm:
+        specs["layers"]["q_norm"] = P("pp", None)
+        specs["layers"]["k_norm"] = P("pp", None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")  # vocab-sharded logits
     return specs
@@ -198,18 +207,21 @@ def _mlp(x, gate, up, down):
 
 
 def _qkv(attn_in, w, cfg: LlamaConfig):
-    """Project+bias+head-split; shared by prefill/decode/trunk."""
+    """Project+bias+head-split (+ Qwen3 per-head q/k RMSNorm, pre-rope);
+    shared by prefill/decode/trunk."""
     s = attn_in.shape[0]
     q_proj = attn_in @ w["wq"]
     k_proj = attn_in @ w["wk"]
     v_proj = attn_in @ w["wv"]
     if cfg.attention_bias:
         q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
-    return (
-        q_proj.reshape(s, cfg.num_heads, cfg.head_dim),
-        k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim),
-        v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim),
-    )
+    q = q_proj.reshape(s, cfg.num_heads, cfg.head_dim)
+    k = k_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    v = v_proj.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
 
 
 def llama_forward_trunk(
@@ -522,6 +534,11 @@ def load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
             bq="model.layers.{i}.self_attn.q_proj.bias",
             bk="model.layers.{i}.self_attn.k_proj.bias",
             bv="model.layers.{i}.self_attn.v_proj.bias",
+        )
+    if cfg.qk_norm:
+        layer_map.update(
+            q_norm="model.layers.{i}.self_attn.q_norm.weight",
+            k_norm="model.layers.{i}.self_attn.k_norm.weight",
         )
     layers: dict[str, list] = {k: [] for k in layer_map}
     for i in range(cfg.num_layers):

@@ -23,7 +23,9 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.ops.attention import (
     dense_causal_attention,
+    gather_prefix_kv,
     paged_decode_attention,
+    prefill_attention_with_prefix,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -142,12 +144,14 @@ def _block(cfg: MixtralConfig, w, x, attn_fn):
     return x + moe_out
 
 
-def mixtral_forward_prefill(
-    params, cfg: MixtralConfig, token_ids, kv_cache, block_ids, seq_len, start_pos, cos, sin
-):
+def _prefill_trunk(params, cfg: MixtralConfig, token_ids, kv_cache,
+                   positions, cos, sin, attend, last_idx):
+    """Shared prefill scaffold: embed → layer scan (qkv+rope handled here,
+    the caller supplies only the attention math via ``attend``) → final
+    norm → last-token logits.  Keeps the plain and continued-prefill paths
+    from drifting apart."""
     s = token_ids.shape[0]
     x = params["embed"][token_ids].astype(cfg.dtype)
-    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
@@ -159,8 +163,7 @@ def mixtral_forward_prefill(
             v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
             q = apply_rope(q, positions, cos, sin)
             k = apply_rope(k, positions, cos, sin)
-            state["kv"] = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
-            attn_out = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+            attn_out, state["kv"] = attend(q, k, v, k_layer, v_layer)
             return attn_out.reshape(s, -1) @ w["wo"]
 
         x = _block(cfg, w, x, attn)
@@ -168,13 +171,28 @@ def mixtral_forward_prefill(
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = x[jnp.maximum(seq_len - 1, 0)]
+    last = x[jnp.maximum(last_idx - 1, 0)]
     logits = (
         last[None] @ params["embed"].T.astype(x.dtype)
         if cfg.tie_word_embeddings
         else last[None] @ params["lm_head"]
     )[0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def mixtral_forward_prefill(
+    params, cfg: MixtralConfig, token_ids, kv_cache, block_ids, seq_len, start_pos, cos, sin
+):
+    positions = start_pos + jnp.arange(token_ids.shape[0], dtype=jnp.int32)
+
+    def attend(q, k, v, k_layer, v_layer):
+        kv = write_prefill_kv(k_layer, v_layer, k, v, block_ids, seq_len)
+        out = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+        return out, kv
+
+    return _prefill_trunk(
+        params, cfg, token_ids, kv_cache, positions, cos, sin, attend, seq_len
+    )
 
 
 def mixtral_forward_prefill_with_prefix(
@@ -183,44 +201,21 @@ def mixtral_forward_prefill_with_prefix(
 ):
     """Continued prefill over a reused prefix for the MoE family: tail
     queries attend to the resident prefix KV plus themselves, MoE FFN on the
-    tail activations only.  Enables prefix-cache reuse and chunked prefill
-    for Mixtral-class models (same contract as
+    tail activations only (same contract as
     llama_forward_prefill_with_prefix)."""
-    from dynamo_tpu.ops.attention import gather_prefix_kv, prefill_attention_with_prefix
+    positions = start_pos + jnp.arange(token_ids.shape[0], dtype=jnp.int32)
 
-    s = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)
-    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    def attend(q, k, v, k_layer, v_layer):
+        k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
+        kv = write_prefill_kv(k_layer, v_layer, k, v, tail_block_ids, tail_len)
+        out = prefill_attention_with_prefix(
+            q, k, v, k_prefix, v_prefix, start_pos, tail_len
+        )
+        return out, kv
 
-    def layer(x, layer_in):
-        w, k_layer, v_layer = layer_in
-        state = {}
-
-        def attn(attn_in):
-            q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
-            k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
-            v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
-            q = apply_rope(q, positions, cos, sin)
-            k = apply_rope(k, positions, cos, sin)
-            k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
-            state["kv"] = write_prefill_kv(k_layer, v_layer, k, v, tail_block_ids, tail_len)
-            attn_out = prefill_attention_with_prefix(
-                q, k, v, k_prefix, v_prefix, start_pos, tail_len
-            )
-            return attn_out.reshape(s, -1) @ w["wo"]
-
-        x = _block(cfg, w, x, attn)
-        return x, state["kv"]
-
-    x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    last = x[jnp.maximum(tail_len - 1, 0)]
-    logits = (
-        last[None] @ params["embed"].T.astype(x.dtype)
-        if cfg.tie_word_embeddings
-        else last[None] @ params["lm_head"]
-    )[0]
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return _prefill_trunk(
+        params, cfg, token_ids, kv_cache, positions, cos, sin, attend, tail_len
+    )
 
 
 def mixtral_forward_decode(

@@ -106,6 +106,34 @@ def _qwen2_family() -> ModelFamily:
     )
 
 
+def _qwen3_family() -> ModelFamily:
+    # Qwen3 = llama geometry + per-head q/k RMSNorm before rope (no qkv
+    # biases); one implementation serves all three via config flags.
+    from dynamo_tpu.models import llama
+
+    def config_from_hf(config):
+        import json
+
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        config = dict(config)
+        config["model_type"] = "qwen3"
+        return llama.LlamaConfig.from_hf_config(config)
+
+    return ModelFamily(
+        name="qwen3",
+        config_from_hf=config_from_hf,
+        init_params=llama.init_params,
+        param_specs=llama.param_specs,
+        forward_prefill=llama.llama_forward_prefill,
+        forward_decode=llama.llama_forward_decode,
+        forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
+        forward_prefill_embeds=llama.llama_forward_prefill_embeds,
+        supports_sp=True,
+        forward_decode_pp=llama.llama_forward_decode_pp,
+    )
+
+
 def _mixtral_family() -> ModelFamily:
     from dynamo_tpu.models import mixtral
 
@@ -139,7 +167,7 @@ def _deepseek_family() -> ModelFamily:
 _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "llama": _llama_family,
     "qwen2": _qwen2_family,
-    "qwen3": _qwen2_family,
+    "qwen3": _qwen3_family,
     "mixtral": _mixtral_family,
     # HF model_type keys for the MLA architectures only — classic
     # DeepSeek-MoE ("deepseek") uses conventional attention and would need

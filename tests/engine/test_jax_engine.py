@@ -388,3 +388,20 @@ async def test_pp_mesh_engine_matches_dense_reference():
         assert tokens == greedy_reference(prompt, len(tokens))
     finally:
         engine.stop()
+
+
+async def test_sp_mesh_engine_matches_dense_reference():
+    """Serving through an sp=2 mesh: ring-attention prefill (sequence
+    sharded over sp) produces exactly the single-device greedy output, and
+    prefix caching auto-disables (the continued-prefill path has no ring)."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    engine = make_engine(mesh=MeshConfig(sp=2))
+    try:
+        assert not engine.prefix_caching
+        prompt = [5, 6, 7, 8, 9, 10]
+        tokens, finish = await collect(engine, request(prompt, max_tokens=6))
+        assert finish in (FinishReason.LENGTH, FinishReason.STOP)
+        assert tokens == greedy_reference(prompt, len(tokens))
+    finally:
+        engine.stop()

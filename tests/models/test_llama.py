@@ -46,9 +46,15 @@ def dense_reference_logits(params, cfg, token_ids):
     for i in range(cfg.num_layers):
         w = jax.tree.map(lambda a: a[i], params["layers"])
         attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
-        q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
-        k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
-        v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        qp, kp, vp = attn_in @ w["wq"], attn_in @ w["wk"], attn_in @ w["wv"]
+        if cfg.attention_bias:
+            qp, kp, vp = qp + w["bq"], kp + w["bk"], vp + w["bv"]
+        q = qp.reshape(s, cfg.num_heads, cfg.head_dim)
+        k = kp.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        v = vp.reshape(s, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         attn = dense_causal_attention(q[None], k[None], v[None])[0]
@@ -170,3 +176,67 @@ def test_tp_sharded_matches_single_device(params):
     # cache must remain sharded over kv heads
     assert isinstance(new_cache["k"].sharding, NamedSharding)
     assert new_cache["k"].sharding.spec == P("pp", None, None, "tp", None)
+
+
+def test_qwen3_qk_norm_matches_dense_reference():
+    """Qwen3 geometry (per-head q/k RMSNorm, pre-rope): paged prefill +
+    decode must match the dense recompute with the norm applied."""
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    # non-trivial norm weights so the test actually exercises the op
+    params["layers"]["q_norm"] = (
+        1.0 + 0.3 * jax.random.normal(jax.random.PRNGKey(8),
+                                      params["layers"]["q_norm"].shape)
+    ).astype(cfg.dtype)
+    params["layers"]["k_norm"] = (
+        1.0 - 0.2 * jax.random.normal(jax.random.PRNGKey(9),
+                                      params["layers"]["k_norm"].shape)
+    ).astype(cfg.dtype)
+
+    prompt = list(range(3, 15))
+    ref = dense_reference_logits(params, cfg, prompt)
+
+    cos, sin = make_rope_tables(cfg)
+    num_blocks, bs = 16, 4
+    cache = init_kv_cache(cfg, num_blocks, bs)
+    block_ids = jnp.arange(4, dtype=jnp.int32)
+    logits, cache = llama_forward_prefill(
+        params, cfg, jnp.asarray(prompt, jnp.int32), cache, block_ids,
+        jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref[len(prompt) - 1]), rtol=2e-4, atol=2e-4
+    )
+
+    # one decode step on the next token must match the dense recompute too
+    nxt = int(jnp.argmax(ref[len(prompt) - 1]))
+    full = prompt + [nxt]
+    ref2 = dense_reference_logits(params, cfg, full)
+    tables = jnp.arange(4, dtype=jnp.int32)[None, :]
+    lens = jnp.asarray([len(full)], jnp.int32)
+    slots = jnp.asarray([len(prompt)], jnp.int32)
+    logits2, _ = llama_forward_decode(
+        params, cfg, jnp.asarray([nxt], jnp.int32), cache, tables, lens, slots,
+        cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits2[0]), np.asarray(ref2[len(full) - 1]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_qwen3_registry_config():
+    from dynamo_tpu.models.registry import get_family
+
+    fam = get_family("qwen3")
+    cfg = fam.config_from_hf(
+        {
+            "vocab_size": 512, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "head_dim": 16,
+        }
+    )
+    assert cfg.qk_norm and not cfg.attention_bias
+    params = fam.init_params(cfg, jax.random.PRNGKey(0))
+    assert params["layers"]["q_norm"].shape == (2, 16)
