@@ -22,11 +22,16 @@ async def _profile_point(
     total_tokens = 0
     t0 = time.monotonic()
     sem = asyncio.Semaphore(concurrency)
+    # draw every request's tokens up front: with the semaphore, draw order
+    # inside the tasks would depend on completion timing and break the
+    # seed's run-to-run reproducibility
+    prompts = [
+        [rng.randrange(10, vocab_size) for _ in range(isl)] for _ in range(requests)
+    ]
 
-    async def one() -> None:
+    async def one(tokens: list[int]) -> None:
         nonlocal total_tokens
         async with sem:
-            tokens = [rng.randrange(10, vocab_size) for _ in range(isl)]
             count, ttft, stamps = await _drive_one(engine, tokens, osl)
             total_tokens += count
             if ttft > 0:
@@ -37,7 +42,7 @@ async def _profile_point(
     # closed-loop load HELD at the target concurrency: a finished request's
     # slot is immediately refilled (batching into gather waves would decay
     # to concurrency 1 as stragglers finish; same pattern as sweep.py)
-    await asyncio.gather(*[one() for _ in range(requests)])
+    await asyncio.gather(*[one(tokens) for tokens in prompts])
     wall = time.monotonic() - t0
     return ProfilePoint(
         isl=isl,

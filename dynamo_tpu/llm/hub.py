@@ -93,6 +93,7 @@ def resolve_model(
     if is_complete(dest):
         logger.info("model %s served from cache %s", name, dest)
         return dest
+    _reject_unloadable_spm(name, dest)
 
     if not allow_download or os.environ.get("DYN_OFFLINE") == "1":
         raise FileNotFoundError(
@@ -110,9 +111,31 @@ def resolve_model(
             f"model {name!r}: hub download failed ({exc}); provide a local "
             "path, pre-populate the cache, or fix network access"
         ) from exc
+    _reject_unloadable_spm(name, dest)
     if not is_complete(dest):
         raise FileNotFoundError(
             f"model {name!r}: download completed but {dest} lacks "
             "config.json or a tokenizer (tokenizer.json/tokenizer.model)"
         )
     return dest
+
+
+def _reject_unloadable_spm(name: str, dest: Path) -> None:
+    """A cached dir whose only tokenizer is an SPM tokenizer.model in an
+    environment without the conversion deps must fail with the actionable
+    cause — not re-download on every resolve, not claim the tokenizer is
+    missing."""
+    from dynamo_tpu.llm.tokenizer import spm_conversion_available
+
+    if (
+        (dest / "config.json").exists()
+        and not (dest / "tokenizer.json").exists()
+        and (dest / "tokenizer.model").exists()
+        and not spm_conversion_available()
+    ):
+        raise FileNotFoundError(
+            f"model {name!r} at {dest} ships only a SentencePiece "
+            "tokenizer.model and the 'sentencepiece'/'transformers' packages "
+            "needed to convert it are not installed; install them or provide "
+            "a tokenizer.json"
+        )

@@ -19,10 +19,14 @@ REPLACEMENT_CHAR = "�"
 
 def spm_conversion_available() -> bool:
     """Whether a SentencePiece tokenizer.model can be converted to a fast
-    tokenizer (transformers' converter needs the sentencepiece package)."""
+    tokenizer (the conversion runs through transformers' converter, which
+    needs the sentencepiece package)."""
     import importlib.util
 
-    return importlib.util.find_spec("sentencepiece") is not None
+    return (
+        importlib.util.find_spec("sentencepiece") is not None
+        and importlib.util.find_spec("transformers") is not None
+    )
 
 
 class HfTokenizer:

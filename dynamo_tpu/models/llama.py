@@ -75,7 +75,7 @@ class LlamaConfig:
             rope_theta=config.get("rope_theta", 10000.0),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
             attention_bias=config.get("attention_bias", False),
-            qk_norm=config.get("model_type") == "qwen3",
+            qk_norm=config.get("qk_norm", config.get("model_type") == "qwen3"),
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or

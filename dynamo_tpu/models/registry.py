@@ -61,12 +61,25 @@ class ModelFamily:
         return llama.make_rope_tables(cfg)
 
 
-def _llama_family() -> ModelFamily:
+def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
+    """One ModelFamily construction for every llama-geometry variant
+    (llama / qwen2 / qwen3); ``config_tweak(dict)`` mutates the HF config
+    before parsing (biases, qk-norm flags)."""
     from dynamo_tpu.models import llama
 
+    def config_from_hf(config):
+        import json
+
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        config = dict(config)
+        if config_tweak is not None:
+            config_tweak(config)
+        return llama.LlamaConfig.from_hf_config(config)
+
     return ModelFamily(
-        name="llama",
-        config_from_hf=llama.LlamaConfig.from_hf_config,
+        name=name,
+        config_from_hf=config_from_hf,
         init_params=llama.init_params,
         param_specs=llama.param_specs,
         forward_prefill=llama.llama_forward_prefill,
@@ -78,60 +91,20 @@ def _llama_family() -> ModelFamily:
     )
 
 
+def _llama_family() -> ModelFamily:
+    return _llama_like_family("llama")
+
+
 def _qwen2_family() -> ModelFamily:
-    # Qwen2/2.5 = llama geometry + attention qkv biases (config flag); the
-    # llama implementation handles both (attention_bias).
-    from dynamo_tpu.models import llama
-
-    def config_from_hf(config):
-        import json
-
-        if not isinstance(config, dict):
-            config = json.loads(Path(config).read_text())
-        config = dict(config)
-        config.setdefault("attention_bias", True)
-        return llama.LlamaConfig.from_hf_config(config)
-
-    return ModelFamily(
-        name="qwen2",
-        config_from_hf=config_from_hf,
-        init_params=llama.init_params,
-        param_specs=llama.param_specs,
-        forward_prefill=llama.llama_forward_prefill,
-        forward_decode=llama.llama_forward_decode,
-        forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
-        forward_prefill_embeds=llama.llama_forward_prefill_embeds,
-        supports_sp=True,
-        forward_decode_pp=llama.llama_forward_decode_pp,
+    # Qwen2/2.5 = llama geometry + attention qkv biases
+    return _llama_like_family(
+        "qwen2", lambda c: c.setdefault("attention_bias", True)
     )
 
 
 def _qwen3_family() -> ModelFamily:
-    # Qwen3 = llama geometry + per-head q/k RMSNorm before rope (no qkv
-    # biases); one implementation serves all three via config flags.
-    from dynamo_tpu.models import llama
-
-    def config_from_hf(config):
-        import json
-
-        if not isinstance(config, dict):
-            config = json.loads(Path(config).read_text())
-        config = dict(config)
-        config["model_type"] = "qwen3"
-        return llama.LlamaConfig.from_hf_config(config)
-
-    return ModelFamily(
-        name="qwen3",
-        config_from_hf=config_from_hf,
-        init_params=llama.init_params,
-        param_specs=llama.param_specs,
-        forward_prefill=llama.llama_forward_prefill,
-        forward_decode=llama.llama_forward_decode,
-        forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
-        forward_prefill_embeds=llama.llama_forward_prefill_embeds,
-        supports_sp=True,
-        forward_decode_pp=llama.llama_forward_decode_pp,
-    )
+    # Qwen3 = llama geometry + per-head q/k RMSNorm before rope, no biases
+    return _llama_like_family("qwen3", lambda c: c.update(qk_norm=True))
 
 
 def _mixtral_family() -> ModelFamily:

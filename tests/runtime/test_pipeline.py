@@ -1,0 +1,85 @@
+"""Typed pipeline node graph (reference: lib/runtime/src/pipeline/nodes.rs):
+source → operators → sink composition, edge validation, and a pipeline cut
+into two network-separated segments over the runtime bus."""
+
+import pytest
+
+from dynamo_tpu.runtime import Context, DistributedRuntime, ResponseStream
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.engine import Operator
+from dynamo_tpu.runtime.pipeline import SegmentSink, PipelineChain, segment_source, source
+from dynamo_tpu.utils.config import RuntimeConfig
+
+from tests.runtime.test_runtime_e2e import EchoEngine
+
+
+class Doubler(Operator):
+    """tokens *2 on the way in; tag responses on the way out."""
+
+    async def preprocess(self, request):
+        return request.transfer({"tokens": [t * 2 for t in request.data["tokens"]]})
+
+    async def postprocess(self, stream, request):
+        return stream.map(lambda item: {**item, "doubled": True})
+
+
+class PlusOne(Operator):
+    async def preprocess(self, request):
+        return request.transfer({"tokens": [t + 1 for t in request.data["tokens"]]})
+
+    async def postprocess(self, stream, request):
+        return stream
+
+
+async def test_chain_composition_and_order():
+    pipe = source().link(Doubler()).link(PlusOne()).link(EchoEngine("sink"))
+    out = await (await pipe.generate(Context({"tokens": [1, 2]}))).collect()
+    # Doubler runs first (outermost), then PlusOne: (t*2)+1
+    assert [o["token"] for o in out] == [3, 5]
+    assert all(o["doubled"] for o in out)
+    assert all(o["worker"] == "sink" for o in out)
+
+
+async def test_unterminated_chain_rejected():
+    chain = source().link(Doubler())
+    assert not chain.terminated
+    with pytest.raises(ValueError, match="not terminated"):
+        await chain.generate(Context({"tokens": [1]}))
+
+
+async def test_terminated_chain_frozen():
+    pipe = source().link(EchoEngine())
+    with pytest.raises(ValueError, match="already terminated"):
+        pipe.link(Doubler())
+
+
+async def test_bad_node_type_rejected():
+    with pytest.raises(TypeError, match="Operator or an AsyncEngine"):
+        source().link(42)
+
+
+async def test_segment_cut_over_the_bus():
+    """A pipeline cut at an operator edge: the downstream segment serves on
+    an endpoint (SegmentSink), the upstream segment links to it through the
+    push router (segment_source) — same results as the in-process chain."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://pipeline-test")
+    )
+    sink = None
+    try:
+        ep = rt.namespace("test").component("pipe").endpoint("gen")
+        # downstream segment: PlusOne → echo, served remotely
+        sink = SegmentSink(ep, source().link(PlusOne()).link(EchoEngine("remote")))
+        await sink.start()
+
+        # upstream segment: Doubler → (network edge)
+        remote = await segment_source(ep)
+        pipe = source().link(Doubler()).link(remote)
+        out = await (await pipe.generate(Context({"tokens": [1, 2]}))).collect()
+        assert [o["token"] for o in out] == [3, 5]
+        assert all(o["worker"] == "remote" for o in out)
+    finally:
+        if sink is not None:
+            await sink.stop()
+        await rt.close()
