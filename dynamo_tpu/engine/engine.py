@@ -43,7 +43,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
-from dynamo_tpu.ops.sampling import apply_penalties, sample_tokens
+from dynamo_tpu.ops.sampling import apply_penalties, sample_tokens, token_logprobs
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
@@ -352,15 +352,16 @@ class JaxLlmEngine:
             )
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            lp = token_logprobs(plogits, token[None])[0]
             gen_counts = gen_counts.at[lane, token].add(1)
-            return token, cache, gen_counts, prompt_counts
+            return token, lp, cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_prefill_prefix(self):
@@ -386,17 +387,18 @@ class JaxLlmEngine:
             )
             step_key = jax.random.fold_in(key, total_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            lp = token_logprobs(plogits, token[None])[0]
             # sample_gate=0 for non-final chunks of a chunked prefill: the
             # logits are discarded and no generated count is recorded
             gen_counts = gen_counts.at[lane, token].add(sample_gate)
-            return token, cache, gen_counts, prompt_counts
+            return token, lp, cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_prefill_mm(self):
@@ -429,15 +431,16 @@ class JaxLlmEngine:
             )
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
+            lp = token_logprobs(plogits, token[None])[0]
             gen_counts = gen_counts.at[lane, token].add(1)
-            return token, cache, gen_counts, prompt_counts
+            return token, lp, cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_decode(self):
@@ -472,7 +475,7 @@ class JaxLlmEngine:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, self._cache_sharding, repl)
+            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl)
 
         if steps <= 1:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
@@ -484,9 +487,10 @@ class JaxLlmEngine:
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
+                lps = token_logprobs(logits, tokens)
                 active = (context_lens > 0).astype(jnp.int32)
                 gen_counts = gen_counts.at[lane_idx, tokens].add(active)
-                return tokens, cache, gen_counts
+                return tokens, lps, cache, gen_counts
 
             return jax.jit(step, donate_argnums=(1, 2), **kwargs)
 
@@ -517,14 +521,15 @@ class JaxLlmEngine:
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
+                lps = token_logprobs(logits, tokens)
                 gen_counts = gen_counts.at[lane_idx, tokens].add(active_i)
                 lens = jnp.where(active, lens + 1, lens)
-                return (tokens, cache, gen_counts, lens), tokens
+                return (tokens, cache, gen_counts, lens), (tokens, lps)
 
-            (_, cache, gen_counts, _), tokens_seq = jax.lax.scan(
+            (_, cache, gen_counts, _), (tokens_seq, lp_seq) = jax.lax.scan(
                 body, (token_ids, cache, gen_counts, context_lens), None, length=steps
             )
-            return tokens_seq, cache, gen_counts  # [steps, lanes]
+            return tokens_seq, lp_seq, cache, gen_counts  # [steps, lanes]
 
         return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
 
@@ -590,8 +595,12 @@ class JaxLlmEngine:
         out_q: asyncio.Queue = asyncio.Queue()
 
         def emit(tokens: list[int], finish: FinishReason | None,
-                 error: str | None = None) -> None:
-            out = LLMEngineOutput(token_ids=tokens, finish_reason=finish, error=error)
+                 error: str | None = None,
+                 logprobs: list[float] | None = None) -> None:
+            out = LLMEngineOutput(
+                token_ids=tokens, finish_reason=finish, error=error,
+                logprobs=logprobs,
+            )
             wire = Annotated.from_data(out).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
             if finish is not None:
@@ -648,9 +657,9 @@ class JaxLlmEngine:
     # -- disaggregation API ------------------------------------------------
     async def prefill_extract(
         self, pre: PreprocessedRequest, *, device: bool = False
-    ) -> tuple[int, dict, int]:
+    ) -> tuple[int, float, dict, int]:
         """Prefill-worker side: run prefill only, return (first_token,
-        blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
+        first_token_logprob, blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
         sequence's blocks, e.g. llama ``{"k": [L, n, bs, kvh, d], "v": ...}``
         — host numpy by default, device arrays with ``device=True`` (the
         same-process/ICI transfer path: no host staging)."""
@@ -706,7 +715,8 @@ class JaxLlmEngine:
         await fut
 
     async def generate_prefilled(
-        self, request: Context[dict], block_ids: list[int], first_token: int
+        self, request: Context[dict], block_ids: list[int], first_token: int,
+        first_token_logprob: float | None = None,
     ) -> ResponseStream[dict]:
         """Decode-worker side: start decoding a sequence whose prompt KV was
         injected into ``block_ids`` and whose first token was already sampled
@@ -720,9 +730,13 @@ class JaxLlmEngine:
         self.allocator.adopt_sequence(seq.seq_id, block_ids)
 
         def emit(tokens: list[int], finish: FinishReason | None,
-                 error: str | None = None) -> None:
+                 error: str | None = None,
+                 logprobs: list[float] | None = None) -> None:
             wire = Annotated.from_data(
-                LLMEngineOutput(token_ids=tokens, finish_reason=finish, error=error)
+                LLMEngineOutput(
+                    token_ids=tokens, finish_reason=finish, error=error,
+                    logprobs=logprobs,
+                )
             ).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
             if finish is not None:
@@ -731,7 +745,10 @@ class JaxLlmEngine:
         seq.emit = emit
         # surface the prefill worker's token as the first stream item
         finish = seq.hit_stop(first_token)
-        emit([first_token], finish)
+        emit(
+            [first_token], finish,
+            logprobs=None if first_token_logprob is None else [first_token_logprob],
+        )
         if finish is None:
             self._submit_q.put(("add", seq))
             self._wake.set()
@@ -1139,14 +1156,14 @@ class JaxLlmEngine:
             emb_pad[: seq.mm_len] = seq.mm_embeds
             block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             block_ids[: len(blocks)] = blocks
-            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_mm(
+            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_mm(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(emb_pad), jnp.asarray(tok_arr),
                 jnp.int32(seq.mm_len), jnp.asarray(block_ids), jnp.int32(total),
                 jnp.asarray(gen_row), jnp.asarray(key), *sampling_tail,
             )
             seq.prefilled_tokens = total
-            self._process_token(seq, int(token))
+            self._process_token(seq, int(token), float(lp))
             return
         # the continued-prefill jit serves prefix hits AND every chunk (an
         # intermediate first chunk needs its sample gate; start_pos=0 masks
@@ -1169,7 +1186,7 @@ class JaxLlmEngine:
             tail_ids = np.zeros((table_len,), np.int32)
             tail_ids[: len(blocks) - start_blocks] = blocks[start_blocks:]
             prompt_row = self._count_row(seq.request.token_ids)
-            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
+            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(full_ids),
                 jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(start),
@@ -1181,7 +1198,7 @@ class JaxLlmEngine:
             padded[:end] = tokens[:end]
             block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             block_ids[: len(blocks)] = blocks
-            token, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
+            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
                 jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
@@ -1205,14 +1222,14 @@ class JaxLlmEngine:
                 blocks_out = jax.tree.map(lambda x: x[:, :n_used], gathered)
             else:
                 blocks_out = jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered)
-            result = (int(token), blocks_out, n_used)
+            result = (int(token), float(lp), blocks_out, n_used)
             self.scheduler.finish(seq)
             if seq.on_prefill_done:
                 seq.on_prefill_done(result)
             return
         if seq.mm_embeds is None:
             self.allocator.publish_stored(seq.seq_id, tokens)
-        self._process_token(seq, int(token))
+        self._process_token(seq, int(token), float(lp))
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
         lanes = self.config.max_batch_size
@@ -1263,33 +1280,43 @@ class JaxLlmEngine:
             jnp.asarray(freq), jnp.asarray(rep),
         )
         if steps <= 1:
-            tokens, self.cache, self._gen_counts = self._jit_decode(
+            tokens, lps, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
+            lps_host = np.asarray(lps)[None, :]
         else:
-            tokens, self.cache, self._gen_counts = self._jit_decode(
+            tokens, lps, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)  # [steps, lanes]
+            lps_host = np.asarray(lps)
 
         for s in range(tokens_host.shape[0]):
             for seq in active:
                 if seq.status != SeqStatus.RUNNING:
                     continue  # finished at an earlier step in this window
-                self._process_token(seq, int(tokens_host[s, seq.lane]))
+                self._process_token(
+                    seq, int(tokens_host[s, seq.lane]),
+                    float(lps_host[s, seq.lane]),
+                )
 
-    def _process_token(self, seq: Sequence, token: int) -> None:
+    def _process_token(
+        self, seq: Sequence, token: int, logprob: float | None = None
+    ) -> None:
         seq.output_ids.append(token)
         finish = seq.hit_stop(token)
         if finish is None and seq.context_len >= self.max_len:
             finish = FinishReason.LENGTH
         if seq.emit:
-            seq.emit([token], finish)
+            seq.emit(
+                [token], finish,
+                logprobs=None if logprob is None else [logprob],
+            )
         if finish is not None:
             self.scheduler.finish(seq)
         elif seq.context_len % self.config.block_size == 0 and seq.mm_embeds is None:

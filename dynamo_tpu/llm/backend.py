@@ -91,12 +91,14 @@ class Backend(Operator):
                 out: LLMEngineOutput = ann.data
                 text_parts: list[str] = []
                 finish = out.finish_reason
+                consumed = 0
                 for token_id in out.token_ids:
                     if _is_stop_token(token_id, pre):
                         if finish is None:
                             finish = FinishReason.STOP
                         finished = True
                         break
+                    consumed += 1
                     piece = decode.step(token_id)
                     if piece is None:
                         continue
@@ -109,6 +111,11 @@ class Backend(Operator):
                         break
                 if finish is not None and not finished:
                     finished = True
+                if consumed < len(out.token_ids):
+                    # a stop cut the burst short: keep tokens/logprobs in sync
+                    out.token_ids = out.token_ids[:consumed]
+                    if out.logprobs is not None:
+                        out.logprobs = out.logprobs[:consumed]
                 out.text = "".join(text_parts)
                 out.finish_reason = finish
                 yield Annotated.from_data(out).to_wire(LLMEngineOutput.to_wire)

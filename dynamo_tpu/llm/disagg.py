@@ -152,7 +152,7 @@ class DisaggDecodeEngine:
         await self.engine.inject_blocks(payload.block_ids, payload.blocks)
         fut = self._pending.pop(payload.seq_id, None)
         if fut is not None and not fut.done():
-            fut.set_result(payload.first_token)
+            fut.set_result((payload.first_token, payload.first_token_logprob))
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         pre = PreprocessedRequest.from_wire(request.data)
@@ -182,12 +182,14 @@ class DisaggDecodeEngine:
             }
         )
         try:
-            first_token = await asyncio.wait_for(fut, timeout=300)
+            first_token, first_lp = await asyncio.wait_for(fut, timeout=300)
         except (asyncio.TimeoutError, asyncio.CancelledError):
             self._pending.pop(seq_id, None)
             self.engine.release_blocks(block_ids)
             raise RuntimeError(f"remote prefill for {seq_id} timed out")
-        return await self.engine.generate_prefilled(request, block_ids, first_token)
+        return await self.engine.generate_prefilled(
+            request, block_ids, first_token, first_token_logprob=first_lp
+        )
 
     def stats(self) -> dict:
         stats = self.engine.stats()
@@ -244,12 +246,15 @@ class PrefillWorker:
         # block/transfer/strategy.rs:345): same-process destinations keep
         # blocks on device (ICI-class copy), remote ones stage to host
         local = item["transfer_address"] in LOCAL_SERVERS
-        first_token, blocks, n = await self.engine.prefill_extract(pre, device=local)
+        first_token, first_lp, blocks, n = await self.engine.prefill_extract(
+            pre, device=local
+        )
         await self.client.send(
             item["transfer_address"],
             KvTransferPayload(
                 seq_id=item["seq_id"],
                 first_token=first_token,
+                first_token_logprob=first_lp,
                 block_ids=item["dst_block_ids"][:n],
                 blocks=blocks,
             ),

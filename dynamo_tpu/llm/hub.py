@@ -55,18 +55,25 @@ def _hf_download(repo_id: str, dest: Path) -> None:
     )
 
 
-def is_complete(path: Path) -> bool:
-    """A usable model dir has at least a config and a LOADABLE tokenizer:
-    tokenizer.json always, tokenizer.model only when the SPM conversion
-    path is available (else resolution must fail early, not at pipeline
-    build)."""
+def classify_model_dir(path: Path) -> str:
+    """One classification for resolution decisions:
+    - "complete": config + a loadable tokenizer;
+    - "unloadable_spm": only an SPM tokenizer.model and the conversion
+      deps (sentencepiece/transformers) are missing — actionable error;
+    - "incomplete": anything else (download / keep looking)."""
     from dynamo_tpu.llm.tokenizer import spm_conversion_available
 
     if not (path / "config.json").exists():
-        return False
+        return "incomplete"
     if (path / "tokenizer.json").exists():
-        return True
-    return (path / "tokenizer.model").exists() and spm_conversion_available()
+        return "complete"
+    if (path / "tokenizer.model").exists():
+        return "complete" if spm_conversion_available() else "unloadable_spm"
+    return "incomplete"
+
+
+def is_complete(path: Path) -> bool:
+    return classify_model_dir(path) == "complete"
 
 
 def resolve_model(
@@ -125,14 +132,7 @@ def _reject_unloadable_spm(name: str, dest: Path) -> None:
     environment without the conversion deps must fail with the actionable
     cause — not re-download on every resolve, not claim the tokenizer is
     missing."""
-    from dynamo_tpu.llm.tokenizer import spm_conversion_available
-
-    if (
-        (dest / "config.json").exists()
-        and not (dest / "tokenizer.json").exists()
-        and (dest / "tokenizer.model").exists()
-        and not spm_conversion_available()
-    ):
+    if classify_model_dir(dest) == "unloadable_spm":
         raise FileNotFoundError(
             f"model {name!r} at {dest} ships only a SentencePiece "
             "tokenizer.model and the 'sentencepiece'/'transformers' packages "

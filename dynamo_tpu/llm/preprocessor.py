@@ -67,6 +67,27 @@ class PromptFormatter:
         )
 
 
+def render_logprob_entries(
+    tokenizer: HfTokenizer, token_ids: list[int], logprobs: list[float]
+) -> list[dict]:
+    """OpenAI chat ``logprobs.content`` entries for one emitted burst.
+    ``top_logprobs`` is empty (alternatives are not tracked by the engine).
+    Callers must skip rendering when the engine supplied no logprobs —
+    fabricating values would report false certainty."""
+    entries = []
+    for tid, lp in zip(token_ids, logprobs):
+        text = tokenizer.decode([tid], skip_special_tokens=False)
+        entries.append(
+            {
+                "token": text,
+                "logprob": lp,
+                "bytes": list(text.encode("utf-8")),
+                "top_logprobs": [],
+            }
+        )
+    return entries
+
+
 def _raise_exception(message: str):
     raise ValueError(message)
 
@@ -133,6 +154,9 @@ class ChatPreprocessor(Operator):
             request.data.stream_options and request.data.stream_options.get("include_usage")
         )
 
+        want_logprobs = bool(request.data.logprobs)
+        tokenizer = self.core.tokenizer
+
         async def gen() -> AsyncIterator[Annotated[ChatCompletionChunk]]:
             first = True
             completion_tokens = 0
@@ -152,6 +176,13 @@ class ChatPreprocessor(Operator):
                     content=out.text if out.text else ("" if first else None),
                 )
                 first = False
+                lp_content = None
+                if want_logprobs and out.token_ids and out.logprobs is not None:
+                    lp_content = {
+                        "content": render_logprob_entries(
+                            tokenizer, out.token_ids, out.logprobs
+                        )
+                    }
                 yield Annotated.from_data(
                     ChatCompletionChunk(
                         id=state["response_id"],
@@ -161,6 +192,7 @@ class ChatPreprocessor(Operator):
                                 index=0,
                                 delta=delta,
                                 finish_reason=finish_reason_to_openai(out.finish_reason),
+                                logprobs=lp_content,
                             )
                         ],
                     )
@@ -213,14 +245,34 @@ class CompletionPreprocessor(Operator):
             request.data.stream_options and request.data.stream_options.get("include_usage")
         )
 
+        want_logprobs = request.data.logprobs is not None and request.data.logprobs > 0
+        tokenizer = self.core.tokenizer
+
         async def gen() -> AsyncIterator[Annotated[CompletionResponse]]:
             completion_tokens = 0
+            char_offset = 0  # running offset within the generated text
             async for item in stream:
                 ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
                 if ann.is_annotation() or ann.data is None:
                     continue
                 out = ann.data
                 completion_tokens += len(out.token_ids)
+                lp_block = None
+                if want_logprobs and out.token_ids and out.logprobs is not None:
+                    token_texts = [
+                        tokenizer.decode([t], skip_special_tokens=False)
+                        for t in out.token_ids
+                    ]
+                    offsets = []
+                    for text in token_texts:
+                        offsets.append(char_offset)
+                        char_offset += len(text)
+                    lp_block = {
+                        "tokens": token_texts,
+                        "token_logprobs": out.logprobs,
+                        "top_logprobs": None,
+                        "text_offset": offsets,
+                    }
                 yield Annotated.from_data(
                     CompletionResponse(
                         id=state["response_id"],
@@ -230,6 +282,7 @@ class CompletionPreprocessor(Operator):
                                 index=0,
                                 text=out.text or "",
                                 finish_reason=finish_reason_to_openai(out.finish_reason),
+                                logprobs=lp_block,
                             )
                         ],
                     )

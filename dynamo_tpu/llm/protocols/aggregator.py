@@ -31,6 +31,7 @@ async def aggregate_chat_stream(
     roles: dict[int, str] = {}
     finish: dict[int, str | None] = {}
     tool_calls: dict[int, list[dict]] = {}
+    logprob_content: dict[int, list[dict]] = {}
 
     async for chunk in chunks:
         response_id = chunk.id or response_id
@@ -49,6 +50,8 @@ async def aggregate_chat_stream(
                 tool_calls.setdefault(idx, []).extend(choice.delta.tool_calls)
             if choice.finish_reason is not None:
                 finish[idx] = choice.finish_reason
+            if choice.logprobs and choice.logprobs.get("content"):
+                logprob_content.setdefault(idx, []).extend(choice.logprobs["content"])
 
     choices = [
         ChatChoice(
@@ -59,6 +62,9 @@ async def aggregate_chat_stream(
                 tool_calls=tool_calls.get(idx) or None,
             ),
             finish_reason=finish.get(idx),
+            logprobs=(
+                {"content": logprob_content[idx]} if idx in logprob_content else None
+            ),
         )
         for idx, parts in sorted(contents.items())
     ]
@@ -76,6 +82,8 @@ async def aggregate_completion_stream(
     usage: Usage | None = None
     texts: dict[int, list[str]] = {}
     finish: dict[int, str | None] = {}
+    lp_tokens: dict[int, list[str]] = {}
+    lp_values: dict[int, list[float]] = {}
 
     async for chunk in chunks:
         response_id = chunk.id or response_id
@@ -89,9 +97,28 @@ async def aggregate_completion_stream(
                 texts[choice.index].append(choice.text)
             if choice.finish_reason is not None:
                 finish[choice.index] = choice.finish_reason
+            if choice.logprobs:
+                lp_tokens.setdefault(choice.index, []).extend(
+                    choice.logprobs.get("tokens", [])
+                )
+                lp_values.setdefault(choice.index, []).extend(
+                    choice.logprobs.get("token_logprobs", [])
+                )
 
     choices = [
-        CompletionChoice(index=idx, text="".join(parts), finish_reason=finish.get(idx))
+        CompletionChoice(
+            index=idx, text="".join(parts), finish_reason=finish.get(idx),
+            logprobs=(
+                {
+                    "tokens": lp_tokens[idx],
+                    "token_logprobs": lp_values[idx],
+                    "top_logprobs": None,
+                    "text_offset": [],
+                }
+                if idx in lp_tokens
+                else None
+            ),
+        )
         for idx, parts in sorted(texts.items())
     ]
     return CompletionResponse(

@@ -120,6 +120,8 @@ class LLMEngineOutput:
     completion_tokens: int | None = None
     # engine-side failure detail (finish_reason == ERROR)
     error: str | None = None
+    # per-token logprobs parallel to token_ids (engines fill when available)
+    logprobs: list[float] | None = None
 
     def to_wire(self) -> dict:
         d: dict[str, Any] = {"token_ids": self.token_ids}
@@ -133,6 +135,8 @@ class LLMEngineOutput:
             d["completion_tokens"] = self.completion_tokens
         if self.error is not None:
             d["error"] = self.error
+        if self.logprobs is not None:
+            d["logprobs"] = self.logprobs
         return d
 
     @classmethod
@@ -145,6 +149,7 @@ class LLMEngineOutput:
             finish_reason=FinishReason(fr) if fr else None,
             completion_tokens=d.get("completion_tokens"),
             error=d.get("error"),
+            logprobs=d.get("logprobs"),
         )
 
 

@@ -78,3 +78,15 @@ def apply_penalties(
     penalized = jnp.where(logits > 0, logits / rep, logits * rep)
     logits = jnp.where(seen, penalized, logits)
     return logits
+
+
+def token_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """log-softmax probability of each chosen token [batch] (float32),
+    computed from the given logits (the engine passes the penalized,
+    untempered distribution — vLLM's convention for reported logprobs)."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, tokens.astype(jnp.int32)[:, None], axis=-1
+    )[:, 0]
+    return picked - lse

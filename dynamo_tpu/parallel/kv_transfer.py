@@ -57,6 +57,8 @@ class KvTransferPayload:
     # per cache leaf — llama: {"k": [L, n, bs, kvh, d], "v": ...}; DeepSeek
     # MLA: latent + rope-key leaves with different trailing shapes
     blocks: dict[str, np.ndarray]
+    # logprob of first_token under the prefill worker's distribution
+    first_token_logprob: float | None = None
 
 
 class KvTransferServer:
@@ -115,6 +117,7 @@ class KvTransferServer:
                 payload = KvTransferPayload(
                     seq_id=h["seq_id"],
                     first_token=h["first_token"],
+                    first_token_logprob=h.get("first_token_logprob"),
                     block_ids=list(h["block_ids"]),
                     blocks=blocks,
                 )
@@ -155,6 +158,7 @@ class KvTransferClient:
         header = {
             "seq_id": payload.seq_id,
             "first_token": payload.first_token,
+            "first_token_logprob": payload.first_token_logprob,
             "block_ids": payload.block_ids,
             "parts": [
                 {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}

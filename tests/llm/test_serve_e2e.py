@@ -328,3 +328,62 @@ async def test_artifact_distribution_via_object_store(tmp_path, monkeypatch):
         if worker:
             await worker.shutdown()
         await rt.close()
+
+
+async def test_chat_logprobs_end_to_end():
+    """OpenAI logprobs: the engine computes the sampled token's logprob from
+    the penalized distribution, and the chat layer renders
+    choices[].logprobs.content entries (token text, logprob, bytes) for
+    both unary and aggregated responses."""
+    import math
+
+    rt = await make_runtime()
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(
+            rt, MODEL_DIR, model_name="tiny", engine_kind="jax",
+            num_blocks=64, max_batch_size=4, max_model_len=128,
+            prefill_buckets=(32, 64),
+        )
+        service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            await wait_for_model(client, "tiny")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello there"}],
+                    "max_tokens": 5,
+                    "logprobs": True,
+                },
+                timeout=120,
+            )
+            assert r.status_code == 200
+            body = r.json()
+            content = body["choices"][0]["logprobs"]["content"]
+            assert len(content) == body["usage"]["completion_tokens"]
+            for entry in content:
+                assert isinstance(entry["token"], str)
+                assert entry["logprob"] <= 1e-6  # log-probabilities
+                assert math.isfinite(entry["logprob"])
+                assert bytes(entry["bytes"]).decode("utf-8") == entry["token"]
+
+            # without the flag, no logprobs in the response
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello there"}],
+                    "max_tokens": 3,
+                },
+                timeout=120,
+            )
+            assert r.json()["choices"][0].get("logprobs") is None
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()
