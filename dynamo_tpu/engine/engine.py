@@ -43,7 +43,12 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
-from dynamo_tpu.ops.sampling import apply_penalties, sample_tokens, token_logprobs
+from dynamo_tpu.ops.sampling import (
+    apply_penalties,
+    sample_tokens,
+    token_logprobs,
+    topk_logprobs,
+)
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
@@ -81,6 +86,11 @@ class EngineConfig:
     # Reference: block manager G1→G2 offload, lib/llm/src/block_manager/
     # offload.rs:77-80.
     host_offload_blocks: int = 0
+    # Compile-time K for per-token top-k alternatives (OpenAI
+    # top_logprobs caps at 20); 0 compiles the tracking down to size-0
+    # arrays.  Host transfer of the rows only happens for sequences that
+    # asked for them.
+    top_logprobs_k: int = 20
     # Decode iterations fused into one jit launch (lax.scan with device-side
     # token feedback + slot derivation).  >1 amortizes per-step dispatch and
     # host↔device roundtrips — the dominant cost at small batch — at the
@@ -318,6 +328,7 @@ class JaxLlmEngine:
     def _build_prefill(self):
         cfg = self.config.model
         vocab = cfg.vocab_size
+        topk_k = self.config.top_logprobs_k
 
         # sequence parallelism: prefill attention rides the ring kernel when
         # the mesh has an sp axis and the family supports it
@@ -353,15 +364,16 @@ class JaxLlmEngine:
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
+            tk_vals, tk_ids = topk_logprobs(plogits, topk_k)
             gen_counts = gen_counts.at[lane, token].add(1)
-            return token, lp, cache, gen_counts, prompt_counts
+            return token, lp, tk_vals[0], tk_ids[0], cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_prefill_prefix(self):
@@ -371,6 +383,7 @@ class JaxLlmEngine:
         folds with the total context length so seeded sampling matches the
         uncached path exactly."""
         cfg = self.config.model
+        topk_k = self.config.top_logprobs_k
 
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
@@ -388,17 +401,18 @@ class JaxLlmEngine:
             step_key = jax.random.fold_in(key, total_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
+            tk_vals, tk_ids = topk_logprobs(plogits, topk_k)
             # sample_gate=0 for non-final chunks of a chunked prefill: the
             # logits are discarded and no generated count is recorded
             gen_counts = gen_counts.at[lane, token].add(sample_gate)
-            return token, lp, cache, gen_counts, prompt_counts
+            return token, lp, tk_vals[0], tk_ids[0], cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_prefill_mm(self):
@@ -408,6 +422,7 @@ class JaxLlmEngine:
         examples/multimodal/components/encode_worker.py:61.)"""
         cfg = self.config.model
         vocab = cfg.vocab_size
+        topk_k = self.config.top_logprobs_k
 
         def step(params, cache, gen_counts, prompt_counts, lane, embeds,
                  token_ids, n_patch, block_ids, seq_len, gen_row, key, temp,
@@ -432,20 +447,22 @@ class JaxLlmEngine:
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
+            tk_vals, tk_ids = topk_logprobs(plogits, topk_k)
             gen_counts = gen_counts.at[lane, token].add(1)
-            return token, lp, cache, gen_counts, prompt_counts
+            return token, lp, tk_vals[0], tk_ids[0], cache, gen_counts, prompt_counts
 
         kwargs = {}
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl, repl)
+            kwargs["out_shardings"] = (repl, repl, repl, repl, self._cache_sharding, repl, repl)
         return jax.jit(step, donate_argnums=(1, 2, 3), **kwargs)
 
     def _build_decode(self):
         cfg = self.config.model
         steps = self.config.decode_steps
+        topk_k = self.config.top_logprobs_k
 
         # pipeline parallelism: when the mesh has a pp axis and the family
         # ships a pipelined decode, the layer stack runs as GPipe-style
@@ -475,7 +492,7 @@ class JaxLlmEngine:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, repl, self._cache_sharding, repl)
+            kwargs["out_shardings"] = (repl, repl, repl, repl, self._cache_sharding, repl)
 
         if steps <= 1:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
@@ -488,9 +505,10 @@ class JaxLlmEngine:
                 step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
                 lps = token_logprobs(logits, tokens)
+                tk_vals, tk_ids = topk_logprobs(logits, topk_k)
                 active = (context_lens > 0).astype(jnp.int32)
                 gen_counts = gen_counts.at[lane_idx, tokens].add(active)
-                return tokens, lps, cache, gen_counts
+                return tokens, lps, tk_vals, tk_ids, cache, gen_counts
 
             return jax.jit(step, donate_argnums=(1, 2), **kwargs)
 
@@ -522,14 +540,15 @@ class JaxLlmEngine:
                 step_keys = jax.vmap(jax.random.fold_in)(keys, lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
                 lps = token_logprobs(logits, tokens)
+                tk_vals, tk_ids = topk_logprobs(logits, topk_k)
                 gen_counts = gen_counts.at[lane_idx, tokens].add(active_i)
                 lens = jnp.where(active, lens + 1, lens)
-                return (tokens, cache, gen_counts, lens), (tokens, lps)
+                return (tokens, cache, gen_counts, lens), (tokens, lps, tk_vals, tk_ids)
 
-            (_, cache, gen_counts, _), (tokens_seq, lp_seq) = jax.lax.scan(
+            (_, cache, gen_counts, _), (tokens_seq, lp_seq, tkv_seq, tki_seq) = jax.lax.scan(
                 body, (token_ids, cache, gen_counts, context_lens), None, length=steps
             )
-            return tokens_seq, lp_seq, cache, gen_counts  # [steps, lanes]
+            return tokens_seq, lp_seq, tkv_seq, tki_seq, cache, gen_counts
 
         return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
 
@@ -596,10 +615,11 @@ class JaxLlmEngine:
 
         def emit(tokens: list[int], finish: FinishReason | None,
                  error: str | None = None,
-                 logprobs: list[float] | None = None) -> None:
+                 logprobs: list[float] | None = None,
+                 top_logprobs: list[list[list]] | None = None) -> None:
             out = LLMEngineOutput(
                 token_ids=tokens, finish_reason=finish, error=error,
-                logprobs=logprobs,
+                logprobs=logprobs, top_logprobs=top_logprobs,
             )
             wire = Annotated.from_data(out).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
@@ -657,9 +677,9 @@ class JaxLlmEngine:
     # -- disaggregation API ------------------------------------------------
     async def prefill_extract(
         self, pre: PreprocessedRequest, *, device: bool = False
-    ) -> tuple[int, float, dict, int]:
+    ) -> tuple[int, float, list | None, dict, int]:
         """Prefill-worker side: run prefill only, return (first_token,
-        first_token_logprob, blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
+        first_token_logprob, first_token_top_logprobs, blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
         sequence's blocks, e.g. llama ``{"k": [L, n, bs, kvh, d], "v": ...}``
         — host numpy by default, device arrays with ``device=True`` (the
         same-process/ICI transfer path: no host staging)."""
@@ -717,6 +737,7 @@ class JaxLlmEngine:
     async def generate_prefilled(
         self, request: Context[dict], block_ids: list[int], first_token: int,
         first_token_logprob: float | None = None,
+        first_token_top_logprobs: list | None = None,
     ) -> ResponseStream[dict]:
         """Decode-worker side: start decoding a sequence whose prompt KV was
         injected into ``block_ids`` and whose first token was already sampled
@@ -731,11 +752,12 @@ class JaxLlmEngine:
 
         def emit(tokens: list[int], finish: FinishReason | None,
                  error: str | None = None,
-                 logprobs: list[float] | None = None) -> None:
+                 logprobs: list[float] | None = None,
+                 top_logprobs: list[list[list]] | None = None) -> None:
             wire = Annotated.from_data(
                 LLMEngineOutput(
                     token_ids=tokens, finish_reason=finish, error=error,
-                    logprobs=logprobs,
+                    logprobs=logprobs, top_logprobs=top_logprobs,
                 )
             ).to_wire(LLMEngineOutput.to_wire)
             loop.call_soon_threadsafe(out_q.put_nowait, wire)
@@ -748,6 +770,10 @@ class JaxLlmEngine:
         emit(
             [first_token], finish,
             logprobs=None if first_token_logprob is None else [first_token_logprob],
+            top_logprobs=(
+                None if first_token_top_logprobs is None
+                else [first_token_top_logprobs]
+            ),
         )
         if finish is None:
             self._submit_q.put(("add", seq))
@@ -1156,14 +1182,17 @@ class JaxLlmEngine:
             emb_pad[: seq.mm_len] = seq.mm_embeds
             block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             block_ids[: len(blocks)] = blocks
-            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_mm(
+            token, lp, tkv, tki, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_mm(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(emb_pad), jnp.asarray(tok_arr),
                 jnp.int32(seq.mm_len), jnp.asarray(block_ids), jnp.int32(total),
                 jnp.asarray(gen_row), jnp.asarray(key), *sampling_tail,
             )
             seq.prefilled_tokens = total
-            self._process_token(seq, int(token), float(lp))
+            want_top = seq.request.sampling.top_logprobs > 0
+            self._process_token(
+                seq, int(token), float(lp), top=(tkv, tki) if want_top else None
+            )
             return
         # the continued-prefill jit serves prefix hits AND every chunk (an
         # intermediate first chunk needs its sample gate; start_pos=0 masks
@@ -1186,7 +1215,7 @@ class JaxLlmEngine:
             tail_ids = np.zeros((table_len,), np.int32)
             tail_ids[: len(blocks) - start_blocks] = blocks[start_blocks:]
             prompt_row = self._count_row(seq.request.token_ids)
-            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
+            token, lp, tkv, tki, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill_prefix(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(full_ids),
                 jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(start),
@@ -1198,7 +1227,7 @@ class JaxLlmEngine:
             padded[:end] = tokens[:end]
             block_ids = np.zeros((self.max_blocks_per_seq,), np.int32)
             block_ids[: len(blocks)] = blocks
-            token, lp, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
+            token, lp, tkv, tki, self.cache, self._gen_counts, self._prompt_counts = self._jit_prefill(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
                 jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
@@ -1222,14 +1251,23 @@ class JaxLlmEngine:
                 blocks_out = jax.tree.map(lambda x: x[:, :n_used], gathered)
             else:
                 blocks_out = jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered)
-            result = (int(token), float(lp), blocks_out, n_used)
+            want_top = seq.request.sampling.top_logprobs
+            top_rows = None
+            if want_top > 0:
+                tkv_h, tki_h = np.asarray(tkv), np.asarray(tki)
+                k = min(want_top, len(tki_h))
+                top_rows = [[int(tki_h[i]), float(tkv_h[i])] for i in range(k)]
+            result = (int(token), float(lp), top_rows, blocks_out, n_used)
             self.scheduler.finish(seq)
             if seq.on_prefill_done:
                 seq.on_prefill_done(result)
             return
         if seq.mm_embeds is None:
             self.allocator.publish_stored(seq.seq_id, tokens)
-        self._process_token(seq, int(token), float(lp))
+        want_top = seq.request.sampling.top_logprobs > 0
+        self._process_token(
+            seq, int(token), float(lp), top=(tkv, tki) if want_top else None
+        )
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
         lanes = self.config.max_batch_size
@@ -1273,6 +1311,9 @@ class JaxLlmEngine:
         if not active:
             return
 
+        want_top = any(
+            seq.request.sampling.top_logprobs > 0 for seq in active
+        )
         temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays(active, lanes)
         sampling_tail = (
             jnp.asarray(self._lane_keys), jnp.asarray(temp), jnp.asarray(top_k),
@@ -1280,21 +1321,25 @@ class JaxLlmEngine:
             jnp.asarray(freq), jnp.asarray(rep),
         )
         if steps <= 1:
-            tokens, lps, self.cache, self._gen_counts = self._jit_decode(
+            tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
             lps_host = np.asarray(lps)[None, :]
+            tkv_host = np.asarray(tkvs)[None] if want_top else None
+            tki_host = np.asarray(tkis)[None] if want_top else None
         else:
-            tokens, lps, self.cache, self._gen_counts = self._jit_decode(
+            tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), *sampling_tail,
             )
             tokens_host = np.asarray(tokens)  # [steps, lanes]
             lps_host = np.asarray(lps)
+            tkv_host = np.asarray(tkvs) if want_top else None
+            tki_host = np.asarray(tkis) if want_top else None
 
         for s in range(tokens_host.shape[0]):
             for seq in active:
@@ -1303,19 +1348,31 @@ class JaxLlmEngine:
                 self._process_token(
                     seq, int(tokens_host[s, seq.lane]),
                     float(lps_host[s, seq.lane]),
+                    top=(
+                        (tkv_host[s, seq.lane], tki_host[s, seq.lane])
+                        if want_top else None
+                    ),
                 )
 
     def _process_token(
-        self, seq: Sequence, token: int, logprob: float | None = None
+        self, seq: Sequence, token: int, logprob: float | None = None,
+        top=None,
     ) -> None:
         seq.output_ids.append(token)
         finish = seq.hit_stop(token)
         if finish is None and seq.context_len >= self.max_len:
             finish = FinishReason.LENGTH
         if seq.emit:
+            top_rows = None
+            want = seq.request.sampling.top_logprobs
+            if top is not None and want > 0:
+                vals, ids = top
+                k = min(want, len(ids))
+                top_rows = [[[int(ids[i]), float(vals[i])] for i in range(k)]]
             seq.emit(
                 [token], finish,
                 logprobs=None if logprob is None else [logprob],
+                top_logprobs=top_rows,
             )
         if finish is not None:
             self.scheduler.finish(seq)

@@ -116,6 +116,8 @@ class Backend(Operator):
                     out.token_ids = out.token_ids[:consumed]
                     if out.logprobs is not None:
                         out.logprobs = out.logprobs[:consumed]
+                    if out.top_logprobs is not None:
+                        out.top_logprobs = out.top_logprobs[:consumed]
                 out.text = "".join(text_parts)
                 out.finish_reason = finish
                 yield Annotated.from_data(out).to_wire(LLMEngineOutput.to_wire)

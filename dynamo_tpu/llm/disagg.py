@@ -152,7 +152,13 @@ class DisaggDecodeEngine:
         await self.engine.inject_blocks(payload.block_ids, payload.blocks)
         fut = self._pending.pop(payload.seq_id, None)
         if fut is not None and not fut.done():
-            fut.set_result((payload.first_token, payload.first_token_logprob))
+            fut.set_result(
+                (
+                    payload.first_token,
+                    payload.first_token_logprob,
+                    payload.first_token_top_logprobs,
+                )
+            )
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         pre = PreprocessedRequest.from_wire(request.data)
@@ -182,13 +188,14 @@ class DisaggDecodeEngine:
             }
         )
         try:
-            first_token, first_lp = await asyncio.wait_for(fut, timeout=300)
+            first_token, first_lp, first_top = await asyncio.wait_for(fut, timeout=300)
         except (asyncio.TimeoutError, asyncio.CancelledError):
             self._pending.pop(seq_id, None)
             self.engine.release_blocks(block_ids)
             raise RuntimeError(f"remote prefill for {seq_id} timed out")
         return await self.engine.generate_prefilled(
-            request, block_ids, first_token, first_token_logprob=first_lp
+            request, block_ids, first_token, first_token_logprob=first_lp,
+            first_token_top_logprobs=first_top,
         )
 
     def stats(self) -> dict:
@@ -246,7 +253,7 @@ class PrefillWorker:
         # block/transfer/strategy.rs:345): same-process destinations keep
         # blocks on device (ICI-class copy), remote ones stage to host
         local = item["transfer_address"] in LOCAL_SERVERS
-        first_token, first_lp, blocks, n = await self.engine.prefill_extract(
+        first_token, first_lp, first_top, blocks, n = await self.engine.prefill_extract(
             pre, device=local
         )
         await self.client.send(
@@ -255,6 +262,7 @@ class PrefillWorker:
                 seq_id=item["seq_id"],
                 first_token=first_token,
                 first_token_logprob=first_lp,
+                first_token_top_logprobs=first_top,
                 block_ids=item["dst_block_ids"][:n],
                 blocks=blocks,
             ),

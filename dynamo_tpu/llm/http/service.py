@@ -150,12 +150,10 @@ class HttpService:
             chat_request = ChatCompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
             return _error(400, f"invalid request: {exc}")
-        if chat_request.top_logprobs:
-            return _error(
-                400,
-                "top_logprobs is not supported (per-token alternatives are "
-                "not tracked); use logprobs=true for sampled-token logprobs",
-            )
+        if chat_request.top_logprobs and not chat_request.logprobs:
+            return _error(400, "top_logprobs requires logprobs=true")
+        if chat_request.top_logprobs and chat_request.top_logprobs > 20:
+            return _error(400, "top_logprobs must be <= 20")
         engine = self.manager.chat_engines.get(chat_request.model)
         if engine is None:
             return _error(404, f"model '{chat_request.model}' not found", "model_not_found")
@@ -194,12 +192,8 @@ class HttpService:
             completion_request = CompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
             return _error(400, f"invalid request: {exc}")
-        if completion_request.logprobs is not None and completion_request.logprobs > 1:
-            return _error(
-                400,
-                "logprobs > 1 is not supported (per-token alternatives are "
-                "not tracked); use logprobs=1 for sampled-token logprobs",
-            )
+        if completion_request.logprobs is not None and completion_request.logprobs > 5:
+            return _error(400, "logprobs must be <= 5")
         engine = self.manager.completion_engines.get(completion_request.model)
         if engine is None:
             return _error(404, f"model '{completion_request.model}' not found", "model_not_found")

@@ -68,21 +68,36 @@ class PromptFormatter:
 
 
 def render_logprob_entries(
-    tokenizer: HfTokenizer, token_ids: list[int], logprobs: list[float]
+    tokenizer: HfTokenizer,
+    token_ids: list[int],
+    logprobs: list[float],
+    top_logprobs: list[list[list]] | None = None,
 ) -> list[dict]:
     """OpenAI chat ``logprobs.content`` entries for one emitted burst.
-    ``top_logprobs`` is empty (alternatives are not tracked by the engine).
-    Callers must skip rendering when the engine supplied no logprobs —
-    fabricating values would report false certainty."""
+    ``top_logprobs`` rows are [[token_id, logprob], ...] alternatives when
+    the engine supplied them.  Callers must skip rendering when the engine
+    supplied no logprobs — fabricating values would report false
+    certainty."""
     entries = []
-    for tid, lp in zip(token_ids, logprobs):
+    for pos, (tid, lp) in enumerate(zip(token_ids, logprobs)):
         text = tokenizer.decode([tid], skip_special_tokens=False)
+        alts = []
+        if top_logprobs is not None and pos < len(top_logprobs):
+            for alt_id, alt_lp in top_logprobs[pos]:
+                alt_text = tokenizer.decode([int(alt_id)], skip_special_tokens=False)
+                alts.append(
+                    {
+                        "token": alt_text,
+                        "logprob": float(alt_lp),
+                        "bytes": list(alt_text.encode("utf-8")),
+                    }
+                )
         entries.append(
             {
                 "token": text,
                 "logprob": lp,
                 "bytes": list(text.encode("utf-8")),
-                "top_logprobs": [],
+                "top_logprobs": alts,
             }
         )
     return entries
@@ -180,7 +195,8 @@ class ChatPreprocessor(Operator):
                 if want_logprobs and out.token_ids and out.logprobs is not None:
                     lp_content = {
                         "content": render_logprob_entries(
-                            tokenizer, out.token_ids, out.logprobs
+                            tokenizer, out.token_ids, out.logprobs,
+                            out.top_logprobs,
                         )
                     }
                 yield Annotated.from_data(
@@ -267,10 +283,20 @@ class CompletionPreprocessor(Operator):
                     for text in token_texts:
                         offsets.append(char_offset)
                         char_offset += len(text)
+                    top = None
+                    if out.top_logprobs is not None:
+                        top = [
+                            {
+                                tokenizer.decode([int(aid)], skip_special_tokens=False):
+                                float(alp)
+                                for aid, alp in row
+                            }
+                            for row in out.top_logprobs
+                        ]
                     lp_block = {
                         "tokens": token_texts,
                         "token_logprobs": out.logprobs,
-                        "top_logprobs": None,
+                        "top_logprobs": top,
                         "text_offset": offsets,
                     }
                 yield Annotated.from_data(

@@ -84,6 +84,8 @@ async def aggregate_completion_stream(
     finish: dict[int, str | None] = {}
     lp_tokens: dict[int, list[str]] = {}
     lp_values: dict[int, list[float]] = {}
+    lp_offsets: dict[int, list[int]] = {}
+    lp_top: dict[int, list] = {}
 
     async for chunk in chunks:
         response_id = chunk.id or response_id
@@ -104,6 +106,15 @@ async def aggregate_completion_stream(
                 lp_values.setdefault(choice.index, []).extend(
                     choice.logprobs.get("token_logprobs", [])
                 )
+                lp_offsets.setdefault(choice.index, []).extend(
+                    choice.logprobs.get("text_offset") or []
+                )
+                # keep top rows PARALLEL to tokens: a chunk without
+                # alternatives contributes empty rows, never a shift
+                n_toks = len(choice.logprobs.get("tokens", []))
+                rows = choice.logprobs.get("top_logprobs") or []
+                rows = list(rows[:n_toks]) + [{}] * max(0, n_toks - len(rows))
+                lp_top.setdefault(choice.index, []).extend(rows)
 
     choices = [
         CompletionChoice(
@@ -112,8 +123,12 @@ async def aggregate_completion_stream(
                 {
                     "tokens": lp_tokens[idx],
                     "token_logprobs": lp_values[idx],
-                    "top_logprobs": None,
-                    "text_offset": [],
+                    "top_logprobs": (
+                        lp_top[idx]
+                        if idx in lp_top and any(lp_top[idx])
+                        else None
+                    ),
+                    "text_offset": lp_offsets.get(idx, []),
                 }
                 if idx in lp_tokens
                 else None

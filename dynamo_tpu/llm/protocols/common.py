@@ -38,6 +38,9 @@ class SamplingOptions:
     seed: int | None = None
     n: int = 1
     use_greedy: bool = False
+    # number of per-token alternatives to report (OpenAI top_logprobs);
+    # capped by the engine's compile-time K
+    top_logprobs: int = 0
 
     def to_wire(self) -> dict:
         return {k: v for k, v in asdict(self).items() if v not in (None,)}
@@ -122,6 +125,9 @@ class LLMEngineOutput:
     error: str | None = None
     # per-token logprobs parallel to token_ids (engines fill when available)
     logprobs: list[float] | None = None
+    # per-token top-k alternatives: list (parallel to token_ids) of
+    # [[token_id, logprob], ...] rows
+    top_logprobs: list[list[list]] | None = None
 
     def to_wire(self) -> dict:
         d: dict[str, Any] = {"token_ids": self.token_ids}
@@ -137,6 +143,8 @@ class LLMEngineOutput:
             d["error"] = self.error
         if self.logprobs is not None:
             d["logprobs"] = self.logprobs
+        if self.top_logprobs is not None:
+            d["top_logprobs"] = self.top_logprobs
         return d
 
     @classmethod
@@ -150,6 +158,7 @@ class LLMEngineOutput:
             completion_tokens=d.get("completion_tokens"),
             error=d.get("error"),
             logprobs=d.get("logprobs"),
+            top_logprobs=d.get("top_logprobs"),
         )
 
 

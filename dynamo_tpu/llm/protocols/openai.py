@@ -93,6 +93,7 @@ class ChatCompletionRequest(BaseModel):
             seed=self.seed,
             n=self.n or 1,
             use_greedy=bool(self.ext and self.ext.greed_sampling),
+            top_logprobs=(self.top_logprobs or 0) if self.logprobs else 0,
         )
 
     def stop_conditions(self) -> StopConditions:
@@ -139,6 +140,7 @@ class CompletionRequest(BaseModel):
             seed=self.seed,
             n=self.n or 1,
             use_greedy=bool(self.ext and self.ext.greed_sampling),
+            top_logprobs=self.logprobs if (self.logprobs or 0) > 1 else 0,
         )
 
     def stop_conditions(self) -> StopConditions:

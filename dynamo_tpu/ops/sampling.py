@@ -90,3 +90,12 @@ def token_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
         logits, tokens.astype(jnp.int32)[:, None], axis=-1
     )[:, 0]
     return picked - lse
+
+
+def topk_logprobs(logits: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k log-softmax probabilities and their token ids
+    ([batch, k] f32, [batch, k] i32) from the given logits."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    vals, ids = jax.lax.top_k(logits, k)
+    return vals - lse, ids.astype(jnp.int32)

@@ -59,6 +59,8 @@ class KvTransferPayload:
     blocks: dict[str, np.ndarray]
     # logprob of first_token under the prefill worker's distribution
     first_token_logprob: float | None = None
+    # [[token_id, logprob], ...] alternatives for first_token (when asked)
+    first_token_top_logprobs: list | None = None
 
 
 class KvTransferServer:
@@ -118,6 +120,7 @@ class KvTransferServer:
                     seq_id=h["seq_id"],
                     first_token=h["first_token"],
                     first_token_logprob=h.get("first_token_logprob"),
+                    first_token_top_logprobs=h.get("first_token_top_logprobs"),
                     block_ids=list(h["block_ids"]),
                     blocks=blocks,
                 )
@@ -159,6 +162,7 @@ class KvTransferClient:
             "seq_id": payload.seq_id,
             "first_token": payload.first_token,
             "first_token_logprob": payload.first_token_logprob,
+            "first_token_top_logprobs": payload.first_token_top_logprobs,
             "block_ids": payload.block_ids,
             "parts": [
                 {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}

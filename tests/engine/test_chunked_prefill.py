@@ -132,12 +132,12 @@ async def test_chunked_prefill_extract_for_disagg():
 
     plain = make_engine()
     try:
-        tok_ref, _, _, n_ref = await plain.prefill_extract(pre())
+        tok_ref, _, _, _, n_ref = await plain.prefill_extract(pre())
     finally:
         plain.stop()
     chunked = make_engine(prefill_chunk_tokens=8)
     try:
-        tok, _, blocks, n = await chunked.prefill_extract(pre())
+        tok, _, _, blocks, n = await chunked.prefill_extract(pre())
     finally:
         chunked.stop()
     assert tok == tok_ref

@@ -253,3 +253,53 @@ async def test_deepseek_remote_prefill_exactness():
         decode_engine.stop()
         prefill_engine.stop()
         await rt.close()
+
+
+async def test_disagg_logprobs_cross_boundary():
+    """logprobs + top_logprobs survive the prefill→decode boundary: the
+    remotely-sampled first token carries its logprob and alternatives just
+    like locally-decoded tokens."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-lp"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine()
+    disagg = prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        wire = PreprocessedRequest(
+            token_ids=list(range(3, 13)),
+            sampling=SamplingOptions(use_greedy=True, top_logprobs=3),
+            stop=StopConditions(max_tokens=4),
+            eos_token_ids=[1],
+        ).to_wire()
+        stream = await disagg.generate(Context(wire))
+        outs = []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None and ann.data.token_ids:
+                outs.append(ann.data)
+        assert disagg.remote_prefills == 1
+        assert len(outs) >= 2  # remote first token + local decode tokens
+        for out in outs:
+            assert out.logprobs is not None and len(out.logprobs) == len(out.token_ids)
+            assert out.top_logprobs is not None
+            for row in out.top_logprobs:
+                assert len(row) == 3
+                # rows sorted best-first; greedy choice is the argmax
+                lps = [lp for _, lp in row]
+                assert lps == sorted(lps, reverse=True)
+        assert outs[0].top_logprobs[0][0][0] == outs[0].token_ids[0]
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()

@@ -368,6 +368,42 @@ async def test_chat_logprobs_end_to_end():
                 assert math.isfinite(entry["logprob"])
                 assert bytes(entry["bytes"]).decode("utf-8") == entry["token"]
 
+            # top_logprobs: per-token alternatives, sorted best-first,
+            # containing the sampled (greedy) token as the argmax
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "hello there"}],
+                    "max_tokens": 3,
+                    "logprobs": True,
+                    "top_logprobs": 3,
+                },
+                timeout=120,
+            )
+            assert r.status_code == 200
+            content = r.json()["choices"][0]["logprobs"]["content"]
+            for entry in content:
+                alts = entry["top_logprobs"]
+                assert len(alts) == 3
+                lps = [a["logprob"] for a in alts]
+                assert lps == sorted(lps, reverse=True)
+                # greedy sampling: the chosen token IS the top alternative
+                assert alts[0]["token"] == entry["token"]
+                assert abs(alts[0]["logprob"] - entry["logprob"]) < 1e-4
+
+            # top_logprobs without logprobs → 400
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "top_logprobs": 2,
+                },
+                timeout=30,
+            )
+            assert r.status_code == 400
+
             # without the flag, no logprobs in the response
             r = await client.post(
                 "/v1/chat/completions",
