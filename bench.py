@@ -139,6 +139,7 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             prefill_buckets=(chunk,) if chunk else (prompt_len,),
             decode_steps=decode_steps,
             prefill_chunk_tokens=chunk,
+            top_logprobs_k=0,  # no top-k tax on the measured decode loop
         ),
         params=params,
     )

@@ -87,9 +87,10 @@ class EngineConfig:
     # offload.rs:77-80.
     host_offload_blocks: int = 0
     # Compile-time K for per-token top-k alternatives (OpenAI
-    # top_logprobs caps at 20); 0 compiles the tracking down to size-0
-    # arrays.  Host transfer of the rows only happens for sequences that
-    # asked for them.
+    # top_logprobs caps at 20).  K>0 adds one lax.top_k over [lanes, vocab]
+    # to every step (the host transfer of the rows is skipped unless a
+    # sequence asked); K=0 removes the compute entirely (top_logprobs
+    # requests then get empty alternative rows).
     top_logprobs_k: int = 20
     # Decode iterations fused into one jit launch (lax.scan with device-side
     # token feedback + slot derivation).  >1 amortizes per-step dispatch and

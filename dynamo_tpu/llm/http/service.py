@@ -162,10 +162,17 @@ class HttpService:
         if not chat_request.stream:
             # non-streaming responses always carry usage (OpenAI semantics)
             chat_request.stream_options = {**(chat_request.stream_options or {}), "include_usage": True}
+        ctx = None
         try:
-            ctx = Context(chat_request)
+            n = chat_request.n or 1
+            if n > 16:
+                return _error(400, "n must be <= 16")
             try:
-                stream = await engine.generate(ctx)
+                if n > 1:
+                    stream, ctx = await _generate_fanout(engine, chat_request, n)
+                else:
+                    ctx = Context(chat_request)
+                    stream = await engine.generate(ctx)
             except ValueError as exc:
                 return _error(400, str(exc))
             if chat_request.stream:
@@ -176,7 +183,8 @@ class HttpService:
             self._observe_usage(chat_request.model, response.usage)
             return web.json_response(response.model_dump(exclude_none=True))
         except asyncio.CancelledError:
-            ctx.ctx.kill()
+            if ctx is not None:
+                ctx.ctx.kill()
             raise
         except Exception as exc:  # noqa: BLE001
             logger.exception("chat request failed")
@@ -203,10 +211,17 @@ class HttpService:
         )
         if not completion_request.stream:
             completion_request.stream_options = {**(completion_request.stream_options or {}), "include_usage": True}
+        ctx = None
         try:
-            ctx = Context(completion_request)
+            n = completion_request.n or 1
+            if n > 16:
+                return _error(400, "n must be <= 16")
             try:
-                stream = await engine.generate(ctx)
+                if n > 1:
+                    stream, ctx = await _generate_fanout(engine, completion_request, n)
+                else:
+                    ctx = Context(completion_request)
+                    stream = await engine.generate(ctx)
             except ValueError as exc:
                 return _error(400, str(exc))
             if completion_request.stream:
@@ -217,7 +232,8 @@ class HttpService:
             self._observe_usage(completion_request.model, response.usage)
             return web.json_response(response.model_dump(exclude_none=True))
         except asyncio.CancelledError:
-            ctx.ctx.kill()
+            if ctx is not None:
+                ctx.ctx.kill()
             raise
         except Exception as exc:  # noqa: BLE001
             logger.exception("completion request failed")
@@ -298,3 +314,120 @@ def _data_only(stream, guard):
             yield ann.data
 
     return gen()
+
+
+class _FanoutCtx:
+    """Composite EngineContext facade: cancellation fans out to every
+    sub-request of an n>1 fan-out (duck-typed for _stream_sse's ctx.ctx)."""
+
+    class _Inner:
+        def __init__(self, ctxs):
+            self._ctxs = ctxs
+
+        def kill(self) -> None:
+            for c in self._ctxs:
+                c.ctx.kill()
+
+        def stop_generating(self) -> None:
+            for c in self._ctxs:
+                c.ctx.stop_generating()
+
+    def __init__(self, ctxs):
+        self.ctx = self._Inner(ctxs)
+
+
+async def _generate_fanout(engine, request_model, n: int):
+    """OpenAI ``n>1``: issue n independent single-choice requests (seeded
+    requests get seed+i per choice, like vLLM) and merge the streams with
+    choice indices rewritten; per-choice usage chunks are summed into one.
+    Returns (merged_annotated_stream, fanout_ctx)."""
+    subs = []
+    for i in range(n):
+        sub = request_model.model_copy(deep=True)
+        sub.n = 1
+        if getattr(sub, "seed", None) is not None:
+            sub.seed = sub.seed + i
+        subs.append(sub)
+    ctxs = [Context(sub) for sub in subs]
+    streams = []
+    try:
+        for c in ctxs:
+            streams.append(await engine.generate(c))
+    except BaseException:
+        # sub-requests already submitted must not decode to max_tokens
+        # with nobody consuming them
+        for c in ctxs:
+            c.ctx.kill()
+        raise
+
+    queue: asyncio.Queue = asyncio.Queue()
+
+    async def pump(i, stream):
+        try:
+            async for ann in stream:
+                await queue.put((i, ann))
+        except Exception as exc:  # noqa: BLE001 — surface to the consumer
+            await queue.put((i, exc))
+        finally:
+            await queue.put((i, None))
+
+    tasks = [asyncio.ensure_future(pump(i, st)) for i, st in enumerate(streams)]
+
+    async def gen():
+        done = 0
+        usage_sum = None
+        proto = None   # any data chunk: template for the final usage chunk
+        resp_id = None  # one response id for the whole merged stream
+        try:
+            while done < len(streams):
+                i, ann = await queue.get()
+                if ann is None:
+                    done += 1
+                    continue
+                if isinstance(ann, Exception):
+                    raise ann
+                if ann.is_annotation():
+                    if i == 0:  # identical per sub-request: emit once
+                        yield ann
+                    continue
+                data = ann.data
+                if data is None:
+                    continue
+                if getattr(data, "usage", None) is not None and not data.choices:
+                    u = data.usage
+                    if usage_sum is None:
+                        usage_sum = u.model_copy()
+                    else:
+                        # one shared prompt, n completions
+                        usage_sum.completion_tokens += u.completion_tokens
+                        usage_sum.total_tokens += u.completion_tokens
+                    continue
+                # every sub-request minted its own id: present ONE id so
+                # clients grouping deltas by response id see one stream
+                if resp_id is None:
+                    resp_id = data.id
+                data.id = resp_id
+                proto = proto or data
+                for choice in data.choices:
+                    choice.index = i
+                yield ann
+            if usage_sum is not None and proto is not None:
+                final = type(proto)(
+                    id=resp_id, model=proto.model, choices=[], usage=usage_sum
+                )
+                from dynamo_tpu.llm.protocols.common import Annotated
+
+                yield Annotated.from_data(final)
+        except BaseException:
+            # one sub-stream failed or the consumer went away: the healthy
+            # sub-requests must not keep decoding into dead air
+            for c in ctxs:
+                c.ctx.kill()
+            raise
+        finally:
+            for t in tasks:
+                t.cancel()
+
+    from dynamo_tpu.runtime.engine import ResponseStream
+
+    return ResponseStream(gen(), ctxs[0].ctx), _FanoutCtx(ctxs)

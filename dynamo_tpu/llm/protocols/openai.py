@@ -140,7 +140,7 @@ class CompletionRequest(BaseModel):
             seed=self.seed,
             n=self.n or 1,
             use_greedy=bool(self.ext and self.ext.greed_sampling),
-            top_logprobs=self.logprobs if (self.logprobs or 0) > 1 else 0,
+            top_logprobs=self.logprobs or 0,
         )
 
     def stop_conditions(self) -> StopConditions:

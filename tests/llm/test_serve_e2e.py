@@ -423,3 +423,58 @@ async def test_chat_logprobs_end_to_end():
         if worker:
             await worker.shutdown()
         await rt.close()
+
+
+async def test_n_choices_fanout():
+    """OpenAI n>1: the frontend fans out n single-choice requests, rewrites
+    choice indices, and sums usage; greedy sampling makes all choices
+    identical (determinism), distinct indices prove the merge."""
+    rt = await make_runtime()
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(
+            rt, MODEL_DIR, model_name="tiny", engine_kind="jax",
+            num_blocks=64, max_batch_size=4, max_model_len=128,
+            prefill_buckets=(32, 64),
+        )
+        service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            await wait_for_model(client, "tiny")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "fan out"}],
+                    "max_tokens": 4,
+                    "n": 3,
+                },
+                timeout=120,
+            )
+            assert r.status_code == 200
+            body = r.json()
+            choices = body["choices"]
+            assert sorted(c["index"] for c in choices) == [0, 1, 2]
+            # greedy → identical content across choices
+            contents = {c["message"]["content"] for c in choices}
+            assert len(contents) == 1
+            # usage: one prompt, 3 completions of 4 tokens
+            assert body["usage"]["completion_tokens"] == 12
+
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "x"}],
+                    "n": 99,
+                },
+                timeout=30,
+            )
+            assert r.status_code == 400
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()
