@@ -164,15 +164,8 @@ class HttpService:
             chat_request.stream_options = {**(chat_request.stream_options or {}), "include_usage": True}
         ctx = None
         try:
-            n = chat_request.n or 1
-            if n > 16:
-                return _error(400, "n must be <= 16")
             try:
-                if n > 1:
-                    stream, ctx = await _generate_fanout(engine, chat_request, n)
-                else:
-                    ctx = Context(chat_request)
-                    stream = await engine.generate(ctx)
+                stream, ctx = await _start_generation(engine, chat_request)
             except ValueError as exc:
                 return _error(400, str(exc))
             if chat_request.stream:
@@ -213,15 +206,8 @@ class HttpService:
             completion_request.stream_options = {**(completion_request.stream_options or {}), "include_usage": True}
         ctx = None
         try:
-            n = completion_request.n or 1
-            if n > 16:
-                return _error(400, "n must be <= 16")
             try:
-                if n > 1:
-                    stream, ctx = await _generate_fanout(engine, completion_request, n)
-                else:
-                    ctx = Context(completion_request)
-                    stream = await engine.generate(ctx)
+                stream, ctx = await _start_generation(engine, completion_request)
             except ValueError as exc:
                 return _error(400, str(exc))
             if completion_request.stream:
@@ -316,31 +302,27 @@ def _data_only(stream, guard):
     return gen()
 
 
-class _FanoutCtx:
-    """Composite EngineContext facade: cancellation fans out to every
-    sub-request of an n>1 fan-out (duck-typed for _stream_sse's ctx.ctx)."""
-
-    class _Inner:
-        def __init__(self, ctxs):
-            self._ctxs = ctxs
-
-        def kill(self) -> None:
-            for c in self._ctxs:
-                c.ctx.kill()
-
-        def stop_generating(self) -> None:
-            for c in self._ctxs:
-                c.ctx.stop_generating()
-
-    def __init__(self, ctxs):
-        self.ctx = self._Inner(ctxs)
+async def _start_generation(engine, request_model):
+    """One dispatch for both OpenAI endpoints: validates ``n``, fans out
+    when n>1, else a plain single-choice generate.  Returns (stream, ctx);
+    raises ValueError for 400-class problems."""
+    n = request_model.n if request_model.n is not None else 1
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    if n > 16:
+        raise ValueError("n must be <= 16")
+    if n > 1:
+        return await _generate_fanout(engine, request_model, n)
+    ctx = Context(request_model)
+    return await engine.generate(ctx), ctx
 
 
 async def _generate_fanout(engine, request_model, n: int):
     """OpenAI ``n>1``: issue n independent single-choice requests (seeded
     requests get seed+i per choice, like vLLM) and merge the streams with
     choice indices rewritten; per-choice usage chunks are summed into one.
-    Returns (merged_annotated_stream, fanout_ctx)."""
+    Returns (merged_annotated_stream, parent_ctx); cancelling the parent
+    context fans out to every sub-request through link_child."""
     subs = []
     for i in range(n):
         sub = request_model.model_copy(deep=True)
@@ -348,7 +330,10 @@ async def _generate_fanout(engine, request_model, n: int):
         if getattr(sub, "seed", None) is not None:
             sub.seed = sub.seed + i
         subs.append(sub)
+    parent = Context(request_model)
     ctxs = [Context(sub) for sub in subs]
+    for c in ctxs:
+        parent.ctx.link_child(c.ctx)
     streams = []
     try:
         for c in ctxs:
@@ -430,4 +415,4 @@ async def _generate_fanout(engine, request_model, n: int):
 
     from dynamo_tpu.runtime.engine import ResponseStream
 
-    return ResponseStream(gen(), ctxs[0].ctx), _FanoutCtx(ctxs)
+    return ResponseStream(gen(), parent.ctx), parent

@@ -365,6 +365,74 @@ def _mla_prefill_attn(w, x, cfg: DeepseekConfig, positions, seq_len, k_layer, v_
     return out.reshape(s, -1) @ w["wo"], (k_layer, v_layer)
 
 
+def _mla_prefill_attn_with_prefix(
+    w, x, cfg: DeepseekConfig, positions, tail_len, start_pos, k_layer, v_layer,
+    full_block_ids, tail_block_ids, cos, sin,
+):
+    """Continued MLA prefill: the tail's queries attend to the resident
+    prefix LATENTS (absorbed form — scores in latent space, context
+    decompressed once) jointly with the in-chunk dense attention under one
+    softmax; only the tail's latents are written.  Enables prefix-cache
+    reuse and chunked prefill for the MLA family."""
+    s = x.shape[0]
+    H = cfg.num_heads
+    q = _project_q(w, x, cfg)
+    q_nope, q_rope = q[..., : cfg.qk_nope_head_dim], q[..., cfg.qk_nope_head_dim :]
+    q_rope = apply_rope(q_rope, positions, cos, sin)
+
+    c_kv, k_rope = _latent_kv(w, x, cfg)
+    k_rope = apply_rope(k_rope[:, None, :], positions, cos, sin)[:, 0]
+
+    # gather the resident prefix BEFORE writing the tail
+    block_size = k_layer.shape[1]
+    t_pref = full_block_ids.shape[0] * block_size
+    ck_pref = k_layer[full_block_ids].reshape(t_pref, cfg.kv_lora_rank)
+    kr_pref = v_layer[full_block_ids].reshape(t_pref, cfg.qk_rope_head_dim)
+
+    k_layer, v_layer = write_prefill_kv(
+        k_layer, v_layer, c_kv[:, None, :], k_rope[:, None, :], tail_block_ids, tail_len
+    )
+
+    w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
+    w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
+    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
+
+    # prefix scores, absorbed: q_lat·ck + q_rope·kr (identical math to
+    # decompressing the prefix keys, without materializing them per head)
+    q_lat = jnp.einsum(
+        "qhn,rhn->qhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32)
+    )
+    sp = (
+        jnp.einsum("qhr,tr->hqt", q_lat, ck_pref.astype(jnp.float32))
+        + jnp.einsum("qhp,tp->hqt", q_rope.astype(jnp.float32), kr_pref.astype(jnp.float32))
+    ) * scale
+    pref_valid = jnp.arange(t_pref)[None, :] < start_pos  # [1, Tp]
+    sp = jnp.where(pref_valid[None], sp, NEG_INF)
+
+    # in-chunk dense scores (decompressed, as in _mla_prefill_attn)
+    k_nope = jnp.einsum("tr,rhn->thn", c_kv, w_uk)
+    sc = (
+        jnp.einsum("qhn,khn->hqk", q_nope.astype(jnp.float32), k_nope.astype(jnp.float32))
+        + jnp.einsum("qhp,kp->hqk", q_rope.astype(jnp.float32), k_rope.astype(jnp.float32))
+    ) * scale
+    pos = jnp.arange(s)
+    chunk_mask = (pos[None, :] <= pos[:, None]) & (pos[None, :] < tail_len)
+    sc = jnp.where(chunk_mask[None], sc, NEG_INF)
+
+    # one softmax across prefix + chunk keys
+    logits = jnp.concatenate([sp, sc], axis=-1)  # [H, s, Tp + s]
+    weights = jax.nn.softmax(logits, axis=-1)
+    wp, wc = weights[..., :t_pref], weights[..., t_pref:]
+
+    # prefix context in latent space, decompressed once; chunk context dense
+    ctx_lat = jnp.einsum("hqt,tr->qhr", wp, ck_pref.astype(jnp.float32))
+    out_pref = jnp.einsum("qhr,rhv->qhv", ctx_lat, w_uv.astype(jnp.float32))
+    v_chunk = jnp.einsum("tr,rhv->thv", c_kv, w_uv)
+    out_chunk = jnp.einsum("hqk,khv->qhv", wc, v_chunk.astype(jnp.float32))
+    out = (out_pref + out_chunk).astype(cfg.dtype)
+    return out.reshape(s, -1) @ w["wo"], (k_layer, v_layer)
+
+
 def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
                      block_tables, context_lens, slot_ids, cos, sin,
                      attention: str = "jax"):
@@ -504,6 +572,28 @@ def deepseek_forward_prefill(
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)
     last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _logits(params, cfg, last[None])[0]
+    return logits.astype(jnp.float32), new_cache
+
+
+def deepseek_forward_prefill_with_prefix(
+    params, cfg: DeepseekConfig, token_ids, kv_cache, full_block_ids,
+    tail_block_ids, tail_len, start_pos, cos, sin,
+):
+    """Continued prefill over a reused prefix for the MLA family (same
+    contract as llama_forward_prefill_with_prefix)."""
+    s = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    def attn(w, attn_in, k_layer, v_layer):
+        return _mla_prefill_attn_with_prefix(
+            w, attn_in, cfg, positions, tail_len, start_pos, k_layer, v_layer,
+            full_block_ids, tail_block_ids, cos, sin,
+        )
+
+    x, new_cache = _forward(params, cfg, x, kv_cache, attn)
+    last = x[jnp.maximum(tail_len - 1, 0)]
     logits = _logits(params, cfg, last[None])[0]
     return logits.astype(jnp.float32), new_cache
 

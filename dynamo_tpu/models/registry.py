@@ -131,6 +131,7 @@ def _deepseek_family() -> ModelFamily:
         param_specs=deepseek.param_specs,
         forward_prefill=deepseek.deepseek_forward_prefill,
         forward_decode=deepseek.deepseek_forward_decode,
+        forward_prefill_with_prefix=deepseek.deepseek_forward_prefill_with_prefix,
         init_kv_cache=deepseek.init_kv_cache,
         kv_cache_specs=deepseek.kv_cache_specs,
         make_rope_tables=deepseek.make_rope_tables,

@@ -221,3 +221,46 @@ async def test_mixtral_prefix_reuse_identical_output():
         assert stats["prefix_cached_tokens_total"] == 12
     finally:
         engine.stop()
+
+
+async def test_deepseek_prefix_reuse_and_chunked_prefill():
+    """The MLA family serves with prefix-cache reuse AND chunked prefill:
+    identical outputs with hits recorded, and a chunked engine matches the
+    whole-prompt engine exactly."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.models.deepseek import DeepseekConfig, init_params
+
+    cfg = DeepseekConfig.tiny_mla()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build(**overrides):
+        defaults = dict(
+            model=cfg, model_family="deepseek_v2", num_blocks=64, block_size=4,
+            max_batch_size=4, prefill_buckets=(16, 32), max_model_len=64,
+        )
+        defaults.update(overrides)
+        e = JaxLlmEngine(EngineConfig(**defaults), params=params)
+        e.start()
+        return e
+
+    prompt = list(range(3, 17))  # 14 tokens → 3 full blocks
+    engine = build()
+    try:
+        assert engine.prefix_caching
+        first, _ = await collect(engine, request(prompt, max_tokens=5))
+        second, _ = await collect(engine, request(prompt, max_tokens=5))
+        assert second == first
+        stats = engine.stats()
+        assert stats["prefix_hits_total"] == 1
+        assert stats["prefix_cached_tokens_total"] == 12
+    finally:
+        engine.stop()
+
+    chunked = build(prefill_chunk_tokens=8)
+    try:
+        chunked_out, _ = await collect(chunked, request(prompt, max_tokens=5))
+        assert chunked_out == first  # chunked prefill changes nothing
+    finally:
+        chunked.stop()

@@ -460,16 +460,17 @@ async def test_n_choices_fanout():
             # usage: one prompt, 3 completions of 4 tokens
             assert body["usage"]["completion_tokens"] == 12
 
-            r = await client.post(
-                "/v1/chat/completions",
-                json={
-                    "model": "tiny",
-                    "messages": [{"role": "user", "content": "x"}],
-                    "n": 99,
-                },
-                timeout=30,
-            )
-            assert r.status_code == 400
+            for bad_n in (99, 0, -3):
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "x"}],
+                        "n": bad_n,
+                    },
+                    timeout=30,
+                )
+                assert r.status_code == 400, bad_n
     finally:
         if watcher:
             await watcher.stop()

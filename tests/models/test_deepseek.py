@@ -166,3 +166,52 @@ def test_decode_pallas_kernel_matches_gather_path():
     np.testing.assert_allclose(logits_pl, logits_jax, rtol=2e-4, atol=2e-4)
     for k in cache_jax:
         np.testing.assert_allclose(cache_pl[k], cache_jax[k], rtol=1e-6, atol=1e-6)
+
+
+def test_prefix_prefill_matches_plain_prefill():
+    """MLA continued prefill: prefilling [prefix] then [tail] over the
+    resident prefix latents must equal one whole-prompt prefill (logits and
+    cache)."""
+    import numpy as np
+
+    from dynamo_tpu.models.deepseek import (
+        deepseek_forward_prefill_with_prefix,
+        init_kv_cache,
+        make_rope_tables,
+    )
+
+    cfg = CFG
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cos, sin = make_rope_tables(cfg)
+    num_blocks, bs = 16, 4
+    prompt = list(range(3, 19))  # 16 tokens = 4 blocks
+    split = 8                    # block-aligned prefix
+
+    # reference: whole-prompt prefill
+    blocks = jnp.arange(8, dtype=jnp.int32)
+    ref_logits, ref_cache = deepseek_forward_prefill(
+        params, cfg, jnp.asarray(prompt, jnp.int32),
+        init_kv_cache(cfg, num_blocks, bs), blocks,
+        jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+    )
+
+    # two-step: prefix prefill, then continued prefill over it
+    _, cache = deepseek_forward_prefill(
+        params, cfg, jnp.asarray(prompt[:split], jnp.int32),
+        init_kv_cache(cfg, num_blocks, bs), blocks[: split // bs],
+        jnp.int32(split), jnp.int32(0), cos, sin,
+    )
+    tail = prompt[split:]
+    tail_blocks = blocks[split // bs :]
+    logits2, cache2 = deepseek_forward_prefill_with_prefix(
+        params, cfg, jnp.asarray(tail, jnp.int32), cache,
+        blocks[: split // bs], tail_blocks, jnp.int32(len(tail)),
+        jnp.int32(split), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits2), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    for key in ref_cache:
+        np.testing.assert_allclose(
+            np.asarray(cache2[key]), np.asarray(ref_cache[key]), rtol=1e-5, atol=1e-5
+        )
