@@ -619,3 +619,121 @@ def deepseek_forward_decode(
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)
     logits = _logits(params, cfg, x)
     return logits.astype(jnp.float32), new_cache
+
+
+# ------------------------------------------------------------------ weights
+
+
+def load_hf_weights(cfg: DeepseekConfig, model_dir) -> dict:
+    """Load HF DeepSeek-V2/V3 safetensors into the dense/moe layer-stacked
+    pytree.  MLA projections split and transpose:
+    ``kv_b_proj [H*(nope+v), R]`` splits into ``w_uk [R, H*nope]`` and
+    ``w_uv [R, H*v]`` (per-head row grouping), the latent down-projection
+    ``kv_a_proj_with_mqa`` transposes into ``w_dkv [h, R+P]``."""
+    import numpy as np
+
+    from dynamo_tpu.models.hf_io import read_safetensors
+
+    tensors = read_safetensors(model_dir)
+
+    def get(name: str, transpose: bool = False):
+        t = tensors[name]
+        if transpose:
+            t = t.T
+        return np.asarray(t)
+
+    H, nope, v_dim, r = (
+        cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim, cfg.kv_lora_rank
+    )
+
+    def deinterleave(cols: "np.ndarray") -> "np.ndarray":
+        """HF DeepSeek stores rope feature dims interleaved (the official
+        modeling code de-interleaves activations before rotate-half; vLLM
+        loads with is_neox_style=False).  Our apply_rope is split-half
+        (NeoX), so bake the permutation into the projection's rope output
+        columns once at load time."""
+        return np.concatenate([cols[..., 0::2], cols[..., 1::2]], axis=-1)
+
+    def fix_q_rope(mat: "np.ndarray") -> "np.ndarray":
+        """mat [in, H*qk_head]: de-interleave each head's rope slice."""
+        shaped = mat.reshape(mat.shape[0], H, nope + cfg.qk_rope_head_dim).copy()
+        shaped[..., nope:] = deinterleave(shaped[..., nope:])
+        return shaped.reshape(mat.shape[0], -1)
+
+    def attn_leaves(i: int) -> dict:
+        p = f"model.layers.{i}.self_attn"
+        kv_b = get(f"{p}.kv_b_proj.weight")          # [H*(nope+v), R]
+        kv_b = kv_b.reshape(H, nope + v_dim, r)
+        w_uk = kv_b[:, :nope, :].transpose(2, 0, 1).reshape(r, H * nope)
+        w_uv = kv_b[:, nope:, :].transpose(2, 0, 1).reshape(r, H * v_dim)
+        w_dkv = get(f"{p}.kv_a_proj_with_mqa.weight", True).copy()
+        w_dkv[:, r:] = deinterleave(w_dkv[:, r:])  # rope key columns
+        out = {
+            "attn_norm": get(f"model.layers.{i}.input_layernorm.weight"),
+            "w_dkv": w_dkv,
+            "kv_norm": get(f"{p}.kv_a_layernorm.weight"),
+            "w_uk": w_uk,
+            "w_uv": w_uv,
+            "wo": get(f"{p}.o_proj.weight", True),
+            "mlp_norm": get(f"model.layers.{i}.post_attention_layernorm.weight"),
+        }
+        if cfg.q_lora_rank:
+            out["w_dq"] = get(f"{p}.q_a_proj.weight", True)
+            out["q_norm"] = get(f"{p}.q_a_layernorm.weight")
+            out["w_uq"] = fix_q_rope(get(f"{p}.q_b_proj.weight", True))
+        else:
+            out["wq"] = fix_q_rope(get(f"{p}.q_proj.weight", True))
+        return out
+
+    def stack(dicts: list[dict]) -> dict:
+        return {
+            k: jnp.asarray(np.stack([d[k] for d in dicts]), cfg.dtype)
+            for k in dicts[0]
+        }
+
+    dense, moe = [], []
+    for i in range(cfg.num_layers):
+        leaves = attn_leaves(i)
+        mlp = f"model.layers.{i}.mlp"
+        if i < cfg.first_k_dense:
+            leaves.update(
+                w_gate=get(f"{mlp}.gate_proj.weight", True),
+                w_up=get(f"{mlp}.up_proj.weight", True),
+                w_down=get(f"{mlp}.down_proj.weight", True),
+            )
+            dense.append(leaves)
+        else:
+            leaves.update(
+                w_router=get(f"{mlp}.gate.weight", True),
+                w_gate=np.stack([
+                    get(f"{mlp}.experts.{e}.gate_proj.weight", True)
+                    for e in range(cfg.num_experts)
+                ]),
+                w_up=np.stack([
+                    get(f"{mlp}.experts.{e}.up_proj.weight", True)
+                    for e in range(cfg.num_experts)
+                ]),
+                w_down=np.stack([
+                    get(f"{mlp}.experts.{e}.down_proj.weight", True)
+                    for e in range(cfg.num_experts)
+                ]),
+            )
+            if cfg.n_shared_experts:
+                leaves.update(
+                    ws_gate=get(f"{mlp}.shared_experts.gate_proj.weight", True),
+                    ws_up=get(f"{mlp}.shared_experts.up_proj.weight", True),
+                    ws_down=get(f"{mlp}.shared_experts.down_proj.weight", True),
+                )
+            moe.append(leaves)
+
+    params: dict = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), cfg.dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), cfg.dtype),
+    }
+    if dense:
+        params["dense_layers"] = stack(dense)
+    if moe:
+        params["moe_layers"] = stack(moe)
+    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight", True), cfg.dtype)
+    return params

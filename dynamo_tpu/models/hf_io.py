@@ -1,0 +1,27 @@
+"""Shared HF-checkpoint IO for the model families.
+
+Reads sharded ``*.safetensors`` into one name→array dict (the reference
+delegates weight IO to its engines; here every family maps HF names onto
+its layer-stacked pytree — llama.py, mixtral.py, deepseek.py
+``load_hf_weights``)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def read_safetensors(model_dir: str | Path) -> dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    model_dir = Path(model_dir)
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no safetensors in {model_dir}")
+    tensors: dict[str, np.ndarray] = {}
+    for file in files:
+        with safe_open(str(file), framework="np") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+    return tensors

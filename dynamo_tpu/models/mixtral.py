@@ -262,3 +262,62 @@ def mixtral_forward_decode(
         else x @ params["lm_head"]
     )
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+# ------------------------------------------------------------------ weights
+
+
+def load_hf_weights(cfg: MixtralConfig, model_dir) -> dict:
+    """Load and stack HF Mixtral safetensors into the layer-stacked pytree
+    (HF projections are [out, in]; ours [in, out] → transpose; experts stack
+    on a leading E axis)."""
+    import numpy as np
+
+    from dynamo_tpu.models.hf_io import read_safetensors
+
+    tensors = read_safetensors(model_dir)
+
+    def get(name: str, transpose: bool = False):
+        t = tensors[name]
+        if transpose:
+            t = t.T
+        return np.asarray(t)
+
+    names = (
+        "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+        "w_router", "w_gate", "w_up", "w_down",
+    )
+    layers: dict[str, list] = {k: [] for k in names}
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        layers["attn_norm"].append(get(f"{p}.input_layernorm.weight"))
+        layers["wq"].append(get(f"{p}.self_attn.q_proj.weight", True))
+        layers["wk"].append(get(f"{p}.self_attn.k_proj.weight", True))
+        layers["wv"].append(get(f"{p}.self_attn.v_proj.weight", True))
+        layers["wo"].append(get(f"{p}.self_attn.o_proj.weight", True))
+        layers["mlp_norm"].append(get(f"{p}.post_attention_layernorm.weight"))
+        layers["w_router"].append(get(f"{p}.block_sparse_moe.gate.weight", True))
+        # experts: w1=gate, w3=up, w2=down (llama.cpp/HF Mixtral naming)
+        layers["w_gate"].append(np.stack([
+            get(f"{p}.block_sparse_moe.experts.{e}.w1.weight", True)
+            for e in range(cfg.num_experts)
+        ]))
+        layers["w_up"].append(np.stack([
+            get(f"{p}.block_sparse_moe.experts.{e}.w3.weight", True)
+            for e in range(cfg.num_experts)
+        ]))
+        layers["w_down"].append(np.stack([
+            get(f"{p}.block_sparse_moe.experts.{e}.w2.weight", True)
+            for e in range(cfg.num_experts)
+        ]))
+
+    params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), cfg.dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), cfg.dtype),
+        "layers": {
+            k: jnp.asarray(np.stack(v), cfg.dtype) for k, v in layers.items()
+        },
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in tensors:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight", True), cfg.dtype)
+    return params

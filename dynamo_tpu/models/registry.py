@@ -36,6 +36,8 @@ class ModelFamily:
     supports_sp: bool = False
     # pipelined decode over the pp mesh axis (parallel/pipeline.py)
     forward_decode_pp: Callable | None = None
+    # HF safetensors loader: (cfg, model_dir) -> params pytree
+    load_weights: Callable | None = None
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -88,6 +90,7 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         forward_prefill_embeds=llama.llama_forward_prefill_embeds,
         supports_sp=True,
         forward_decode_pp=llama.llama_forward_decode_pp,
+        load_weights=llama.load_hf_weights,
     )
 
 
@@ -118,6 +121,7 @@ def _mixtral_family() -> ModelFamily:
         forward_prefill=mixtral.mixtral_forward_prefill,
         forward_decode=mixtral.mixtral_forward_decode,
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
+        load_weights=mixtral.load_hf_weights,
     )
 
 
@@ -132,6 +136,7 @@ def _deepseek_family() -> ModelFamily:
         forward_prefill=deepseek.deepseek_forward_prefill,
         forward_decode=deepseek.deepseek_forward_decode,
         forward_prefill_with_prefix=deepseek.deepseek_forward_prefill_with_prefix,
+        load_weights=deepseek.load_hf_weights,
         init_kv_cache=deepseek.init_kv_cache,
         kv_cache_specs=deepseek.kv_cache_specs,
         make_rope_tables=deepseek.make_rope_tables,

@@ -48,7 +48,6 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
     import json as _json
 
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
-    from dynamo_tpu.models.llama import load_hf_weights
     from dynamo_tpu.models.registry import get_family
 
     model_dir = Path(model_dir)
@@ -70,9 +69,9 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
     defaults.update(overrides)
     config = EngineConfig(**defaults)
     params = None
-    if family_name in ("llama", "qwen2", "qwen3"):
+    if family.load_weights is not None:
         try:
-            params = load_hf_weights(cfg, model_dir)
+            params = family.load_weights(cfg, model_dir)
             logger.info("loaded weights from %s", model_dir)
         except FileNotFoundError:
             logger.warning("no safetensors in %s — random-initializing weights", model_dir)
