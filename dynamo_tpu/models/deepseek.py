@@ -27,10 +27,11 @@ extract/inject and disagg KV shipping work unchanged:
     k: [layers, num_blocks, block_size, 1, kv_lora_rank]   (latent)
     v: [layers, num_blocks, block_size, 1, qk_rope_head_dim] (rope key)
 
-Routing is renormalized softmax top-k (V2 style) scaled by
-``routed_scaling_factor``; V3's sigmoid+aux-free bias routing maps onto the
-same dispatch path and can be added behind a config flag.  YaRN long-context
-rope scaling is not yet applied (plain rope tables at ``rope_theta``).
+Routing: V2-style renormalized softmax top-k, or V3/R1 aux-free sigmoid
+routing (e_score_correction_bias steers selection only, group-limited
+top-k) behind ``scoring_func="sigmoid"``.  Long context: YaRN rope scaling
+via the HF ``rope_scaling`` dict, including the mscale attention-temperature
+correction (``attn_scale``).
 """
 
 from __future__ import annotations
@@ -72,16 +73,32 @@ class DeepseekConfig:
     n_shared_experts: int = 2
     routed_scaling_factor: float = 1.0
     capacity_factor: float = 2.0
+    # V3/R1 aux-free routing: sigmoid scores + e_score_correction_bias +
+    # group-limited top-k; V2 uses plain renormalized softmax
+    scoring_func: str = "softmax"     # "softmax" | "sigmoid"
+    n_group: int = 1
+    topk_group: int = 1
+    norm_topk_prob: bool = True
     # common
     max_position_embeddings: int = 163840
     rms_norm_eps: float = 1e-6
     rope_theta: float = 10000.0
+    # HF rope_scaling dict; "yarn" also corrects the attention temperature
+    # (mscale) — see attn_scale
+    rope_scaling: Any = None
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
 
     @property
     def qk_head_dim(self) -> int:
         return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def attn_scale(self) -> float:
+        from dynamo_tpu.ops.rope import yarn_mscale
+
+        m = yarn_mscale(self.rope_scaling)
+        return (self.qk_head_dim ** -0.5) * m * m
 
     @property
     def num_moe_layers(self) -> int:
@@ -109,9 +126,14 @@ class DeepseekConfig:
             experts_per_token=config.get("num_experts_per_tok", 1) or 1,
             n_shared_experts=config.get("n_shared_experts", 0) or 0,
             routed_scaling_factor=config.get("routed_scaling_factor", 1.0),
+            scoring_func=config.get("scoring_func", "softmax"),
+            n_group=config.get("n_group", 1) or 1,
+            topk_group=config.get("topk_group", 1) or 1,
+            norm_topk_prob=config.get("norm_topk_prob", True),
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             rms_norm_eps=config.get("rms_norm_eps", 1e-6),
             rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=config.get("rope_scaling"),
             tie_word_embeddings=config.get("tie_word_embeddings", False),
         )
 
@@ -129,6 +151,7 @@ class DeepseekConfig:
             qk_rope_head_dim=64, v_head_dim=128, intermediate_size=18432,
             first_k_dense=3, moe_intermediate_size=2048, num_experts=256,
             experts_per_token=8, n_shared_experts=1, routed_scaling_factor=2.5,
+            scoring_func="sigmoid", n_group=8, topk_group=4,
         )
 
     @classmethod
@@ -211,6 +234,10 @@ def init_params(cfg: DeepseekConfig, rng: jax.Array) -> dict:
         moe.update(
             mlp_norm=jnp.ones((km, h), cfg.dtype),
             w_router=norm_init(keys[16], (km, h, e), h),
+            **(
+                {"router_bias": jnp.zeros((km, e), jnp.float32)}
+                if cfg.scoring_func == "sigmoid" else {}
+            ),
             w_gate=norm_init(keys[17], (km, e, h, mi), h),
             w_up=norm_init(keys[18], (km, e, h, mi), h),
             w_down=norm_init(keys[19], (km, e, mi, h), mi),
@@ -264,6 +291,10 @@ def param_specs(cfg: DeepseekConfig) -> dict:
         moe.update(
             mlp_norm=P(None, None),
             w_router=P(None, None, None),
+            **(
+                {"router_bias": P(None, None)}
+                if cfg.scoring_func == "sigmoid" else {}
+            ),
             # routed experts over 'ep', within-expert FFN over 'tp'
             w_gate=P(None, "ep", None, "tp"),
             w_up=P(None, "ep", None, "tp"),
@@ -301,7 +332,12 @@ def kv_cache_specs(cfg: DeepseekConfig) -> dict:
 
 
 def make_rope_tables(cfg: DeepseekConfig):
-    return rope_table(cfg.max_position_embeddings, cfg.qk_rope_head_dim, cfg.rope_theta)
+    # DeepSeek applies the YaRN temperature on the softmax scale
+    # (attn_scale = mscale**2 / sqrt(d)), not baked into the tables
+    return rope_table(
+        cfg.max_position_embeddings, cfg.qk_rope_head_dim, cfg.rope_theta,
+        scaling=cfg.rope_scaling, yarn_apply_attention_factor=False,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -352,7 +388,7 @@ def _mla_prefill_attn(w, x, cfg: DeepseekConfig, positions, seq_len, k_layer, v_
     k_nope = jnp.einsum("tr,rhn->thn", c_kv, w_uk)
     v = jnp.einsum("tr,rhv->thv", c_kv, w_uv)
 
-    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
+    scale = jnp.float32(cfg.attn_scale)
     logits = (
         jnp.einsum("qhn,khn->hqk", q_nope.astype(jnp.float32), k_nope.astype(jnp.float32))
         + jnp.einsum("qhp,kp->hqk", q_rope.astype(jnp.float32), k_rope.astype(jnp.float32))
@@ -395,7 +431,7 @@ def _mla_prefill_attn_with_prefix(
 
     w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
     w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
-    scale = 1.0 / jnp.sqrt(jnp.float32(cfg.qk_head_dim))
+    scale = jnp.float32(cfg.attn_scale)
 
     # prefix scores, absorbed: q_lat·ck + q_rope·kr (identical math to
     # decompressing the prefix keys, without materializing them per head)
@@ -461,7 +497,7 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
     q_lat = jnp.einsum("bhn,rhn->bhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32))
 
     num_blocks, block_size = k_layer.shape[0], k_layer.shape[1]
-    scale = 1.0 / float(np.sqrt(cfg.qk_head_dim))
+    scale = float(cfg.attn_scale)
 
     if attention in ("pallas", "pallas_interpret"):
         from dynamo_tpu.ops.pallas.mla_attention import mla_paged_attention_decode
@@ -500,6 +536,10 @@ def _moe_mlp(w, x, cfg: DeepseekConfig):
     routed = moe_ffn(
         x, w["w_router"], w["w_gate"], w["w_up"], w["w_down"],
         top_k=cfg.experts_per_token, capacity_factor=cfg.capacity_factor,
+        router_bias=w.get("router_bias"),
+        scoring="sigmoid_noaux" if cfg.scoring_func == "sigmoid" else "softmax",
+        n_group=cfg.n_group, topk_group=cfg.topk_group,
+        norm_topk_prob=cfg.norm_topk_prob,
     )
     out = routed * jnp.asarray(cfg.routed_scaling_factor, routed.dtype)
     if cfg.n_shared_experts:
@@ -687,7 +727,12 @@ def load_hf_weights(cfg: DeepseekConfig, model_dir) -> dict:
 
     def stack(dicts: list[dict]) -> dict:
         return {
-            k: jnp.asarray(np.stack([d[k] for d in dicts]), cfg.dtype)
+            # e_score_correction_bias must stay fp32: bf16 rounding flips
+            # near-tied expert selections vs the reference
+            k: jnp.asarray(
+                np.stack([d[k] for d in dicts]),
+                jnp.float32 if k == "router_bias" else cfg.dtype,
+            )
             for k in dicts[0]
         }
 
@@ -703,6 +748,8 @@ def load_hf_weights(cfg: DeepseekConfig, model_dir) -> dict:
             )
             dense.append(leaves)
         else:
+            if cfg.scoring_func == "sigmoid":
+                leaves["router_bias"] = get(f"{mlp}.gate.e_score_correction_bias")
             leaves.update(
                 w_router=get(f"{mlp}.gate.weight", True),
                 w_gate=np.stack([

@@ -55,6 +55,8 @@ class LlamaConfig:
     attention_bias: bool = False
     # per-head RMSNorm on q/k after projection, before rope (Qwen3 geometry)
     qk_norm: bool = False
+    # HF rope_scaling dict: "linear" | "llama3" | "yarn" (ops/rope.py)
+    rope_scaling: Any = None
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -76,6 +78,7 @@ class LlamaConfig:
             tie_word_embeddings=config.get("tie_word_embeddings", False),
             attention_bias=config.get("attention_bias", False),
             qk_norm=config.get("qk_norm", config.get("model_type") == "qwen3"),
+            rope_scaling=config.get("rope_scaling"),
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or
@@ -486,7 +489,10 @@ def llama_forward_decode_pp(
 
 
 def make_rope_tables(cfg: LlamaConfig) -> tuple[jnp.ndarray, jnp.ndarray]:
-    return rope_table(cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta)
+    return rope_table(
+        cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta,
+        scaling=cfg.rope_scaling,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -509,18 +515,9 @@ _HF_LAYER_MAP = {
 def load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
     """Load and stack HF llama safetensors into our layer-stacked pytree.
     (HF stores projections as [out, in]; ours are [in, out] → transpose.)"""
-    import numpy as np
-    from safetensors import safe_open
+    from dynamo_tpu.models.hf_io import read_safetensors
 
-    model_dir = Path(model_dir)
-    tensors: dict[str, np.ndarray] = {}
-    files = sorted(model_dir.glob("*.safetensors"))
-    if not files:
-        raise FileNotFoundError(f"no safetensors in {model_dir}")
-    for file in files:
-        with safe_open(str(file), framework="np") as f:
-            for name in f.keys():
-                tensors[name] = f.get_tensor(name)
+    tensors = read_safetensors(model_dir)
 
     def get(name: str, transpose: bool = False):
         t = tensors[name]

@@ -21,12 +21,56 @@ import jax.numpy as jnp
 
 
 def moe_router(x: jnp.ndarray, w_router: jnp.ndarray, top_k: int):
-    """Returns (expert_ids [T, k], probs [T, k]) with renormalized top-k."""
+    """Returns (expert_ids [T, k], probs [T, k]) with renormalized top-k
+    (DeepSeek-V2 / Mixtral style softmax routing)."""
     logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     top_probs, top_ids = jax.lax.top_k(probs, top_k)
     top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
     return top_ids.astype(jnp.int32), top_probs
+
+
+def moe_router_sigmoid_noaux(
+    x: jnp.ndarray,
+    w_router: jnp.ndarray,
+    bias: jnp.ndarray,        # [E] e_score_correction_bias
+    top_k: int,
+    *,
+    n_group: int = 1,
+    topk_group: int = 1,
+    norm_topk_prob: bool = True,
+):
+    """DeepSeek-V3/R1 aux-free routing: sigmoid scores, the load-balancing
+    bias affects SELECTION only, group-limited top-k (pick the best
+    ``topk_group`` of ``n_group`` expert groups by the sum of each group's
+    top-2 biased scores, then top-k experts within), combine weights from
+    the UNBIASED scores renormalized over the chosen experts.
+    (Reference semantics: HF modeling_deepseek noaux_tc / vLLM
+    grouped_topk with scoring_func="sigmoid".)"""
+    t = x.shape[0]
+    e = w_router.shape[-1]
+    logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
+    scores = jax.nn.sigmoid(logits)
+    biased = scores + bias.astype(jnp.float32)[None, :]
+
+    if n_group > 1:
+        grouped = biased.reshape(t, n_group, e // n_group)
+        top2 = jax.lax.top_k(grouped, min(2, e // n_group))[0]
+        group_scores = jnp.sum(top2, axis=-1)                    # [T, G]
+        _, keep_groups = jax.lax.top_k(group_scores, topk_group)  # [T, g]
+        group_mask = jnp.zeros((t, n_group), jnp.float32).at[
+            jnp.arange(t)[:, None], keep_groups
+        ].set(1.0)
+        expert_mask = jnp.repeat(group_mask, e // n_group, axis=-1)
+        biased = jnp.where(expert_mask > 0, biased, -jnp.inf)
+
+    _, top_ids = jax.lax.top_k(biased, top_k)
+    top_scores = jnp.take_along_axis(scores, top_ids, axis=-1)
+    if norm_topk_prob:
+        top_scores = top_scores / (
+            jnp.sum(top_scores, axis=-1, keepdims=True) + 1e-20
+        )
+    return top_ids.astype(jnp.int32), top_scores
 
 
 def moe_dispatch_combine(
@@ -82,11 +126,24 @@ def moe_ffn(
     *,
     top_k: int,
     capacity_factor: float = 2.0,
+    router_bias: jnp.ndarray | None = None,
+    scoring: str = "softmax",     # "softmax" | "sigmoid_noaux"
+    n_group: int = 1,
+    topk_group: int = 1,
+    norm_topk_prob: bool = True,
 ) -> jnp.ndarray:
     t = x.shape[0]
     e = w_gate.shape[0]
     capacity = max(1, int(t * top_k / e * capacity_factor))
-    ids, probs = moe_router(x, w_router, top_k)
+    if scoring == "sigmoid_noaux":
+        ids, probs = moe_router_sigmoid_noaux(
+            x, w_router,
+            router_bias if router_bias is not None else jnp.zeros((e,), jnp.float32),
+            top_k, n_group=n_group, topk_group=topk_group,
+            norm_topk_prob=norm_topk_prob,
+        )
+    else:
+        ids, probs = moe_router(x, w_router, top_k)
     return moe_dispatch_combine(
         x, ids, probs, w_gate, w_up, w_down, capacity=capacity
     )

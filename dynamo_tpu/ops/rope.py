@@ -7,15 +7,103 @@ decode steps at arbitrary offsets are a cheap gather.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 
 
-def rope_table(max_len: int, head_dim: int, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Precompute (cos, sin) tables, shape [max_len, head_dim//2], float32."""
+def _llama3_scale_freqs(freqs: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Llama-3.1 frequency-dependent scaling: long wavelengths divide by
+    ``factor``, short ones stay, a smooth ramp interpolates between
+    (reference semantics: HF modeling_rope_utils _compute_llama3_parameters)."""
+    factor = float(scaling.get("factor", 8.0))
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling.get("original_max_position_embeddings", 8192))
+
+    wavelen = 2.0 * math.pi / freqs
+    low_wavelen = orig / low
+    high_wavelen = orig / high
+    smooth = (orig / wavelen - low) / (high - low)
+    interp = (1.0 - smooth) * (freqs / factor) + smooth * freqs
+    out = jnp.where(wavelen > low_wavelen, freqs / factor, freqs)
+    mid = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(mid, interp, out)
+
+
+def _yarn_scale_freqs(freqs: jnp.ndarray, half: int, theta: float, scaling: dict) -> jnp.ndarray:
+    """YaRN NTK-by-parts interpolation (reference semantics: the YaRN paper
+    / HF _compute_yarn_parameters; DeepSeek-V2+ long-context rope): dims
+    whose rotations at the original context are many (high-frequency)
+    extrapolate (keep), few (low-frequency) interpolate (divide by factor),
+    with a linear ramp between ``beta_fast`` and ``beta_slow`` rotations."""
+    factor = float(scaling.get("factor", 1.0))
+    orig = float(scaling.get("original_max_position_embeddings", 4096))
+    beta_fast = float(scaling.get("beta_fast", 32.0))
+    beta_slow = float(scaling.get("beta_slow", 1.0))
+
+    def dim_for_rotations(rot: float) -> float:
+        # dim index whose wavelength fits `rot` rotations in `orig` tokens
+        return (2 * half) * math.log(orig / (rot * 2 * math.pi)) / (2 * math.log(theta))
+
+    low = max(math.floor(dim_for_rotations(beta_fast)), 0)
+    high = min(math.ceil(dim_for_rotations(beta_slow)), half - 1)
+    ramp = jnp.clip(
+        (jnp.arange(half, dtype=jnp.float32) - low) / max(high - low, 1e-3), 0.0, 1.0
+    )
+    extrapolation = freqs            # high-frequency dims keep
+    interpolation = freqs / factor   # low-frequency dims stretch
+    return interpolation * ramp + extrapolation * (1.0 - ramp)
+
+
+def yarn_mscale(scaling: dict | None) -> float:
+    """YaRN attention-temperature correction: multiply the softmax scale by
+    ``mscale**2`` (DeepSeek convention, mscale_all_dim)."""
+    if not scaling or scaling.get("rope_type", scaling.get("type")) != "yarn":
+        return 1.0
+    factor = float(scaling.get("factor", 1.0))
+    m_all = float(scaling.get("mscale_all_dim", 0.0) or scaling.get("mscale", 1.0))
+    if factor <= 1.0 or not m_all:
+        return 1.0
+    return 0.1 * m_all * math.log(factor) + 1.0
+
+
+def rope_table(
+    max_len: int, head_dim: int, theta: float = 10000.0,
+    scaling: dict | None = None,
+    *,
+    yarn_apply_attention_factor: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Precompute (cos, sin) tables, shape [max_len, head_dim//2], float32.
+
+    ``scaling`` is an HF ``rope_scaling`` dict: type "linear", "llama3"
+    (Llama-3.1+) or "yarn".  For yarn, HF's llama-family convention bakes
+    the attention temperature (``attention_factor``, default
+    0.1*ln(factor)+1) into the tables — both q and k scale by it, squaring
+    into the logits.  DeepSeek compensates on the softmax scale instead
+    (``yarn_mscale``), so its caller passes
+    ``yarn_apply_attention_factor=False``."""
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    attn_factor = 1.0
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type", ""))
+        if kind == "linear":
+            freqs = freqs / float(scaling.get("factor", 1.0))
+        elif kind == "llama3":
+            freqs = _llama3_scale_freqs(freqs, scaling)
+        elif kind == "yarn":
+            freqs = _yarn_scale_freqs(freqs, half, theta, scaling)
+            if yarn_apply_attention_factor:
+                factor = float(scaling.get("factor", 1.0))
+                attn_factor = float(
+                    scaling.get("attention_factor")
+                    or (0.1 * math.log(factor) + 1.0 if factor > 1.0 else 1.0)
+                )
+        elif kind:
+            raise NotImplementedError(f"rope_scaling type {kind!r}")
     angles = jnp.arange(max_len, dtype=jnp.float32)[:, None] * freqs[None, :]
-    return jnp.cos(angles), jnp.sin(angles)
+    return jnp.cos(angles) * attn_factor, jnp.sin(angles) * attn_factor
 
 
 def apply_rope(

@@ -96,3 +96,48 @@ def test_mla_paged_attention_matches_reference():
     weights = jax.nn.softmax(logits, axis=-1)
     ref = jnp.einsum("bht,btr->bhr", weights, ck_g)
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_rope_scaling_llama3_and_yarn():
+    """rope_table scaling: llama3 divides long-wavelength freqs by the
+    factor and keeps short ones; yarn interpolates low-frequency dims and
+    extrapolates high-frequency ones; mscale follows 0.1*m*ln(s)+1."""
+    import math
+
+    from dynamo_tpu.ops.rope import rope_table, yarn_mscale
+
+    head_dim, theta = 64, 500000.0
+    base_cos, _ = rope_table(64, head_dim, theta)
+
+    l3 = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+          "high_freq_factor": 4.0, "original_max_position_embeddings": 8192}
+    cos3, sin3 = rope_table(64, head_dim, theta, scaling=l3)
+    # dim 0 is the highest frequency (shortest wavelength): unscaled
+    np.testing.assert_allclose(cos3[:, 0], base_cos[:, 0], rtol=1e-6)
+    # the last dim is lowest frequency: angle divided by exactly the factor
+    # (small angles: assert via sin, which preserves them in float32)
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (np.arange(half) / half))
+    np.testing.assert_allclose(
+        float(sin3[63, -1]), math.sin(63 * freqs[-1] / 8.0), rtol=1e-4
+    )
+
+    yarn = {"rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 4096,
+            "beta_fast": 32, "beta_slow": 1, "mscale_all_dim": 1.0}
+    m = 0.1 * math.log(4.0) + 1.0  # HF attention_factor baked into tables
+    cosy, siny = rope_table(64, head_dim, theta, scaling=yarn)
+    # highest-frequency dim extrapolates (angle unscaled, amplitude * m)
+    np.testing.assert_allclose(cosy[:, 0], base_cos[:, 0] * m, rtol=1e-6)
+    # lowest-frequency dim interpolates (angle / factor)
+    np.testing.assert_allclose(
+        float(siny[63, -1]), m * math.sin(63 * freqs[-1] / 4.0), rtol=1e-4
+    )
+    # DeepSeek convention: tables unscaled (temperature rides attn_scale)
+    cosd, _ = rope_table(
+        64, head_dim, theta, scaling=yarn, yarn_apply_attention_factor=False
+    )
+    np.testing.assert_allclose(cosd[:, 0], base_cos[:, 0], rtol=1e-6)
+    assert abs(yarn_mscale(yarn) - (0.1 * math.log(4.0) + 1.0)) < 1e-9
+    assert yarn_mscale(None) == 1.0
+    assert yarn_mscale({"rope_type": "llama3"}) == 1.0
