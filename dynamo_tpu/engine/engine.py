@@ -40,6 +40,7 @@ from dynamo_tpu.llm.protocols.common import (
     FinishReason,
     LLMEngineOutput,
     PreprocessedRequest,
+    StopConditions,
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
@@ -795,6 +796,44 @@ class JaxLlmEngine:
                 cancel_task.cancel()
 
         return ResponseStream(gen(), ctx)
+
+    async def warmup(self) -> None:
+        """Compile every serving program up front: one throwaway greedy
+        request per prefill bucket (which also compiles the decode program
+        on its first window), then a full cache flush so warmup blocks
+        never pollute prefix-reuse state or router indexes.  Production
+        cold-start pays compiles here instead of on the first user
+        request."""
+        rng = np.random.default_rng(0x5EED)
+        # the prefill jit emits the first token itself, so compiling the
+        # decode program needs at least one full decode window on top
+        want_tokens = self.config.decode_steps + 1
+        prev = 0
+        for bucket in self.buckets:
+            # prompt must land IN this bucket (> prev) and leave room for
+            # at least one generated token under max_len
+            n = min(bucket, self.max_len - 1)
+            if n <= prev or n < 2:
+                logger.debug("warmup: bucket %d unreachable under max_len", bucket)
+                prev = bucket
+                continue
+            prev = bucket
+            max_toks = min(want_tokens, self.max_len - n)
+            # distinct tokens per bucket: identical prompts would prefix-hit
+            # and compile the continued-prefill jit instead of this bucket's
+            tokens = rng.integers(
+                2, max(3, self.config.model.vocab_size - 2), size=n
+            ).tolist()
+            req = PreprocessedRequest(
+                token_ids=tokens,
+                stop=StopConditions(max_tokens=max_toks, ignore_eos=True),
+                eos_token_ids=[],
+            )
+            req.sampling.use_greedy = True
+            stream = await self.generate(Context(req.to_wire()))
+            async for _ in stream:
+                pass
+        await self.clear_kv_blocks()
 
     async def clear_kv_blocks(self) -> None:
         """Admin flush: drop published prefix-cache state (runs on the device

@@ -62,7 +62,10 @@ def yarn_mscale(scaling: dict | None) -> float:
     if not scaling or scaling.get("rope_type", scaling.get("type")) != "yarn":
         return 1.0
     factor = float(scaling.get("factor", 1.0))
-    m_all = float(scaling.get("mscale_all_dim", 0.0) or scaling.get("mscale", 1.0))
+    # HF DeepSeek applies the softmax-scale correction only when
+    # mscale_all_dim is nonzero ("mscale" alone affects the reference's
+    # cos/sin ratio, not the softmax temperature)
+    m_all = float(scaling.get("mscale_all_dim", 0.0) or 0.0)
     if factor <= 1.0 or not m_all:
         return 1.0
     return 0.1 * m_all * math.log(factor) + 1.0

@@ -111,6 +111,7 @@ async def serve_worker(
         engine.start()
         service = await ep.serve(engine, stats_handler=engine.stats)
     elif engine_kind == "jax":
+        do_warmup = engine_overrides.pop("warmup", False)
         # publishers are wired before the engine so allocator events flow
         engine = build_jax_engine(model_dir, mdc, **engine_overrides)
         service = await ep.serve(engine, stats_handler=engine.stats)
@@ -125,6 +126,10 @@ async def serve_worker(
         clear_listener.start()
         publishers = [kv_pub, metrics_pub, clear_listener]
         engine.start()
+        if do_warmup:
+            # compile every serving program before the model registers:
+            # the first user request must not pay cold-start compiles
+            await engine.warmup()
     else:
         raise ValueError(f"unknown engine kind {engine_kind!r}")
 

@@ -405,3 +405,19 @@ async def test_sp_mesh_engine_matches_dense_reference():
         assert tokens == greedy_reference(prompt, len(tokens))
     finally:
         engine.stop()
+
+
+async def test_warmup_compiles_and_leaves_no_state():
+    """warmup() drives every prefill bucket then flushes: no resident
+    blocks, empty prefix registry, and a following request is exact."""
+    engine = make_engine()
+    try:
+        await engine.warmup()
+        assert engine.allocator.used_blocks == 0
+        assert not engine.allocator._hash_to_block  # registry flushed
+        assert engine.allocator.cached_blocks == 0
+        prompt = [5, 6, 7, 8]
+        tokens, _ = await collect(engine, request(prompt, max_tokens=4))
+        assert tokens == greedy_reference(prompt, 4)
+    finally:
+        engine.stop()
