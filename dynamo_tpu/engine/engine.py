@@ -189,7 +189,17 @@ class JaxLlmEngine:
             except Exception:  # RuntimeError: unable to initialize backend
                 logger.warning("backend probe failed; using gather-based attention")
                 backend = "unknown"
-            self.attention_impl = "pallas" if (backend == "tpu" and self.mesh is None) else "jax"
+            mesh_ok = self.mesh is None or (
+                self.family.decode_accepts_tp_mesh
+                and all(
+                    getattr(config.mesh, a) == 1 for a in ("ep", "sp", "pp")
+                )
+                # shard_map needs even head sharding; the GSPMD gather path
+                # handles uneven tp fine, so fall back there
+                and getattr(cfg, "num_kv_heads", 0) % config.mesh.tp == 0
+                and getattr(cfg, "num_heads", 0) % config.mesh.tp == 0
+            )
+            self.attention_impl = "pallas" if (backend == "tpu" and mesh_ok) else "jax"
         else:
             self.attention_impl = config.attention_impl
 
@@ -481,9 +491,17 @@ class JaxLlmEngine:
                     params, cfg, tokens, cache, tables, lens, slots,
                     self.cos, self.sin, pp_mesh=self.mesh,
                 )
+            kwargs = {"attention": self.attention_impl}
+            if (
+                self.mesh is not None
+                and self.attention_impl.startswith("pallas")
+                and self.family.decode_accepts_tp_mesh
+            ):
+                # the pallas kernel runs per tp shard under shard_map
+                kwargs["tp_mesh"] = self.mesh
             return self.family.forward_decode(
                 params, cfg, tokens, cache, tables, lens, slots,
-                self.cos, self.sin, attention=self.attention_impl,
+                self.cos, self.sin, **kwargs,
             )
 
         lanes = self.config.max_batch_size
@@ -808,19 +826,10 @@ class JaxLlmEngine:
         # the prefill jit emits the first token itself, so compiling the
         # decode program needs at least one full decode window on top
         want_tokens = self.config.decode_steps + 1
-        prev = 0
-        for bucket in self.buckets:
-            # prompt must land IN this bucket (> prev) and leave room for
-            # at least one generated token under max_len
-            n = min(bucket, self.max_len - 1)
-            if n <= prev or n < 2:
-                logger.debug("warmup: bucket %d unreachable under max_len", bucket)
-                prev = bucket
-                continue
-            prev = bucket
-            max_toks = min(want_tokens, self.max_len - n)
-            # distinct tokens per bucket: identical prompts would prefix-hit
-            # and compile the continued-prefill jit instead of this bucket's
+
+        async def drive(n: int, max_toks: int) -> None:
+            # distinct tokens per call: identical prompts would prefix-hit
+            # and compile the continued-prefill jit instead of the target
             tokens = rng.integers(
                 2, max(3, self.config.model.vocab_size - 2), size=n
             ).tolist()
@@ -833,6 +842,31 @@ class JaxLlmEngine:
             stream = await self.generate(Context(req.to_wire()))
             async for _ in stream:
                 pass
+
+        prev = 0
+        for bucket in self.buckets:
+            if self.chunk_tokens is not None and bucket > self.chunk_tokens:
+                # chunked serving never runs full-prompt programs above the
+                # chunk budget; the chunk pipeline warms below
+                prev = bucket
+                continue
+            # prompt must land IN this bucket (> prev), preferring room for
+            # a full decode window under max_len (shrink max_tokens only
+            # when the bucket itself touches max_len)
+            n = min(bucket, self.max_len - want_tokens)
+            if n <= prev:
+                n = min(bucket, self.max_len - 1)
+            if n <= prev or n < 2:
+                logger.debug("warmup: bucket %d unreachable under max_len", bucket)
+                prev = bucket
+                continue
+            prev = bucket
+            await drive(n, min(want_tokens, self.max_len - n))
+        if self.chunk_tokens is not None and self.max_len > self.chunk_tokens + 1:
+            # one longer prompt compiles the chunk + continued-prefill jits
+            n = min(2 * self.chunk_tokens, self.max_len - want_tokens)
+            if n > self.chunk_tokens:
+                await drive(n, min(want_tokens, self.max_len - n))
         await self.clear_kv_blocks()
 
     async def clear_kv_blocks(self) -> None:

@@ -396,12 +396,16 @@ def llama_forward_decode(
     sin: jnp.ndarray,
     *,
     attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
+    tp_mesh=None,
 ) -> tuple[jnp.ndarray, dict]:
     """Batched single-token decode.  Returns (logits [batch, vocab], cache).
 
     ``attention="pallas"`` uses the Pallas paged-attention kernel (no
-    materialized page gather) — single-chip only until the shard_map
-    integration lands; "jax" is the portable gather-based fallback.
+    materialized page gather); with ``tp_mesh`` the kernel runs under
+    shard_map per tp shard — queries sharded on the head axis, cache on the
+    kv-head axis (head order is kv-major, so contiguous head chunks align
+    with their kv heads) — and GSPMD handles everything around it.
+    "jax" is the portable gather-based fallback.
     """
     b = token_ids.shape[0]
     x = params["embed"][token_ids].astype(cfg.dtype)  # [b, h]
@@ -411,9 +415,27 @@ def llama_forward_decode(
         if attention.startswith("pallas"):
             from dynamo_tpu.ops.pallas import paged_attention_decode
 
+            interpret = attention == "pallas_interpret"
+            if tp_mesh is not None and tp_mesh.shape.get("tp", 1) > 1:
+                kernel = jax.shard_map(
+                    lambda q_, k_, v_, bt, cl: paged_attention_decode(
+                        q_, k_, v_, bt, cl, interpret=interpret
+                    ),
+                    mesh=tp_mesh,
+                    in_specs=(
+                        P(None, "tp", None),        # q: heads sharded
+                        P(None, None, "tp", None),  # cache: kv heads sharded
+                        P(None, None, "tp", None),
+                        P(),
+                        P(),
+                    ),
+                    out_specs=P(None, "tp", None),
+                    check_vma=False,  # pallas_call outputs carry no vma info
+                )
+                return kernel(q, k_layer, v_layer, block_tables, context_lens)
             return paged_attention_decode(
                 q, k_layer, v_layer, block_tables, context_lens,
-                interpret=attention == "pallas_interpret",
+                interpret=interpret,
             )
         return paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
 

@@ -38,6 +38,8 @@ class ModelFamily:
     forward_decode_pp: Callable | None = None
     # HF safetensors loader: (cfg, model_dir) -> params pytree
     load_weights: Callable | None = None
+    # forward_decode accepts tp_mesh= (shard_map'd pallas attention)
+    decode_accepts_tp_mesh: bool = False
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -91,6 +93,7 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         supports_sp=True,
         forward_decode_pp=llama.llama_forward_decode_pp,
         load_weights=llama.load_hf_weights,
+        decode_accepts_tp_mesh=True,
     )
 
 
