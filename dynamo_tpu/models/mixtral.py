@@ -39,6 +39,13 @@ class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     experts_per_token: int = 2
     capacity_factor: float = 2.0
+    # expert FFN width; 0 = same as intermediate_size (Mixtral proper).
+    # Qwen3-MoE configs carry a distinct moe_intermediate_size.
+    moe_intermediate_size: int = 0
+
+    @property
+    def expert_intermediate_size(self) -> int:
+        return self.moe_intermediate_size or self.intermediate_size
 
     @classmethod
     def mixtral_8x7b(cls) -> "MixtralConfig":
@@ -75,14 +82,21 @@ class MixtralConfig(LlamaConfig):
             max_position_embeddings=config.get("max_position_embeddings", 4096),
             rms_norm_eps=config.get("rms_norm_eps", 1e-5),
             rope_theta=config.get("rope_theta", 1e6),
-            num_experts=config.get("num_local_experts", 8),
+            num_experts=config.get("num_local_experts", 0)
+            or config.get("num_experts", 8),
             experts_per_token=config.get("num_experts_per_tok", 2),
+            moe_intermediate_size=config.get("moe_intermediate_size", 0) or 0,
+            qk_norm=config.get(
+                "qk_norm", config.get("model_type") == "qwen3_moe"
+            ),
         )
 
 
 def init_params(cfg: MixtralConfig, rng: jax.Array) -> dict:
     keys = jax.random.split(rng, 12)
-    h, i, l_, e = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.num_experts
+    h, i, l_, e = (
+        cfg.hidden_size, cfg.expert_intermediate_size, cfg.num_layers, cfg.num_experts
+    )
     qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
 
     def norm_init(key, shape, fan_in):
@@ -104,6 +118,9 @@ def init_params(cfg: MixtralConfig, rng: jax.Array) -> dict:
             "w_down": norm_init(keys[8], (l_, e, i, h), i),
         },
     }
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((l_, cfg.head_dim), cfg.dtype)
+        params["layers"]["k_norm"] = jnp.ones((l_, cfg.head_dim), cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm_init(keys[9], (h, cfg.vocab_size), h)
     return params
@@ -128,6 +145,9 @@ def param_specs(cfg: MixtralConfig) -> dict:
             "w_down": P(None, "ep", "tp", None),
         },
     }
+    if cfg.qk_norm:
+        specs["layers"]["q_norm"] = P(None, None)
+        specs["layers"]["k_norm"] = P(None, None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
@@ -161,6 +181,9 @@ def _prefill_trunk(params, cfg: MixtralConfig, token_ids, kv_cache,
             q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
             k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
             v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
+                q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
             q = apply_rope(q, positions, cos, sin)
             k = apply_rope(k, positions, cos, sin)
             attn_out, state["kv"] = attend(q, k, v, k_layer, v_layer)
@@ -245,6 +268,9 @@ def mixtral_forward_decode(
             q = (attn_in @ w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
             k = (attn_in @ w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
             v = (attn_in @ w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
+                q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
             q = apply_rope(q[:, None], positions[:, None], cos, sin)[:, 0]
             k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
             state["kv"] = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
@@ -286,7 +312,7 @@ def load_hf_weights(cfg: MixtralConfig, model_dir) -> dict:
     names = (
         "attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
         "w_router", "w_gate", "w_up", "w_down",
-    )
+    ) + (("q_norm", "k_norm") if cfg.qk_norm else ())
     layers: dict[str, list] = {k: [] for k in names}
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}"
@@ -296,20 +322,21 @@ def load_hf_weights(cfg: MixtralConfig, model_dir) -> dict:
         layers["wv"].append(get(f"{p}.self_attn.v_proj.weight", True))
         layers["wo"].append(get(f"{p}.self_attn.o_proj.weight", True))
         layers["mlp_norm"].append(get(f"{p}.post_attention_layernorm.weight"))
-        layers["w_router"].append(get(f"{p}.block_sparse_moe.gate.weight", True))
-        # experts: w1=gate, w3=up, w2=down (llama.cpp/HF Mixtral naming)
-        layers["w_gate"].append(np.stack([
-            get(f"{p}.block_sparse_moe.experts.{e}.w1.weight", True)
-            for e in range(cfg.num_experts)
-        ]))
-        layers["w_up"].append(np.stack([
-            get(f"{p}.block_sparse_moe.experts.{e}.w3.weight", True)
-            for e in range(cfg.num_experts)
-        ]))
-        layers["w_down"].append(np.stack([
-            get(f"{p}.block_sparse_moe.experts.{e}.w2.weight", True)
-            for e in range(cfg.num_experts)
-        ]))
+        if cfg.qk_norm:
+            layers["q_norm"].append(get(f"{p}.self_attn.q_norm.weight"))
+            layers["k_norm"].append(get(f"{p}.self_attn.k_norm.weight"))
+        if f"{p}.block_sparse_moe.gate.weight" in tensors:
+            # Mixtral naming: w1=gate, w3=up, w2=down
+            moe_p, hf_names = f"{p}.block_sparse_moe", ("w1", "w3", "w2")
+        else:
+            # Qwen3-MoE naming: mlp.experts.{e}.gate/up/down_proj
+            moe_p, hf_names = f"{p}.mlp", ("gate_proj", "up_proj", "down_proj")
+        layers["w_router"].append(get(f"{moe_p}.gate.weight", True))
+        for ours, theirs in zip(("w_gate", "w_up", "w_down"), hf_names):
+            layers[ours].append(np.stack([
+                get(f"{moe_p}.experts.{e}.{theirs}.weight", True)
+                for e in range(cfg.num_experts)
+            ]))
 
     params = {
         "embed": jnp.asarray(get("model.embed_tokens.weight"), cfg.dtype),

@@ -128,6 +128,31 @@ def _mixtral_family() -> ModelFamily:
     )
 
 
+def _qwen3_moe_family() -> ModelFamily:
+    # Qwen3-MoE = Mixtral-style routed experts + per-head q/k RMSNorm
+    from dynamo_tpu.models import mixtral
+
+    def config_from_hf(config):
+        import json
+
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        config = dict(config)
+        config.setdefault("qk_norm", True)
+        return mixtral.MixtralConfig.from_hf_config(config)
+
+    return ModelFamily(
+        name="qwen3_moe",
+        config_from_hf=config_from_hf,
+        init_params=mixtral.init_params,
+        param_specs=mixtral.param_specs,
+        forward_prefill=mixtral.mixtral_forward_prefill,
+        forward_decode=mixtral.mixtral_forward_decode,
+        forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
+        load_weights=mixtral.load_hf_weights,
+    )
+
+
 def _deepseek_family() -> ModelFamily:
     from dynamo_tpu.models import deepseek
 
@@ -151,6 +176,7 @@ _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "qwen2": _qwen2_family,
     "qwen3": _qwen3_family,
     "mixtral": _mixtral_family,
+    "qwen3_moe": _qwen3_moe_family,
     # HF model_type keys for the MLA architectures only — classic
     # DeepSeek-MoE ("deepseek") uses conventional attention and would need
     # its own family

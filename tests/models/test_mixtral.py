@@ -126,3 +126,102 @@ def test_mixtral_ep_sharded_matches_single():
     np.testing.assert_allclose(
         np.asarray(logits_ep), np.asarray(logits_single), rtol=2e-3, atol=2e-3
     )
+
+
+def test_qwen3_moe_qk_norm_prefill_decode_consistency():
+    """Qwen3-MoE geometry (MoE + per-head qk-norm): decode at position t
+    must match prefill logits at the same position."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg = dataclasses.replace(CFG, qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    params["layers"]["q_norm"] = (
+        1.0 + 0.3 * jax.random.normal(jax.random.PRNGKey(6),
+                                      params["layers"]["q_norm"].shape)
+    ).astype(cfg.dtype)
+    params["layers"]["k_norm"] = (
+        1.0 - 0.2 * jax.random.normal(jax.random.PRNGKey(7),
+                                      params["layers"]["k_norm"].shape)
+    ).astype(cfg.dtype)
+    cos, sin = make_rope_tables(cfg)
+    prompt = list(range(3, 11))
+    cache = init_kv_cache(CFG, NUM_BLOCKS, BLOCK_SIZE)
+    blocks = jnp.asarray([0, 1, 2], jnp.int32)
+    logits, cache = mixtral_forward_prefill(
+        params, cfg, jnp.asarray(prompt, jnp.int32), cache, blocks,
+        jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+    )
+    nxt = int(jnp.argmax(logits))
+    full = prompt + [nxt]
+    cache2 = init_kv_cache(CFG, NUM_BLOCKS, BLOCK_SIZE)
+    ref, _ = mixtral_forward_prefill(
+        params, cfg, jnp.asarray(full, jnp.int32), cache2, blocks,
+        jnp.int32(len(full)), jnp.int32(0), cos, sin,
+    )
+    tables = blocks[None, :]
+    dec, _ = mixtral_forward_decode(
+        params, cfg, jnp.asarray([nxt], jnp.int32), cache, tables,
+        jnp.asarray([len(full)], jnp.int32),
+        jnp.asarray([len(prompt)], jnp.int32), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(dec[0]), np.asarray(ref), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_qwen3_moe_registry_and_loader(tmp_path):
+    """qwen3_moe family: config flags flow, and the loader reads the
+    Qwen3-MoE expert naming (mlp.experts.{e}.gate_proj) + q/k norms."""
+    import dataclasses
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.models.registry import get_family
+
+    fam = get_family("qwen3_moe")
+    cfg = fam.config_from_hf(
+        {
+            "vocab_size": 512, "hidden_size": 64, "intermediate_size": 96,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "head_dim": 16,
+            "num_experts": 4, "num_experts_per_tok": 2,
+            "tie_word_embeddings": True,
+        }
+    )
+    assert cfg.qk_norm and cfg.num_experts == 4
+
+    cfg = dataclasses.replace(CFG, qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    L = params["layers"]
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        tensors[f"{p}.input_layernorm.weight"] = np.asarray(L["attn_norm"][i], np.float32)
+        for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
+            tensors[f"{p}.self_attn.{theirs}.weight"] = np.ascontiguousarray(
+                np.asarray(L[ours][i], np.float32).T
+            )
+        tensors[f"{p}.self_attn.q_norm.weight"] = np.asarray(L["q_norm"][i], np.float32)
+        tensors[f"{p}.self_attn.k_norm.weight"] = np.asarray(L["k_norm"][i], np.float32)
+        tensors[f"{p}.post_attention_layernorm.weight"] = np.asarray(L["mlp_norm"][i], np.float32)
+        tensors[f"{p}.mlp.gate.weight"] = np.ascontiguousarray(
+            np.asarray(L["w_router"][i], np.float32).T
+        )
+        for e in range(cfg.num_experts):
+            for ours, theirs in (("w_gate", "gate_proj"), ("w_up", "up_proj"), ("w_down", "down_proj")):
+                tensors[f"{p}.mlp.experts.{e}.{theirs}.weight"] = np.ascontiguousarray(
+                    np.asarray(L[ours][i, e], np.float32).T
+                )
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    loaded = fam.load_weights(cfg, tmp_path)
+    for k in L:
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][k]), np.asarray(L[k]), atol=1e-6,
+            err_msg=k,
+        )
