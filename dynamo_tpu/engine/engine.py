@@ -87,6 +87,10 @@ class EngineConfig:
     # Reference: block manager G1→G2 offload, lib/llm/src/block_manager/
     # offload.rs:77-80.
     host_offload_blocks: int = 0
+    # G3 SSD tier: host-LRU evictions cascade to an np.memmap disk pool and
+    # restore from there (0 = off; needs host_offload_blocks > 0).
+    disk_offload_blocks: int = 0
+    disk_offload_path: str | None = None
     # Compile-time K for per-token top-k alternatives (OpenAI
     # top_logprobs caps at 20).  K>0 adds one lax.top_k over [lanes, vocab]
     # to every step (the host transfer of the rows is skipped unless a
@@ -283,11 +287,14 @@ class JaxLlmEngine:
                 config.host_offload_blocks,
                 {k: (v.shape[0], *v.shape[2:]) for k, v in leaves.items()},
                 {k: np.dtype(v.dtype) for k, v in leaves.items()},
+                disk_blocks=config.disk_offload_blocks,
+                disk_path=config.disk_offload_path,
             )
             offload_sink = self._offload_blocks
-            # a hash evicted from the host LRU while no longer device-
-            # resident exists in no tier: routers must forget it
-            self.host_tier.pool.evict_sink = self._host_evicted
+            # a hash that left EVERY tier (fell off the host LRU with no
+            # disk spill, or off the disk LRU) while no longer device-
+            # resident: routers must forget it
+            self.host_tier.evict_observer = self._host_evicted
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event,
             enable_prefix_caching=self.prefix_caching,
@@ -615,6 +622,8 @@ class JaxLlmEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.host_tier is not None:
+            self.host_tier.close()  # release + delete the G3 memmap
 
     # -- async engine interface -------------------------------------------
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:

@@ -16,6 +16,8 @@ tier catches blocks evicted from it:
 
 from __future__ import annotations
 
+import pathlib
+
 import numpy as np
 
 from dynamo_tpu.llm.block_manager.pool import BlockPool
@@ -26,13 +28,20 @@ logger = get_logger("engine.offload")
 
 
 class HostOffloadTier:
-    """Hash-addressed host pool of serialized KV blocks (G2).
+    """Hash-addressed host pool of serialized KV blocks (G2), with an
+    optional G3 spill: blocks evicted from the host LRU cascade to a
+    disk-backed pool (np.memmap SSD tier) and restore from there on a
+    later prefix hit — the reference's G1→G2→G3 offload chain
+    (lib/llm/src/block_manager/offload.rs).
 
     Payload layout: per block, the concatenated raw bytes of each cache leaf
     slice ``leaf[:, block_id]`` in sorted leaf-name order.
     """
 
-    def __init__(self, num_blocks: int, leaf_shapes: dict, leaf_dtypes: dict):
+    def __init__(
+        self, num_blocks: int, leaf_shapes: dict, leaf_dtypes: dict,
+        *, disk_blocks: int = 0, disk_path=None,
+    ):
         self._names = sorted(leaf_shapes)
         self._shapes = {n: tuple(leaf_shapes[n]) for n in self._names}
         self._dtypes = {n: np.dtype(leaf_dtypes[n]) for n in self._names}
@@ -44,18 +53,79 @@ class HostOffloadTier:
         self.pool = BlockPool(
             HostStorage(num_blocks, (self.block_nbytes,), np.uint8), tier_name="g2"
         )
+        self.disk: BlockPool | None = None
+        self._disk_path = None
+        if disk_blocks:
+            import os
+            import uuid
+
+            from dynamo_tpu.llm.block_manager.storage import DiskStorage
+
+            # unique per tier: a fixed shared path would let a second
+            # engine's mode="w+" memmap truncate this engine's live pool
+            self._disk_path = pathlib.Path(
+                disk_path
+                or f"/tmp/dynamo_tpu_g3.{os.getpid()}.{uuid.uuid4().hex[:8]}.blocks"
+            )
+            self.disk = BlockPool(
+                DiskStorage(
+                    disk_blocks, (self.block_nbytes,), np.uint8,
+                    path=self._disk_path,
+                ),
+                tier_name="g3",
+            )
+            self.disk.evict_sink = self._on_disk_evict
+        self._host_evicted_hash: int | None = None
+        self.pool.evict_sink = self._on_host_evict
+        self.evict_observer = None  # engine hook: hash left EVERY tier
         self.offloads = 0
         self.restores = 0
+        self.disk_spills = 0
+        self.disk_restores = 0
+
+    # -- eviction cascade ----------------------------------------------------
+    def _on_host_evict(self, seq_hash: int) -> None:
+        # allocate() evicted this hash; the caller (put) spills its bytes
+        # to disk before overwriting the host block
+        self._host_evicted_hash = seq_hash
+
+    def _on_disk_evict(self, seq_hash: int) -> None:
+        if self.evict_observer is not None:
+            self.evict_observer(seq_hash)
+
+    def _spill_to_disk(self, seq_hash: int, host_bid: int) -> None:
+        """Copy an evicted host block's (still-resident) bytes down-tier."""
+        if self.disk is None or self.disk.has_hash(seq_hash):
+            self._notify_if_gone(seq_hash)
+            return
+        dbid = self.disk.allocate()
+        if dbid is None:
+            self._notify_if_gone(seq_hash)
+            return
+        self.disk.write([dbid], self.pool.read([host_bid]))
+        self.disk.complete(dbid, 0)
+        self.disk.register(dbid, seq_hash)
+        self.disk.release(dbid)
+        self.disk_spills += 1
+
+    def _notify_if_gone(self, seq_hash: int) -> None:
+        if not self.has(seq_hash) and self.evict_observer is not None:
+            self.evict_observer(seq_hash)
 
     # -- offload (device eviction → host) -----------------------------------
     def put(self, seq_hash: int, leaves: dict) -> bool:
         """Store one evicted block's content; dedupes by hash.  False when
-        the tier is full of pinned blocks (offload skipped)."""
+        the tier is full of pinned blocks (offload skipped).  A host block
+        this put evicts cascades to the disk tier first."""
         if self.pool.has_hash(seq_hash):
             return True
+        self._host_evicted_hash = None
         bid = self.pool.allocate()  # evicts host LRU if needed
         if bid is None:
             return False
+        if self._host_evicted_hash is not None:
+            self._spill_to_disk(self._host_evicted_hash, bid)
+            self._host_evicted_hash = None
         buf = np.concatenate(
             [
                 np.ascontiguousarray(np.asarray(leaves[n])).view(np.uint8).ravel()
@@ -69,26 +139,50 @@ class HostOffloadTier:
         self.offloads += 1
         return True
 
-    # -- restore (host → device) ---------------------------------------------
+    # -- restore (host/disk → device) ----------------------------------------
     def has(self, seq_hash: int) -> bool:
-        return self.pool.has_hash(seq_hash)
+        return self.pool.has_hash(seq_hash) or (
+            self.disk is not None and self.disk.has_hash(seq_hash)
+        )
 
     def pin(self, seq_hash: int) -> bool:
         """Claim a block for an upcoming restore so interleaved offloads
-        can't evict it between match and prefill."""
-        return self.pool.match_hash(seq_hash) is not None
+        can't evict it between match and prefill (whichever tier holds it)."""
+        if self.pool.match_hash(seq_hash) is not None:
+            return True
+        return self.disk is not None and self.disk.match_hash(seq_hash) is not None
 
     def unpin(self, seq_hash: int) -> None:
         bid = self.pool.peek_hash(seq_hash)
         if bid is not None:
             self.pool.release(bid)
+            return
+        if self.disk is not None:
+            dbid = self.disk.peek_hash(seq_hash)
+            if dbid is not None:
+                self.disk.release(dbid)
 
     def read_pinned(self, seq_hash: int) -> dict | None:
-        """Deserialize a pinned block's leaves and release the pin."""
+        """Deserialize a pinned block's leaves and release the pin; disk
+        hits count as restores from G3."""
         bid = self.pool.peek_hash(seq_hash)
         if bid is None:
-            return None
+            if self.disk is None:
+                return None
+            dbid = self.disk.peek_hash(seq_hash)
+            if dbid is None:
+                return None
+            buf = self.disk.read([dbid])[0]
+            self.disk.release(dbid)
+            self.disk_restores += 1
+            self.restores += 1
+            return self._deserialize(buf)
         buf = self.pool.read([bid])[0]
+        self.pool.release(bid)
+        self.restores += 1
+        return self._deserialize(buf)
+
+    def _deserialize(self, buf: np.ndarray) -> dict:
         out = {}
         offset = 0
         for n in self._names:
@@ -97,8 +191,6 @@ class HostOffloadTier:
                 buf[offset : offset + size].view(self._dtypes[n]).reshape(self._shapes[n])
             )
             offset += size
-        self.pool.release(bid)
-        self.restores += 1
         return out
 
     def clear(self) -> None:
@@ -109,12 +201,36 @@ class HostOffloadTier:
             if self.pool.ref_count(h) > 0:
                 continue
             self.pool.drop_hash(h)
+        if self.disk is not None:
+            for h in self.disk.registered_hashes():
+                if self.disk.ref_count(h) > 0:
+                    continue
+                self.disk.drop_hash(h)
+
+    def close(self) -> None:
+        """Release the disk memmap and delete its backing file."""
+        if self.disk is not None:
+            try:
+                self.disk.storage.close()
+            except Exception:  # noqa: BLE001
+                pass
+            if self._disk_path is not None:
+                self._disk_path.unlink(missing_ok=True)
+            self.disk = None
 
     def stats(self) -> dict:
-        return {
+        out = {
             "host_blocks_total": self.pool.num_blocks,
             "host_blocks_used": self.pool.num_blocks - self.pool.free_count,
             "host_offloads_total": self.offloads,
             "host_restores_total": self.restores,
             "host_evictions": self.pool.evictions,
         }
+        if self.disk is not None:
+            out.update(
+                disk_blocks_total=self.disk.num_blocks,
+                disk_spills_total=self.disk_spills,
+                disk_restores_total=self.disk_restores,
+                disk_evictions=self.disk.evictions,
+            )
+        return out

@@ -42,6 +42,9 @@ class MixtralConfig(LlamaConfig):
     # expert FFN width; 0 = same as intermediate_size (Mixtral proper).
     # Qwen3-MoE configs carry a distinct moe_intermediate_size.
     moe_intermediate_size: int = 0
+    # renormalize top-k router weights (Mixtral yes; some Qwen3-MoE
+    # variants disable it)
+    norm_topk_prob: bool = True
 
     @property
     def expert_intermediate_size(self) -> int:
@@ -86,6 +89,9 @@ class MixtralConfig(LlamaConfig):
             or config.get("num_experts", 8),
             experts_per_token=config.get("num_experts_per_tok", 2),
             moe_intermediate_size=config.get("moe_intermediate_size", 0) or 0,
+            norm_topk_prob=config.get("norm_topk_prob", True),
+            tie_word_embeddings=config.get("tie_word_embeddings", False),
+            rope_scaling=config.get("rope_scaling"),
             qk_norm=config.get(
                 "qk_norm", config.get("model_type") == "qwen3_moe"
             ),
@@ -160,6 +166,7 @@ def _block(cfg: MixtralConfig, w, x, attn_fn):
     moe_out = moe_ffn(
         mlp_in, w["w_router"], w["w_gate"], w["w_up"], w["w_down"],
         top_k=cfg.experts_per_token, capacity_factor=cfg.capacity_factor,
+        norm_topk_prob=cfg.norm_topk_prob,
     )
     return x + moe_out
 

@@ -130,20 +130,13 @@ def _mixtral_family() -> ModelFamily:
 
 def _qwen3_moe_family() -> ModelFamily:
     # Qwen3-MoE = Mixtral-style routed experts + per-head q/k RMSNorm
+    # (from_hf_config infers qk_norm from model_type, which the registry
+    # key guarantees is present on any config routed here)
     from dynamo_tpu.models import mixtral
-
-    def config_from_hf(config):
-        import json
-
-        if not isinstance(config, dict):
-            config = json.loads(Path(config).read_text())
-        config = dict(config)
-        config.setdefault("qk_norm", True)
-        return mixtral.MixtralConfig.from_hf_config(config)
 
     return ModelFamily(
         name="qwen3_moe",
-        config_from_hf=config_from_hf,
+        config_from_hf=mixtral.MixtralConfig.from_hf_config,
         init_params=mixtral.init_params,
         param_specs=mixtral.param_specs,
         forward_prefill=mixtral.mixtral_forward_prefill,

@@ -20,13 +20,18 @@ import jax
 import jax.numpy as jnp
 
 
-def moe_router(x: jnp.ndarray, w_router: jnp.ndarray, top_k: int):
-    """Returns (expert_ids [T, k], probs [T, k]) with renormalized top-k
-    (DeepSeek-V2 / Mixtral style softmax routing)."""
+def moe_router(
+    x: jnp.ndarray, w_router: jnp.ndarray, top_k: int,
+    norm_topk_prob: bool = True,
+):
+    """Returns (expert_ids [T, k], probs [T, k]) — softmax routing
+    (DeepSeek-V2 / Mixtral style); ``norm_topk_prob=False`` keeps the raw
+    softmax weights for the selected experts (some Qwen3-MoE variants)."""
     logits = (x.astype(jnp.float32)) @ w_router.astype(jnp.float32)  # [T, E]
     probs = jax.nn.softmax(logits, axis=-1)
     top_probs, top_ids = jax.lax.top_k(probs, top_k)
-    top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
+    if norm_topk_prob:
+        top_probs = top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
     return top_ids.astype(jnp.int32), top_probs
 
 
@@ -143,7 +148,7 @@ def moe_ffn(
             norm_topk_prob=norm_topk_prob,
         )
     else:
-        ids, probs = moe_router(x, w_router, top_k)
+        ids, probs = moe_router(x, w_router, top_k, norm_topk_prob=norm_topk_prob)
     return moe_dispatch_combine(
         x, ids, probs, w_gate, w_up, w_down, capacity=capacity
     )

@@ -116,3 +116,79 @@ async def test_offload_disabled_without_config():
         assert "host_offloads_total" not in engine.stats()
     finally:
         engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# G3 disk tier
+# ---------------------------------------------------------------------------
+
+
+def make_disk_tier(tmp_path, host_n=2, disk_n=4):
+    sample = _leaves()
+    return HostOffloadTier(
+        host_n,
+        {k: v.shape for k, v in sample.items()},
+        {k: v.dtype for k, v in sample.items()},
+        disk_blocks=disk_n,
+        disk_path=tmp_path / "g3.blocks",
+    )
+
+
+def test_host_eviction_spills_to_disk_and_restores(tmp_path):
+    """A block evicted from the host LRU cascades to the disk pool and a
+    later hit restores its exact bytes from G3."""
+    tier = make_disk_tier(tmp_path, host_n=2, disk_n=4)
+    for i in range(4):  # 4 puts into 2 host blocks → 2 cascade to disk
+        assert tier.put(100 + i, _leaves(i))
+    stats = tier.stats()
+    assert stats["disk_spills_total"] == 2, stats
+    # oldest hashes now live only on disk
+    assert tier.has(100) and tier.has(101)
+    assert tier.pin(100)
+    out = tier.read_pinned(100)
+    np.testing.assert_array_equal(out["k"], _leaves(0)["k"])
+    np.testing.assert_array_equal(out["v"], _leaves(0)["v"])
+    assert tier.stats()["disk_restores_total"] == 1
+
+
+def test_disk_eviction_notifies_observer(tmp_path):
+    """When a hash falls off the DISK LRU too (left every tier), the
+    engine's observer hears about it; host evictions that spilled do not
+    notify."""
+    tier = make_disk_tier(tmp_path, host_n=1, disk_n=1)
+    gone: list[int] = []
+    tier.evict_observer = gone.append
+    tier.put(1, _leaves(0))
+    tier.put(2, _leaves(1))   # 1 spills host→disk: no notify
+    assert gone == []
+    tier.put(3, _leaves(2))   # 2 spills; disk evicts 1 → notify(1)
+    assert gone == [1]
+    assert not tier.has(1) and tier.has(2) and tier.has(3)
+
+
+async def test_engine_restores_through_disk_tier(tmp_path):
+    """Engine e2e: tiny host tier + disk tier — blocks pushed off the host
+    LRU restore from G3 with identical output."""
+    engine = make_engine(
+        num_blocks=6, max_batch_size=2, max_model_len=24,
+        host_offload_blocks=2, disk_offload_blocks=16,
+        disk_offload_path=str(tmp_path / "g3.blocks"),
+        prefill_buckets=(16,),
+    )
+    try:
+        prompt_a = list(range(3, 15))
+        ref_a = greedy_reference(prompt_a, 2)
+        out_a, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a == ref_a
+        # churn: two more prompts push A's blocks through host into disk
+        await collect(engine, request(list(range(40, 56)), max_tokens=2, ignore_eos=True))
+        await collect(engine, request(list(range(60, 76)), max_tokens=2, ignore_eos=True))
+        stats = engine.stats()
+        assert stats["disk_spills_total"] >= 1, stats
+
+        out_a2, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a2 == ref_a
+        stats = engine.stats()
+        assert stats["disk_restores_total"] >= 1, stats
+    finally:
+        engine.stop()

@@ -184,14 +184,19 @@ def test_qwen3_moe_registry_and_loader(tmp_path):
     fam = get_family("qwen3_moe")
     cfg = fam.config_from_hf(
         {
+            "model_type": "qwen3_moe",
             "vocab_size": 512, "hidden_size": 64, "intermediate_size": 96,
+            "moe_intermediate_size": 48,
             "num_hidden_layers": 2, "num_attention_heads": 4,
             "num_key_value_heads": 2, "head_dim": 16,
             "num_experts": 4, "num_experts_per_tok": 2,
-            "tie_word_embeddings": True,
+            "tie_word_embeddings": True, "norm_topk_prob": False,
         }
     )
     assert cfg.qk_norm and cfg.num_experts == 4
+    assert cfg.tie_word_embeddings            # must not drop HF fields
+    assert cfg.expert_intermediate_size == 48
+    assert not cfg.norm_topk_prob
 
     cfg = dataclasses.replace(CFG, qk_norm=True)
     params = init_params(cfg, jax.random.PRNGKey(0))
