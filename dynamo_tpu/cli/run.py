@@ -51,6 +51,8 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--max-batch-size", type=int, default=8)
     run.add_argument("--context-length", type=int, default=None)
     run.add_argument("--tensor-parallel-size", type=int, default=1)
+    run.add_argument("--warmup", action="store_true",
+                     help="pre-compile every serving program before registering")
     args = parser.parse_args(argv)
 
     args.input, args.output = "http", "jax"
@@ -89,6 +91,8 @@ async def _run(args) -> int:
                 from dynamo_tpu.parallel.mesh import MeshConfig
 
                 overrides["mesh"] = MeshConfig(tp=args.tensor_parallel_size)
+            if args.warmup:
+                overrides["warmup"] = True
         worker = await serve_worker(
             runtime,
             args.model_path,

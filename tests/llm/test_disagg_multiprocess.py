@@ -85,15 +85,20 @@ async def test_cross_process_disagg_exactness(tmp_path):
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     script = tmp_path / "prefill_worker.py"
     script.write_text(PREFILL_WORKER_SCRIPT)
-    proc = await asyncio.create_subprocess_exec(
-        sys.executable, str(script), address,
-        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE, env=env,
-    )
+    stderr_path = tmp_path / "prefill_worker.stderr"
+    with open(stderr_path, "wb") as stderr_file:
+        proc = await asyncio.create_subprocess_exec(
+            sys.executable, str(script), address,
+            stdout=asyncio.subprocess.PIPE, stderr=stderr_file, env=env,
+        )
     rt = disagg = None
     decode_engine = None
     try:
         line = await asyncio.wait_for(proc.stdout.readline(), 120)
-        assert b"PREFILL_READY" in line, line
+        assert b"PREFILL_READY" in line, (
+            f"worker never came up: stdout={line!r}\n"
+            f"stderr tail:\n{stderr_path.read_text()[-3000:]}"
+        )
 
         cfg = LlamaConfig.tiny()
         decode_engine = JaxLlmEngine(
