@@ -39,7 +39,16 @@ PEAK_FLOPS = [
     ("v2", 45e12),
 ]
 
-MODEL_LADDER = ["llama3_8b", "llama32_3b", "llama32_1b"]
+# (model, weight-only quant) ladder.  int8-first mirrors the reference's
+# headline model being FP8-quantized (examples/llm/benchmarks/README.md:66)
+# and is what makes an 8B-class model fit one v5e's 16GB HBM; bf16 entries
+# remain as fallbacks if the quantized path ever fails to compile.
+MODEL_LADDER = [
+    ("llama3_8b", "int8"),
+    ("llama32_3b", "int8"),
+    ("llama32_3b", None),
+    ("llama32_1b", None),
+]
 
 
 def _peak_flops(device_kind: str, platform: str) -> float | None:
@@ -52,16 +61,11 @@ def _peak_flops(device_kind: str, platform: str) -> float | None:
     return 197e12  # unknown TPU: assume v5e-class
 
 
-def _is_oom(err: BaseException) -> bool:
-    msg = str(err).lower()
-    return "resource_exhausted" in msg or "out of memory" in msg or "oom" in msg
-
-
 class DoesNotFit(Exception):
     """Pre-flight estimate: params+cache exceed this chip's HBM."""
 
 
-async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
+async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) -> dict:
     import jax
     import numpy as np
 
@@ -100,7 +104,16 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
     t_init = time.monotonic()
 
     family = get_family("llama")
-    param_shapes = jax.eval_shape(lambda k: family.init_params(cfg, k), jax.random.PRNGKey(0))
+
+    def shaped_params(k):
+        p = family.init_params(cfg, k)
+        if quant:
+            from dynamo_tpu.ops.quant import quantize_params
+
+            p = quantize_params(p, family.quant_leaves)
+        return p
+
+    param_shapes = jax.eval_shape(shaped_params, jax.random.PRNGKey(0))
     cache_shapes = jax.eval_shape(
         lambda: family.cache_init(cfg, num_blocks, block_size, None)
     )
@@ -109,24 +122,33 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
     )
     need = tree_bytes(param_shapes) + tree_bytes(cache_shapes)
     # pre-flight HBM check: don't spend minutes initializing a model the
-    # chip cannot hold (observed: 8B @ ISL3000 needs ~4.5G of HLO temps on
-    # top of params+cache)
+    # chip cannot hold.  Monolithic ISL-3000 prefill was observed to need
+    # ~4.5G of HLO temps on top of params+cache; chunked prefill (the
+    # accelerator default) keeps activations to the chunk window, so a
+    # 2G margin suffices there.
+    temps = 2.0e9 if chunk else 4.5e9
     try:
         limit = jax.devices()[0].memory_stats().get("bytes_limit")
     except Exception:  # noqa: BLE001 — CPU/backends without stats
         limit = None
-    if limit and need + 4.5e9 > limit:
+    if limit and need + temps > limit:
         raise DoesNotFit(
-            f"{model_name}: params+cache {need/1e9:.1f}GB + ~4.5GB temps "
-            f"> HBM {limit/1e9:.1f}GB"
+            f"{model_name}: params+cache {need/1e9:.1f}GB + ~{temps/1e9:.1f}GB "
+            f"temps > HBM {limit/1e9:.1f}GB"
         )
 
     # constant-fill init: throughput/MFU are weight-agnostic, and real RNG
-    # init of 8B params on host cost ~15 min of the round-2/3 bench budget
+    # init of 8B params on host cost ~15 min of the round-2/3 bench budget.
+    # Quantized leaves fill with 1 (int8) — pre-quantized trees pass through
+    # the engine's quantize step untouched.
     params = None
     if os.environ.get("DYN_BENCH_INIT", "const") == "const":
         params = jax.tree.map(
-            lambda s: np.full(s.shape, 0.01, dtype=s.dtype), param_shapes
+            lambda s: np.full(
+                s.shape, 1 if np.issubdtype(s.dtype, np.integer) else 0.01,
+                dtype=s.dtype,
+            ),
+            param_shapes,
         )
 
     engine = JaxLlmEngine(
@@ -140,11 +162,12 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
             decode_steps=decode_steps,
             prefill_chunk_tokens=chunk,
             top_logprobs_k=0,  # no top-k tax on the measured decode loop
+            quantize=quant,
         ),
         params=params,
     )
     try:
-        return await _measure(engine, cfg, model_name, num_requests, prompt_len,
+        return await _measure(engine, cfg, model_name, quant, num_requests, prompt_len,
                               output_len, max_batch, decode_steps, fallback_cpu, t_init)
     finally:
         # release HBM before a ladder step-down retries in this process
@@ -152,7 +175,7 @@ async def _run_model(model_name: str, *, fallback_cpu: bool) -> dict:
         engine.params = engine.cache = None
 
 
-async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len,
+async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, output_len,
                    max_batch, decode_steps, fallback_cpu, t_init) -> dict:
     import jax
     import numpy as np
@@ -215,7 +238,14 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
 
     xfer = await _measure_kv_xfer(engine)
 
-    n_params = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(engine.params))
+    from dynamo_tpu.ops.quant import QuantizedMatrix
+
+    n_params = sum(
+        int(np.prod(x.q.shape if isinstance(x, QuantizedMatrix) else x.shape))
+        for x in jax.tree.leaves(
+            engine.params, is_leaf=lambda x: isinstance(x, QuantizedMatrix)
+        )
+    )
 
     total_tokens = sum(c for c, _ in results)
     ttfts = sorted(t for _, t in results)
@@ -249,6 +279,7 @@ async def _measure(engine, cfg, model_name, num_requests, prompt_len, output_len
         "vs_baseline": 0.0 if fallback_cpu else round(tok_s / BASELINE_TOK_S_PER_GPU, 3),
         "detail": {
             "model": model_name,
+            "quantize": quant,
             "n_params": n_params,
             "num_requests": num_requests,
             "isl": prompt_len,
@@ -344,18 +375,33 @@ async def _measure_kv_xfer(engine, n_blocks: int = 64, iters: int = 5) -> dict:
 async def run_bench() -> dict:
     fallback_cpu = os.environ.get("DYN_BENCH_FALLBACK_CPU") == "1"
     forced = os.environ.get("DYN_BENCH_MODEL")
+    forced_quant = os.environ.get("DYN_BENCH_QUANT")  # "int8" | "none" | unset
+    if forced_quant not in (None, "", "int8", "none", "0"):
+        raise ValueError(
+            f"DYN_BENCH_QUANT={forced_quant!r} not understood (want int8|none)"
+        )
     if fallback_cpu:
-        ladder = [forced or "tiny"]
+        ladder = [(forced or "tiny", None)]
+    elif forced:
+        ladder = [(forced, "int8" if forced_quant == "int8" else None)]
     else:
-        ladder = [forced] if forced else list(MODEL_LADDER)
+        ladder = list(MODEL_LADDER)
+        if forced_quant == "int8":
+            ladder = list(dict.fromkeys((m, "int8") for m, _ in ladder))
+        elif forced_quant in ("none", "0"):
+            ladder = list(dict.fromkeys((m, None) for m, _ in ladder))
     last_err: BaseException | None = None
-    for model_name in ladder:
+    for i, (model_name, quant) in enumerate(ladder):
         try:
-            return await _run_model(model_name, fallback_cpu=fallback_cpu)
-        except Exception as err:  # OOM: step down the ladder; else re-raise
-            if (isinstance(err, DoesNotFit) or _is_oom(err)) and model_name != ladder[-1]:
+            return await _run_model(model_name, quant, fallback_cpu=fallback_cpu)
+        except Exception as err:
+            # ANY failure steps down while rungs remain (an OOM wants a
+            # smaller model; a quantized-path compile failure wants the bf16
+            # rung) — only the last rung's error escapes to the parent retry
+            if i + 1 < len(ladder):
                 print(
-                    f"bench: {model_name} does not fit ({err!r:.200}); stepping down",
+                    f"bench: {model_name}/{quant or 'bf16'} failed "
+                    f"({err!r:.200}); stepping down",
                     file=sys.stderr,
                 )
                 last_err = err

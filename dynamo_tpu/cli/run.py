@@ -53,6 +53,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
+    run.add_argument("--quantize", choices=["int8"], default=None,
+                     help="weight-only quantization (llama-family; halves "
+                          "decode HBM traffic — the TPU analog of the "
+                          "reference's FP8 serving)")
     args = parser.parse_args(argv)
 
     args.input, args.output = "http", "jax"
@@ -93,6 +97,8 @@ async def _run(args) -> int:
                 overrides["mesh"] = MeshConfig(tp=args.tensor_parallel_size)
             if args.warmup:
                 overrides["warmup"] = True
+            if args.quantize:
+                overrides["quantize"] = args.quantize
         worker = await serve_worker(
             runtime,
             args.model_path,

@@ -103,6 +103,12 @@ class EngineConfig:
     # price of emitting tokens in bursts of this size and wasting up to
     # decode_steps-1 iterations on sequences that hit a stop mid-window.
     decode_steps: int = 1
+    # Weight-only quantization ("int8" | None).  The TPU analog of the
+    # reference's FP8 headline model (examples/llm/benchmarks/README.md:66):
+    # named projection matrices become int8 + per-channel scale
+    # (ops/quant.py), halving the HBM bytes every decode step streams.
+    # Requires a family with quant_leaves (llama/qwen2/qwen3).
+    quantize: str | None = None
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -219,6 +225,13 @@ class JaxLlmEngine:
         with host_ctx:
             rng = jax.random.PRNGKey(config.seed)
             raw_params = params if params is not None else self.family.init_params(cfg, rng)
+            raw_params = self._maybe_quantize(raw_params)
+            # sharding specs follow the params tree's CONTENT (a caller may
+            # hand in a pre-quantized artifact without setting
+            # config.quantize — the spec twin must still match)
+            from dynamo_tpu.ops.quant import is_quantized
+
+            self._params_quantized = is_quantized(raw_params)
             raw_cache = self.family.cache_init(
                 cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
             )
@@ -229,8 +242,13 @@ class JaxLlmEngine:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
+            param_specs = self.family.param_specs(cfg)
+            if self._params_quantized:
+                from dynamo_tpu.ops.quant import quantize_specs
+
+                param_specs = quantize_specs(param_specs, self.family.quant_leaves)
             self._param_shardings = jax.tree.map(
-                lambda s: NamedSharding(self.mesh, s), self.family.param_specs(cfg)
+                lambda s: NamedSharding(self.mesh, s), param_specs
             )
             self._cache_sharding = jax.tree.map(
                 lambda s: NamedSharding(self.mesh, s), self.family.cache_specs(cfg)
@@ -342,6 +360,27 @@ class JaxLlmEngine:
             lambda counts, lane, row: counts.at[lane].set(row),
             donate_argnums=(0,), **set_row_kwargs,
         )
+
+    def _maybe_quantize(self, raw_params: dict) -> dict:
+        """Apply EngineConfig.quantize to a (host-resident) param tree.
+        Pre-quantized trees (e.g. loaded from a quantized artifact) pass
+        through untouched."""
+        if not self.config.quantize:
+            return raw_params
+        if self.config.quantize != "int8":
+            raise ValueError(
+                f"unknown quantize mode {self.config.quantize!r} (want 'int8')"
+            )
+        if not self.family.quant_leaves:
+            raise ValueError(
+                f"model family {self.config.model_family!r} does not support "
+                "weight-only quantization (no quant_leaves)"
+            )
+        from dynamo_tpu.ops.quant import is_quantized, quantize_params
+
+        if is_quantized(raw_params):
+            return raw_params
+        return quantize_params(raw_params, self.family.quant_leaves)
 
     # -- jitted steps ------------------------------------------------------
     def _build_prefill(self):

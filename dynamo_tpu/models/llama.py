@@ -35,6 +35,7 @@ from dynamo_tpu.ops.attention import (
     write_prefill_kv,
 )
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import mm
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
 
@@ -206,16 +207,17 @@ def init_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int, dtype=None
 
 
 def _mlp(x, gate, up, down):
-    return jax.nn.silu(x @ gate) * (x @ up) @ down
+    return mm(jax.nn.silu(mm(x, gate)) * mm(x, up), down)
 
 
 def _qkv(attn_in, w, cfg: LlamaConfig):
     """Project+bias+head-split (+ Qwen3 per-head q/k RMSNorm, pre-rope);
-    shared by prefill/decode/trunk."""
+    shared by prefill/decode/trunk.  Projections run through ``mm`` so
+    int8-quantized weights (ops/quant.py) drop in transparently."""
     s = attn_in.shape[0]
-    q_proj = attn_in @ w["wq"]
-    k_proj = attn_in @ w["wk"]
-    v_proj = attn_in @ w["wv"]
+    q_proj = mm(attn_in, w["wq"])
+    k_proj = mm(attn_in, w["wk"])
+    v_proj = mm(attn_in, w["wv"])
     if cfg.attention_bias:
         q_proj, k_proj, v_proj = q_proj + w["bq"], k_proj + w["bk"], v_proj + w["bv"]
     q = q_proj.reshape(s, cfg.num_heads, cfg.head_dim)
@@ -247,7 +249,7 @@ def llama_forward_trunk(
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
         attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
-        x = x + attn.reshape(s, -1) @ w["wo"]
+        x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
         return x, None
@@ -259,7 +261,7 @@ def llama_forward_trunk(
 def _logits(params, cfg, x):
     if cfg.tie_word_embeddings:
         return x @ params["embed"].T.astype(x.dtype)
-    return x @ params["lm_head"]
+    return mm(x, params["lm_head"])
 
 
 def llama_forward_prefill(
@@ -322,7 +324,7 @@ def llama_forward_prefill_embeds(
             attn = ring_attention(q[None], k[None], v[None], seq_len, sp_mesh)[0]
         else:
             attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
-        x = x + attn.reshape(s, -1) @ w["wo"]
+        x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
         return x, (k_layer, v_layer)
@@ -370,7 +372,7 @@ def llama_forward_prefill_with_prefix(
         attn = prefill_attention_with_prefix(
             q, k, v, k_prefix, v_prefix, start_pos, tail_len
         )
-        x = x + attn.reshape(s, -1) @ w["wo"]
+        x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
         return x, (k_layer, v_layer)
@@ -448,7 +450,7 @@ def llama_forward_decode(
         k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
         k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
         attn = attend(q, k_layer, v_layer)
-        x = x + attn.reshape(b, -1) @ w["wo"]
+        x = x + mm(attn.reshape(b, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
         return x, (k_layer, v_layer)
@@ -493,7 +495,7 @@ def llama_forward_decode_pp(
         k = apply_rope(k[:, None], pos_mb[:, None], cos, sin)[:, 0]
         k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slots_mb)
         attn = paged_decode_attention(q, k_layer, v_layer, tables_mb, lens_mb)
-        x_mb = x_mb + attn.reshape(x_mb.shape[0], -1) @ w["wo"]
+        x_mb = x_mb + mm(attn.reshape(x_mb.shape[0], -1), w["wo"])
         mlp_in = rms_norm(x_mb, w["mlp_norm"], cfg.rms_norm_eps)
         x_mb = x_mb + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
         return x_mb, (k_layer, v_layer)

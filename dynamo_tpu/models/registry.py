@@ -40,6 +40,9 @@ class ModelFamily:
     load_weights: Callable | None = None
     # forward_decode accepts tp_mesh= (shard_map'd pallas attention)
     decode_accepts_tp_mesh: bool = False
+    # param-tree leaf names eligible for weight-only int8 (ops/quant.py);
+    # empty = the family's forwards don't route matmuls through quant.mm
+    quant_leaves: tuple[str, ...] = ()
 
     def cache_init(self, cfg, num_blocks: int, block_size: int, dtype=None):
         if self.init_kv_cache is not None:
@@ -94,6 +97,9 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         forward_decode_pp=llama.llama_forward_decode_pp,
         load_weights=llama.load_hf_weights,
         decode_accepts_tp_mesh=True,
+        quant_leaves=(
+            "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+        ),
     )
 
 

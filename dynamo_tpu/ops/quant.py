@@ -1,0 +1,140 @@
+"""Weight-only int8 quantization for serving.
+
+The reference's headline benchmark serves an FP8-quantized model
+(DeepSeek-R1-Distill-Llama-70B-FP8-dynamic, examples/llm/benchmarks/
+README.md:66-105); the TPU-native analog is weight-only int8: the MXU has
+no FP8, but an int8 weight resident in HBM halves the bytes each decode
+step streams — and decode is HBM-bandwidth-bound — while the convert to
+bf16 fuses into the matmul on TPU (no materialized dequantized copy).
+
+Design:
+- ``QuantizedMatrix``: a pytree node pairing int8 values with a symmetric
+  per-output-channel scale.  The scale keeps the matrix's ndim (size 1 on
+  the contraction axis), so a family's existing ``PartitionSpec`` for the
+  full-precision matrix applies verbatim to BOTH leaves — quantization
+  never changes the sharding story.
+- ``mm(x, w)``: matmul that accepts either a plain array or a
+  ``QuantizedMatrix``; model forwards call it instead of ``@`` and stay
+  quantization-agnostic.
+- ``quantize_params`` / ``quantize_specs``: map a param pytree (and its
+  spec twin) replacing named leaves; layer-stacked [L, in, out] weights
+  quantize per (layer, out-channel) and still slice correctly under
+  ``lax.scan`` (both leaves carry the leading L axis).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedMatrix:
+    """Symmetric weight-only int8 matrix: ``w ≈ q.astype(f) * s``.
+
+    ``q``: int8, the original weight's shape.
+    ``s``: scale, same ndim, size 1 on the contraction (second-to-last)
+    axis — broadcastable against the matmul result.
+    """
+
+    q: Any
+    s: Any
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # reported dtype = compute dtype of the scale
+        return self.s.dtype
+
+
+def quantize_matrix(w: jnp.ndarray, scale_dtype=jnp.float32) -> QuantizedMatrix:
+    """Per-output-channel symmetric int8: scale over the contraction axis
+    (second-to-last), keepdims so the scale broadcasts in ``mm``."""
+    axis = w.ndim - 2
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(jnp.int8)
+    return QuantizedMatrix(q=q, s=s.astype(scale_dtype))
+
+
+def dequantize_matrix(w: QuantizedMatrix, dtype=jnp.bfloat16) -> jnp.ndarray:
+    return (w.q.astype(jnp.float32) * w.s.astype(jnp.float32)).astype(dtype)
+
+
+def mm(x: jnp.ndarray, w) -> jnp.ndarray:
+    """``x @ w`` for plain or quantized ``w``.
+
+    Quantized path: the int8→bf16 convert sits directly on the dot operand,
+    where XLA:TPU fuses it into the matmul (weights stream from HBM as
+    int8); the per-channel scale multiplies the [..., out] result."""
+    if isinstance(w, QuantizedMatrix):
+        out = x @ w.q.astype(x.dtype)
+        # scale is [.., 1, out]; drop the kept contraction axis against the
+        # result's [..., out]
+        return out * jnp.squeeze(w.s, axis=w.s.ndim - 2).astype(x.dtype)
+    return x @ w
+
+
+def _replace_named_leaves(tree: dict, leaf_names: tuple[str, ...], transform):
+    """One walker for the params tree and its spec twin: replace leaves
+    matched by dict key (anywhere in the tree) via ``transform``; one match
+    rule keeps the two trees structurally identical."""
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k in leaf_names and not isinstance(v, dict):
+                    out[k] = transform(v)
+                else:
+                    out[k] = walk(v)
+            return out
+        return node
+
+    return walk(tree)
+
+
+def quantize_params(params: dict, leaf_names: tuple[str, ...]) -> dict:
+    """Replace named leaves with QuantizedMatrix nodes."""
+    return _replace_named_leaves(params, leaf_names, quantize_matrix)
+
+
+def quantize_specs(specs: dict, leaf_names: tuple[str, ...]) -> dict:
+    """Spec-tree twin of ``quantize_params``: the int8 values keep the
+    full-precision leaf's PartitionSpec; the scale keeps it too EXCEPT on
+    the contraction axis, where its extent is 1 (keepdims) and cannot carry
+    a real sharding (row-parallel matrices like wo shard the contraction
+    axis over tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    def scale_spec(spec):
+        entries = list(spec)
+        if len(entries) >= 2:
+            entries[-2] = None
+        return P(*entries)
+
+    return _replace_named_leaves(
+        specs, leaf_names, lambda v: QuantizedMatrix(q=v, s=scale_spec(v))
+    )
+
+
+def is_quantized(params: dict) -> bool:
+    """True if the tree contains any QuantizedMatrix node."""
+    return any(
+        isinstance(x, QuantizedMatrix)
+        for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QuantizedMatrix)
+        )
+    )

@@ -94,7 +94,13 @@ async def test_cross_process_disagg_exactness(tmp_path):
     rt = disagg = None
     decode_engine = None
     try:
-        line = await asyncio.wait_for(proc.stdout.readline(), 120)
+        try:
+            line = await asyncio.wait_for(proc.stdout.readline(), 120)
+        except asyncio.TimeoutError:
+            raise AssertionError(
+                "worker never came up (timeout)\n"
+                f"stderr tail:\n{stderr_path.read_text()[-3000:]}"
+            ) from None
         assert b"PREFILL_READY" in line, (
             f"worker never came up: stdout={line!r}\n"
             f"stderr tail:\n{stderr_path.read_text()[-3000:]}"

@@ -1,0 +1,159 @@
+"""Weight-only int8 quantization (ops/quant.py): numeric accuracy of the
+quantized matmul, pytree/spec transforms, and the engine serving a
+quantized model end-to-end (single-device and tp-sharded)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.llama import LlamaConfig, init_params, param_specs
+from dynamo_tpu.ops.quant import (
+    QuantizedMatrix,
+    dequantize_matrix,
+    mm,
+    quantize_matrix,
+    quantize_params,
+    quantize_specs,
+)
+
+
+def test_roundtrip_error_small():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32) * 0.05
+    qm = quantize_matrix(w)
+    assert qm.q.dtype == jnp.int8
+    assert qm.s.shape == (1, 32)
+    back = dequantize_matrix(qm, jnp.float32)
+    # symmetric per-channel int8: max error bounded by scale/2 per channel
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    bound = np.asarray(qm.s)[0] / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_mm_matches_dense():
+    k = jax.random.PRNGKey(1)
+    x = jax.random.normal(k, (8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(k, 1), (64, 32), jnp.float32) * 0.1
+    exact = x @ w
+    approx = mm(x, quantize_matrix(w))
+    rel = np.linalg.norm(np.asarray(approx - exact)) / np.linalg.norm(np.asarray(exact))
+    assert rel < 0.01
+    # plain arrays pass straight through
+    np.testing.assert_allclose(np.asarray(mm(x, w)), np.asarray(exact))
+
+
+def test_mm_stacked_layers_under_scan():
+    """Layer-stacked [L, in, out] weights slice per-layer through lax.scan
+    (both q and s carry the leading axis)."""
+    k = jax.random.PRNGKey(2)
+    w = jax.random.normal(k, (3, 16, 8), jnp.float32) * 0.1
+    qm = quantize_matrix(w)
+    assert qm.s.shape == (3, 1, 8)
+    x = jax.random.normal(jax.random.fold_in(k, 1), (4, 16), jnp.float32)
+
+    def body(_, layer_w):
+        return None, mm(x, layer_w)
+
+    _, scanned = jax.lax.scan(body, None, qm)
+    expect = jnp.stack([mm(x, QuantizedMatrix(qm.q[i], qm.s[i])) for i in range(3)])
+    np.testing.assert_allclose(np.asarray(scanned), np.asarray(expect), rtol=1e-6)
+
+
+def test_quantize_params_and_specs_structures_match():
+    cfg = LlamaConfig.tiny()
+    leaves = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
+    params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)), leaves)
+    specs = quantize_specs(param_specs(cfg), leaves)
+    assert isinstance(params["layers"]["wq"], QuantizedMatrix)
+    assert not isinstance(params["embed"], QuantizedMatrix)
+    # tiny config ties embeddings: lm_head absent, quietly skipped
+    assert "lm_head" not in params
+    assert jax.tree.structure(params) == jax.tree.structure(specs)
+    # row-parallel wo: scale's contraction axis must NOT carry the tp shard
+    wo = specs["layers"]["wo"]
+    assert wo.q == jax.sharding.PartitionSpec("pp", "tp", None)
+    assert wo.s == jax.sharding.PartitionSpec("pp", None, None)
+
+
+def _greedy_tokens(engine_kwargs, prompt, n=8):
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.engine import Context
+
+    engine = JaxLlmEngine(EngineConfig(**engine_kwargs))
+    engine.start()
+    try:
+        req = PreprocessedRequest(
+            token_ids=list(prompt),
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+
+        async def run():
+            stream = await engine.generate(Context(req))
+            out = []
+            async for item in stream:
+                ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+                if ann.data is not None:
+                    assert ann.data.error is None, ann.data.error
+                    out.extend(ann.data.token_ids)
+            return out
+
+        return asyncio.run(run())
+    finally:
+        engine.stop()
+
+
+def test_engine_serves_quantized():
+    cfg = LlamaConfig.tiny()
+    kwargs = dict(
+        model=cfg, num_blocks=64, block_size=4, max_batch_size=2,
+        prefill_buckets=(16,), max_model_len=64,
+    )
+    prompt = [5, 9, 13, 17, 21]
+    full = _greedy_tokens(kwargs, prompt)
+    quant = _greedy_tokens({**kwargs, "quantize": "int8"}, prompt)
+    assert len(quant) == len(full) == 8
+    # int8 on a tiny random model still tracks the full-precision argmax
+    # for the first few steps (same seed ⇒ same underlying weights)
+    assert quant[0] == full[0]
+
+
+def test_engine_quantized_tp_mesh():
+    """Quantized params shard over a tp mesh (spec twin structure + the
+    scale's contraction-axis fix exercised on a real 8-device CPU mesh)."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    cfg = LlamaConfig.tiny()
+    toks = _greedy_tokens(
+        dict(
+            model=cfg, num_blocks=64, block_size=4, max_batch_size=2,
+            prefill_buckets=(16,), max_model_len=64, quantize="int8",
+            mesh=MeshConfig(tp=2),
+        ),
+        [5, 9, 13, 17, 21],
+    )
+    assert len(toks) == 8
+
+
+def test_engine_rejects_unsupported_family():
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    cfg = MixtralConfig.tiny()
+    with pytest.raises(ValueError, match="quantization"):
+        JaxLlmEngine(
+            EngineConfig(
+                model=cfg, model_family="mixtral", quantize="int8",
+                num_blocks=16, block_size=4, max_batch_size=2,
+            )
+        )
