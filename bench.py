@@ -383,7 +383,9 @@ async def run_bench() -> dict:
     if fallback_cpu:
         ladder = [(forced or "tiny", None)]
     elif forced:
-        ladder = [(forced, "int8" if forced_quant == "int8" else None)]
+        # default matches the ladder's headline rung (int8); set
+        # DYN_BENCH_QUANT=none for bf16
+        ladder = [(forced, None if forced_quant in ("none", "0") else "int8")]
     else:
         ladder = list(MODEL_LADDER)
         if forced_quant == "int8":
@@ -411,6 +413,33 @@ async def run_bench() -> dict:
 
 
 def child_main() -> None:
+    # Fast-fail on a wedged accelerator tunnel: jax.devices() can hang
+    # forever when the axon relay is down (observed: two silent 25-minute
+    # child timeouts).  A watchdog kills this child if device init hasn't
+    # completed within the window, so the parent's retry/fallback ladder
+    # advances in minutes, not attempt-timeouts.
+    import threading
+
+    ready = threading.Event()
+    window = float(os.environ.get("DYN_BENCH_DEVICE_TIMEOUT", "240"))
+
+    def watchdog() -> None:
+        if not ready.wait(window):
+            print(
+                f"bench: device init still hung after {window:.0f}s; aborting child",
+                file=sys.stderr,
+            )
+            sys.stderr.flush()
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    import jax
+
+    t0 = time.monotonic()
+    devs = jax.devices()
+    ready.set()
+    print(f"bench: devices {devs} in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+
     result = asyncio.run(run_bench())
     print(json.dumps(result))
     sys.stdout.flush()
@@ -448,7 +477,7 @@ def main() -> None:
         return
 
     attempt_timeout = float(os.environ.get("DYN_BENCH_ATTEMPT_TIMEOUT", "1500"))
-    tpu_attempts = int(os.environ.get("DYN_BENCH_ATTEMPTS", "2"))
+    tpu_attempts = int(os.environ.get("DYN_BENCH_ATTEMPTS", "3"))
     for attempt in range(tpu_attempts):
         print(f"bench: attempt {attempt + 1}/{tpu_attempts}", file=sys.stderr)
         result = _try_child(dict(os.environ), attempt_timeout)
@@ -456,7 +485,9 @@ def main() -> None:
             print(json.dumps(result))
             return
         if attempt + 1 < tpu_attempts:
-            time.sleep(20)
+            # a wedged tunnel fails fast via the child watchdog; give it a
+            # real chance to recover before the next attempt
+            time.sleep(45)
 
     # accelerator never produced a result: CPU fallback so the round still
     # records a parseable (clearly-marked) data point instead of rc=1

@@ -54,9 +54,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
     run.add_argument("--quantize", choices=["int8"], default=None,
-                     help="weight-only quantization (llama-family; halves "
-                          "decode HBM traffic — the TPU analog of the "
-                          "reference's FP8 serving)")
+                     help="weight-only quantization (all served families; "
+                          "halves decode HBM traffic — the TPU analog of "
+                          "the reference's FP8 serving)")
     args = parser.parse_args(argv)
 
     args.input, args.output = "http", "jax"

@@ -107,7 +107,7 @@ class EngineConfig:
     # reference's FP8 headline model (examples/llm/benchmarks/README.md:66):
     # named projection matrices become int8 + per-channel scale
     # (ops/quant.py), halving the HBM bytes every decode step streams.
-    # Requires a family with quant_leaves (llama/qwen2/qwen3).
+    # Requires a family with quant_leaves (all registered families).
     quantize: str | None = None
 
     def resolved_max_len(self) -> int:

@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.ops.attention import NEG_INF, write_decode_kv, write_prefill_kv
 from dynamo_tpu.ops.moe import moe_ffn
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import mm
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
 
@@ -350,15 +351,15 @@ def _project_q(w, x, cfg: DeepseekConfig):
     bottleneck)."""
     t = x.shape[0]
     if cfg.q_lora_rank:
-        q = rms_norm(x @ w["w_dq"], w["q_norm"], cfg.rms_norm_eps) @ w["w_uq"]
+        q = mm(rms_norm(mm(x, w["w_dq"]), w["q_norm"], cfg.rms_norm_eps), w["w_uq"])
     else:
-        q = x @ w["wq"]
+        q = mm(x, w["wq"])
     return q.reshape(t, cfg.num_heads, cfg.qk_head_dim)
 
 
 def _latent_kv(w, x, cfg: DeepseekConfig):
     """x [t, h] → (c_kv [t, r] normalized, k_rope [t, rope_dim] un-roped)."""
-    dkv = x @ w["w_dkv"]
+    dkv = mm(x, w["w_dkv"])
     c_kv = rms_norm(dkv[:, : cfg.kv_lora_rank], w["kv_norm"], cfg.rms_norm_eps)
     k_rope = dkv[:, cfg.kv_lora_rank :]
     return c_kv, k_rope
@@ -398,7 +399,7 @@ def _mla_prefill_attn(w, x, cfg: DeepseekConfig, positions, seq_len, k_layer, v_
     logits = jnp.where(mask[None], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("hqk,khv->qhv", weights, v.astype(jnp.float32)).astype(cfg.dtype)
-    return out.reshape(s, -1) @ w["wo"], (k_layer, v_layer)
+    return mm(out.reshape(s, -1), w["wo"]), (k_layer, v_layer)
 
 
 def _mla_prefill_attn_with_prefix(
@@ -466,7 +467,7 @@ def _mla_prefill_attn_with_prefix(
     v_chunk = jnp.einsum("tr,rhv->thv", c_kv, w_uv)
     out_chunk = jnp.einsum("hqk,khv->qhv", wc, v_chunk.astype(jnp.float32))
     out = (out_pref + out_chunk).astype(cfg.dtype)
-    return out.reshape(s, -1) @ w["wo"], (k_layer, v_layer)
+    return mm(out.reshape(s, -1), w["wo"]), (k_layer, v_layer)
 
 
 def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
@@ -525,11 +526,11 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
         ctx = jnp.einsum("bht,btr->bhr", weights, ck.astype(jnp.float32))
     # decompress through the v up-projection
     out = jnp.einsum("bhr,rhv->bhv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
-    return out.reshape(b, -1) @ w["wo"], (k_layer, v_layer)
+    return mm(out.reshape(b, -1), w["wo"]), (k_layer, v_layer)
 
 
 def _dense_mlp(w, x):
-    return jax.nn.silu(x @ w["w_gate"]) * (x @ w["w_up"]) @ w["w_down"]
+    return mm(jax.nn.silu(mm(x, w["w_gate"])) * mm(x, w["w_up"]), w["w_down"])
 
 
 def _moe_mlp(w, x, cfg: DeepseekConfig):
@@ -543,7 +544,7 @@ def _moe_mlp(w, x, cfg: DeepseekConfig):
     )
     out = routed * jnp.asarray(cfg.routed_scaling_factor, routed.dtype)
     if cfg.n_shared_experts:
-        out = out + jax.nn.silu(x @ w["ws_gate"]) * (x @ w["ws_up"]) @ w["ws_down"]
+        out = out + mm(jax.nn.silu(mm(x, w["ws_gate"])) * mm(x, w["ws_up"]), w["ws_down"])
     return out
 
 
@@ -593,7 +594,7 @@ def _forward(params, cfg: DeepseekConfig, x, kv_cache, attn_fn):
 def _logits(params, cfg, x):
     if cfg.tie_word_embeddings:
         return x @ params["embed"].T.astype(x.dtype)
-    return x @ params["lm_head"]
+    return mm(x, params["lm_head"])
 
 
 def deepseek_forward_prefill(

@@ -31,6 +31,7 @@ from dynamo_tpu.ops.attention import (
 )
 from dynamo_tpu.ops.moe import moe_ffn
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import mm
 from dynamo_tpu.ops.rope import apply_rope
 
 
@@ -185,16 +186,16 @@ def _prefill_trunk(params, cfg: MixtralConfig, token_ids, kv_cache,
         state = {}
 
         def attn(attn_in):
-            q = (attn_in @ w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
-            k = (attn_in @ w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
-            v = (attn_in @ w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            q = mm(attn_in, w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+            k = mm(attn_in, w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+            v = mm(attn_in, w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
             if cfg.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
                 q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
                 k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
             q = apply_rope(q, positions, cos, sin)
             k = apply_rope(k, positions, cos, sin)
             attn_out, state["kv"] = attend(q, k, v, k_layer, v_layer)
-            return attn_out.reshape(s, -1) @ w["wo"]
+            return mm(attn_out.reshape(s, -1), w["wo"])
 
         x = _block(cfg, w, x, attn)
         return x, state["kv"]
@@ -205,7 +206,7 @@ def _prefill_trunk(params, cfg: MixtralConfig, token_ids, kv_cache,
     logits = (
         last[None] @ params["embed"].T.astype(x.dtype)
         if cfg.tie_word_embeddings
-        else last[None] @ params["lm_head"]
+        else mm(last[None], params["lm_head"])
     )[0]
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
@@ -272,9 +273,9 @@ def mixtral_forward_decode(
         state = {}
 
         def attn(attn_in):
-            q = (attn_in @ w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
-            k = (attn_in @ w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
-            v = (attn_in @ w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+            q = mm(attn_in, w["wq"]).reshape(b, cfg.num_heads, cfg.head_dim)
+            k = mm(attn_in, w["wk"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
+            v = mm(attn_in, w["wv"]).reshape(b, cfg.num_kv_heads, cfg.head_dim)
             if cfg.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
                 q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
                 k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
@@ -282,7 +283,7 @@ def mixtral_forward_decode(
             k = apply_rope(k[:, None], positions[:, None], cos, sin)[:, 0]
             state["kv"] = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
             attn_out = paged_attn(q, state["kv"][0], state["kv"][1])
-            return attn_out.reshape(b, -1) @ w["wo"]
+            return mm(attn_out.reshape(b, -1), w["wo"])
 
         x = _block(cfg, w, x, attn)
         return x, state["kv"]
@@ -292,7 +293,7 @@ def mixtral_forward_decode(
     logits = (
         x @ params["embed"].T.astype(x.dtype)
         if cfg.tie_word_embeddings
-        else x @ params["lm_head"]
+        else mm(x, params["lm_head"])
     )
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 

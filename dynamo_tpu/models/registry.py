@@ -68,6 +68,15 @@ class ModelFamily:
         return llama.make_rope_tables(cfg)
 
 
+# attention projections + FFN/expert banks shared by the llama-like and
+# MoE families ([L, E, in, out] expert banks quantize per (layer, expert,
+# out-channel) — the scale rule is axis-position based, not rank based);
+# small routers and norms stay full-precision
+_PROJ_QUANT_LEAVES = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
+)
+
+
 def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
     """One ModelFamily construction for every llama-geometry variant
     (llama / qwen2 / qwen3); ``config_tweak(dict)`` mutates the HF config
@@ -97,9 +106,7 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         forward_decode_pp=llama.llama_forward_decode_pp,
         load_weights=llama.load_hf_weights,
         decode_accepts_tp_mesh=True,
-        quant_leaves=(
-            "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head",
-        ),
+        quant_leaves=_PROJ_QUANT_LEAVES,
     )
 
 
@@ -131,6 +138,7 @@ def _mixtral_family() -> ModelFamily:
         forward_decode=mixtral.mixtral_forward_decode,
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
         load_weights=mixtral.load_hf_weights,
+        quant_leaves=_PROJ_QUANT_LEAVES,
     )
 
 
@@ -149,6 +157,7 @@ def _qwen3_moe_family() -> ModelFamily:
         forward_decode=mixtral.mixtral_forward_decode,
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
         load_weights=mixtral.load_hf_weights,
+        quant_leaves=_PROJ_QUANT_LEAVES,
     )
 
 
@@ -167,6 +176,12 @@ def _deepseek_family() -> ModelFamily:
         init_kv_cache=deepseek.init_kv_cache,
         kv_cache_specs=deepseek.kv_cache_specs,
         make_rope_tables=deepseek.make_rope_tables,
+        # absorbed-form up-projections (w_uk/w_uv) stay full precision:
+        # they are reshaped + consumed inside fp32 einsums
+        quant_leaves=(
+            "w_dq", "w_uq", "wq", "w_dkv", "wo", "w_gate", "w_up", "w_down",
+            "ws_gate", "ws_up", "ws_down", "lm_head",
+        ),
     )
 
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.ops.quant import qeinsum
+
 
 def moe_router(
     x: jnp.ndarray, w_router: jnp.ndarray, top_k: int,
@@ -108,11 +110,13 @@ def moe_dispatch_combine(
         x[token_idx], mode="drop"
     )
 
-    # expert FFN batched over E (rides the MXU per expert shard)
-    hidden = jax.nn.silu(jnp.einsum("ech,ehi->eci", buffers, w_gate)) * jnp.einsum(
+    # expert FFN batched over E (rides the MXU per expert shard; qeinsum
+    # streams int8-quantized expert banks from HBM — the dominant bytes of
+    # an MoE decode step)
+    hidden = jax.nn.silu(qeinsum("ech,ehi->eci", buffers, w_gate)) * qeinsum(
         "ech,ehi->eci", buffers, w_up
     )
-    out_buffers = jnp.einsum("eci,eih->ech", hidden, w_down)  # [E, C, H]
+    out_buffers = qeinsum("eci,eih->ech", hidden, w_down)  # [E, C, H]
 
     # combine: gather each (token, k)'s expert output, weight by prob
     gathered = out_buffers[safe_expert, safe_slot]            # [T*k, H]

@@ -87,6 +87,16 @@ def mm(x: jnp.ndarray, w) -> jnp.ndarray:
     return x @ w
 
 
+def qeinsum(subscripts: str, x: jnp.ndarray, w) -> jnp.ndarray:
+    """Two-operand einsum whose second operand may be quantized (e.g. the
+    MoE expert banks ``ech,ehi->eci``).  Requires the weight's contraction
+    axis to be its second-to-last (the ``quantize_matrix`` convention), so
+    the keepdims scale broadcasts against the result unchanged."""
+    if isinstance(w, QuantizedMatrix):
+        return jnp.einsum(subscripts, x, w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return jnp.einsum(subscripts, x, w)
+
+
 def _replace_named_leaves(tree: dict, leaf_names: tuple[str, ...], transform):
     """One walker for the params tree and its spec twin: replace leaves
     matched by dict key (anywhere in the tree) via ``transform``; one match

@@ -145,15 +145,44 @@ def test_engine_quantized_tp_mesh():
     assert len(toks) == 8
 
 
-def test_engine_rejects_unsupported_family():
+def test_engine_rejects_unknown_mode():
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
-    from dynamo_tpu.models.mixtral import MixtralConfig
 
-    cfg = MixtralConfig.tiny()
-    with pytest.raises(ValueError, match="quantization"):
+    with pytest.raises(ValueError, match="quantize"):
         JaxLlmEngine(
             EngineConfig(
-                model=cfg, model_family="mixtral", quantize="int8",
+                model=LlamaConfig.tiny(), quantize="fp4",
                 num_blocks=16, block_size=4, max_batch_size=2,
             )
         )
+
+
+def test_engine_serves_quantized_moe():
+    """Mixtral family: attention mm() + int8 expert banks through qeinsum."""
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    toks = _greedy_tokens(
+        dict(
+            model=MixtralConfig.tiny_moe(), model_family="mixtral",
+            num_blocks=64, block_size=4, max_batch_size=2,
+            prefill_buckets=(16,), max_model_len=64, quantize="int8",
+        ),
+        [5, 9, 13, 17, 21],
+    )
+    assert len(toks) == 8
+
+
+def test_engine_serves_quantized_mla():
+    """DeepSeek family: q-lora/latent projections quantized, absorbed-form
+    up-projections full precision."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    toks = _greedy_tokens(
+        dict(
+            model=DeepseekConfig.tiny_mla(), model_family="deepseek_v2",
+            num_blocks=64, block_size=4, max_batch_size=2,
+            prefill_buckets=(16,), max_model_len=64, quantize="int8",
+        ),
+        [5, 9, 13, 17, 21],
+    )
+    assert len(toks) == 8
