@@ -162,6 +162,7 @@ async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) 
             decode_steps=decode_steps,
             prefill_chunk_tokens=chunk,
             top_logprobs_k=0,  # no top-k tax on the measured decode loop
+            logit_bias_k=0,    # nor a bias scatter
             quantize=quant,
         ),
         params=params,

@@ -45,6 +45,7 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
 from dynamo_tpu.ops.sampling import (
+    apply_logit_bias,
     apply_penalties,
     sample_tokens,
     token_logprobs,
@@ -109,6 +110,10 @@ class EngineConfig:
     # (ops/quant.py), halving the HBM bytes every decode step streams.
     # Requires a family with quant_leaves (all registered families).
     quantize: str | None = None
+    # Compile-time width of the per-lane OpenAI logit_bias rows (sparse
+    # {token: bias} scattered onto the logits each step).  Requests with
+    # more entries keep the largest-magnitude ones; 0 disables the scatter.
+    logit_bias_k: int = 64
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -400,7 +405,7 @@ class JaxLlmEngine:
 
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  block_ids, seq_len, start_pos, gen_row, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep):
+                 greedy, pres, freq, rep, bias_ids, bias_vals):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
                 self.cos, self.sin, **prefill_kwargs,
@@ -419,6 +424,7 @@ class JaxLlmEngine:
             plogits = apply_penalties(
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
+            plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -446,7 +452,7 @@ class JaxLlmEngine:
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
                  prompt_row, gen_row, sample_gate, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep):
+                 greedy, pres, freq, rep, bias_ids, bias_vals):
             logits, cache = self.family.forward_prefill_with_prefix(
                 params, cfg, token_ids, cache, full_block_ids, tail_block_ids,
                 tail_len, start_pos, self.cos, self.sin,
@@ -456,6 +462,7 @@ class JaxLlmEngine:
             plogits = apply_penalties(
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
+            plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
             step_key = jax.random.fold_in(key, total_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -484,7 +491,7 @@ class JaxLlmEngine:
 
         def step(params, cache, gen_counts, prompt_counts, lane, embeds,
                  token_ids, n_patch, block_ids, seq_len, gen_row, key, temp,
-                 top_k, top_p, greedy, pres, freq, rep):
+                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
             s = token_ids.shape[0]
             pos = jnp.arange(s)
             x_text = params["embed"][token_ids].astype(cfg.dtype)
@@ -502,6 +509,7 @@ class JaxLlmEngine:
             plogits = apply_penalties(
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
+            plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -563,11 +571,12 @@ class JaxLlmEngine:
         if steps <= 1:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
                      block_tables, context_lens, slot_ids, keys, temp, top_k,
-                     top_p, greedy, pres, freq, rep):
+                     top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
                 logits, cache = fwd_decode(
                     params, cache, token_ids, block_tables, context_lens, slot_ids
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
+                logits = apply_logit_bias(logits, bias_ids, bias_vals)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
                 lps = token_logprobs(logits, tokens)
@@ -587,7 +596,7 @@ class JaxLlmEngine:
 
         def multi(params, cache, gen_counts, prompt_counts, token_ids,
                   block_tables, context_lens, keys, temp, top_k, top_p, greedy,
-                  pres, freq, rep):
+                  pres, freq, rep, bias_ids, bias_vals):
             active = context_lens > 0
             active_i = active.astype(jnp.int32)
 
@@ -603,6 +612,7 @@ class JaxLlmEngine:
                     params, cache, tokens, block_tables, lens, slots
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
+                logits = apply_logit_bias(logits, bias_ids, bias_vals)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
                 lps = token_logprobs(logits, tokens)
@@ -1199,6 +1209,8 @@ class JaxLlmEngine:
         )
 
     def _sampling_arrays(self, seqs: list[Sequence], lanes: int):
+        vocab = self.config.model.vocab_size
+        kb = self.config.logit_bias_k
         temp = np.zeros((lanes,), np.float32)
         top_k = np.zeros((lanes,), np.int32)
         top_p = np.ones((lanes,), np.float32)
@@ -1206,6 +1218,10 @@ class JaxLlmEngine:
         pres = np.zeros((lanes,), np.float32)
         freq = np.zeros((lanes,), np.float32)
         rep = np.ones((lanes,), np.float32)
+        # OpenAI logit_bias: fixed-width sparse rows, pad id = vocab (OOB
+        # drop in the scatter)
+        bias_ids = np.full((lanes, kb), vocab, np.int32)
+        bias_vals = np.zeros((lanes, kb), np.float32)
         for i, seq in enumerate(seqs):
             s = seq.request.sampling
             lane = seq.lane if lanes > 1 else i
@@ -1218,7 +1234,21 @@ class JaxLlmEngine:
             pres[lane] = s.presence_penalty or 0.0
             freq[lane] = s.frequency_penalty or 0.0
             rep[lane] = s.repetition_penalty if s.repetition_penalty else 1.0
-        return temp, top_k, top_p, greedy, pres, freq, rep
+            if s.logit_bias and kb:
+                # drop out-of-vocab ids BEFORE truncating so they cannot
+                # displace valid biases from the bucket
+                entries = sorted(
+                    (
+                        (int(t), float(v))
+                        for t, v in s.logit_bias.items()
+                        if 0 <= int(t) < vocab
+                    ),
+                    key=lambda e: -abs(e[1]),
+                )[:kb]  # over-wide requests keep the strongest biases
+                for j, (tok, val) in enumerate(entries):
+                    bias_ids[lane, j] = tok
+                    bias_vals[lane, j] = val
+        return temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals
 
     def _next_rng(self) -> np.ndarray:
         return self._host_rng.integers(0, 2**32, size=2, dtype=np.uint32)
@@ -1271,11 +1301,13 @@ class JaxLlmEngine:
                 self.allocator.put_back_restore_plan(seq.seq_id, restore)
                 raise
         blocks = self.allocator.block_ids(seq.seq_id)
-        temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays([seq], 1)
+        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
+            self._sampling_arrays([seq], 1)
+        )
         sampling_tail = (
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
-            jnp.asarray(rep),
+            jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
         )
         key = self._seed_lane_key(seq)
         seq.sampling_seeded = True
@@ -1436,11 +1468,14 @@ class JaxLlmEngine:
         want_top = any(
             seq.request.sampling.top_logprobs > 0 for seq in active
         )
-        temp, top_k, top_p, greedy, pres, freq, rep = self._sampling_arrays(active, lanes)
+        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
+            self._sampling_arrays(active, lanes)
+        )
         sampling_tail = (
             jnp.asarray(self._lane_keys), jnp.asarray(temp), jnp.asarray(top_k),
             jnp.asarray(top_p), jnp.asarray(greedy), jnp.asarray(pres),
-            jnp.asarray(freq), jnp.asarray(rep),
+            jnp.asarray(freq), jnp.asarray(rep), jnp.asarray(bias_ids),
+            jnp.asarray(bias_vals),
         )
         if steps <= 1:
             tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(

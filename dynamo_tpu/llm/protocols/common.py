@@ -41,9 +41,18 @@ class SamplingOptions:
     # number of per-token alternatives to report (OpenAI top_logprobs);
     # capped by the engine's compile-time K
     top_logprobs: int = 0
+    # OpenAI logit_bias: {token_id: bias}.  Keys go over the wire as
+    # STRINGS (the msgpack envelope unpacks with strict string map keys;
+    # JSON does the same) — consumers must int() them.  Entries beyond the
+    # engine's compile bucket are dropped (largest-magnitude first
+    # retained).
+    logit_bias: dict | None = None
 
     def to_wire(self) -> dict:
-        return {k: v for k, v in asdict(self).items() if v not in (None,)}
+        d = {k: v for k, v in asdict(self).items() if v not in (None,)}
+        if d.get("logit_bias"):
+            d["logit_bias"] = {str(k): float(v) for k, v in d["logit_bias"].items()}
+        return d
 
     @classmethod
     def from_wire(cls, d: dict) -> "SamplingOptions":

@@ -72,6 +72,7 @@ class ChatCompletionRequest(BaseModel):
     seed: int | None = None
     logprobs: bool | None = None
     top_logprobs: int | None = None
+    logit_bias: dict[str, float] | None = None
     user: str | None = None
     tools: list[dict[str, Any]] | None = None
     tool_choice: Any | None = None
@@ -94,6 +95,10 @@ class ChatCompletionRequest(BaseModel):
             n=self.n or 1,
             use_greedy=bool(self.ext and self.ext.greed_sampling),
             top_logprobs=(self.top_logprobs or 0) if self.logprobs else 0,
+            logit_bias=(
+                {int(k): float(v) for k, v in self.logit_bias.items()}
+                if self.logit_bias else None
+            ),
         )
 
     def stop_conditions(self) -> StopConditions:
@@ -117,6 +122,7 @@ class CompletionRequest(BaseModel):
     stream: bool = False
     stream_options: dict[str, Any] | None = None
     logprobs: int | None = None
+    logit_bias: dict[str, float] | None = None
     echo: bool | None = None
     stop: Union[str, list[str], None] = None
     presence_penalty: float | None = None
@@ -141,6 +147,10 @@ class CompletionRequest(BaseModel):
             n=self.n or 1,
             use_greedy=bool(self.ext and self.ext.greed_sampling),
             top_logprobs=self.logprobs or 0,
+            logit_bias=(
+                {int(k): float(v) for k, v in self.logit_bias.items()}
+                if self.logit_bias else None
+            ),
         )
 
     def stop_conditions(self) -> StopConditions:

@@ -80,6 +80,20 @@ def apply_penalties(
     return logits
 
 
+def apply_logit_bias(
+    logits: jnp.ndarray,  # [batch, vocab] f32
+    ids: jnp.ndarray,     # [batch, K] int32; pad entries = vocab (dropped)
+    vals: jnp.ndarray,    # [batch, K] f32
+) -> jnp.ndarray:
+    """OpenAI ``logit_bias``: add per-token biases before sampling.  The
+    sparse (ids, vals) rows are fixed-width (engine compile bucket); OOB
+    pad ids drop out of the scatter."""
+    if ids.shape[-1] == 0:
+        return logits
+    b = logits.shape[0]
+    return logits.at[jnp.arange(b)[:, None], ids].add(vals, mode="drop")
+
+
 def token_logprobs(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """log-softmax probability of each chosen token [batch] (float32),
     computed from the given logits (the engine passes the penalized,
