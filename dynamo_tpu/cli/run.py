@@ -53,6 +53,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
+    run.add_argument("--kv-cache-dtype", choices=["fp8", "bf16", "f32"],
+                     default=None,
+                     help="KV cache storage dtype (fp8 halves KV bytes; "
+                          "default: model dtype)")
     run.add_argument("--quantize", choices=["int8"], default=None,
                      help="weight-only quantization (all served families; "
                           "halves decode HBM traffic — the TPU analog of "
@@ -99,6 +103,8 @@ async def _run(args) -> int:
                 overrides["warmup"] = True
             if args.quantize:
                 overrides["quantize"] = args.quantize
+            if args.kv_cache_dtype:
+                overrides["kv_cache_dtype"] = args.kv_cache_dtype
         worker = await serve_worker(
             runtime,
             args.model_path,

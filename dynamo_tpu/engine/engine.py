@@ -69,7 +69,12 @@ class EngineConfig:
     prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048, 4096)
     mesh: MeshConfig | None = None
     seed: int = 0
-    kv_cache_dtype: object = None  # default: model dtype
+    # KV cache storage dtype: None = model dtype; a jnp dtype, or a string
+    # ("fp8" → float8_e4m3fn, "bf16", "f32").  fp8 halves KV bytes — the
+    # cache is upcast at every use (attention ops and kernels read through
+    # .astype) — doubling the context a chip holds and the decode batch it
+    # can run (vLLM's --kv-cache-dtype fp8 equivalent).
+    kv_cache_dtype: object = None
     # "auto": Pallas paged-attention kernel on single-chip TPU, gather-based
     # XLA fallback otherwise.  "jax" | "pallas" | "pallas_interpret" force.
     attention_impl: str = "auto"
@@ -119,6 +124,32 @@ class EngineConfig:
         hard = self.num_blocks * self.block_size
         soft = self.max_model_len or self.model.max_position_embeddings
         return min(soft, self.model.max_position_embeddings, hard)
+
+
+_KV_DTYPE_NAMES = {
+    "fp8": "float8_e4m3fn",
+    "float8": "float8_e4m3fn",
+    "float8_e4m3fn": "float8_e4m3fn",
+    "float8_e5m2": "float8_e5m2",
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
+    "f32": "float32",
+    "float32": "float32",
+    "f16": "float16",
+    "float16": "float16",
+}
+
+
+def resolve_kv_cache_dtype(spec):
+    """None | jnp dtype | string name → dtype usable for cache init."""
+    if spec is None or not isinstance(spec, str):
+        return spec
+    name = _KV_DTYPE_NAMES.get(spec.lower())
+    if name is None:
+        raise ValueError(
+            f"unknown kv_cache_dtype {spec!r} (want one of {sorted(set(_KV_DTYPE_NAMES))})"
+        )
+    return jnp.dtype(name)
 
 
 class JaxLlmEngine:
@@ -238,7 +269,8 @@ class JaxLlmEngine:
 
             self._params_quantized = is_quantized(raw_params)
             raw_cache = self.family.cache_init(
-                cfg, config.num_blocks, config.block_size, config.kv_cache_dtype
+                cfg, config.num_blocks, config.block_size,
+                resolve_kv_cache_dtype(config.kv_cache_dtype),
             )
             cos, sin = self.family.rope_tables(cfg)
             lanes = config.max_batch_size

@@ -144,8 +144,15 @@ def prefill_attention_with_prefix(
     kvh = k_new.shape[1]
     groups = h // kvh
     p = k_prefix.shape[0]
-    k = jnp.concatenate([k_prefix, k_new], axis=0).astype(jnp.float32)
-    v = jnp.concatenate([v_prefix, v_new], axis=0).astype(jnp.float32)
+    # cast BEFORE concatenating: the prefix comes from the cache (possibly
+    # fp8, which jax refuses to promote implicitly), the new K/V from the
+    # activation dtype
+    k = jnp.concatenate(
+        [k_prefix.astype(jnp.float32), k_new.astype(jnp.float32)], axis=0
+    )
+    v = jnp.concatenate(
+        [v_prefix.astype(jnp.float32), v_new.astype(jnp.float32)], axis=0
+    )
     qg = q.reshape(s, kvh, groups, d).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     logits = jnp.einsum("qkgd,lkd->kgql", qg, k) * scale

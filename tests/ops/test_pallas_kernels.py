@@ -141,3 +141,50 @@ def test_rope_scaling_llama3_and_yarn():
     assert abs(yarn_mscale(yarn) - (0.1 * math.log(4.0) + 1.0)) < 1e-9
     assert yarn_mscale(None) == 1.0
     assert yarn_mscale({"rope_type": "llama3"}) == 1.0
+
+
+def test_paged_attention_fp8_cache():
+    """fp8 (e4m3) KV pages through the Pallas kernel — the dtype TPU
+    serving/bench defaults feed it (engine 'auto' → pallas + fp8 cache)."""
+    rng = jax.random.PRNGKey(2)
+    k_cache, v_cache, tables, ctx = build_cache(rng)
+    fp8 = jnp.dtype("float8_e4m3fn")
+    k8, v8 = k_cache.astype(fp8), v_cache.astype(fp8)
+    q = jax.random.normal(jax.random.fold_in(rng, 9), (3, 4, 128), jnp.float32)
+
+    ref = paged_decode_attention(q, k8, v8, tables, ctx)  # XLA path, fp8
+    out = paged_attention_decode(q, k8, v8, tables, ctx, interpret=True)
+    np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-2)
+    # and the fp8 result tracks the full-precision one within e4m3 error
+    exact = paged_decode_attention(q, k_cache, v_cache, tables, ctx)
+    rel = np.linalg.norm(np.asarray(out) - np.asarray(exact)) / np.linalg.norm(
+        np.asarray(exact)
+    )
+    assert rel < 0.08
+
+
+def test_mla_paged_attention_fp8_cache():
+    from dynamo_tpu.ops.pallas.mla_attention import mla_paged_attention_decode
+
+    rng = np.random.default_rng(3)
+    b, h, r, p, nb, bs, maxb = 2, 4, 32, 16, 8, 4, 3
+    fp8 = jnp.dtype("float8_e4m3fn")
+    ck = jnp.asarray(rng.standard_normal((nb, bs, r)), jnp.float32)
+    kr = jnp.asarray(rng.standard_normal((nb, bs, p)), jnp.float32)
+    q_lat = jnp.asarray(rng.standard_normal((b, h, r)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((b, h, p)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nb, (b, maxb)), jnp.int32)
+    ctx = jnp.asarray([7, 10], jnp.int32)
+    scale = 1.0 / np.sqrt(r + p)
+
+    exact = mla_paged_attention_decode(
+        q_lat, q_rope, ck, kr, tables, ctx, scale=scale, interpret=True
+    )
+    out = mla_paged_attention_decode(
+        q_lat, q_rope, ck.astype(fp8), kr.astype(fp8), tables, ctx,
+        scale=scale, interpret=True,
+    )
+    rel = np.linalg.norm(np.asarray(out) - np.asarray(exact)) / np.linalg.norm(
+        np.asarray(exact)
+    )
+    assert rel < 0.1
