@@ -53,6 +53,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
+    run.add_argument("--speculative", choices=["ngram"], default=None,
+                     help="speculative decoding (ngram = prompt-lookup "
+                          "self-drafting with exact greedy verification)")
+    run.add_argument("--spec-tokens", type=int, default=4,
+                     help="draft tokens verified per step")
     run.add_argument("--kv-cache-dtype", choices=["fp8", "bf16", "f32"],
                      default=None,
                      help="KV cache storage dtype (fp8 halves KV bytes; "
@@ -105,6 +110,9 @@ async def _run(args) -> int:
                 overrides["quantize"] = args.quantize
             if args.kv_cache_dtype:
                 overrides["kv_cache_dtype"] = args.kv_cache_dtype
+            if args.speculative:
+                overrides["speculative"] = args.speculative
+                overrides["spec_tokens"] = args.spec_tokens
         worker = await serve_worker(
             runtime,
             args.model_path,

@@ -119,6 +119,17 @@ class EngineConfig:
     # {token: bias} scattered onto the logits each step).  Requests with
     # more entries keep the largest-magnitude ones; 0 disables the scatter.
     logit_bias_k: int = 64
+    # Speculative decoding ("ngram" = prompt-lookup self-drafting: the last
+    # spec_ngram tokens are matched against the sequence's history and the
+    # continuation proposed).  One verify pass scores spec_tokens+1
+    # positions per weight stream from HBM — decode is bandwidth-bound, so
+    # accepted drafts are nearly free tokens.  Verification is exact: a
+    # lane emits beyond one token only while drafts match what plain
+    # greedy decode would have produced (sampled/penalized lanes fall back
+    # to one token per step).  Incompatible with decode_steps > 1 and pp.
+    speculative: str | None = None
+    spec_tokens: int = 4
+    spec_ngram: int = 2
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -380,6 +391,31 @@ class JaxLlmEngine:
             else None
         )
         self._jit_decode = self._build_decode()
+        self.spec_enabled = bool(config.speculative)
+        if self.spec_enabled:
+            if config.speculative != "ngram":
+                raise ValueError(
+                    f"unknown speculative mode {config.speculative!r} (want 'ngram')"
+                )
+            if self.family.forward_verify is None:
+                raise ValueError(
+                    f"model family {config.model_family!r} has no verification "
+                    "forward (speculative decoding unsupported)"
+                )
+            if config.decode_steps > 1:
+                raise ValueError(
+                    "speculative decoding is incompatible with decode_steps > 1 "
+                    "(the verify window already fuses multiple tokens per launch)"
+                )
+            if config.mesh is not None and config.mesh.pp > 1:
+                raise ValueError("speculative decoding does not support pp meshes")
+            if config.spec_tokens < 1:
+                raise ValueError("spec_tokens must be >= 1")
+            if config.spec_ngram < 1:
+                raise ValueError("spec_ngram must be >= 1")
+        self._jit_verify = self._build_verify() if self.spec_enabled else None
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._jit_extract = self._build_extract()
         # block-table compile buckets (id-array lengths for extract/inject/
         # restore/prefix paths — no full-size pad buffers)
@@ -659,6 +695,81 @@ class JaxLlmEngine:
             return tokens_seq, lp_seq, tkv_seq, tki_seq, cache, gen_counts
 
         return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
+
+    def _build_verify(self):
+        """Speculative verification step: one forward over the [lanes, w]
+        window (w = spec_tokens + 1), position 0 through the full sampling
+        machinery, later positions greedy.  Lanes verify drafts with the
+        leading-match rule; ``spec_ok`` gates lanes whose sampling config
+        makes greedy verification exact (greedy, no penalties)."""
+        cfg = self.config.model
+        topk_k = self.config.top_logprobs_k
+        w_len = self.config.spec_tokens + 1
+        lanes = self.config.max_batch_size
+        lane_idx = jnp.arange(lanes)
+
+        def step(params, cache, gen_counts, prompt_counts, token_ids,
+                 block_tables, context_lens, slot_ids, spec_ok, keys, temp,
+                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
+            # the pallas window kernel runs single-device only (the tp
+            # shard_map wrapper exists just for the 1-query kernel)
+            impl = self.attention_impl if self.mesh is None else "jax"
+            logits, cache = self.family.forward_verify(
+                params, cfg, token_ids, cache, block_tables, context_lens,
+                slot_ids, self.cos, self.sin, attention=impl,
+            )  # [lanes, w, vocab]
+            active = context_lens > 0
+            base_lens = jnp.maximum(context_lens - (w_len - 1), 0)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, base_lens)
+
+            outs, lps, tkvs, tkis = [], [], [], []
+            for i in range(w_len):
+                li = apply_penalties(
+                    logits[:, i], gen_counts, prompt_counts, pres, freq, rep
+                )
+                li = apply_logit_bias(li, bias_ids, bias_vals)
+                if i == 0:
+                    ti = sample_tokens(li, step_keys, temp, top_k, top_p, greedy)
+                else:
+                    ti = jnp.argmax(li, axis=-1).astype(jnp.int32)
+                outs.append(ti)
+                lps.append(token_logprobs(li, ti))
+                tv, tk_ = topk_logprobs(li, topk_k)
+                tkvs.append(tv)
+                tkis.append(tk_)
+            tokens_out = jnp.stack(outs, axis=1)       # [lanes, w]
+            lp_out = jnp.stack(lps, axis=1)
+            tkv_out = jnp.stack(tkvs, axis=1)
+            tki_out = jnp.stack(tkis, axis=1)
+
+            # leading-match acceptance: draft i (window token i) is kept iff
+            # every earlier draft matched and it equals the model's output
+            # at position i-1
+            acc = spec_ok & active
+            n_accept = jnp.where(active, 1, 0)
+            for i in range(1, w_len):
+                acc = acc & (token_ids[:, i] == tokens_out[:, i - 1])
+                n_accept = n_accept + acc.astype(jnp.int32)
+
+            # penalty bookkeeping for accepted tokens only (spec_ok lanes
+            # have no penalties, but counts must stay exact for later
+            # requests reusing the lane and for stats)
+            pos = jnp.arange(w_len)[None, :]
+            take = (pos < n_accept[:, None]) & active[:, None]
+            gen_counts = gen_counts.at[
+                lane_idx[:, None], tokens_out
+            ].add(take.astype(jnp.int32))
+            return tokens_out, n_accept, lp_out, tkv_out, tki_out, cache, gen_counts
+
+        kwargs = {}
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            repl = NamedSharding(self.mesh, PartitionSpec())
+            kwargs["out_shardings"] = (
+                repl, repl, repl, repl, repl, self._cache_sharding, repl
+            )
+        return jax.jit(step, donate_argnums=(1, 2), **kwargs)
 
     def _build_extract(self):
         """Gather a sequence's KV blocks (padded to max_blocks_per_seq) for
@@ -990,6 +1101,8 @@ class JaxLlmEngine:
             "iterations_total": self._iterations,
             "prefix_hits_total": self.allocator.prefix_hits_total,
             "prefix_cached_tokens_total": self.allocator.prefix_cached_tokens_total,
+            "spec_drafted_tokens_total": self._spec_drafted,
+            "spec_accepted_tokens_total": self._spec_accepted,
         }
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
@@ -1084,6 +1197,8 @@ class JaxLlmEngine:
         if self._jit_prefill_mm is not None:
             self._jit_prefill_mm = self._build_prefill_mm()
         self._jit_decode = self._build_decode()
+        if self._jit_verify is not None:
+            self._jit_verify = self._build_verify()
         return True
 
     def _fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
@@ -1455,7 +1570,56 @@ class JaxLlmEngine:
             seq, int(token), float(lp), top=(tkv, tki) if want_top else None
         )
 
+    def _ngram_draft(self, tokens: list[int]) -> list[int]:
+        """Prompt-lookup drafting: find the most recent earlier occurrence
+        of the sequence's final ``spec_ngram`` tokens and propose the
+        continuation that followed it (up to ``spec_tokens``)."""
+        g = self.config.spec_ngram
+        k = self.config.spec_tokens
+        if len(tokens) < g + 1:
+            return []
+        # bound the host-side scan: matches far behind the tail rarely help,
+        # and an O(context) rescan per lane per step would grow with
+        # generation length
+        tokens = tokens[-4096:]
+        arr = np.asarray(tokens, np.int64)
+        tail = arr[-g:]
+        # windows of width g ending strictly before the final position
+        windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], g)
+        matches = np.flatnonzero((windows == tail).all(axis=1))
+        if len(matches) == 0:
+            return []
+        j = int(matches[-1])  # most recent prior occurrence
+        draft = arr[j + g : j + g + k]
+        return draft.tolist()
+
+    def _spec_ok(self, seq: Sequence) -> bool:
+        """Greedy verification is exact only for greedy, penalty-free
+        sampling (logit_bias is static per-lane and stays exact)."""
+        s = seq.request.sampling
+        greedy = bool(s.use_greedy or s.temperature is None or s.temperature <= 0.0)
+        return (
+            greedy
+            and not s.presence_penalty
+            and not s.frequency_penalty
+            and (not s.repetition_penalty or s.repetition_penalty == 1.0)
+        )
+
     def _run_decode(self, seqs: list[Sequence]) -> None:
+        if self.spec_enabled:
+            # draft first: when NO lane has a usable draft the w-wide
+            # verify program would emit one token per lane at w× the
+            # logits/sampling cost — take the plain decode path instead
+            drafts = {
+                seq.seq_id: self._ngram_draft(seq.all_token_ids)
+                for seq in seqs
+                if seq.status == SeqStatus.RUNNING and self._spec_ok(seq)
+            }
+            if any(drafts.values()):
+                return self._run_verify_decode(seqs, drafts)
+        return self._run_plain_decode(seqs)
+
+    def _run_plain_decode(self, seqs: list[Sequence]) -> None:
         lanes = self.config.max_batch_size
         steps = self.config.decode_steps
         token_ids = np.zeros((lanes,), np.int32)
@@ -1540,6 +1704,88 @@ class JaxLlmEngine:
                     top=(
                         (tkv_host[s, seq.lane], tki_host[s, seq.lane])
                         if want_top else None
+                    ),
+                )
+
+    def _run_verify_decode(self, seqs: list[Sequence], drafts: dict) -> None:
+        """Speculative decode step: draft via prompt lookup, verify the
+        whole window in one forward, emit the accepted prefix."""
+        lanes = self.config.max_batch_size
+        w = self.config.spec_tokens + 1
+        bs = self.config.block_size
+        oob = self.config.num_blocks * bs
+
+        candidates: list[Sequence] = []
+        for seq in list(seqs):
+            if seq.status != SeqStatus.RUNNING:
+                continue
+            # cover the whole window (like decode_steps=w); rejected
+            # positions' blocks are simply reused later
+            slot = self.scheduler.ensure_slots(seq, w, max_pos=self.max_len - 1)
+            if slot is None:
+                self.scheduler.preempt(seq)
+                continue
+            candidates.append(seq)
+        active = [s for s in candidates if s.status == SeqStatus.RUNNING]
+        if not active:
+            return
+
+        token_mat = np.zeros((lanes, w), np.int32)
+        slot_mat = np.full((lanes, w), oob, np.int32)
+        block_tables = np.zeros((lanes, self.max_blocks_per_seq), np.int32)
+        context_lens = np.zeros((lanes,), np.int32)
+        spec_ok = np.zeros((lanes,), bool)
+        for seq in active:
+            if not seq.sampling_seeded:
+                self._seed_lane_state(seq)
+            lane = seq.lane
+            all_tokens = seq.all_token_ids
+            draft = drafts.get(seq.seq_id) or []
+            if draft:
+                spec_ok[lane] = True
+                # attempted = the whole window (pads count: they can accept
+                # too), so accepted/drafted is a true rate <= 1
+                self._spec_drafted += w - 1
+            row = [all_tokens[-1]] + draft
+            row = (row + [row[-1]] * w)[:w]  # pad: never accepted unless equal
+            token_mat[lane] = row
+            blocks = self.allocator.block_ids(seq.seq_id)
+            block_tables[lane, : len(blocks)] = blocks
+            ctx = seq.context_len
+            context_lens[lane] = ctx + w - 1
+            for j in range(w):
+                pos = min(ctx - 1 + j, self.max_len - 1)
+                slot_mat[lane, j] = blocks[pos // bs] * bs + pos % bs
+
+        want_top = any(s.request.sampling.top_logprobs > 0 for s in active)
+        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
+            self._sampling_arrays(active, lanes)
+        )
+        tokens, n_accept, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_verify(
+            self.params, self.cache, self._gen_counts, self._prompt_counts,
+            jnp.asarray(token_mat), jnp.asarray(block_tables),
+            jnp.asarray(context_lens), jnp.asarray(slot_mat),
+            jnp.asarray(spec_ok), jnp.asarray(self._lane_keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
+            jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+        )
+        tokens_h = np.asarray(tokens)
+        n_h = np.asarray(n_accept)
+        lps_h = np.asarray(lps)
+        tkv_h = np.asarray(tkvs) if want_top else None
+        tki_h = np.asarray(tkis) if want_top else None
+        for seq in active:
+            lane = seq.lane
+            n = int(n_h[lane])
+            self._spec_accepted += max(0, n - 1)
+            for i in range(n):
+                if seq.status != SeqStatus.RUNNING:
+                    break
+                self._process_token(
+                    seq, int(tokens_h[lane, i]), float(lps_h[lane, i]),
+                    top=(
+                        (tkv_h[lane, i], tki_h[lane, i]) if want_top else None
                     ),
                 )
 

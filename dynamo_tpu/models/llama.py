@@ -30,6 +30,7 @@ from dynamo_tpu.ops.attention import (
     dense_causal_attention,
     gather_prefix_kv,
     paged_decode_attention,
+    paged_window_attention,
     prefill_attention_with_prefix,
     write_decode_kv,
     write_prefill_kv,
@@ -460,6 +461,69 @@ def llama_forward_decode(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_forward_verify(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,     # [batch, w] int32 — window: last accepted
+                                # token then draft tokens
+    kv_cache: dict,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32 INCLUDING the window's last token
+    slot_ids: jnp.ndarray,      # [batch, w] int32 flat cache slots per position
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
+) -> tuple[jnp.ndarray, dict]:
+    """Speculative-verification forward: score all w window positions in one
+    pass (logits [batch, w, vocab]).  The whole window's K/V is written like
+    decode; rejected positions' cache entries are overwritten when the
+    sequence continues (slots derive from the accepted length).  One weight
+    stream from HBM scores w tokens — the bandwidth economics of
+    speculative decoding on TPU.  ``attention="pallas"`` runs the
+    multi-query paged kernel (no materialized page gather)."""
+    b, w_len = token_ids.shape
+    x = params["embed"][token_ids.reshape(-1)].astype(cfg.dtype)  # [b*w, h]
+    positions = jnp.maximum(
+        context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
+    )  # [b, w]
+    flat_slots = slot_ids.reshape(-1)
+
+    def attend(q, k_layer, v_layer):
+        if attention.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import paged_window_attention_decode
+
+            return paged_window_attention_decode(
+                q, k_layer, v_layer, block_tables, context_lens,
+                interpret=attention == "pallas_interpret",
+            )
+        return paged_window_attention(q, k_layer, v_layer, block_tables, context_lens)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q.reshape(b, w_len, cfg.num_heads, cfg.head_dim), positions, cos, sin)
+        k = apply_rope(k.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim), positions, cos, sin)
+        v = v.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim)
+        k_layer, v_layer = write_decode_kv(
+            k_layer, v_layer, k.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim), flat_slots,
+        )
+        attn = attend(q, k_layer, v_layer)
+        x = x + mm(attn.reshape(b * w_len, -1), w["wo"])
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = _logits(params, cfg, x).reshape(b, w_len, -1)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

@@ -40,6 +40,9 @@ class ModelFamily:
     load_weights: Callable | None = None
     # forward_decode accepts tp_mesh= (shard_map'd pallas attention)
     decode_accepts_tp_mesh: bool = False
+    # multi-position verification forward (speculative decoding); None =
+    # the engine rejects speculative config for this family
+    forward_verify: Callable | None = None
     # param-tree leaf names eligible for weight-only int8 (ops/quant.py);
     # empty = the family's forwards don't route matmuls through quant.mm
     quant_leaves: tuple[str, ...] = ()
@@ -107,6 +110,7 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         load_weights=llama.load_hf_weights,
         decode_accepts_tp_mesh=True,
         quant_leaves=_PROJ_QUANT_LEAVES,
+        forward_verify=llama.llama_forward_verify,
     )
 
 

@@ -117,6 +117,42 @@ def paged_decode_attention(
     return out.reshape(b, h, d).astype(q.dtype)
 
 
+def paged_window_attention(
+    q: jnp.ndarray,            # [batch, w, heads, head_dim] — w queries per seq
+    k_cache: jnp.ndarray,      # [num_blocks, block_size, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32: context length INCLUDING the
+                                # window's last token (0 ⇒ inactive lane)
+) -> jnp.ndarray:
+    """Multi-query decode attention for speculative verification: the w
+    window tokens' K/V are already written to the cache (like decode), and
+    query i attends up to absolute position ``context_lens - w + i``
+    (causal within the window, full context before it).  Returns
+    [batch, w, heads, head_dim]."""
+    b, w, h, d = q.shape
+    _, block_size, kvh, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    groups = h // kvh
+
+    k = k_cache[block_tables].reshape(b, max_blocks * block_size, kvh, d)
+    v = v_cache[block_tables].reshape(b, max_blocks * block_size, kvh, d)
+    length = max_blocks * block_size
+
+    qg = q.reshape(b, w, kvh, groups, d).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    logits = jnp.einsum("bwkgd,blkd->bkgwl", qg, k.astype(jnp.float32)) * scale
+    # query i sits at absolute position context_lens - w + i; it sees
+    # positions <= its own
+    q_pos = context_lens[:, None] - w + jnp.arange(w)[None, :]       # [b, w]
+    kv_pos = jnp.arange(length)[None, None, :]                        # [1, 1, l]
+    mask = kv_pos <= q_pos[:, :, None]                                # [b, w, l]
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgwl,blkd->bwkgd", weights, v.astype(jnp.float32))
+    return out.reshape(b, w, h, d).astype(q.dtype)
+
+
 def gather_prefix_kv(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,

@@ -10,7 +10,15 @@
 Kernels run in interpret mode on CPU (tests) and compiled on TPU.
 """
 
-from dynamo_tpu.ops.pallas.paged_attention import paged_attention_decode
+from dynamo_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+    paged_window_attention_decode,
+)
 from dynamo_tpu.ops.pallas.block_copy import gather_blocks, scatter_blocks
 
-__all__ = ["paged_attention_decode", "gather_blocks", "scatter_blocks"]
+__all__ = [
+    "paged_attention_decode",
+    "paged_window_attention_decode",
+    "gather_blocks",
+    "scatter_blocks",
+]

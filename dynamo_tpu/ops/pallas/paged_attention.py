@@ -96,6 +96,139 @@ def _kernel(
         out_ref[0] = out.astype(out_ref.dtype)
 
 
+def _window_kernel(
+    block_tables_ref,   # [B, maxb] int32
+    context_lens_ref,   # [B] int32 — INCLUDING the window's last token
+    q_ref,              # [1, W, H, D]
+    k_page_ref,         # [1, bs, KVH, D]
+    v_page_ref,
+    out_ref,            # [1, W, H, D]
+    m_ref,              # [KVH, W*G, 128] f32
+    l_ref,
+    acc_ref,            # [KVH, W*G, D] f32
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    groups: int,
+    head_dim: int,
+    max_blocks: int,
+    window: int,
+):
+    """Multi-query (speculative verification) variant: the W window queries
+    fold into the group axis — one extra mask term per query position,
+    otherwise the same online-softmax page loop as ``_kernel``."""
+    seq = pl.program_id(0)
+    page = pl.program_id(1)
+    ctx = context_lens_ref[seq]
+    wg = window * groups
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_start = page * block_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        # [W, KVH, G, D] → [KVH, W, G, D] → [KVH, W*G, D]
+        q = (
+            q_ref[0]
+            .reshape(window, num_kv_heads, groups, head_dim)
+            .transpose(1, 0, 2, 3)
+            .reshape(num_kv_heads, wg, head_dim)
+            .astype(jnp.float32)
+        )
+        k = k_page_ref[0].astype(jnp.float32)
+        v = v_page_ref[0].astype(jnp.float32)
+        scale = 1.0 / (head_dim ** 0.5)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                            # [KVH, W*G, bs]
+        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
+        w_idx = jax.lax.broadcasted_iota(jnp.int32, (1, wg, 1), 1) // groups
+        q_pos = ctx - window + w_idx                          # [1, W*G, 1]
+        s = jnp.where(pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(page == max_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
+        out = (
+            (acc_ref[...] / denom)
+            .reshape(num_kv_heads, window, groups, head_dim)
+            .transpose(1, 0, 2, 3)
+            .reshape(window, num_kv_heads * groups, head_dim)
+        )
+        out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_window_attention_decode(
+    q: jnp.ndarray,            # [B, W, H, D]
+    k_cache: jnp.ndarray,      # [N, bs, KVH, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [B, maxb] int32
+    context_lens: jnp.ndarray,  # [B] int32 — INCLUDING the window's last token
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Pallas multi-query paged attention for speculative verification
+    (pure-JAX twin: ops/attention.py paged_window_attention)."""
+    b, w, h, d = q.shape
+    _, bs, kvh, _ = k_cache.shape
+    maxb = block_tables.shape[1]
+    groups = h // kvh
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, w, h, d), lambda s, p, bt, cl: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, w, h, d), lambda s, p, bt, cl: (s, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, w * groups, 128), jnp.float32),
+            pltpu.VMEM((kvh, w * groups, 128), jnp.float32),
+            pltpu.VMEM((kvh, w * groups, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _window_kernel,
+        block_size=bs,
+        num_kv_heads=kvh,
+        groups=groups,
+        head_dim=d,
+        max_blocks=maxb,
+        window=w,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, w, h, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, context_lens, q, k_cache, v_cache)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention_decode(
     q: jnp.ndarray,            # [B, H, D]

@@ -1,0 +1,171 @@
+"""Prompt-lookup speculative decoding: the op, the drafter, and the
+engine-level exactness guarantee (speculative output == plain greedy
+output, token for token), plus acceptance accounting."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.ops.attention import (
+    paged_decode_attention,
+    paged_window_attention,
+    write_decode_kv,
+)
+from dynamo_tpu.runtime.engine import Context
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_window_attention_matches_decode():
+    """Each window position must equal a plain decode step at that context
+    length (same cache)."""
+    rng = np.random.default_rng(0)
+    nb, bs, kvh, h, d, b, w = 8, 4, 2, 4, 16, 2, 3
+    k_cache = jnp.asarray(rng.standard_normal((nb, bs, kvh, d)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((nb, bs, kvh, d)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nb, (b, nb)), jnp.int32)
+    ctx = jnp.asarray([9, 6], jnp.int32)  # INCLUDING window's last token
+    q = jnp.asarray(rng.standard_normal((b, w, h, d)), jnp.float32)
+
+    out = paged_window_attention(q, k_cache, v_cache, tables, ctx)
+    for i in range(w):
+        ref = paged_decode_attention(
+            q[:, i], k_cache, v_cache, tables, ctx - (w - 1 - i)
+        )
+        np.testing.assert_allclose(np.asarray(out[:, i]), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def _engine(**overrides):
+    defaults = dict(
+        model=CFG, num_blocks=128, block_size=4, max_batch_size=2,
+        prefill_buckets=(16, 32), max_model_len=128,
+    )
+    defaults.update(overrides)
+    eng = JaxLlmEngine(EngineConfig(**defaults), params=PARAMS)
+    eng.start()
+    return eng
+
+
+def _generate(engine, prompt, n=24, **sampling):
+    req = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(**sampling) if sampling else SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=n, ignore_eos=True),
+        eos_token_ids=[],
+    ).to_wire()
+
+    async def run():
+        stream = await engine.generate(Context(req))
+        out = []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None:
+                assert ann.data.error is None, ann.data.error
+                out.extend(ann.data.token_ids)
+        return out
+
+    return asyncio.run(run())
+
+
+# a prompt with a strong repeated pattern so prompt-lookup finds drafts
+PATTERN = [7, 11, 19, 7, 11, 19, 7, 11, 19, 7, 11]
+
+
+def test_ngram_drafter():
+    eng = _engine(speculative="ngram", spec_tokens=3, spec_ngram=2)
+    try:
+        d = eng._ngram_draft(PATTERN)
+        # last 2-gram [7, 11] last occurred at index 6; continuation [19, 7, 11]
+        assert d == [19, 7, 11]
+        assert eng._ngram_draft([1, 2, 3, 4]) == []
+    finally:
+        eng.stop()
+
+
+def test_speculative_matches_plain_greedy():
+    plain = _engine()
+    spec = _engine(speculative="ngram", spec_tokens=4)
+    try:
+        for prompt in (PATTERN, [5, 9, 13, 17, 21], list(range(30, 60))):
+            a = _generate(plain, prompt)
+            b = _generate(spec, prompt)
+            assert a == b, f"speculative diverged on {prompt}: {a} vs {b}"
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_speculative_accepts_on_repetitive_output():
+    """Constant-ish weights produce repetitive greedy output, so lookup
+    drafts should accept and the counter must advance."""
+    spec = _engine(speculative="ngram", spec_tokens=4)
+    try:
+        out = _generate(spec, PATTERN, n=32)
+        stats = spec.stats()
+        assert stats["spec_drafted_tokens_total"] > 0
+        # deterministic weights (PRNGKey(0)) drive greedy decode into a
+        # repeating loop on this prompt, so prompt-lookup MUST accept —
+        # a broken acceptance chain (always n=1) fails here
+        assert stats["spec_accepted_tokens_total"] > 0, (out, stats)
+    finally:
+        spec.stop()
+
+
+def test_sampled_lane_falls_back_exactly():
+    """Seeded temperature sampling must be identical with and without
+    speculation (non-greedy lanes take only position-0 tokens, through the
+    same sampling machinery)."""
+    plain = _engine()
+    spec = _engine(speculative="ngram", spec_tokens=3)
+    try:
+        kw = dict(temperature=0.8, seed=1234)
+        a = _generate(plain, PATTERN, n=16, **kw)
+        b = _generate(spec, PATTERN, n=16, **kw)
+        assert a == b
+    finally:
+        plain.stop()
+        spec.stop()
+
+
+def test_speculative_config_validation():
+    with pytest.raises(ValueError, match="decode_steps"):
+        _engine(speculative="ngram", decode_steps=4)
+    with pytest.raises(ValueError, match="verification"):
+        from dynamo_tpu.models.mixtral import MixtralConfig
+
+        JaxLlmEngine(
+            EngineConfig(
+                model=MixtralConfig.tiny_moe(), model_family="mixtral",
+                speculative="ngram", num_blocks=16, block_size=4,
+                max_batch_size=2,
+            )
+        )
+
+
+def test_speculative_pallas_interpret_matches():
+    """Engine verify path through the Pallas window kernel (interpret)."""
+    plain = _engine()
+    spec = _engine(
+        speculative="ngram", spec_tokens=3, attention_impl="pallas_interpret"
+    )
+    try:
+        a = _generate(plain, PATTERN, n=12)
+        b = _generate(spec, PATTERN, n=12)
+        assert a == b
+    finally:
+        plain.stop()
+        spec.stop()
