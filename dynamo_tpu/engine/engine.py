@@ -1068,6 +1068,21 @@ class JaxLlmEngine:
             n = min(2 * self.chunk_tokens, self.max_len - want_tokens)
             if n > self.chunk_tokens:
                 await drive(n, min(want_tokens, self.max_len - n))
+        if self.spec_enabled:
+            # warmup's random prompts never draft, so the verify program
+            # would otherwise pay its compile on the first real accepting
+            # step: run it once with every lane inactive (writes all drop,
+            # nothing emitted) on the device thread
+            loop = asyncio.get_running_loop()
+            fut: asyncio.Future = loop.create_future()
+            self._submit_q.put((
+                "warm_verify",
+                lambda: loop.call_soon_threadsafe(
+                    lambda: fut.set_result(None) if not fut.done() else None
+                ),
+            ))
+            self._wake.set()
+            await fut
         await self.clear_kv_blocks()
 
     async def clear_kv_blocks(self) -> None:
@@ -1224,6 +1239,13 @@ class JaxLlmEngine:
                     seq.status = SeqStatus.FINISHED
                     if seq.emit:
                         seq.emit([], FinishReason.CANCELLED)
+            elif op == "warm_verify":
+                done = seq  # payload is the completion callback
+                try:
+                    self._warm_verify_step()
+                except Exception:  # noqa: BLE001 — warmup best-effort
+                    logger.exception("verify warmup failed")
+                done()
             elif op == "clear_kv":
                 done = seq  # payload is the completion callback
                 cleared = self.allocator.clear_published()
@@ -1707,6 +1729,27 @@ class JaxLlmEngine:
                     ),
                 )
 
+    def _warm_verify_step(self) -> None:
+        """Compile the verify program: one launch with every lane inactive
+        (ctx 0 ⇒ slots OOB ⇒ all cache writes drop, nothing accepted)."""
+        lanes = self.config.max_batch_size
+        w = self.config.spec_tokens + 1
+        oob = self.config.num_blocks * self.config.block_size
+        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
+            self._sampling_arrays([], lanes)
+        )
+        _, _, _, _, _, self.cache, self._gen_counts = self._jit_verify(
+            self.params, self.cache, self._gen_counts, self._prompt_counts,
+            jnp.zeros((lanes, w), jnp.int32),
+            jnp.zeros((lanes, self.max_blocks_per_seq), jnp.int32),
+            jnp.zeros((lanes,), jnp.int32),
+            jnp.full((lanes, w), oob, jnp.int32),
+            jnp.zeros((lanes,), bool), jnp.asarray(self._lane_keys),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
+            jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+        )
+
     def _run_verify_decode(self, seqs: list[Sequence], drafts: dict) -> None:
         """Speculative decode step: draft via prompt lookup, verify the
         whole window in one forward, emit the accepted prefix."""
@@ -1743,9 +1786,6 @@ class JaxLlmEngine:
             draft = drafts.get(seq.seq_id) or []
             if draft:
                 spec_ok[lane] = True
-                # attempted = the whole window (pads count: they can accept
-                # too), so accepted/drafted is a true rate <= 1
-                self._spec_drafted += w - 1
             row = [all_tokens[-1]] + draft
             row = (row + [row[-1]] * w)[:w]  # pad: never accepted unless equal
             token_mat[lane] = row
@@ -1775,6 +1815,10 @@ class JaxLlmEngine:
         lps_h = np.asarray(lps)
         tkv_h = np.asarray(tkvs) if want_top else None
         tki_h = np.asarray(tkis) if want_top else None
+        # count attempts only after the jit succeeded (an attention-fallback
+        # retry re-enters this method for the same step); attempted = the
+        # whole window (pads can accept too), so accepted/drafted <= 1
+        self._spec_drafted += int(spec_ok.sum()) * (w - 1)
         for seq in active:
             lane = seq.lane
             n = int(n_h[lane])

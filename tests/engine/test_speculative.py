@@ -141,6 +141,56 @@ def test_sampled_lane_falls_back_exactly():
         spec.stop()
 
 
+def _generate_pair(engine, prompts_and_sampling, n=16):
+    """Run several requests CONCURRENTLY on one engine (shared decode
+    batch) and return their token streams in order."""
+
+    async def one(prompt, sampling):
+        req = PreprocessedRequest(
+            token_ids=list(prompt),
+            sampling=sampling,
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+        stream = await engine.generate(Context(req))
+        out = []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None:
+                assert ann.data.error is None, ann.data.error
+                out.extend(ann.data.token_ids)
+        return out
+
+    async def run():
+        return await asyncio.gather(
+            *(one(p, s) for p, s in prompts_and_sampling)
+        )
+
+    return asyncio.run(run())
+
+
+def test_mixed_batch_sampled_and_greedy_lanes():
+    """A seeded-sampled request decoding CONCURRENTLY with a drafting
+    greedy request goes through the verify program (the greedy lane
+    drafts), so the sampled lane's position-0 sampling and single-token
+    emission in _build_verify must match plain decode exactly."""
+    mixed = [
+        (PATTERN, SamplingOptions(use_greedy=True)),
+        ([40, 41, 42, 43, 44], SamplingOptions(temperature=0.8, seed=77)),
+    ]
+    plain = _engine()
+    spec = _engine(speculative="ngram", spec_tokens=3)
+    try:
+        a = _generate_pair(plain, mixed)
+        b = _generate_pair(spec, mixed)
+        assert a == b
+        # the greedy lane must actually have drafted (verify path taken)
+        assert spec.stats()["spec_drafted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec.stop()
+
+
 def test_speculative_config_validation():
     with pytest.raises(ValueError, match="decode_steps"):
         _engine(speculative="ngram", decode_steps=4)
@@ -168,4 +218,18 @@ def test_speculative_pallas_interpret_matches():
         assert a == b
     finally:
         plain.stop()
+        spec.stop()
+
+
+def test_warmup_compiles_verify():
+    spec = _engine(speculative="ngram", spec_tokens=2)
+    try:
+        asyncio.run(spec.warmup())
+        # the verify program is compiled and the engine still serves exactly
+        plain = _engine()
+        try:
+            assert _generate(spec, PATTERN, n=8) == _generate(plain, PATTERN, n=8)
+        finally:
+            plain.stop()
+    finally:
         spec.stop()
