@@ -1075,12 +1075,19 @@ class JaxLlmEngine:
             # nothing emitted) on the device thread
             loop = asyncio.get_running_loop()
             fut: asyncio.Future = loop.create_future()
-            self._submit_q.put((
-                "warm_verify",
-                lambda: loop.call_soon_threadsafe(
-                    lambda: fut.set_result(None) if not fut.done() else None
-                ),
-            ))
+
+            def done(exc) -> None:
+                def resolve() -> None:
+                    if fut.done():
+                        return
+                    if exc is not None:
+                        fut.set_exception(exc)
+                    else:
+                        fut.set_result(None)
+
+                loop.call_soon_threadsafe(resolve)
+
+            self._submit_q.put(("warm_verify", done))
             self._wake.set()
             await fut
         await self.clear_kv_blocks()
@@ -1240,12 +1247,24 @@ class JaxLlmEngine:
                     if seq.emit:
                         seq.emit([], FinishReason.CANCELLED)
             elif op == "warm_verify":
-                done = seq  # payload is the completion callback
+                done = seq  # payload: completion callback (exc | None)
                 try:
-                    self._warm_verify_step()
-                except Exception:  # noqa: BLE001 — warmup best-effort
+                    try:
+                        self._warm_verify_step()
+                    except Exception as exc:  # noqa: BLE001 — same fallback
+                        # contract as prefill/decode: compile-class kernel
+                        # failures degrade to XLA attention and retry once
+                        if not self._attention_fallback(exc):
+                            raise
+                        self._warm_verify_step()
+                except Exception as exc:  # noqa: BLE001 — surface to the
+                    # awaiting warmup() call; a swallowed failure here could
+                    # hide a donation-consumed cache behind a "successful"
+                    # warmup
                     logger.exception("verify warmup failed")
-                done()
+                    done(exc)
+                else:
+                    done(None)
             elif op == "clear_kv":
                 done = seq  # payload is the completion callback
                 cleared = self.allocator.clear_published()

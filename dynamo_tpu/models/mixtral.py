@@ -25,6 +25,7 @@ from dynamo_tpu.ops.attention import (
     dense_causal_attention,
     gather_prefix_kv,
     paged_decode_attention,
+    paged_window_attention,
     prefill_attention_with_prefix,
     write_decode_kv,
     write_prefill_kv,
@@ -295,6 +296,83 @@ def mixtral_forward_decode(
         if cfg.tie_word_embeddings
         else mm(x, params["lm_head"])
     )
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def mixtral_forward_verify(
+    params, cfg: MixtralConfig, token_ids, kv_cache, block_tables,
+    context_lens, slot_ids, cos, sin, *, attention: str = "jax",
+):
+    """Speculative-verification forward for the MoE family: the [b, w]
+    window runs through the same attention scaffold as decode (multi-query
+    paged window attention) and the MoE FFN sees the window's b*w tokens.
+    Contract matches llama_forward_verify.
+
+    Token order is POSITION-major (all lanes' position-0 tokens first):
+    expert-capacity slots assign in dispatch order (ops/moe.py), so the
+    always-emitted position-0 tokens never lose a slot to a later draft
+    position.  MoE parity with plain decode is therefore near-exact but
+    not guaranteed under extreme routing skew — capacity grows w-fold with
+    the window, yet which tokens drop can differ from the non-speculative
+    schedule (a capacity-dropping property, not an acceptance-logic one)."""
+    b, w_len = token_ids.shape
+    # [b, w] → position-major flat [w*b]
+    x = params["embed"][token_ids.T.reshape(-1)].astype(cfg.dtype)
+    positions = jnp.maximum(
+        context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
+    )  # [b, w]
+    flat_slots = slot_ids.T.reshape(-1)
+
+    def attend_pages(q, k_layer, v_layer):
+        if attention.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import paged_window_attention_decode
+
+            return paged_window_attention_decode(
+                q, k_layer, v_layer, block_tables, context_lens,
+                interpret=attention == "pallas_interpret",
+            )
+        return paged_window_attention(q, k_layer, v_layer, block_tables, context_lens)
+
+    def to_bw(t, *tail):
+        # position-major flat [w*b, ...] → [b, w, ...]
+        return t.reshape(w_len, b, *tail).transpose(1, 0, *(i + 2 for i in range(len(tail))))
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        state = {}
+
+        def attn(attn_in):
+            q = to_bw(mm(attn_in, w["wq"]), cfg.num_heads, cfg.head_dim)
+            k = to_bw(mm(attn_in, w["wk"]), cfg.num_kv_heads, cfg.head_dim)
+            v = to_bw(mm(attn_in, w["wv"]), cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:
+                q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            state["kv"] = write_decode_kv(
+                k_layer, v_layer,
+                k.transpose(1, 0, 2, 3).reshape(w_len * b, cfg.num_kv_heads, cfg.head_dim),
+                v.transpose(1, 0, 2, 3).reshape(w_len * b, cfg.num_kv_heads, cfg.head_dim),
+                flat_slots,
+            )
+            attn_out = attend_pages(q, state["kv"][0], state["kv"][1])  # [b, w, H, D]
+            flat = attn_out.transpose(1, 0, 2, 3).reshape(w_len * b, -1)
+            return mm(flat, w["wo"])
+
+        x = _block(cfg, w, x, attn)
+        return x, state["kv"]
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (
+        x @ params["embed"].T.astype(x.dtype)
+        if cfg.tie_word_embeddings
+        else mm(x, params["lm_head"])
+    )
+    logits = logits.reshape(w_len, b, -1).transpose(1, 0, 2)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

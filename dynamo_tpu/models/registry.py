@@ -143,6 +143,7 @@ def _mixtral_family() -> ModelFamily:
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
+        forward_verify=mixtral.mixtral_forward_verify,
     )
 
 
@@ -162,6 +163,7 @@ def _qwen3_moe_family() -> ModelFamily:
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
+        forward_verify=mixtral.mixtral_forward_verify,
     )
 
 

@@ -195,15 +195,44 @@ def test_speculative_config_validation():
     with pytest.raises(ValueError, match="decode_steps"):
         _engine(speculative="ngram", decode_steps=4)
     with pytest.raises(ValueError, match="verification"):
-        from dynamo_tpu.models.mixtral import MixtralConfig
+        from dynamo_tpu.models.deepseek import DeepseekConfig
 
         JaxLlmEngine(
             EngineConfig(
-                model=MixtralConfig.tiny_moe(), model_family="mixtral",
+                model=DeepseekConfig.tiny_mla(), model_family="deepseek_v2",
                 speculative="ngram", num_blocks=16, block_size=4,
                 max_batch_size=2,
             )
         )
+
+
+def test_moe_speculative_matches_plain_greedy():
+    """Mixtral family verify forward: spec output == plain greedy output."""
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    cfg = MixtralConfig.tiny_moe()
+
+    def build(**kw):
+        eng = JaxLlmEngine(
+            EngineConfig(
+                model=cfg, model_family="mixtral", num_blocks=128,
+                block_size=4, max_batch_size=2, prefill_buckets=(16, 32),
+                max_model_len=128, **kw,
+            ),
+        )
+        eng.start()
+        return eng
+
+    plain = build()
+    spec = build(speculative="ngram", spec_tokens=3)
+    try:
+        a = _generate(plain, PATTERN, n=16)
+        b = _generate(spec, PATTERN, n=16)
+        assert a == b
+        assert spec.stats()["spec_drafted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec.stop()
 
 
 def test_speculative_pallas_interpret_matches():
