@@ -49,6 +49,7 @@ from jax.sharding import PartitionSpec as P
 from dynamo_tpu.ops.attention import NEG_INF, write_decode_kv, write_prefill_kv
 from dynamo_tpu.ops.moe import moe_ffn
 from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.attention import position_major_to_batch
 from dynamo_tpu.ops.quant import mm
 from dynamo_tpu.ops.rope import apply_rope, rope_table
 
@@ -529,6 +530,60 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
     return mm(out.reshape(b, -1), w["wo"]), (k_layer, v_layer)
 
 
+def _mla_window_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
+                     block_tables, context_lens, flat_slots, cos, sin,
+                     b: int, w_len: int):
+    """Multi-query absorbed-form attention for speculative verification:
+    w window queries per lane against the latent cache (XLA gather path;
+    the single-query MLA Pallas kernel does not cover windows yet).
+    ``x`` is position-major flat [w*b, h] (see mixtral_forward_verify on
+    why dispatch order matters for the MoE layers)."""
+    H = cfg.num_heads
+
+    def to_bw(t, *tail):
+        return position_major_to_batch(t, w_len, b, *tail)
+
+    q = _project_q(w, x, cfg)                    # [w*b, H, qk_head_dim]
+    q = to_bw(q, H, cfg.qk_head_dim)             # [b, w, H, d]
+    q_nope, q_rope = q[..., : cfg.qk_nope_head_dim], q[..., cfg.qk_nope_head_dim :]
+    q_rope = apply_rope(q_rope, positions, cos, sin)  # [b, w, H, p]
+
+    c_kv_new, k_rope_new = _latent_kv(w, x, cfg)  # [w*b, r], [w*b, p]
+    k_rope_bw = to_bw(k_rope_new, cfg.qk_rope_head_dim)[:, :, None, :]  # [b, w, 1, p]
+    k_rope_bw = apply_rope(k_rope_bw, positions, cos, sin)
+    k_layer, v_layer = write_decode_kv(
+        k_layer, v_layer,
+        c_kv_new[:, None, :],
+        k_rope_bw.transpose(1, 0, 2, 3).reshape(w_len * b, 1, -1),
+        flat_slots,
+    )
+
+    w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
+    w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
+    q_lat = jnp.einsum(
+        "bwhn,rhn->bwhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32)
+    )
+
+    block_size = k_layer.shape[1]
+    max_blocks = block_tables.shape[1]
+    length = max_blocks * block_size
+    ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
+    kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
+    logits = (
+        jnp.einsum("bwhr,btr->bhwt", q_lat, ck.astype(jnp.float32))
+        + jnp.einsum("bwhp,btp->bhwt", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
+    ) * float(cfg.attn_scale)
+    q_pos = context_lens[:, None] - w_len + jnp.arange(w_len)[None, :]   # [b, w]
+    kv_pos = jnp.arange(length)[None, None, :]                            # [1, 1, t]
+    mask = kv_pos <= q_pos[:, :, None]                                    # [b, w, t]
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    ctx = jnp.einsum("bhwt,btr->bwhr", weights, ck.astype(jnp.float32))
+    out = jnp.einsum("bwhr,rhv->bwhv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
+    flat = out.transpose(1, 0, 2, 3).reshape(w_len * b, -1)
+    return mm(flat, w["wo"]), (k_layer, v_layer)
+
+
 def _dense_mlp(w, x):
     return mm(jax.nn.silu(mm(x, w["w_gate"])) * mm(x, w["w_up"]), w["w_down"])
 
@@ -659,6 +714,35 @@ def deepseek_forward_decode(
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)
     logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), new_cache
+
+
+def deepseek_forward_verify(
+    params, cfg: DeepseekConfig, token_ids, kv_cache, block_tables,
+    context_lens, slot_ids, cos, sin, *, attention: str = "jax",
+):
+    """Speculative-verification forward for the MLA family (contract:
+    llama_forward_verify).  Window tokens run position-major (expert
+    capacity priority, see mixtral_forward_verify); attention uses the XLA
+    absorbed-form multi-query path regardless of ``attention`` (no MLA
+    window kernel yet)."""
+    del attention
+    b, w_len = token_ids.shape
+    x = params["embed"][token_ids.T.reshape(-1)].astype(cfg.dtype)
+    positions = jnp.maximum(
+        context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
+    )
+    flat_slots = slot_ids.T.reshape(-1)
+
+    def attn(w, attn_in, k_layer, v_layer):
+        return _mla_window_attn(
+            w, attn_in, cfg, positions, k_layer, v_layer, block_tables,
+            context_lens, flat_slots, cos, sin, b, w_len,
+        )
+
+    x, new_cache = _forward(params, cfg, x, kv_cache, attn)
+    logits = _logits(params, cfg, x)
+    logits = logits.reshape(w_len, b, -1).transpose(1, 0, 2)
     return logits.astype(jnp.float32), new_cache
 
 

@@ -30,8 +30,9 @@ from dynamo_tpu.ops.attention import (
     dense_causal_attention,
     gather_prefix_kv,
     paged_decode_attention,
-    paged_window_attention,
+    paged_window_attention,  # noqa: F401 — re-exported for tests
     prefill_attention_with_prefix,
+    window_attention,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -493,14 +494,9 @@ def llama_forward_verify(
     flat_slots = slot_ids.reshape(-1)
 
     def attend(q, k_layer, v_layer):
-        if attention.startswith("pallas"):
-            from dynamo_tpu.ops.pallas import paged_window_attention_decode
-
-            return paged_window_attention_decode(
-                q, k_layer, v_layer, block_tables, context_lens,
-                interpret=attention == "pallas_interpret",
-            )
-        return paged_window_attention(q, k_layer, v_layer, block_tables, context_lens)
+        return window_attention(
+            attention, q, k_layer, v_layer, block_tables, context_lens
+        )
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in

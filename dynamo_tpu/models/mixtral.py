@@ -25,8 +25,9 @@ from dynamo_tpu.ops.attention import (
     dense_causal_attention,
     gather_prefix_kv,
     paged_decode_attention,
-    paged_window_attention,
+    position_major_to_batch,
     prefill_attention_with_prefix,
+    window_attention,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -324,18 +325,12 @@ def mixtral_forward_verify(
     flat_slots = slot_ids.T.reshape(-1)
 
     def attend_pages(q, k_layer, v_layer):
-        if attention.startswith("pallas"):
-            from dynamo_tpu.ops.pallas import paged_window_attention_decode
-
-            return paged_window_attention_decode(
-                q, k_layer, v_layer, block_tables, context_lens,
-                interpret=attention == "pallas_interpret",
-            )
-        return paged_window_attention(q, k_layer, v_layer, block_tables, context_lens)
+        return window_attention(
+            attention, q, k_layer, v_layer, block_tables, context_lens
+        )
 
     def to_bw(t, *tail):
-        # position-major flat [w*b, ...] → [b, w, ...]
-        return t.reshape(w_len, b, *tail).transpose(1, 0, *(i + 2 for i in range(len(tail))))
+        return position_major_to_batch(t, w_len, b, *tail)
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in

@@ -188,6 +188,7 @@ def _deepseek_family() -> ModelFamily:
             "w_dq", "w_uq", "wq", "w_dkv", "wo", "w_gate", "w_up", "w_down",
             "ws_gate", "ws_up", "ws_down", "lm_head",
         ),
+        forward_verify=deepseek.deepseek_forward_verify,
     )
 
 

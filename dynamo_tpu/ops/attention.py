@@ -153,6 +153,35 @@ def paged_window_attention(
     return out.reshape(b, w, h, d).astype(q.dtype)
 
 
+def window_attention(
+    attention: str,
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Dispatch speculative-window attention by implementation name
+    ("pallas"/"pallas_interpret" → the Pallas window kernel, else the
+    XLA gather path above).  One dispatch shared by every family's verify
+    forward so kernel signature changes happen in one place."""
+    if attention.startswith("pallas"):
+        from dynamo_tpu.ops.pallas import paged_window_attention_decode
+
+        return paged_window_attention_decode(
+            q, k_cache, v_cache, block_tables, context_lens,
+            interpret=attention == "pallas_interpret",
+        )
+    return paged_window_attention(q, k_cache, v_cache, block_tables, context_lens)
+
+
+def position_major_to_batch(t: jnp.ndarray, w: int, b: int, *tail: int) -> jnp.ndarray:
+    """Reshape a position-major flat window axis ([w*b, ...], index =
+    position*b + lane — the dispatch order that gives position-0 tokens
+    expert-capacity priority in MoE verify forwards) into [b, w, ...]."""
+    return t.reshape(w, b, *tail).transpose(1, 0, *(i + 2 for i in range(len(tail))))
+
+
 def gather_prefix_kv(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,

@@ -194,28 +194,31 @@ def test_mixed_batch_sampled_and_greedy_lanes():
 def test_speculative_config_validation():
     with pytest.raises(ValueError, match="decode_steps"):
         _engine(speculative="ngram", decode_steps=4)
-    with pytest.raises(ValueError, match="verification"):
-        from dynamo_tpu.models.deepseek import DeepseekConfig
-
-        JaxLlmEngine(
-            EngineConfig(
-                model=DeepseekConfig.tiny_mla(), model_family="deepseek_v2",
-                speculative="ngram", num_blocks=16, block_size=4,
-                max_batch_size=2,
-            )
-        )
+    with pytest.raises(ValueError, match="speculative"):
+        _engine(speculative="medusa")
+    with pytest.raises(ValueError, match="spec_ngram"):
+        _engine(speculative="ngram", spec_ngram=0)
 
 
-def test_moe_speculative_matches_plain_greedy():
-    """Mixtral family verify forward: spec output == plain greedy output."""
-    from dynamo_tpu.models.mixtral import MixtralConfig
-
-    cfg = MixtralConfig.tiny_moe()
+@pytest.mark.parametrize(
+    "family,config_factory",
+    [
+        ("mixtral", lambda: __import__(
+            "dynamo_tpu.models.mixtral", fromlist=["MixtralConfig"]
+        ).MixtralConfig.tiny_moe()),
+        ("deepseek_v2", lambda: __import__(
+            "dynamo_tpu.models.deepseek", fromlist=["DeepseekConfig"]
+        ).DeepseekConfig.tiny_mla()),
+    ],
+)
+def test_family_speculative_matches_plain_greedy(family, config_factory):
+    """MoE and MLA verify forwards: spec output == plain greedy output."""
+    cfg = config_factory()
 
     def build(**kw):
         eng = JaxLlmEngine(
             EngineConfig(
-                model=cfg, model_family="mixtral", num_blocks=128,
+                model=cfg, model_family=family, num_blocks=128,
                 block_size=4, max_batch_size=2, prefill_buckets=(16, 32),
                 max_model_len=128, **kw,
             ),
@@ -224,27 +227,16 @@ def test_moe_speculative_matches_plain_greedy():
         return eng
 
     plain = build()
-    spec = build(speculative="ngram", spec_tokens=3)
+    try:
+        spec = build(speculative="ngram", spec_tokens=3)
+    except BaseException:
+        plain.stop()
+        raise
     try:
         a = _generate(plain, PATTERN, n=16)
         b = _generate(spec, PATTERN, n=16)
         assert a == b
         assert spec.stats()["spec_drafted_tokens_total"] > 0
-    finally:
-        plain.stop()
-        spec.stop()
-
-
-def test_speculative_pallas_interpret_matches():
-    """Engine verify path through the Pallas window kernel (interpret)."""
-    plain = _engine()
-    spec = _engine(
-        speculative="ngram", spec_tokens=3, attention_impl="pallas_interpret"
-    )
-    try:
-        a = _generate(plain, PATTERN, n=12)
-        b = _generate(spec, PATTERN, n=12)
-        assert a == b
     finally:
         plain.stop()
         spec.stop()
