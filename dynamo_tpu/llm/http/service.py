@@ -195,6 +195,18 @@ class HttpService:
             return _error(400, f"invalid request: {exc}")
         if completion_request.logprobs is not None and completion_request.logprobs > 5:
             return _error(400, "logprobs must be <= 5")
+        if completion_request.echo:
+            # echo prepends the prompt to the completion text (OpenAI
+            # completions semantics); supported for unary string prompts
+            if completion_request.stream:
+                return _error(400, "echo is not supported with stream")
+            if not isinstance(completion_request.prompt, str):
+                return _error(400, "echo requires a string prompt")
+            if completion_request.logprobs:
+                # prompt-token logprobs are not computed, and prepending the
+                # prompt would desync text_offset; reject rather than return
+                # silently-wrong scoring data
+                return _error(400, "echo is not supported with logprobs")
         engine = self.manager.completion_engines.get(completion_request.model)
         if engine is None:
             return _error(404, f"model '{completion_request.model}' not found", "model_not_found")
@@ -214,6 +226,9 @@ class HttpService:
                 return await self._stream_sse(request, stream, ctx, guard, completion_request.model)
             chunks = _data_only(stream, guard)
             response = await aggregate_completion_stream(chunks)
+            if completion_request.echo:
+                for choice in response.choices:
+                    choice.text = completion_request.prompt + (choice.text or "")
             guard.mark_ok()
             self._observe_usage(completion_request.model, response.usage)
             return web.json_response(response.model_dump(exclude_none=True))

@@ -269,3 +269,36 @@ async def test_http_annotations_via_sse_events(mdc, tokenizer):
             assert {"formatted_prompt", "token_ids"} <= names
     finally:
         await service.stop()
+
+
+async def test_http_completions_echo(mdc, tokenizer):
+    """OpenAI completions echo=true prepends the prompt to the text."""
+    service = await start_service(mdc, tokenizer)
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "hello there", "echo": True,
+                      "max_tokens": 4},
+                timeout=30,
+            )
+            assert r.status_code == 200
+            assert r.json()["choices"][0]["text"].startswith("hello there")
+            # unsupported combinations reject cleanly
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "x", "echo": True, "stream": True},
+            )
+            assert r.status_code == 400
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": [1, 2, 3], "echo": True},
+            )
+            assert r.status_code == 400
+            r = await client.post(
+                "/v1/completions",
+                json={"model": "tiny", "prompt": "x", "echo": True, "logprobs": 1},
+            )
+            assert r.status_code == 400
+    finally:
+        await service.stop()
