@@ -186,3 +186,19 @@ def test_engine_serves_quantized_mla():
         [5, 9, 13, 17, 21],
     )
     assert len(toks) == 8
+
+
+def test_quantized_lm_head_untied():
+    """Non-tied configs quantize lm_head; the unembed matmul must track
+    full precision (2-D [h, vocab] scale handling)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), tie_word_embeddings=False)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    q = quantize_params(params, ("lm_head",))
+    assert isinstance(q["lm_head"], QuantizedMatrix)
+    x = jax.random.normal(jax.random.PRNGKey(4), (5, cfg.hidden_size), jnp.float32)
+    exact = x @ params["lm_head"]
+    approx = mm(x, q["lm_head"])
+    rel = np.linalg.norm(np.asarray(approx - exact)) / np.linalg.norm(np.asarray(exact))
+    assert rel < 0.02
