@@ -66,6 +66,11 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
         max_batch_size=overrides.pop("max_batch_size", 8),
         max_model_len=overrides.pop("max_model_len", mdc.context_length),
     )
+    # "warmup" is a launch-time behavior, not an EngineConfig field: pop it
+    # here so EVERY launch path (serve_worker, disagg workers, example
+    # graphs) can pass it through engine_overrides; callers check
+    # ``engine.wants_warmup`` after start()
+    wants_warmup = bool(overrides.pop("warmup", False))
     defaults.update(overrides)
     config = EngineConfig(**defaults)
     params = None
@@ -75,7 +80,9 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
             logger.info("loaded weights from %s", model_dir)
         except FileNotFoundError:
             logger.warning("no safetensors in %s — random-initializing weights", model_dir)
-    return JaxLlmEngine(config, params=params)
+    engine = JaxLlmEngine(config, params=params)
+    engine.wants_warmup = wants_warmup
+    return engine
 
 
 async def serve_worker(
@@ -111,9 +118,9 @@ async def serve_worker(
         engine.start()
         service = await ep.serve(engine, stats_handler=engine.stats)
     elif engine_kind == "jax":
-        do_warmup = engine_overrides.pop("warmup", False)
         # publishers are wired before the engine so allocator events flow
         engine = build_jax_engine(model_dir, mdc, **engine_overrides)
+        do_warmup = engine.wants_warmup
         service = await ep.serve(engine, stats_handler=engine.stats)
         kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)
         kv_pub.start()

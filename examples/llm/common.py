@@ -159,6 +159,8 @@ async def launch_disagg_decode_worker(
     decode = DisaggDecodeEngine(rt, engine, disagg_router, queue)
     await decode.start()
     engine.start()
+    if getattr(engine, "wants_warmup", False):
+        await engine.warmup()
     ep = rt.namespace(None).component("backend").endpoint("generate")
     service = await ep.serve(decode, stats_handler=decode.stats)
     await register_llm(service, mdc)
@@ -181,6 +183,8 @@ async def launch_prefill_workers(
             **cfg.engine_overrides,
         )
         engine.start()
+        if getattr(engine, "wants_warmup", False):
+            await engine.warmup()
         pump = PrefillWorker(rt, engine, queue)
         pump.start()
         handles.append(_PrefillHandle(pump=pump, engine=engine))

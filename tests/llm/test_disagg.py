@@ -6,6 +6,7 @@ single-engine greedy output.  Plus decision logic and queue behavior.
 import asyncio
 
 import jax
+import jax.numpy as jnp
 import pytest
 
 from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
@@ -35,11 +36,11 @@ CFG = LlamaConfig.tiny()
 PARAMS = init_params(CFG, jax.random.PRNGKey(0))
 
 
-def make_engine():
+def make_engine(**overrides):
     engine = JaxLlmEngine(
         EngineConfig(
             model=CFG, num_blocks=64, block_size=4, max_batch_size=4,
-            prefill_buckets=(16, 32), max_model_len=64,
+            prefill_buckets=(16, 32), max_model_len=64, **overrides,
         ),
         params=PARAMS,
     )
@@ -295,6 +296,55 @@ async def test_disagg_logprobs_cross_boundary():
                 lps = [lp for _, lp in row]
                 assert lps == sorted(lps, reverse=True)
         assert outs[0].top_logprobs[0][0][0] == outs[0].token_ids[0]
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
+async def test_remote_prefill_exactness_fp8_cache():
+    """Disagg with the fp8 KV cache: blocks serialize/transfer/inject as
+    float8_e4m3fn over the TCP path, and outputs match a single fp8 engine
+    bit-for-bit."""
+    def make_fp8_engine():
+        return make_engine(kv_cache_dtype="fp8")
+
+    prompt = list(range(3, 13))
+    # fp8 single-engine reference
+    ref_engine = make_fp8_engine()
+    try:
+        ref = await collect(await ref_engine.generate(Context(request(prompt, max_tokens=6))))
+    finally:
+        ref_engine.stop()
+
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-fp8"))
+    decode_engine = make_fp8_engine()
+    prefill_engine = make_fp8_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns8", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS.pop(disagg.transfer_server.address, None)  # force TCP
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        tokens = await collect(stream)
+        assert tokens == ref, f"fp8 disagg {tokens} != fp8 reference {ref}"
+        assert disagg.remote_prefills == 1
+        assert jax.tree.leaves(dict(decode_engine.cache))[0].dtype == jnp.dtype(
+            "float8_e4m3fn"
+        )
     finally:
         if prefill_worker:
             await prefill_worker.stop()
