@@ -532,10 +532,12 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
 
 def _mla_window_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
                      block_tables, context_lens, flat_slots, cos, sin,
-                     b: int, w_len: int):
+                     b: int, w_len: int, attention: str = "jax"):
     """Multi-query absorbed-form attention for speculative verification:
-    w window queries per lane against the latent cache (XLA gather path;
-    the single-query MLA Pallas kernel does not cover windows yet).
+    w window queries per lane against the latent cache.
+    ``attention="pallas"`` runs the MLA window kernel (W queries folded
+    into the head axis, latent pages streamed once for all W positions);
+    the XLA gather path is the portable fallback.
     ``x`` is position-major flat [w*b, h] (see mixtral_forward_verify on
     why dispatch order matters for the MoE layers)."""
     H = cfg.num_heads
@@ -564,21 +566,35 @@ def _mla_window_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
         "bwhn,rhn->bwhr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32)
     )
 
-    block_size = k_layer.shape[1]
-    max_blocks = block_tables.shape[1]
-    length = max_blocks * block_size
-    ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
-    kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
-    logits = (
-        jnp.einsum("bwhr,btr->bhwt", q_lat, ck.astype(jnp.float32))
-        + jnp.einsum("bwhp,btp->bhwt", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
-    ) * float(cfg.attn_scale)
-    q_pos = context_lens[:, None] - w_len + jnp.arange(w_len)[None, :]   # [b, w]
-    kv_pos = jnp.arange(length)[None, None, :]                            # [1, 1, t]
-    mask = kv_pos <= q_pos[:, :, None]                                    # [b, w, t]
-    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
-    weights = jax.nn.softmax(logits, axis=-1)
-    ctx = jnp.einsum("bhwt,btr->bwhr", weights, ck.astype(jnp.float32))
+    num_blocks, block_size = k_layer.shape[0], k_layer.shape[1]
+    if attention in ("pallas", "pallas_interpret"):
+        from dynamo_tpu.ops.pallas.mla_attention import (
+            mla_paged_window_attention_decode,
+        )
+
+        ctx = mla_paged_window_attention_decode(
+            q_lat, q_rope,
+            k_layer.reshape(num_blocks, block_size, cfg.kv_lora_rank),
+            v_layer.reshape(num_blocks, block_size, cfg.qk_rope_head_dim),
+            block_tables, context_lens,
+            scale=float(cfg.attn_scale),
+            interpret=attention == "pallas_interpret",
+        )
+    else:
+        max_blocks = block_tables.shape[1]
+        length = max_blocks * block_size
+        ck = k_layer[block_tables].reshape(b, length, cfg.kv_lora_rank)
+        kr = v_layer[block_tables].reshape(b, length, cfg.qk_rope_head_dim)
+        logits = (
+            jnp.einsum("bwhr,btr->bhwt", q_lat, ck.astype(jnp.float32))
+            + jnp.einsum("bwhp,btp->bhwt", q_rope.astype(jnp.float32), kr.astype(jnp.float32))
+        ) * float(cfg.attn_scale)
+        q_pos = context_lens[:, None] - w_len + jnp.arange(w_len)[None, :]   # [b, w]
+        kv_pos = jnp.arange(length)[None, None, :]                            # [1, 1, t]
+        mask = kv_pos <= q_pos[:, :, None]                                    # [b, w, t]
+        logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+        weights = jax.nn.softmax(logits, axis=-1)
+        ctx = jnp.einsum("bhwt,btr->bwhr", weights, ck.astype(jnp.float32))
     out = jnp.einsum("bwhr,rhv->bwhv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
     flat = out.transpose(1, 0, 2, 3).reshape(w_len * b, -1)
     return mm(flat, w["wo"]), (k_layer, v_layer)
@@ -723,10 +739,7 @@ def deepseek_forward_verify(
 ):
     """Speculative-verification forward for the MLA family (contract:
     llama_forward_verify).  Window tokens run position-major (expert
-    capacity priority, see mixtral_forward_verify); attention uses the XLA
-    absorbed-form multi-query path regardless of ``attention`` (no MLA
-    window kernel yet)."""
-    del attention
+    capacity priority, see mixtral_forward_verify)."""
     b, w_len = token_ids.shape
     x = params["embed"][token_ids.T.reshape(-1)].astype(cfg.dtype)
     positions = jnp.maximum(
@@ -737,7 +750,7 @@ def deepseek_forward_verify(
     def attn(w, attn_in, k_layer, v_layer):
         return _mla_window_attn(
             w, attn_in, cfg, positions, k_layer, v_layer, block_tables,
-            context_lens, flat_slots, cos, sin, b, w_len,
+            context_lens, flat_slots, cos, sin, b, w_len, attention=attention,
         )
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)

@@ -97,6 +97,133 @@ def _kernel(
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
+def _window_kernel(
+    block_tables_ref,   # [B, maxb] int32
+    context_lens_ref,   # [B] int32 — INCLUDING the window's last token
+    q_lat_ref,          # [1, W*H, R]  (w-major fold: row = w*H + h)
+    q_rope_ref,         # [1, W*H, P]
+    ck_page_ref,        # [1, bs, R]
+    kr_page_ref,        # [1, bs, P]
+    out_ref,            # [1, W*H, R]
+    m_ref,              # [W*H, 128] f32
+    l_ref,
+    acc_ref,            # [W*H, R] f32
+    *,
+    block_size: int,
+    scale: float,
+    max_blocks: int,
+    window: int,
+    num_heads: int,
+):
+    """Speculative-verification variant: W window queries fold into the
+    head axis; each query row masks to its own absolute position."""
+    seq = pl.program_id(0)
+    page = pl.program_id(1)
+    ctx = context_lens_ref[seq]
+    wh = window * num_heads
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_start = page * block_size
+
+    @pl.when(page_start < ctx)
+    def _compute():
+        q_lat = q_lat_ref[0].astype(jnp.float32)    # [W*H, R]
+        q_rope = q_rope_ref[0].astype(jnp.float32)
+        ck = ck_page_ref[0].astype(jnp.float32)
+        kr = kr_page_ref[0].astype(jnp.float32)
+        s = (
+            jax.lax.dot_general(
+                q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * scale                                    # [W*H, bs]
+        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        w_idx = jax.lax.broadcasted_iota(jnp.int32, (wh, 1), 0) // num_heads
+        q_pos = ctx - window + w_idx                  # [W*H, 1]
+        s = jnp.where(pos <= q_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(page == max_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def mla_paged_window_attention_decode(
+    q_lat: jnp.ndarray,         # [B, W, H, R]
+    q_rope: jnp.ndarray,        # [B, W, H, P]
+    ck_cache: jnp.ndarray,      # [N, bs, R]
+    kr_cache: jnp.ndarray,      # [N, bs, P]
+    block_tables: jnp.ndarray,  # [B, maxb] int32
+    context_lens: jnp.ndarray,  # [B] int32 — INCLUDING the window's last token
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query MLA paged attention for speculative verification.
+    Returns the latent-space context [B, W, H, R] (float32)."""
+    b, w, h, r = q_lat.shape
+    p_dim = q_rope.shape[-1]
+    bs = ck_cache.shape[1]
+    maxb = block_tables.shape[1]
+    wh = w * h
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, maxb),
+        in_specs=[
+            pl.BlockSpec((1, wh, r), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, wh, p_dim), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, bs, r), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+            pl.BlockSpec((1, bs, p_dim), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, wh, r), lambda s, p, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((wh, 128), jnp.float32),
+            pltpu.VMEM((wh, 128), jnp.float32),
+            pltpu.VMEM((wh, r), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _window_kernel, block_size=bs, scale=scale, max_blocks=maxb,
+        window=w, num_heads=h,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, wh, r), jnp.float32),
+        interpret=interpret,
+    )(
+        block_tables, context_lens,
+        q_lat.reshape(b, wh, r), q_rope.reshape(b, wh, p_dim),
+        ck_cache, kr_cache,
+    )
+    return out.reshape(b, w, h, r)
+
+
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def mla_paged_attention_decode(
     q_lat: jnp.ndarray,         # [B, H, R] f32/bf16

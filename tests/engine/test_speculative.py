@@ -254,3 +254,37 @@ def test_warmup_compiles_verify():
             plain.stop()
     finally:
         spec.stop()
+
+
+def test_mla_speculative_pallas_interpret():
+    """MLA verify path through the Pallas window kernel (interpret)."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    cfg = DeepseekConfig.tiny_mla()
+
+    def build(**kw):
+        eng = JaxLlmEngine(
+            EngineConfig(
+                model=cfg, model_family="deepseek_v2", num_blocks=128,
+                block_size=4, max_batch_size=2, prefill_buckets=(16, 32),
+                max_model_len=128, **kw,
+            ),
+        )
+        eng.start()
+        return eng
+
+    plain = build()
+    try:
+        spec = build(
+            speculative="ngram", spec_tokens=3, attention_impl="pallas_interpret"
+        )
+    except BaseException:
+        plain.stop()
+        raise
+    try:
+        a = _generate(plain, PATTERN, n=12)
+        b = _generate(spec, PATTERN, n=12)
+        assert a == b
+    finally:
+        plain.stop()
+        spec.stop()

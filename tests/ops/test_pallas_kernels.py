@@ -223,3 +223,33 @@ def test_window_attention_kernel_fp8_cache():
         q, k_cache.astype(fp8), v_cache.astype(fp8), tables, ctx_w, interpret=True
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_mla_window_attention_kernel_matches_reference():
+    from dynamo_tpu.ops.pallas.mla_attention import (
+        mla_paged_attention_decode,
+        mla_paged_window_attention_decode,
+    )
+
+    rng = np.random.default_rng(9)
+    b, w, h, r, p, nb, bs, maxb = 2, 3, 4, 32, 16, 8, 4, 3
+    ck = jnp.asarray(rng.standard_normal((nb, bs, r)), jnp.float32)
+    kr = jnp.asarray(rng.standard_normal((nb, bs, p)), jnp.float32)
+    q_lat = jnp.asarray(rng.standard_normal((b, w, h, r)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((b, w, h, p)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nb, (b, maxb)), jnp.int32)
+    ctx_w = jnp.asarray([9, 6], jnp.int32)  # including window's last token
+    scale = 1.0 / np.sqrt(r + p)
+
+    out = mla_paged_window_attention_decode(
+        q_lat, q_rope, ck, kr, tables, ctx_w, scale=scale, interpret=True
+    )
+    # each window position must equal a single-query call at that length
+    for i in range(w):
+        ref = mla_paged_attention_decode(
+            q_lat[:, i], q_rope[:, i], ck, kr, tables, ctx_w - (w - 1 - i),
+            scale=scale, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, i]), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
