@@ -288,3 +288,24 @@ def test_mla_speculative_pallas_interpret():
     finally:
         plain.stop()
         spec.stop()
+
+
+def test_full_perf_stack_composition():
+    """int8 weights + fp8 KV + ngram speculation together (the agg_perf
+    profile) must emit the same tokens as int8 + fp8 without speculation —
+    speculation never changes outputs, whatever the numerics underneath."""
+    base = dict(quantize="int8", kv_cache_dtype="fp8")
+    plain = _engine(**base)
+    try:
+        spec = _engine(speculative="ngram", spec_tokens=3, **base)
+    except BaseException:
+        plain.stop()
+        raise
+    try:
+        a = _generate(plain, PATTERN, n=16)
+        b = _generate(spec, PATTERN, n=16)
+        assert a == b
+        assert spec.stats()["spec_drafted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec.stop()
