@@ -218,6 +218,8 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
             eos_token_ids=[],
         ).to_wire()
 
+    itls: list[float] = []  # per-request mean inter-token latency
+
     async def drive(req: dict) -> tuple[int, float]:
         t0 = time.monotonic()
         ttft = None
@@ -235,6 +237,8 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
                 if ttft is None:
                     ttft = time.monotonic() - t0
                 count += len(ann.data.token_ids)
+        if ttft is not None and count > 1:
+            itls.append((time.monotonic() - t0 - ttft) / (count - 1))
         return count, ttft or 0.0
 
     # warmup: trigger prefill + decode compiles (first device use — a crash
@@ -243,6 +247,7 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
     t0 = time.monotonic()
     await drive(make_request())
     print(f"bench: warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+    itls.clear()  # warmup's compile-inflated ITL must not enter the stats
 
     t0 = time.monotonic()
     results = await asyncio.gather(*[drive(make_request()) for _ in range(num_requests)])
@@ -309,6 +314,17 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
             "total_tflops": round(total_flops / 1e12, 1),
             "ttft_p50_ms": round(p50 * 1000, 1),
             "ttft_p99_ms": round(p99 * 1000, 1),
+            # per-request mean ITL percentiles (decode_steps>1 emits in
+            # bursts; the request-level mean amortizes that honestly)
+            "itl_p50_ms": (
+                round(sorted(itls)[len(itls) // 2] * 1000, 2) if itls else None
+            ),
+            "itl_p99_ms": (
+                round(sorted(itls)[min(len(itls) - 1, int(len(itls) * 0.99))] * 1000, 2)
+                if itls else None
+            ),
+            "prefix_hits_total": engine.stats().get("prefix_hits_total"),
+            "spec_accepted_tokens_total": engine.stats().get("spec_accepted_tokens_total"),
             "req_s": round(num_requests / wall, 3),
             "decode_steps": decode_steps,
             "batch": max_batch,
