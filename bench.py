@@ -234,11 +234,12 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
                 # instead of recording a 0-token "measurement"
                 raise RuntimeError(ann.data.error or "sequence failed in engine")
             if ann.data.token_ids:
+                t_last = time.monotonic()
                 if ttft is None:
-                    ttft = time.monotonic() - t0
+                    ttft = t_last - t0
                 count += len(ann.data.token_ids)
         if ttft is not None and count > 1:
-            itls.append((time.monotonic() - t0 - ttft) / (count - 1))
+            itls.append((t_last - t0 - ttft) / (count - 1))
         return count, ttft or 0.0
 
     # warmup: trigger prefill + decode compiles (first device use — a crash
@@ -252,6 +253,10 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
     t0 = time.monotonic()
     results = await asyncio.gather(*[drive(make_request()) for _ in range(num_requests)])
     wall = time.monotonic() - t0
+    # snapshot counters NOW: the auxiliary microbenchmarks below replay
+    # prompts and would pollute cumulative prefix/spec counts
+    run_stats = engine.stats()
+    run_itls = list(itls)
 
     xfer = await _measure_kv_xfer(engine)
     # below ~512 tokens the prefix machinery's fixed overhead (table
@@ -272,10 +277,14 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
     )
 
     total_tokens = sum(c for c, _ in results)
-    ttfts = sorted(t for _, t in results)
     tok_s = total_tokens / wall
-    p50 = ttfts[len(ttfts) // 2]
-    p99 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.99))]
+
+    def pctile(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+    p50 = pctile([t for _, t in results], 0.5)
+    p99 = pctile([t for _, t in results], 0.99)
 
     # model FLOPs: 2*P per token (matmuls) + 4*L*H*D*ctx attention per token
     # (QK^T and AV, 2 flops/MAC each); summed exactly over every position of
@@ -317,14 +326,13 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
             # per-request mean ITL percentiles (decode_steps>1 emits in
             # bursts; the request-level mean amortizes that honestly)
             "itl_p50_ms": (
-                round(sorted(itls)[len(itls) // 2] * 1000, 2) if itls else None
+                round(pctile(run_itls, 0.5) * 1000, 2) if run_itls else None
             ),
             "itl_p99_ms": (
-                round(sorted(itls)[min(len(itls) - 1, int(len(itls) * 0.99))] * 1000, 2)
-                if itls else None
+                round(pctile(run_itls, 0.99) * 1000, 2) if run_itls else None
             ),
-            "prefix_hits_total": engine.stats().get("prefix_hits_total"),
-            "spec_accepted_tokens_total": engine.stats().get("spec_accepted_tokens_total"),
+            "prefix_hits_total": run_stats.get("prefix_hits_total"),
+            "spec_accepted_tokens_total": run_stats.get("spec_accepted_tokens_total"),
             "req_s": round(num_requests / wall, 3),
             "decode_steps": decode_steps,
             "batch": max_batch,
