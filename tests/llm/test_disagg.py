@@ -353,3 +353,40 @@ async def test_remote_prefill_exactness_fp8_cache():
         decode_engine.stop()
         prefill_engine.stop()
         await rt.close()
+
+
+async def test_remote_prefill_with_speculative_decode():
+    """Disagg decode-side speculation: the decode worker drafts from the
+    remotely-prefilled sequence's tokens and output still matches the
+    non-disagg, non-speculative greedy reference."""
+    # repetitive prompt so the decode worker's prompt-lookup drafts
+    prompt = [7, 11, 19, 7, 11, 19, 7, 11, 19, 7, 11]
+    ref = greedy_reference(prompt, 8)
+
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-spec"))
+    decode_engine = make_engine(speculative="ngram", spec_tokens=3)
+    prefill_engine = make_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-spec", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        stream = await disagg.generate(Context(request(prompt, max_tokens=8)))
+        tokens = await collect(stream)
+        assert tokens == ref, f"disagg+spec {tokens} != reference {ref}"
+        assert disagg.remote_prefills == 1
+        assert decode_engine.stats()["spec_drafted_tokens_total"] > 0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
