@@ -170,7 +170,18 @@ class PushRouter:
             await runtime.plane.bus.publish(inst.subject, envelope)
             # rendezvous: wait for the worker to connect back before
             # returning the stream (the reference awaits the prologue)
-            await asyncio.wait_for(pending.connected.wait(), timeout=30.0)
+            try:
+                await asyncio.wait_for(pending.connected.wait(), timeout=30.0)
+            except asyncio.TimeoutError:
+                # a bare TimeoutError is undiagnosable from the frontend;
+                # name the instance and the usual causes (observed: a
+                # request envelope the worker's codec rejected)
+                raise TimeoutError(
+                    f"no data-plane connect-back from instance "
+                    f"{inst.instance_id:x} ({inst.subject}) within 30s — "
+                    "worker dead/overloaded, or it rejected the request "
+                    "envelope (check worker logs for 'malformed request')"
+                ) from None
         except Exception:
             server.unregister(stream_id)
             raise
