@@ -66,9 +66,12 @@ class Sequence:
 
     def hit_stop(self, token_id: int) -> FinishReason | None:
         stop = self.request.stop
-        if not stop.ignore_eos and token_id in self.request.eos_token_ids:
+        # min_tokens suppresses EOS/stop-token finishes (not max_tokens)
+        # until the minimum is generated — vLLM semantics
+        min_ok = not stop.min_tokens or len(self.output_ids) >= stop.min_tokens
+        if min_ok and not stop.ignore_eos and token_id in self.request.eos_token_ids:
             return FinishReason.STOP
-        if token_id in stop.stop_token_ids:
+        if min_ok and token_id in stop.stop_token_ids:
             return FinishReason.STOP
         if stop.max_tokens is not None and len(self.output_ids) >= stop.max_tokens:
             return FinishReason.LENGTH

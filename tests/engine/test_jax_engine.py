@@ -459,3 +459,32 @@ async def test_warmup_compiles_decode_at_max_len_bucket():
         assert traced["n"] >= 1  # decode ran (hence compiled) during warmup
     finally:
         engine.stop()
+
+
+def test_min_tokens_suppresses_eos():
+    """min_tokens holds off EOS/stop-token finishes until the minimum is
+    generated (vLLM semantics); max_tokens still applies."""
+    from dynamo_tpu.engine.sequence import Sequence
+
+    pre = PreprocessedRequest(
+        token_ids=[1, 2, 3],
+        stop=StopConditions(max_tokens=10, min_tokens=3, stop_token_ids=[42]),
+        eos_token_ids=[7],
+    )
+    seq = Sequence(seq_id="s", request=pre)
+    # below the minimum: EOS and stop tokens pass through
+    seq.output_ids.append(7)
+    assert seq.hit_stop(7) is None
+    seq.output_ids.append(42)
+    assert seq.hit_stop(42) is None
+    # at the minimum: stop token fires
+    seq.output_ids.append(42)
+    assert seq.hit_stop(42) is FinishReason.STOP
+    # max_tokens is never suppressed
+    pre2 = PreprocessedRequest(
+        token_ids=[1], stop=StopConditions(max_tokens=2, min_tokens=5),
+        eos_token_ids=[],
+    )
+    seq2 = Sequence(seq_id="s2", request=pre2)
+    seq2.output_ids.extend([9, 9])
+    assert seq2.hit_stop(9) is FinishReason.LENGTH
