@@ -46,6 +46,27 @@ class MetricsService:
         self.waiting = Gauge(
             f"{PREFIX}_requests_waiting", "Queued requests", ["worker"], registry=self.registry
         )
+        # mirrored remote counters need .set(), so they are gauges —
+        # named WITHOUT the counter-reserved _total suffix
+        self.prefix_hits = Gauge(
+            f"{PREFIX}_prefix_hits", "Engine prefix-cache hits (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefix_cached_tokens = Gauge(
+            f"{PREFIX}_prefix_cached_tokens",
+            "Prompt tokens served from the prefix cache (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.spec_accepted = Gauge(
+            f"{PREFIX}_spec_accepted_tokens",
+            "Draft tokens accepted by speculative verification (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self._worker_gauges = (
+            self.kv_active, self.kv_total, self.cache_usage, self.waiting,
+            self.prefix_hits, self.prefix_cached_tokens, self.spec_accepted,
+        )
+        self._seen_workers: set[str] = set()
         self.hit_blocks = Counter(
             f"{PREFIX}_kv_hit_blocks_total", "Matched prefix blocks routed", registry=self.registry
         )
@@ -93,12 +114,25 @@ class MetricsService:
 
     def _refresh(self) -> None:
         snapshot = self.aggregator.snapshot()
+        live = {f"{wid:x}" for wid in snapshot.workers}
+        # drop gauges for workers that fell out of the snapshot (lease
+        # lost / TTL expired) — stale values must not look alive forever
+        for label in self._seen_workers - live:
+            for g in self._worker_gauges:
+                try:
+                    g.remove(label)
+                except KeyError:
+                    pass
+        self._seen_workers = live
         for wid, m in snapshot.workers.items():
             label = f"{wid:x}"
             self.kv_active.labels(label).set(m.kv_active_blocks)
             self.kv_total.labels(label).set(m.kv_total_blocks)
             self.cache_usage.labels(label).set(m.gpu_cache_usage_perc)
             self.waiting.labels(label).set(m.num_requests_waiting)
+            self.prefix_hits.labels(label).set(m.prefix_hits_total)
+            self.prefix_cached_tokens.labels(label).set(m.prefix_cached_tokens_total)
+            self.spec_accepted.labels(label).set(m.spec_accepted_tokens_total)
 
     async def _metrics(self, request: web.Request) -> web.Response:
         self._refresh()

@@ -53,6 +53,10 @@ class ForwardPassMetrics:
     num_requests_running: int = 0
     request_total_slots: int = 0
     iterations_total: int = 0
+    # engine-side reuse/speculation evidence (cumulative)
+    prefix_hits_total: int = 0
+    prefix_cached_tokens_total: int = 0
+    spec_accepted_tokens_total: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -74,6 +78,9 @@ class ForwardPassMetrics:
             num_requests_running=stats.get("num_requests_running", 0),
             request_total_slots=stats.get("request_total_slots", 0),
             iterations_total=stats.get("iterations_total", 0),
+            prefix_hits_total=stats.get("prefix_hits_total", 0),
+            prefix_cached_tokens_total=stats.get("prefix_cached_tokens_total", 0),
+            spec_accepted_tokens_total=stats.get("spec_accepted_tokens_total", 0),
         )
 
 

@@ -1,0 +1,60 @@
+"""Prometheus metrics service e2e over the in-memory plane: a mock worker
+publishes ForwardPassMetrics (the reference's mock_worker pattern,
+components/metrics/src/bin/mock_worker.rs:159) and /metrics must expose the
+per-worker gauges — including the prefix-reuse and speculation evidence
+counters — plus the KV-hit-rate event counters."""
+
+import asyncio
+
+import httpx
+
+from dynamo_tpu.components.metrics_service import MetricsService
+from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, KvHitRateEvent
+from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+
+STATS = {
+    "kv_active_blocks": 7,
+    "kv_total_blocks": 64,
+    "gpu_cache_usage_perc": 7 / 64,
+    "num_requests_waiting": 2,
+    "num_requests_running": 3,
+    "request_total_slots": 8,
+    "iterations_total": 41,
+    "prefix_hits_total": 5,
+    "prefix_cached_tokens_total": 320,
+    "spec_accepted_tokens_total": 17,
+}
+
+
+async def test_metrics_service_exports_worker_gauges():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://metrics1"))
+    comp = rt.namespace("ns").component("backend")
+    service = MetricsService(comp, host="127.0.0.1", port=0)
+    pub = WorkerMetricsPublisher(comp, worker_id=0xAB, stats_fn=lambda: STATS)
+    try:
+        await service.start()
+        await pub.publish_once()
+        await comp.runtime.plane.bus.publish(
+            comp.event_subject(KV_HIT_RATE_SUBJECT),
+            KvHitRateEvent(worker_id=0xAB, isl_blocks=10, overlap_blocks=4).to_json(),
+        )
+        await asyncio.sleep(0.1)
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{service.port}/metrics")
+        assert r.status_code == 200
+        text = r.text
+        assert 'kv_active_blocks{worker="ab"} 7.0' in text
+        assert 'requests_waiting{worker="ab"} 2.0' in text
+        assert 'prefix_hits{worker="ab"} 5.0' in text
+        assert 'prefix_cached_tokens{worker="ab"} 320.0' in text
+        assert 'spec_accepted_tokens{worker="ab"} 17.0' in text
+        assert "kv_hit_blocks_total 4.0" in text
+        assert "kv_isl_blocks_total 10.0" in text
+    finally:
+        await pub.stop()
+        await service.stop()
+        await rt.close()
