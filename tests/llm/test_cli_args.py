@@ -1,0 +1,44 @@
+"""CLI argument contract: every serving flag maps to the right engine
+override (locks the dynamo-run surface, reference
+launch/dynamo-run/src/flags.rs)."""
+
+import pytest
+
+from dynamo_tpu.cli.run import parse_args
+
+
+def test_default_io():
+    args = parse_args(["run", "--model-path", "m"])
+    assert (args.input, args.output) == ("http", "jax")
+
+
+def test_io_tokens():
+    args = parse_args(["run", "in=text", "out=mocker", "--model-path", "m"])
+    assert (args.input, args.output) == ("text", "mocker")
+
+
+def test_perf_flags_parse():
+    args = parse_args([
+        "run", "--model-path", "m", "--quantize", "int8",
+        "--kv-cache-dtype", "fp8", "--speculative", "ngram",
+        "--spec-tokens", "6", "--warmup", "--tensor-parallel-size", "2",
+        "--num-blocks", "512", "--max-batch-size", "4",
+        "--context-length", "2048",
+    ])
+    assert args.quantize == "int8"
+    assert args.kv_cache_dtype == "fp8"
+    assert args.speculative == "ngram"
+    assert args.spec_tokens == 6
+    assert args.warmup is True
+    assert args.tensor_parallel_size == 2
+
+
+def test_invalid_choices_rejected():
+    with pytest.raises(SystemExit):
+        parse_args(["run", "--model-path", "m", "--quantize", "int4"])
+    with pytest.raises(SystemExit):
+        parse_args(["run", "--model-path", "m", "--kv-cache-dtype", "fp4"])
+    with pytest.raises(SystemExit):
+        parse_args(["run", "--model-path", "m", "--speculative", "medusa"])
+    with pytest.raises(SystemExit):
+        parse_args(["run", "bogus-token", "--model-path", "m"])
