@@ -11,6 +11,7 @@ instance's bus subject.
 from __future__ import annotations
 
 import asyncio
+import os
 import enum
 import random
 import uuid
@@ -170,17 +171,19 @@ class PushRouter:
             await runtime.plane.bus.publish(inst.subject, envelope)
             # rendezvous: wait for the worker to connect back before
             # returning the stream (the reference awaits the prologue)
+            connect_timeout = float(os.environ.get("DYN_CONNECT_TIMEOUT_S", "30"))
             try:
-                await asyncio.wait_for(pending.connected.wait(), timeout=30.0)
+                await asyncio.wait_for(pending.connected.wait(), timeout=connect_timeout)
             except asyncio.TimeoutError:
                 # a bare TimeoutError is undiagnosable from the frontend;
                 # name the instance and the usual causes (observed: a
                 # request envelope the worker's codec rejected)
                 raise TimeoutError(
                     f"no data-plane connect-back from instance "
-                    f"{inst.instance_id:x} ({inst.subject}) within 30s — "
-                    "worker dead/overloaded, or it rejected the request "
-                    "envelope (check worker logs for 'malformed request')"
+                    f"{inst.instance_id:x} ({inst.subject}) within "
+                    f"{connect_timeout:.0f}s — worker dead/overloaded, or it "
+                    "rejected the request envelope (check worker logs for "
+                    "'malformed request')"
                 ) from None
         except Exception:
             server.unregister(stream_id)

@@ -41,6 +41,9 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("DYN_LOG", "warn")
+# first-compile of a pipeline under a loaded CI box can exceed the 30s
+# production data-plane rendezvous (observed flake); give tests slack
+os.environ.setdefault("DYN_CONNECT_TIMEOUT_S", "120")
 
 import pytest
 
