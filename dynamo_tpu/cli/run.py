@@ -58,6 +58,8 @@ def parse_args(argv=None) -> argparse.Namespace:
                           "self-drafting with exact greedy verification)")
     run.add_argument("--spec-tokens", type=int, default=4,
                      help="draft tokens verified per step")
+    run.add_argument("--spec-ngram", type=int, default=2,
+                     help="lookup n-gram width for ngram drafting")
     run.add_argument("--kv-cache-dtype", choices=["fp8", "bf16", "f32"],
                      default=None,
                      help="KV cache storage dtype (fp8 halves KV bytes; "
@@ -113,6 +115,7 @@ async def _run(args) -> int:
             if args.speculative:
                 overrides["speculative"] = args.speculative
                 overrides["spec_tokens"] = args.spec_tokens
+                overrides["spec_ngram"] = args.spec_ngram
         worker = await serve_worker(
             runtime,
             args.model_path,

@@ -21,7 +21,8 @@ def test_perf_flags_parse():
     args = parse_args([
         "run", "--model-path", "m", "--quantize", "int8",
         "--kv-cache-dtype", "fp8", "--speculative", "ngram",
-        "--spec-tokens", "6", "--warmup", "--tensor-parallel-size", "2",
+        "--spec-tokens", "6", "--spec-ngram", "3", "--warmup",
+        "--tensor-parallel-size", "2",
         "--num-blocks", "512", "--max-batch-size", "4",
         "--context-length", "2048",
     ])
@@ -29,6 +30,7 @@ def test_perf_flags_parse():
     assert args.kv_cache_dtype == "fp8"
     assert args.speculative == "ngram"
     assert args.spec_tokens == 6
+    assert args.spec_ngram == 3
     assert args.warmup is True
     assert args.tensor_parallel_size == 2
 
