@@ -96,3 +96,20 @@ async def test_profile_concurrency_grid_and_sla_planner():
         assert plan["concurrency"] == 0 and plan["replicas"] == 0
     finally:
         engine.stop()
+
+
+def test_bench_rejects_unknown_quant_env(monkeypatch):
+    """bench.py env contract: unknown DYN_BENCH_QUANT fails fast instead of
+    silently running the wrong ladder."""
+    import asyncio
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", pathlib.Path(__file__).parents[2] / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("DYN_BENCH_QUANT", "fp8")  # typo'd value
+    with pytest.raises(ValueError, match="DYN_BENCH_QUANT"):
+        asyncio.run(bench.run_bench())
