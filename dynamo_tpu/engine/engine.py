@@ -130,6 +130,12 @@ class EngineConfig:
     speculative: str | None = None
     spec_tokens: int = 4
     spec_ngram: int = 2
+    # Minimum fraction of running lanes that must have a draft for the
+    # w-wide verify program to run; below it, plain decode serves the step.
+    # Non-drafting lanes in a verify step still pay w× the logits/sampling
+    # work while emitting one token — one self-drafting chat request must
+    # not tax a whole mixed batch.
+    spec_min_fraction: float = 0.25
 
     def resolved_max_len(self) -> int:
         hard = self.num_blocks * self.block_size
@@ -1203,6 +1209,12 @@ class JaxLlmEngine:
         if self.attention_impl != "pallas":
             return False
         msg = f"{type(exc).__name__}: {exc}".lower()
+        # HBM exhaustion often mentions "during compilation" — that is a
+        # capacity problem, not a kernel problem; the gather-based fallback
+        # needs MORE memory, so retrying it would fail again after paying
+        # a full jit rebuild
+        if "resource_exhausted" in msg or "out of memory" in msg:
+            return False
         compile_markers = (
             "mosaic", "interpret mode", "compile", "lowering",
             "unimplemented", "not implemented", "unsupported",
@@ -1395,6 +1407,8 @@ class JaxLlmEngine:
             self.cache, jax.tree.map(jnp.asarray, staged),
             jnp.asarray(ids), jnp.int32(n),
         )
+        # content is on device now: the landing blocks become matchable
+        self.allocator.register_restored(plan)
 
     def _sampling_arrays(self, seqs: list[Sequence], lanes: int):
         vocab = self.config.model.vocab_size
@@ -1648,15 +1662,19 @@ class JaxLlmEngine:
 
     def _run_decode(self, seqs: list[Sequence]) -> None:
         if self.spec_enabled:
-            # draft first: when NO lane has a usable draft the w-wide
-            # verify program would emit one token per lane at w× the
-            # logits/sampling cost — take the plain decode path instead
+            # draft first: the w-wide verify program only earns its keep
+            # when enough lanes drafted (non-drafting lanes pay w× the
+            # logits/sampling cost for one token)
+            running = [s for s in seqs if s.status == SeqStatus.RUNNING]
             drafts = {
                 seq.seq_id: self._ngram_draft(seq.all_token_ids)
-                for seq in seqs
-                if seq.status == SeqStatus.RUNNING and self._spec_ok(seq)
+                for seq in running
+                if self._spec_ok(seq)
             }
-            if any(drafts.values()):
+            n_drafting = sum(1 for d in drafts.values() if d)
+            if n_drafting and n_drafting >= (
+                len(running) * self.config.spec_min_fraction
+            ):
                 return self._run_verify_decode(seqs, drafts)
         return self._run_plain_decode(seqs)
 

@@ -22,6 +22,7 @@ lib/llm/src/kv_router/indexer.rs:64,122).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable
@@ -76,6 +77,12 @@ class BlockAllocator:
     ):
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # disagg's reserve/release run on the asyncio thread while the
+        # device thread allocates/frees/offloads: every compound mutation
+        # (capacity check + takes, refcount + registry updates) must be
+        # atomic across threads.  RLock because the offload sink re-enters
+        # (host-tier eviction observer calls back into the allocator).
+        self._lock = threading.RLock()
         self.event_sink = event_sink
         self.enable_prefix_caching = enable_prefix_caching
         self.offload_sink = offload_sink
@@ -141,18 +148,19 @@ class BlockAllocator:
         resident in NO tier emits a removed event so routers forget it.
         MUST run on the device thread (the sink reads the device cache) and
         before any step function writes into the evicted blocks."""
-        if not self._pending_offload:
-            return
-        pairs, self._pending_offload = self._pending_offload, []
-        if self.offload_sink is None:
-            self._emit_removed([h for _, h in pairs])
-            return
-        try:
-            failed = list(self.offload_sink(pairs) or [])
-        except Exception:  # noqa: BLE001 — eviction must proceed
-            logger.exception("block offload failed; dropping %d blocks", len(pairs))
-            failed = [h for _, h in pairs]
-        self._emit_removed(failed)
+        with self._lock:
+            if not self._pending_offload:
+                return
+            pairs, self._pending_offload = self._pending_offload, []
+            if self.offload_sink is None:
+                self._emit_removed([h for _, h in pairs])
+                return
+            try:
+                failed = list(self.offload_sink(pairs) or [])
+            except Exception:  # noqa: BLE001 — eviction must proceed
+                logger.exception("block offload failed; dropping %d blocks", len(pairs))
+                failed = [h for _, h in pairs]
+            self._emit_removed(failed)
 
     def _incref(self, bid: int) -> None:
         if bid in self._cached:  # cached → in use (content kept)
@@ -208,7 +216,8 @@ class BlockAllocator:
 
     def match_prefix(self, token_ids: list[int]) -> int:
         """Number of prompt tokens resident across device + host tiers."""
-        return len(self._match(token_ids)) * self.block_size
+        with self._lock:
+            return len(self._match(token_ids)) * self.block_size
 
     def allocate_sequence(
         self, seq_id: str, num_tokens: int, token_ids: list[int] | None = None
@@ -219,56 +228,57 @@ class BlockAllocator:
         returns (block_ids, cached_tokens) where the first
         ``cached_tokens // block_size`` entries are reused blocks the caller
         must not write.  None ⇒ OOM (nothing claimed)."""
-        matched = self._match(token_ids, pin_host=True)
-        device_hits = [(h, bid) for h, bid in matched if bid is not None]
-        host_hits = [h for h, bid in matched if bid is None]
-        # host hits need a fresh device block each (restored before prefill)
-        needed = self.blocks_needed(num_tokens) - len(device_hits)
-        # claim matched device blocks FIRST (removes them from the evictable
-        # set), then check capacity against what is genuinely left — a
-        # matched block in the cached LRU must not be counted as allocatable
-        for _, bid in device_hits:
-            self._incref(bid)
-        if needed > self.free_blocks:
-            for _, bid in device_hits:  # roll back: nothing claimed on OOM
-                self._decref(bid)
-            for h in host_hits:
-                self.host_tier.unpin(h)
-            return None
-        fresh: list[int] = []
-        for _ in range(max(needed, 0)):
-            bid = self._take_block()
-            assert bid is not None  # guaranteed by the capacity check
-            self._ref[bid] = 1
-            fresh.append(bid)
-        self.flush_offloads()
-        # matched blocks keep prompt order (device and host hits can
-        # interleave); host hits take fresh blocks as restore landing zones,
-        # registered now — content arrives before the prefill runs, and the
-        # single-threaded device loop orders any other matcher after it
-        restore_plan: list[tuple[int, int]] = []
-        block_ids: list[int] = []
-        fresh_iter = iter(fresh)
-        for h, bid in matched:
-            if bid is None:
-                bid = next(fresh_iter)
-                restore_plan.append((h, bid))
-                if h not in self._hash_to_block:
-                    self._hash_to_block[h] = bid
-                    self._block_hash[bid] = h
-            block_ids.append(bid)
-        block_ids.extend(fresh_iter)
-        cached_tokens = len(matched) * self.block_size
-        self._sequences[seq_id] = SequenceBlocks(
-            block_ids=block_ids,
-            published_hashes=[h for h, _ in matched],
-            cached_tokens=cached_tokens,
-            restore_plan=restore_plan,
-        )
-        if cached_tokens:
-            self.prefix_hits_total += 1
-            self.prefix_cached_tokens_total += cached_tokens
-        return block_ids[:], cached_tokens
+        with self._lock:
+            matched = self._match(token_ids, pin_host=True)
+            device_hits = [(h, bid) for h, bid in matched if bid is not None]
+            host_hits = [h for h, bid in matched if bid is None]
+            # host hits need a fresh device block each (restored before prefill)
+            needed = self.blocks_needed(num_tokens) - len(device_hits)
+            # claim matched device blocks FIRST (removes them from the evictable
+            # set), then check capacity against what is genuinely left — a
+            # matched block in the cached LRU must not be counted as allocatable
+            for _, bid in device_hits:
+                self._incref(bid)
+            if needed > self.free_blocks:
+                for _, bid in device_hits:  # roll back: nothing claimed on OOM
+                    self._decref(bid)
+                for h in host_hits:
+                    self.host_tier.unpin(h)
+                return None
+            fresh: list[int] = []
+            for _ in range(max(needed, 0)):
+                bid = self._take_block()
+                assert bid is not None  # guaranteed by the capacity check
+                self._ref[bid] = 1
+                fresh.append(bid)
+            self.flush_offloads()
+            # matched blocks keep prompt order (device and host hits can
+            # interleave); host hits take fresh blocks as restore landing zones.
+            # Landing blocks are NOT registered here: registration happens in
+            # ``register_restored`` after the content actually arrives, so a
+            # co-scheduled prompt can never device-match a block that a failed
+            # restore would leave garbage (it host-matches and restores its own
+            # copy instead).
+            restore_plan: list[tuple[int, int]] = []
+            block_ids: list[int] = []
+            fresh_iter = iter(fresh)
+            for h, bid in matched:
+                if bid is None:
+                    bid = next(fresh_iter)
+                    restore_plan.append((h, bid))
+                block_ids.append(bid)
+            block_ids.extend(fresh_iter)
+            cached_tokens = len(matched) * self.block_size
+            self._sequences[seq_id] = SequenceBlocks(
+                block_ids=block_ids,
+                published_hashes=[h for h, _ in matched],
+                cached_tokens=cached_tokens,
+                restore_plan=restore_plan,
+            )
+            if cached_tokens:
+                self.prefix_hits_total += 1
+                self.prefix_cached_tokens_total += cached_tokens
+            return block_ids[:], cached_tokens
 
     def append_slot(self, seq_id: str, context_len: int) -> int | None:
         """Slot (flat cache index) for token at position ``context_len - 1``,
@@ -282,26 +292,28 @@ class BlockAllocator:
         window so the device can derive per-step slots from the block table).
         Returns the first position's slot, or None on OOM (nothing grown
         partially)."""
-        seq = self._sequences[seq_id]
-        pos = context_len - 1
-        last_pos = pos + steps - 1
-        if max_pos is not None:
-            last_pos = min(last_pos, max_pos)
-        needed = last_pos // self.block_size + 1 - len(seq.block_ids)
-        if needed > self.free_blocks:
-            return None
-        for _ in range(needed):
-            bid = self._take_block()
-            assert bid is not None
-            self._ref[bid] = 1
-            seq.block_ids.append(bid)
-        self.flush_offloads()
-        return seq.block_ids[pos // self.block_size] * self.block_size + pos % self.block_size
+        with self._lock:
+            seq = self._sequences[seq_id]
+            pos = context_len - 1
+            last_pos = pos + steps - 1
+            if max_pos is not None:
+                last_pos = min(last_pos, max_pos)
+            needed = last_pos // self.block_size + 1 - len(seq.block_ids)
+            if needed > self.free_blocks:
+                return None
+            for _ in range(needed):
+                bid = self._take_block()
+                assert bid is not None
+                self._ref[bid] = 1
+                seq.block_ids.append(bid)
+            self.flush_offloads()
+            return seq.block_ids[pos // self.block_size] * self.block_size + pos % self.block_size
 
     def adopt_sequence(self, seq_id: str, block_ids: list[int]) -> None:
         """Register blocks reserved earlier (disagg: reserved before remote
         prefill, adopted when the sequence starts decoding)."""
-        self._sequences[seq_id] = SequenceBlocks(block_ids=list(block_ids))
+        with self._lock:
+            self._sequences[seq_id] = SequenceBlocks(block_ids=list(block_ids))
 
     def reserve_blocks(self, num_tokens: int) -> list[int] | None:
         """Take blocks off the free list without a sequence (disagg decode
@@ -310,71 +322,95 @@ class BlockAllocator:
         Called from the asyncio thread — evictions are NOT flushed here
         (the offload copy reads the device cache, which only the device
         thread may touch); the engine loop flushes them before any write."""
-        needed = self.blocks_needed(num_tokens)
-        if needed > self.free_blocks:
-            return None
-        out = []
-        for _ in range(needed):
-            bid = self._take_block()
-            assert bid is not None
-            self._ref[bid] = 1
-            out.append(bid)
-        return out
+        with self._lock:
+            needed = self.blocks_needed(num_tokens)
+            if needed > self.free_blocks:
+                return None
+            out = []
+            for _ in range(needed):
+                bid = self._take_block()
+                assert bid is not None
+                self._ref[bid] = 1
+                out.append(bid)
+            return out
 
     def release_blocks(self, block_ids: list[int]) -> None:
-        for b in block_ids:
-            self._decref(b)
+        with self._lock:
+            for b in block_ids:
+                self._decref(b)
 
     def block_ids(self, seq_id: str) -> list[int]:
-        return list(self._sequences[seq_id].block_ids)
+        with self._lock:
+            return list(self._sequences[seq_id].block_ids)
 
     def cached_tokens(self, seq_id: str) -> int:
-        seq = self._sequences.get(seq_id)
-        return seq.cached_tokens if seq else 0
+        with self._lock:
+            seq = self._sequences.get(seq_id)
+            return seq.cached_tokens if seq else 0
 
     def is_registered(self, seq_hash: int) -> bool:
         """Whether a block with this content hash is resident on device."""
-        return seq_hash in self._hash_to_block
+        with self._lock:
+            return seq_hash in self._hash_to_block
 
     def emit_removed(self, hashes: list[int]) -> None:
         """Tell routers these hashes left every tier (offload-tier eviction
         with no device copy)."""
         self._emit_removed(hashes)
 
+    def register_restored(self, plan: list[tuple[int, int]]) -> None:
+        """The engine restored these (hash, landing block) pairs from the
+        host tier: the blocks now hold real content and may serve device
+        prefix hits.  First writer wins on duplicate hashes (two sequences
+        restoring the same prefix each keep a private, unshared copy)."""
+        with self._lock:
+            for h, bid in plan:
+                if h not in self._hash_to_block and bid not in self._block_hash:
+                    self._hash_to_block[h] = bid
+                    self._block_hash[bid] = h
+
     def put_back_restore_plan(self, seq_id: str, plan: list[tuple[int, int]]) -> None:
         """Re-arm a taken restore plan after a failed restore so a retry
         re-executes it and sequence teardown cleans up the landing blocks."""
-        seq = self._sequences.get(seq_id)
-        if seq is not None:
-            seq.restore_plan = plan + seq.restore_plan
+        with self._lock:
+            seq = self._sequences.get(seq_id)
+            if seq is not None:
+                seq.restore_plan = plan + seq.restore_plan
 
     def take_restore_plan(self, seq_id: str) -> list[tuple[int, int]]:
         """Hand the engine the pending host→device restores for a sequence
         (cleared so aborts after restore don't double-handle)."""
-        seq = self._sequences.get(seq_id)
-        if seq is None:
-            return []
-        plan, seq.restore_plan = seq.restore_plan, []
-        return plan
+        with self._lock:
+            seq = self._sequences.get(seq_id)
+            if seq is None:
+                return []
+            plan, seq.restore_plan = seq.restore_plan, []
+            return plan
 
     def free_sequence(self, seq_id: str) -> None:
         """Sequence finished: decref its blocks.  Registered (complete)
         blocks whose refcount hits zero stay resident in the LRU cache for
         future prefix hits; ``removed`` events fire only on eviction."""
-        seq = self._sequences.pop(seq_id, None)
-        if seq is None:
-            return
-        for h, bid in seq.restore_plan:
-            # aborted before its restore ran: the landing block holds no
-            # content — unregister it and release the host pin
-            if self._hash_to_block.get(h) == bid:
-                del self._hash_to_block[h]
-            self._block_hash.pop(bid, None)
-            if self.host_tier is not None:
-                self.host_tier.unpin(h)
-        seq.restore_plan = []
-        for b in seq.block_ids:
-            self._decref(b)
+        with self._lock:
+            seq = self._sequences.pop(seq_id, None)
+            if seq is None:
+                return
+            for h, bid in seq.restore_plan:
+                # aborted before its restore ran: the landing block holds no
+                # content — unregister it and release the host pin
+                if self._hash_to_block.get(h) == bid:
+                    del self._hash_to_block[h]
+                self._block_hash.pop(bid, None)
+                if self.host_tier is not None:
+                    self.host_tier.unpin(h)
+            seq.restore_plan = []
+            if not self.enable_prefix_caching and seq.published_hashes:
+                # without the reuse registry the content is gone the moment
+                # the blocks free — routers must forget the stored hashes
+                # now (with reuse, removal fires on LRU eviction instead)
+                self._emit_removed(seq.published_hashes)
+            for b in seq.block_ids:
+                self._decref(b)
 
     def clear_published(self) -> int:
         """Admin flush (reference: http clear_kv_blocks): drop the whole
@@ -382,49 +418,51 @@ class BlockAllocator:
         unregister — and tell routers this worker's cache is gone.  Running
         sequences keep their blocks; their hashes simply re-publish as
         future blocks complete."""
-        forgotten = set(self._hash_to_block)
-        for seq in self._sequences.values():
-            forgotten.update(seq.published_hashes)
-            seq.published_hashes = []
-        cleared = len(forgotten)
-        self._hash_to_block.clear()
-        self._block_hash.clear()
-        while self._cached:
-            bid, _ = self._cached.popitem(last=False)
-            self._free.append(bid)
-        if self.event_sink:
-            self.event_sink(KvEvent(kind="cleared", block_hashes=[]))
-        return cleared
+        with self._lock:
+            forgotten = set(self._hash_to_block)
+            for seq in self._sequences.values():
+                forgotten.update(seq.published_hashes)
+                seq.published_hashes = []
+            cleared = len(forgotten)
+            self._hash_to_block.clear()
+            self._block_hash.clear()
+            while self._cached:
+                bid, _ = self._cached.popitem(last=False)
+                self._free.append(bid)
+            if self.event_sink:
+                self.event_sink(KvEvent(kind="cleared", block_hashes=[]))
+            return cleared
 
     # -- events ------------------------------------------------------------
     def publish_stored(self, seq_id: str, token_ids: list[int]) -> None:
         """Emit stored events for newly-completed full blocks of ``seq_id``
         and register them for prefix reuse."""
-        seq = self._sequences.get(seq_id)
-        if seq is None:
-            return
-        hashes = compute_block_hashes(token_ids, self.block_size)
-        new = hashes[len(seq.published_hashes):]
-        if not new:
-            return
-        parent = seq.published_hashes[-1] if seq.published_hashes else None
-        if self.enable_prefix_caching:
-            for idx in range(len(seq.published_hashes), len(hashes)):
-                if idx >= len(seq.block_ids):
-                    break
-                h, bid = hashes[idx], seq.block_ids[idx]
-                # first writer wins: a hash already resident elsewhere keeps
-                # its mapping; this block simply stays unregistered
-                if h not in self._hash_to_block and bid not in self._block_hash:
-                    self._hash_to_block[h] = bid
-                    self._block_hash[bid] = h
-        seq.published_hashes = hashes
-        if self.event_sink:
-            self.event_sink(
-                KvEvent(
-                    kind="stored",
-                    block_hashes=new,
-                    parent_hash=parent,
-                    token_count=len(new) * self.block_size,
+        with self._lock:
+            seq = self._sequences.get(seq_id)
+            if seq is None:
+                return
+            hashes = compute_block_hashes(token_ids, self.block_size)
+            new = hashes[len(seq.published_hashes):]
+            if not new:
+                return
+            parent = seq.published_hashes[-1] if seq.published_hashes else None
+            if self.enable_prefix_caching:
+                for idx in range(len(seq.published_hashes), len(hashes)):
+                    if idx >= len(seq.block_ids):
+                        break
+                    h, bid = hashes[idx], seq.block_ids[idx]
+                    # first writer wins: a hash already resident elsewhere keeps
+                    # its mapping; this block simply stays unregistered
+                    if h not in self._hash_to_block and bid not in self._block_hash:
+                        self._hash_to_block[h] = bid
+                        self._block_hash[bid] = h
+            seq.published_hashes = hashes
+            if self.event_sink:
+                self.event_sink(
+                    KvEvent(
+                        kind="stored",
+                        block_hashes=new,
+                        parent_hash=parent,
+                        token_count=len(new) * self.block_size,
+                    )
                 )
-            )

@@ -89,6 +89,12 @@ class Backend(Operator):
                     yield item
                     continue
                 out: LLMEngineOutput = ann.data
+                if out.finish_reason is FinishReason.ERROR:
+                    # an engine-side failure must not masquerade as a clean
+                    # stop: raise so unary handlers return 500 and SSE
+                    # streams emit an error event (the diagnostic would
+                    # otherwise be dropped entirely)
+                    raise RuntimeError(out.error or "engine error")
                 text_parts: list[str] = []
                 finish = out.finish_reason
                 consumed = 0

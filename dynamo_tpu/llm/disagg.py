@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import uuid
 from dataclasses import dataclass
 
@@ -136,7 +137,17 @@ class DisaggDecodeEngine:
         self.engine = engine
         self.router = router
         self.queue = queue
-        self._pending: dict[str, asyncio.Future] = {}
+        # seq_id -> (future, reserved landing blocks).  Ownership protocol
+        # (all transitions are atomic dict pops on the one event loop):
+        # whoever pops the entry owns the blocks' fate — the requester
+        # releases on timeout, the transfer path injects and then releases
+        # iff the requester's wait was already cancelled.  This is what
+        # keeps a LATE transfer from scattering stale KV into blocks that
+        # were released and re-allocated to a live sequence.
+        self._pending: dict[str, tuple[asyncio.Future, list[int]]] = {}
+        self.prefill_timeout_s = float(
+            os.environ.get("DYN_DISAGG_PREFILL_TIMEOUT_S", "300")
+        )
         self.transfer_server = KvTransferServer(self._on_transfer, host=transfer_host)
         # observability
         self.remote_prefills = 0
@@ -149,9 +160,30 @@ class DisaggDecodeEngine:
         await self.transfer_server.stop()
 
     async def _on_transfer(self, payload: KvTransferPayload) -> None:
-        await self.engine.inject_blocks(payload.block_ids, payload.blocks)
-        fut = self._pending.pop(payload.seq_id, None)
-        if fut is not None and not fut.done():
+        entry = self._pending.pop(payload.seq_id, None)
+        if entry is None:
+            # the requester already gave up AND released the landing blocks
+            # (they may belong to another sequence by now) — never inject
+            logger.warning(
+                "dropping late KV transfer for %s (request abandoned)",
+                payload.seq_id,
+            )
+            return
+        fut, block_ids = entry
+        try:
+            await self.engine.inject_blocks(payload.block_ids, payload.blocks)
+        except Exception as exc:  # noqa: BLE001
+            if fut.cancelled():
+                self.engine.release_blocks(block_ids)
+            elif not fut.done():
+                fut.set_exception(exc)  # requester releases (generate())
+            return
+        if fut.cancelled():
+            # requester's wait timed out between our pop and the inject
+            # finishing; the blocks were still reserved (we owned them), so
+            # the inject was harmless — free them now
+            self.engine.release_blocks(block_ids)
+        elif not fut.done():
             fut.set_result(
                 (
                     payload.first_token,
@@ -177,7 +209,7 @@ class DisaggDecodeEngine:
         self.remote_prefills += 1
         seq_id = request.ctx.id or uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[seq_id] = fut
+        self._pending[seq_id] = (fut, block_ids)
         n_kv_blocks = self.engine.allocator.blocks_needed(len(pre.token_ids))
         await self.queue.enqueue(
             {
@@ -188,11 +220,23 @@ class DisaggDecodeEngine:
             }
         )
         try:
-            first_token, first_lp, first_top = await asyncio.wait_for(fut, timeout=300)
+            first_token, first_lp, first_top = await asyncio.wait_for(
+                fut, timeout=self.prefill_timeout_s
+            )
         except (asyncio.TimeoutError, asyncio.CancelledError):
+            if self._pending.pop(seq_id, None) is not None:
+                # we still own the landing blocks — a transfer that arrives
+                # from here on finds no pending entry and is dropped
+                self.engine.release_blocks(block_ids)
+            # else: _on_transfer claimed the entry; it observes the
+            # cancelled future and releases the blocks itself
+            raise RuntimeError(f"remote prefill for {seq_id} timed out")
+        except Exception:
+            # inject failed after the transfer claimed the entry; blocks
+            # were never handed to a sequence — release here
             self._pending.pop(seq_id, None)
             self.engine.release_blocks(block_ids)
-            raise RuntimeError(f"remote prefill for {seq_id} timed out")
+            raise
         return await self.engine.generate_prefilled(
             request, block_ids, first_token, first_token_logprob=first_lp,
             first_token_top_logprobs=first_top,

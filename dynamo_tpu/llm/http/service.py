@@ -292,6 +292,18 @@ class HttpService:
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: propagate kill upstream
             ctx.ctx.kill()
+        except Exception as exc:  # noqa: BLE001 — engine failure mid-stream:
+            # the SSE response already started, so surface an error event
+            # (never a fake finish) and stop generation
+            logger.exception("stream failed mid-flight")
+            try:
+                payload = json.dumps(
+                    {"error": {"message": repr(exc), "type": "internal_error"}}
+                )
+                await response.write(sse.encode_event(data=payload).encode())
+            except Exception:  # noqa: BLE001 — connection may be gone too
+                pass
+            ctx.ctx.kill()
         finally:
             self.metrics.output_tokens.labels(model).observe(completion_tokens)
         await response.write_eof()

@@ -36,7 +36,8 @@ DOWNLOAD_PATTERNS = [
 ]
 
 
-def _cache_base(cache_dir: str | Path | None) -> Path:
+def cache_base(cache_dir: str | Path | None = None) -> Path:
+    """Shared on-disk cache root (hub snapshots, MDC artifacts)."""
     return Path(
         cache_dir
         or os.environ.get("DYN_CACHE_DIR")
@@ -96,7 +97,7 @@ def resolve_model(
     if name.startswith((".", "/")) or "/" not in name:
         raise FileNotFoundError(f"model path {name!r} does not exist")
 
-    dest = _cache_base(cache_dir) / "hub" / name.replace("/", "--")
+    dest = cache_base(cache_dir) / "hub" / name.replace("/", "--")
     if is_complete(dest):
         logger.info("model %s served from cache %s", name, dest)
         return dest

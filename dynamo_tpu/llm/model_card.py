@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -117,14 +118,9 @@ class ModelDeploymentCard:
         """Download this card's artifacts into a local cache dir and point
         ``self.path`` at it.  Returns the dir, or None if the store holds
         nothing for this checksum (e.g. a worker that never published)."""
-        import os
+        from dynamo_tpu.llm.hub import cache_base
 
-        base = Path(
-            cache_dir
-            or os.environ.get("DYN_CACHE_DIR")
-            or Path.home() / ".cache" / "dynamo_tpu"
-        )
-        dest = base / "mdc" / self.checksum
+        dest = cache_base(cache_dir) / "mdc" / self.checksum
         fetched = 0
         for fname in ARTIFACT_FILES:
             if (dest / fname).exists():

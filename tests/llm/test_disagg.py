@@ -390,3 +390,89 @@ async def test_remote_prefill_with_speculative_decode():
         decode_engine.stop()
         prefill_engine.stop()
         await rt.close()
+
+
+async def test_late_transfer_after_timeout_is_dropped(monkeypatch):
+    """A KV transfer arriving after the requester timed out (and released
+    its landing blocks) must be DROPPED — never injected into blocks that
+    may belong to another sequence — and the blocks freed exactly once."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-late"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-late", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        disagg.prefill_timeout_s = 0.2
+        await disagg.start()
+        # no prefill worker running → the wait must time out
+        prompt = list(range(3, 13))
+        used_before = engine.allocator.used_blocks
+        with pytest.raises(RuntimeError, match="timed out"):
+            await disagg.generate(Context(request(prompt, max_tokens=4)))
+        assert engine.allocator.used_blocks == used_before  # released once
+        assert not disagg._pending
+
+        # the transfer limps in late: it must not touch the cache
+        injected = []
+
+        async def spy_inject(block_ids, blocks):
+            injected.append(block_ids)
+
+        monkeypatch.setattr(engine, "inject_blocks", spy_inject)
+        from dynamo_tpu.parallel.kv_transfer import KvTransferPayload
+
+        await disagg._on_transfer(
+            KvTransferPayload(
+                seq_id="whatever", first_token=1, block_ids=[0, 1], blocks={}
+            )
+        )
+        assert injected == []
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
+
+
+async def test_claimed_transfer_with_cancelled_waiter_releases():
+    """If the transfer claims the pending entry but the requester's wait
+    was already cancelled, the transfer path releases the landing blocks
+    (no leak, no double-release)."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-claim"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-claim", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+        block_ids = engine.reserve_blocks(8)
+        used_with_reservation = engine.allocator.used_blocks
+        fut = asyncio.get_running_loop().create_future()
+        fut.cancel()
+        disagg._pending["s1"] = (fut, block_ids)
+        from dynamo_tpu.parallel.kv_transfer import KvTransferPayload
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        leaves = {
+            k: np.zeros((v.shape[0], 2, *v.shape[2:]), np.float32)
+            for k, v in dict(engine.cache).items()
+        }
+        await disagg._on_transfer(
+            KvTransferPayload(
+                seq_id="s1", first_token=1,
+                block_ids=block_ids[:2], blocks=leaves,
+            )
+        )
+        assert engine.allocator.used_blocks == used_with_reservation - len(block_ids)
+        assert not disagg._pending
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
