@@ -302,3 +302,50 @@ async def test_http_completions_echo(mdc, tokenizer):
             assert r.status_code == 400
     finally:
         await service.stop()
+
+
+class _FailingEngine:
+    """Streams one token then an engine-side ERROR finish."""
+
+    async def generate(self, request):
+        from dynamo_tpu.llm.protocols.common import (
+            Annotated as Ann,
+            FinishReason,
+            LLMEngineOutput,
+        )
+        from dynamo_tpu.runtime.engine import ResponseStream
+
+        async def gen():
+            yield Ann.from_data(
+                LLMEngineOutput(token_ids=[5])
+            ).to_wire(LLMEngineOutput.to_wire)
+            yield Ann.from_data(
+                LLMEngineOutput(
+                    token_ids=[], finish_reason=FinishReason.ERROR,
+                    error="RuntimeError: cache poisoned",
+                )
+            ).to_wire(LLMEngineOutput.to_wire)
+
+        return ResponseStream(gen(), request.ctx)
+
+
+async def test_engine_error_surfaces_as_500(mdc, tokenizer):
+    """An engine-side ERROR finish must produce HTTP 500 with the
+    diagnostic — never a 200 with finish_reason 'stop'."""
+    manager = ModelManager()
+    manager.add_chat_model(
+        "tiny", ChatPreprocessor(mdc, tokenizer).wrap(Backend(tokenizer).wrap(_FailingEngine()))
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        async with httpx.AsyncClient(base_url=f"http://127.0.0.1:{service.port}") as client:
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "x"}]},
+                timeout=30,
+            )
+            assert r.status_code == 500
+            assert "cache poisoned" in r.json()["error"]["message"]
+    finally:
+        await service.stop()
