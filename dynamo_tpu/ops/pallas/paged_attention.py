@@ -5,12 +5,18 @@ HBM into VMEM by the BlockSpec index_map reading the scalar-prefetched block
 table (so the "gather" is just DMA addressing), and softmax is accumulated
 online flash-style in VMEM scratch across a sequence's pages.
 
-Layout notes (TPU tiling):
-- K/V cache pages are [block_size, kv_heads*head_dim] per page after
-  flattening heads into the lane dimension (head_dim multiple of 128 keeps
-  lanes aligned; block_size ≥ 8 keeps sublanes aligned).
-- GQA: queries [kv_heads*group, head_dim]; per page we contract
-  [G_all, D] × [bs, KVH, D] per kv head.
+Layout notes (TPU tiling / Mosaic):
+- A cache page [bs, KVH, D] is viewed flat as [bs*KVH, D] (an HBM reshape,
+  free) so every matmul in the kernel is plain 2-D — Mosaic's tpu.matmul
+  does not accept batched operands whose batch dims sit at different
+  positions, which is exactly what a per-kv-head batched dot over
+  [KVH, G, D] × [bs, KVH, D] lowers to.
+- GQA head matching is done with iota masks on the score matrix
+  [H, bs*KVH]: column j*KVH+c holds page position j of kv head c, and query
+  head h only keeps columns with c == h // groups.  The masked entries cost
+  KVH× extra MACs, but decode attention is HBM-bandwidth-bound (the page
+  streams dominate) and the whole score matmul is a single MXU tile pass,
+  so the "waste" is free in wall-clock terms.
 """
 
 from __future__ import annotations
@@ -25,87 +31,16 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _kernel(
-    # scalar prefetch
-    block_tables_ref,   # [B, maxb] int32
-    context_lens_ref,   # [B] int32
-    # inputs
-    q_ref,              # [1, H, D]        (this sequence's queries)
-    k_page_ref,         # [1, bs, KVH, D]  (this grid step's page)
-    v_page_ref,
-    # output
-    out_ref,            # [1, H, D]
-    # scratch
-    m_ref,              # [KVH, G, 128] f32 running max (broadcast on lanes)
-    l_ref,              # [KVH, G, 128] f32 running denom
-    acc_ref,            # [KVH, G, D] f32 running numerator
-    *,
-    block_size: int,
-    num_kv_heads: int,
-    groups: int,
-    head_dim: int,
-    max_blocks: int,
-):
-    seq = pl.program_id(0)
-    page = pl.program_id(1)
-    ctx = context_lens_ref[seq]
-
-    @pl.when(page == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    page_start = page * block_size
-
-    @pl.when(page_start < ctx)
-    def _compute():
-        q = q_ref[0].reshape(num_kv_heads, groups, head_dim).astype(jnp.float32)
-        k = k_page_ref[0].astype(jnp.float32)   # [bs, KVH, D]
-        v = v_page_ref[0].astype(jnp.float32)
-        scale = 1.0 / (head_dim ** 0.5)
-        # [KVH, G, bs] = batch(KVH) contract(D)
-        s = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
-        s = jnp.where(pos < ctx, s, NEG_INF)
-
-        m_prev = m_ref[:, :, :1]                            # [KVH, G, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)          # [KVH, G, 1]
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                              # [KVH, G, bs]
-        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # [KVH, G, D] = batch(KVH) contract(bs)
-        pv = jax.lax.dot_general(
-            p, v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(page == max_blocks - 1)
-    def _finish():
-        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
-        out = (acc_ref[...] / denom).reshape(num_kv_heads * groups, head_dim)
-        out_ref[0] = out.astype(out_ref.dtype)
-
-
 def _window_kernel(
     block_tables_ref,   # [B, maxb] int32
     context_lens_ref,   # [B] int32 — INCLUDING the window's last token
-    q_ref,              # [1, W, H, D]
-    k_page_ref,         # [1, bs, KVH, D]
+    q_ref,              # [1, W*H, D]   (w-major fold: row = w*H + h)
+    k_page_ref,         # [1, bs*KVH, D]
     v_page_ref,
-    out_ref,            # [1, W, H, D]
-    m_ref,              # [KVH, W*G, 128] f32
+    out_ref,            # [1, W*H, D]
+    m_ref,              # [W*H, 128] f32
     l_ref,
-    acc_ref,            # [KVH, W*G, D] f32
+    acc_ref,            # [W*H, D] f32
     *,
     block_size: int,
     num_kv_heads: int,
@@ -114,13 +49,15 @@ def _window_kernel(
     max_blocks: int,
     window: int,
 ):
-    """Multi-query (speculative verification) variant: the W window queries
-    fold into the group axis — one extra mask term per query position,
-    otherwise the same online-softmax page loop as ``_kernel``."""
+    """Online-softmax page loop over flat [bs*KVH, D] pages.  The W window
+    queries (W=1 for plain decode) fold into the row axis; each query row
+    masks to its own absolute position."""
     seq = pl.program_id(0)
     page = pl.program_id(1)
     ctx = context_lens_ref[seq]
-    wg = window * groups
+    rows = block_size * num_kv_heads
+    h_all = num_kv_heads * groups
+    wh = window * h_all
 
     @pl.when(page == 0)
     def _init():
@@ -132,36 +69,33 @@ def _window_kernel(
 
     @pl.when(page_start < ctx)
     def _compute():
-        # [W, KVH, G, D] → [KVH, W, G, D] → [KVH, W*G, D]
-        q = (
-            q_ref[0]
-            .reshape(window, num_kv_heads, groups, head_dim)
-            .transpose(1, 0, 2, 3)
-            .reshape(num_kv_heads, wg, head_dim)
-            .astype(jnp.float32)
-        )
-        k = k_page_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)        # [W*H, D]
+        k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
         v = v_page_ref[0].astype(jnp.float32)
         scale = 1.0 / (head_dim ** 0.5)
         s = jax.lax.dot_general(
             q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale                                            # [KVH, W*G, bs]
-        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, block_size), 2)
-        w_idx = jax.lax.broadcasted_iota(jnp.int32, (1, wg, 1), 1) // groups
-        q_pos = ctx - window + w_idx                          # [1, W*G, 1]
-        s = jnp.where(pos <= q_pos, s, NEG_INF)
+        ) * scale                                        # [W*H, bs*KVH]
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
+        pos = page_start + col // num_kv_heads
+        kv_of_col = col % num_kv_heads
+        row = jax.lax.broadcasted_iota(jnp.int32, (wh, 1), 0)
+        kv_of_row = (row % h_all) // groups
+        q_pos = ctx - window + row // h_all              # [W*H, 1]
+        mask = (kv_of_col == kv_of_row) & (pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[:, :, :1]
+        m_prev = m_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = l_ref[:, :, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
             p, v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         acc_ref[...] = acc_ref[...] * alpha + pv
@@ -170,14 +104,8 @@ def _window_kernel(
 
     @pl.when(page == max_blocks - 1)
     def _finish():
-        denom = jnp.maximum(l_ref[:, :, :1], 1e-20)
-        out = (
-            (acc_ref[...] / denom)
-            .reshape(num_kv_heads, window, groups, head_dim)
-            .transpose(1, 0, 2, 3)
-            .reshape(window, num_kv_heads * groups, head_dim)
-        )
-        out_ref[0] = out.astype(out_ref.dtype)
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -193,23 +121,25 @@ def paged_window_attention_decode(
     """Pallas multi-query paged attention for speculative verification
     (pure-JAX twin: ops/attention.py paged_window_attention)."""
     b, w, h, d = q.shape
-    _, bs, kvh, _ = k_cache.shape
+    n, bs, kvh, _ = k_cache.shape
     maxb = block_tables.shape[1]
     groups = h // kvh
+    rows = bs * kvh
+    wh = w * h
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, maxb),
         in_specs=[
-            pl.BlockSpec((1, w, h, d), lambda s, p, bt, cl: (s, 0, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, wh, d), lambda s, p, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, rows, d), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+            pl.BlockSpec((1, rows, d), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, w, h, d), lambda s, p, bt, cl: (s, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, wh, d), lambda s, p, bt, cl: (s, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((kvh, w * groups, 128), jnp.float32),
-            pltpu.VMEM((kvh, w * groups, 128), jnp.float32),
-            pltpu.VMEM((kvh, w * groups, d), jnp.float32),
+            pltpu.VMEM((wh, 128), jnp.float32),
+            pltpu.VMEM((wh, 128), jnp.float32),
+            pltpu.VMEM((wh, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
@@ -221,12 +151,18 @@ def paged_window_attention_decode(
         max_blocks=maxb,
         window=w,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, w, h, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, wh, d), q.dtype),
         interpret=interpret,
-    )(block_tables, context_lens, q, k_cache, v_cache)
+    )(
+        block_tables, context_lens,
+        q.reshape(b, wh, d),
+        k_cache.reshape(n, rows, d),
+        v_cache.reshape(n, rows, d),
+    )
+    return out.reshape(b, w, h, d)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -239,37 +175,9 @@ def paged_attention_decode(
     *,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    b, h, d = q.shape
-    _, bs, kvh, _ = k_cache.shape
-    maxb = block_tables.shape[1]
-    groups = h // kvh
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, maxb),
-        in_specs=[
-            pl.BlockSpec((1, h, d), lambda s, p, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, d), lambda s, p, bt, cl: (bt[s, p], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, h, d), lambda s, p, bt, cl: (s, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((kvh, groups, 128), jnp.float32),
-            pltpu.VMEM((kvh, groups, 128), jnp.float32),
-            pltpu.VMEM((kvh, groups, d), jnp.float32),
-        ],
-    )
-    kernel = functools.partial(
-        _kernel,
-        block_size=bs,
-        num_kv_heads=kvh,
-        groups=groups,
-        head_dim=d,
-        max_blocks=maxb,
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+    # plain decode is the window kernel at W=1: `pos <= ctx - 1` ≡ `pos < ctx`
+    out = paged_window_attention_decode(
+        q[:, None], k_cache, v_cache, block_tables, context_lens,
         interpret=interpret,
-    )(block_tables, context_lens, q, k_cache, v_cache)
+    )
+    return out[:, 0]

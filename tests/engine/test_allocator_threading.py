@@ -35,9 +35,9 @@ def test_allocator_cross_thread_stress():
             errors.append(exc)
 
     threads = [
-        threading.Thread(target=asyncio_side),
-        threading.Thread(target=device_side),
-        threading.Thread(target=asyncio_side),
+        threading.Thread(target=asyncio_side, daemon=True),
+        threading.Thread(target=device_side, daemon=True),
+        threading.Thread(target=asyncio_side, daemon=True),
     ]
     for t in threads:
         t.start()
