@@ -68,8 +68,10 @@ async def test_profile_concurrency_grid_and_sla_planner():
     replicas for the target load."""
     from dynamo_tpu.bench.profile_sla import plan_deployment, profile_engine
 
+    # speedup=20 keeps simulated decode sleeps (~0.5 ms/iter) well above
+    # asyncio event-loop noise so the batching-throughput ordering is stable.
     engine = MockerEngine(
-        MockerConfig(speedup=1000.0, num_blocks=2048, max_batch_size=64)
+        MockerConfig(speedup=20.0, num_blocks=2048, max_batch_size=64)
     )
     engine.start()
     try:
