@@ -29,6 +29,20 @@ import time
 
 BASELINE_TOK_S_PER_GPU = 145.0
 
+# Child-side liveness: stamped at every phase boundary (devices up, engine
+# up, warmup done, ...).  The child watchdog aborts when no stamp lands
+# within DYN_BENCH_PROGRESS_TIMEOUT, so a wedged device tunnel or a hung
+# remote compile fails the attempt in minutes — the persistent compile
+# cache makes the retry resume where this attempt died.
+_last_progress = time.monotonic()
+
+
+def _progress(note: str = "") -> None:
+    global _last_progress
+    _last_progress = time.monotonic()
+    if note:
+        print(f"bench: {note}", file=sys.stderr)
+
 # peak dense bf16 FLOP/s per chip, by device_kind substring (public specs)
 PEAK_FLOPS = [
     ("v6", 918e12),       # Trillium / v6e
@@ -107,6 +121,7 @@ async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) 
     # every chunk of every request.  DYN_BENCH_CHUNK=0 forces whole-prompt.
     default_chunk = "0" if fallback_cpu else "512"
     chunk = int(os.environ.get("DYN_BENCH_CHUNK", default_chunk)) or None
+    _progress(f"rung {model_name}/{quant or 'bf16'} starting")
     t_init = time.monotonic()
 
     family = get_family("llama")
@@ -203,10 +218,7 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
     from dynamo_tpu.runtime.engine import Context
 
     engine.start()
-    print(
-        f"bench: engine up ({model_name}) in {time.monotonic()-t_init:.1f}s",
-        file=sys.stderr,
-    )
+    _progress(f"engine up ({model_name}) in {time.monotonic()-t_init:.1f}s")
     rng = np.random.default_rng(0)
 
     def make_request() -> dict:
@@ -247,18 +259,20 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
     print("bench: warming up (compiles)...", file=sys.stderr)
     t0 = time.monotonic()
     await drive(make_request())
-    print(f"bench: warmup done in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+    _progress(f"warmup done in {time.monotonic()-t0:.1f}s")
     itls.clear()  # warmup's compile-inflated ITL must not enter the stats
 
     t0 = time.monotonic()
     results = await asyncio.gather(*[drive(make_request()) for _ in range(num_requests)])
     wall = time.monotonic() - t0
+    _progress(f"measurement done in {wall:.1f}s")
     # snapshot counters NOW: the auxiliary microbenchmarks below replay
     # prompts and would pollute cumulative prefix/spec counts
     run_stats = engine.stats()
     run_itls = list(itls)
 
     xfer = await _measure_kv_xfer(engine)
+    _progress("kv-xfer microbench done")
     # below ~512 tokens the prefix machinery's fixed overhead (table
     # gather, allocator matching) outweighs the saved prefill compute and
     # the ratio is meaningless noise
@@ -501,32 +515,54 @@ async def run_bench() -> dict:
 
 
 def child_main() -> None:
-    # Fast-fail on a wedged accelerator tunnel: jax.devices() can hang
-    # forever when the axon relay is down (observed: two silent 25-minute
-    # child timeouts).  A watchdog kills this child if device init hasn't
-    # completed within the window, so the parent's retry/fallback ladder
-    # advances in minutes, not attempt-timeouts.
+    # Fast-fail on a wedged phase: jax.devices() can hang forever when the
+    # axon relay is down (observed: silent 25-minute child timeouts), and a
+    # remote compile can hang just as silently mid-warmup.  The watchdog
+    # kills this child when NO phase boundary has been crossed within the
+    # window, so the parent's retry/fallback ladder advances in minutes,
+    # not attempt-timeouts.  Device init gets its own (shorter) window.
     import threading
 
-    ready = threading.Event()
-    window = float(os.environ.get("DYN_BENCH_DEVICE_TIMEOUT", "240"))
+    dev_window = float(os.environ.get("DYN_BENCH_DEVICE_TIMEOUT", "240"))
+    window = float(os.environ.get("DYN_BENCH_PROGRESS_TIMEOUT", "900"))
+    t_arm = time.monotonic()
 
     def watchdog() -> None:
-        if not ready.wait(window):
-            print(
-                f"bench: device init still hung after {window:.0f}s; aborting child",
-                file=sys.stderr,
-            )
-            sys.stderr.flush()
-            os._exit(3)
+        while True:
+            first = _last_progress <= t_arm  # no stamp yet → device init
+            limit = dev_window if first else window
+            idle = time.monotonic() - max(_last_progress, t_arm)
+            if idle > limit:
+                what = "device init" if first else "progress"
+                print(
+                    f"bench: no {what} for {idle:.0f}s; aborting child",
+                    file=sys.stderr,
+                )
+                sys.stderr.flush()
+                os._exit(3 if first else 4)
+            time.sleep(2)
 
     threading.Thread(target=watchdog, daemon=True).start()
     import jax
 
+    # Persistent compilation cache: the 8B serving programs take minutes
+    # each through the remote-compile service, longer than one attempt
+    # window on a bad day.  With the on-disk cache every compile that
+    # finishes is banked, so a timed-out attempt's successor resumes from
+    # where it died instead of starting over (and a later bench run on the
+    # same machine starts warm).
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
     t0 = time.monotonic()
     devs = jax.devices()
-    ready.set()
-    print(f"bench: devices {devs} in {time.monotonic()-t0:.1f}s", file=sys.stderr)
+    _progress(f"devices {devs} in {time.monotonic()-t0:.1f}s")
 
     result = asyncio.run(run_bench())
     print(json.dumps(result))
@@ -574,8 +610,9 @@ def main() -> None:
             return
         if attempt + 1 < tpu_attempts:
             # a wedged tunnel fails fast via the child watchdog; give it a
-            # real chance to recover before the next attempt
-            time.sleep(45)
+            # real chance to recover before the next attempt (observed:
+            # a child killed mid-compile can wedge device init for minutes)
+            time.sleep(float(os.environ.get("DYN_BENCH_RETRY_SLEEP", "90")))
 
     # accelerator never produced a result: CPU fallback so the round still
     # records a parseable (clearly-marked) data point instead of rc=1

@@ -290,6 +290,12 @@ class JaxLlmEngine:
                 resolve_kv_cache_dtype(config.kv_cache_dtype),
             )
             cos, sin = self.family.rope_tables(cfg)
+            # families build tables out to max_position_embeddings (131k for
+            # llama3); the engine only ever indexes positions < max_len.
+            # Slice before upload — with the full table, every compiled
+            # program would carry (and the remote compile service would
+            # ship) tens of MB of trig constants.
+            cos, sin = cos[: self.max_len], sin[: self.max_len]
             lanes = config.max_batch_size
             gen_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
             prompt_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
@@ -477,12 +483,16 @@ class JaxLlmEngine:
         ):
             prefill_kwargs["sp_mesh"] = self.mesh
 
+        # cos/sin ride as arguments, not closure constants: a closed-over
+        # concrete array is baked into the HLO as a constant (observed:
+        # 350MB of trig tables inside one compiled prefill program, which
+        # is what the remote compile service chokes on)
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  block_ids, seq_len, start_pos, gen_row, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep, bias_ids, bias_vals):
+                 greedy, pres, freq, rep, bias_ids, bias_vals, cos, sin):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
-                self.cos, self.sin, **prefill_kwargs,
+                cos, sin, **prefill_kwargs,
             )
             # (re)seed this lane's sampling state.  ``gen_row`` is the count
             # of already-generated tokens (nonzero only on preemption
@@ -526,10 +536,10 @@ class JaxLlmEngine:
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
                  prompt_row, gen_row, sample_gate, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep, bias_ids, bias_vals):
+                 greedy, pres, freq, rep, bias_ids, bias_vals, cos, sin):
             logits, cache = self.family.forward_prefill_with_prefix(
                 params, cfg, token_ids, cache, full_block_ids, tail_block_ids,
-                tail_len, start_pos, self.cos, self.sin,
+                tail_len, start_pos, cos, sin,
             )
             prompt_counts = prompt_counts.at[lane].set(prompt_row)
             gen_counts = gen_counts.at[lane].set(gen_row)
@@ -565,14 +575,15 @@ class JaxLlmEngine:
 
         def step(params, cache, gen_counts, prompt_counts, lane, embeds,
                  token_ids, n_patch, block_ids, seq_len, gen_row, key, temp,
-                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
+                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
+                 cos, sin):
             s = token_ids.shape[0]
             pos = jnp.arange(s)
             x_text = params["embed"][token_ids].astype(cfg.dtype)
             x = jnp.where((pos < n_patch)[:, None], embeds.astype(cfg.dtype), x_text)
             logits, cache = self.family.forward_prefill_embeds(
                 params, cfg, x, cache, block_ids, seq_len, jnp.int32(0),
-                self.cos, self.sin,
+                cos, sin,
             )
             # penalty rows count TEXT tokens only (patch positions masked)
             valid = ((pos >= n_patch) & (pos < seq_len)).astype(jnp.int32)
@@ -613,11 +624,11 @@ class JaxLlmEngine:
             and self.family.forward_decode_pp is not None
         )
 
-        def fwd_decode(params, cache, tokens, tables, lens, slots):
+        def fwd_decode(params, cache, tokens, tables, lens, slots, cos, sin):
             if use_pp:
                 return self.family.forward_decode_pp(
                     params, cfg, tokens, cache, tables, lens, slots,
-                    self.cos, self.sin, pp_mesh=self.mesh,
+                    cos, sin, pp_mesh=self.mesh,
                 )
             kwargs = {"attention": self.attention_impl}
             if (
@@ -629,7 +640,7 @@ class JaxLlmEngine:
                 kwargs["tp_mesh"] = self.mesh
             return self.family.forward_decode(
                 params, cfg, tokens, cache, tables, lens, slots,
-                self.cos, self.sin, **kwargs,
+                cos, sin, **kwargs,
             )
 
         lanes = self.config.max_batch_size
@@ -645,9 +656,11 @@ class JaxLlmEngine:
         if steps <= 1:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
                      block_tables, context_lens, slot_ids, keys, temp, top_k,
-                     top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
+                     top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
+                     cos, sin):
                 logits, cache = fwd_decode(
-                    params, cache, token_ids, block_tables, context_lens, slot_ids
+                    params, cache, token_ids, block_tables, context_lens,
+                    slot_ids, cos, sin,
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 logits = apply_logit_bias(logits, bias_ids, bias_vals)
@@ -670,7 +683,7 @@ class JaxLlmEngine:
 
         def multi(params, cache, gen_counts, prompt_counts, token_ids,
                   block_tables, context_lens, keys, temp, top_k, top_p, greedy,
-                  pres, freq, rep, bias_ids, bias_vals):
+                  pres, freq, rep, bias_ids, bias_vals, cos, sin):
             active = context_lens > 0
             active_i = active.astype(jnp.int32)
 
@@ -683,7 +696,7 @@ class JaxLlmEngine:
                 blk = jnp.take_along_axis(block_tables, (pos // block_size)[:, None], axis=1)[:, 0]
                 slots = jnp.where(active, blk * block_size + pos % block_size, oob)
                 logits, cache = fwd_decode(
-                    params, cache, tokens, block_tables, lens, slots
+                    params, cache, tokens, block_tables, lens, slots, cos, sin
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 logits = apply_logit_bias(logits, bias_ids, bias_vals)
@@ -716,13 +729,14 @@ class JaxLlmEngine:
 
         def step(params, cache, gen_counts, prompt_counts, token_ids,
                  block_tables, context_lens, slot_ids, spec_ok, keys, temp,
-                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals):
+                 top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
+                 cos, sin):
             # the pallas window kernel runs single-device only (the tp
             # shard_map wrapper exists just for the 1-query kernel)
             impl = self.attention_impl if self.mesh is None else "jax"
             logits, cache = self.family.forward_verify(
                 params, cfg, token_ids, cache, block_tables, context_lens,
-                slot_ids, self.cos, self.sin, attention=impl,
+                slot_ids, cos, sin, attention=impl,
             )  # [lanes, w, vocab]
             active = context_lens > 0
             base_lens = jnp.maximum(context_lens - (w_len - 1), 0)
@@ -1543,6 +1557,7 @@ class JaxLlmEngine:
                 jnp.int32(lane), jnp.asarray(emb_pad), jnp.asarray(tok_arr),
                 jnp.int32(seq.mm_len), jnp.asarray(block_ids), jnp.int32(total),
                 jnp.asarray(gen_row), jnp.asarray(key), *sampling_tail,
+                self.cos, self.sin,
             )
             seq.prefilled_tokens = total
             want_top = seq.request.sampling.top_logprobs > 0
@@ -1577,6 +1592,7 @@ class JaxLlmEngine:
                 jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(start),
                 jnp.int32(n), jnp.asarray(prompt_row), jnp.asarray(gen_row),
                 jnp.int32(1 if final else 0), jnp.asarray(key), *sampling_tail,
+                self.cos, self.sin,
             )
         else:
             padded = np.zeros((self._bucket_len(end),), np.int32)
@@ -1587,7 +1603,7 @@ class JaxLlmEngine:
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
                 jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
-                *sampling_tail,
+                *sampling_tail, self.cos, self.sin,
             )
         seq.prefilled_tokens = end
         if not final:
@@ -1737,6 +1753,7 @@ class JaxLlmEngine:
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
+                self.cos, self.sin,
             )
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
             lps_host = np.asarray(lps)[None, :]
@@ -1747,6 +1764,7 @@ class JaxLlmEngine:
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), *sampling_tail,
+                self.cos, self.sin,
             )
             tokens_host = np.asarray(tokens)  # [steps, lanes]
             lps_host = np.asarray(lps)
@@ -1785,6 +1803,7 @@ class JaxLlmEngine:
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
             jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+            self.cos, self.sin,
         )
 
     def _run_verify_decode(self, seqs: list[Sequence], drafts: dict) -> None:
@@ -1846,6 +1865,7 @@ class JaxLlmEngine:
             jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
             jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
             jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+            self.cos, self.sin,
         )
         tokens_h = np.asarray(tokens)
         n_h = np.asarray(n_accept)
