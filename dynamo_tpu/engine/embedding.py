@@ -44,10 +44,15 @@ class JaxEmbeddingEngine:
         self.tokenizer = tokenizer
         cfg = config.model
         self.params = params if params is not None else init_params(cfg, jax.random.PRNGKey(config.seed))
-        self.cos, self.sin = make_rope_tables(cfg)
+        cos, sin = make_rope_tables(cfg)
+        # slice to the served window and pass as jit args: tables built to
+        # max_position_embeddings (131k for llama3) closed over as concrete
+        # arrays get baked into the compiled program as tens of MB of
+        # constants (same defect the serving engine fixed)
+        self.cos, self.sin = cos[: config.max_length], sin[: config.max_length]
 
-        def embed_fn(params, token_ids, seq_len):
-            hidden = llama_forward_trunk(params, cfg, token_ids, seq_len, self.cos, self.sin)
+        def embed_fn(params, token_ids, seq_len, cos, sin):
+            hidden = llama_forward_trunk(params, cfg, token_ids, seq_len, cos, sin)
             mask = (jnp.arange(hidden.shape[0]) < seq_len)[:, None]
             pooled = jnp.sum(hidden * mask, axis=0) / jnp.maximum(seq_len, 1)
             return pooled / jnp.maximum(jnp.linalg.norm(pooled), 1e-9)
@@ -81,7 +86,10 @@ class JaxEmbeddingEngine:
             padded[: len(ids)] = ids
             vec = await asyncio.to_thread(
                 lambda p=padded, n=len(ids): np.asarray(
-                    self._embed(self.params, jnp.asarray(p), jnp.int32(n))
+                    self._embed(
+                        self.params, jnp.asarray(p), jnp.int32(n),
+                        self.cos, self.sin,
+                    )
                 )
             )
             if request.encoding_format == "base64":

@@ -488,3 +488,29 @@ def test_min_tokens_suppresses_eos():
     seq2 = Sequence(seq_id="s2", request=pre2)
     seq2.output_ids.extend([9, 9])
     assert seq2.hit_stop(9) is FinishReason.LENGTH
+
+
+def test_rope_tables_sliced_and_passed_as_args():
+    """Serving programs must not bake the rope tables in as HLO constants:
+    families build them to max_position_embeddings (131k for llama3 — 33MB
+    of fp32 per table), and a closed-over concrete array is embedded into
+    every compiled program (observed: 350MB of trig constants inside one
+    prefill executable, which is what wedged the remote compile service on
+    the TPU bench).  The engine slices to max_len and threads cos/sin
+    through the jits as arguments."""
+    import dataclasses
+    import inspect
+
+    cfg = dataclasses.replace(CFG, max_position_embeddings=131072)
+    engine = JaxLlmEngine(
+        EngineConfig(model=cfg, num_blocks=64, block_size=4,
+                     max_batch_size=4, prefill_buckets=(16,), max_model_len=128)
+    )
+    # sliced: the device table covers max_len positions, not 131k
+    assert engine.cos.shape[0] == engine.max_len == 128
+    assert engine.cos.nbytes < 100_000
+    # threaded as args: every serving jit's wrapped function ends (cos, sin)
+    for jit_fn in (engine._jit_prefill, engine._jit_prefill_prefix,
+                   engine._jit_decode):
+        params = list(inspect.signature(jit_fn.__wrapped__).parameters)
+        assert params[-2:] == ["cos", "sin"], params
