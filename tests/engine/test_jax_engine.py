@@ -514,3 +514,22 @@ def test_rope_tables_sliced_and_passed_as_args():
                    engine._jit_decode):
         params = list(inspect.signature(jit_fn.__wrapped__).parameters)
         assert params[-2:] == ["cos", "sin"], params
+
+
+def test_embedding_engine_rope_tables_sliced_and_passed_as_args():
+    """Same guarantee for JaxEmbeddingEngine: tables sliced to the served
+    window and threaded through the jit as arguments, not closure
+    constants."""
+    import dataclasses
+    import inspect
+
+    from dynamo_tpu.engine.embedding import EmbeddingEngineConfig, JaxEmbeddingEngine
+
+    cfg = dataclasses.replace(CFG, max_position_embeddings=131072)
+    eng = JaxEmbeddingEngine(
+        EmbeddingEngineConfig(model=cfg, max_length=64), tokenizer=None
+    )
+    assert eng.cos.shape[0] == 64
+    assert eng.cos.nbytes < 100_000
+    params = list(inspect.signature(eng._embed.__wrapped__).parameters)
+    assert params[-2:] == ["cos", "sin"], params
