@@ -193,6 +193,27 @@ async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) 
         ),
         params=params,
     )
+    # parallel AOT compile of the serving programs before the first drive:
+    # the remote compile pool can work the prefill/continued-prefill/decode
+    # programs concurrently instead of one-per-first-dispatch (results
+    # reach the serving path through the persistent compilation cache)
+    if not fallback_cpu:
+        # parse OUTSIDE the try: a bad env value must fail fast (bench env
+        # contract), not read as "aot failed, lazy compiles"
+        aot_parallel = int(os.environ.get("DYN_BENCH_AOT_PARALLEL", "6"))
+        try:
+            t0 = time.monotonic()
+            n = engine.aot_precompile(
+                [prompt_len],
+                parallel=aot_parallel,
+                on_program=lambda name: _progress(f"aot compiled {name}"),
+            )
+            _progress(f"aot precompile: {n} programs in {time.monotonic()-t0:.1f}s")
+        except Exception as err:  # noqa: BLE001 — lazy compiles still work
+            print(
+                f"bench: aot_precompile failed ({err!r:.200}); falling back "
+                "to lazy compiles", file=sys.stderr,
+            )
     try:
         return await _measure(engine, cfg, model_name, quant, num_requests, prompt_len,
                               output_len, max_batch, decode_steps, fallback_cpu, t_init)

@@ -1112,6 +1112,130 @@ class JaxLlmEngine:
             await fut
         await self.clear_kv_blocks()
 
+    def aot_precompile(self, prompt_lens, parallel: int = 8, on_program=None) -> int:
+        """Compile the serving programs for the given prompt lengths
+        CONCURRENTLY, ahead of first use.
+
+        The device loop compiles lazily — one program per first dispatch,
+        strictly serially.  Against a remote compile service (or any
+        multi-core compiler) that serializes what could run in parallel:
+        each program is independent.  This lowers every program the
+        serving loop will need for ``prompt_lens`` with exact argument
+        avals and compiles them in a thread pool (XLA releases the GIL
+        during compilation).
+
+        The compiled results reach the real dispatch path through JAX's
+        persistent compilation cache — callers must have
+        ``jax_compilation_cache_dir`` configured (bench.py does); without
+        it this wastes work and returns without compiling.  An aval
+        mismatch would silently compile a useless twin program, so
+        tests/engine/test_aot_precompile.py asserts the real serving path
+        produces ZERO new cache entries after this ran.
+
+        Single-device engines only (the sharded path's out_shardings need
+        device-committed avals; multi-chip engines keep lazy compiles).
+        Returns the number of programs compiled.
+        """
+        if self.mesh is not None:
+            return 0
+        if not jax.config.jax_compilation_cache_dir:
+            logger.warning("aot_precompile: no jax_compilation_cache_dir; skipping")
+            return 0
+
+        sds = jax.ShapeDtypeStruct
+        cfg = self.config
+        vocab = cfg.model.vocab_size
+        lanes = cfg.max_batch_size
+        kb = cfg.logit_bias_k
+        aval = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: sds(x.shape, x.dtype), t
+        )
+        params_a, cache_a = aval(self.params), aval(self.cache)
+        counts_a = sds((lanes, vocab), jnp.int32)
+        i32, row_a = sds((), jnp.int32), sds((vocab,), jnp.int32)
+        key_a = sds((2,), jnp.uint32)
+        keys_a = sds((lanes, 2), jnp.uint32)
+        cos_a, sin_a = aval(self.cos), aval(self.sin)
+
+        def tail(n):
+            f32 = lambda: sds((n,), jnp.float32)  # noqa: E731
+            return (f32(), sds((n,), jnp.int32), f32(), sds((n,), jnp.bool_),
+                    f32(), f32(), f32(), sds((n, kb), jnp.int32),
+                    sds((n, kb), jnp.float32))
+
+        jobs: dict[tuple, tuple] = {}  # dedup key -> (jit_fn, avals)
+        blocks_fixed = sds((self.max_blocks_per_seq,), jnp.int32)
+        for n in prompt_lens:
+            n = min(int(n), self.max_len - 1)
+            if self.chunk_tokens is not None and n > self.chunk_tokens:
+                # chunked path: every window runs the continued-prefill
+                # program; shapes depend only on (window bucket, table
+                # bucket for the full prompt)
+                # mirror _run_prefill's table sizing exactly
+                table_len = self.allocator.blocks_needed(
+                    self._bucket_len(min(n + 1, self.max_len))
+                )
+                table_a = sds((table_len,), jnp.int32)
+                windows = {self.chunk_tokens, n % self.chunk_tokens or self.chunk_tokens}
+                for w in windows:
+                    b = self._bucket_len(w)
+                    jobs[("prefix", b, table_len)] = (
+                        self._jit_prefill_prefix,
+                        (params_a, cache_a, counts_a, counts_a, i32,
+                         sds((b,), jnp.int32), table_a, table_a, i32, i32, i32,
+                         row_a, row_a, i32, key_a, *tail(1), cos_a, sin_a),
+                    )
+            else:
+                b = self._bucket_len(n)
+                jobs[("prefill", b)] = (
+                    self._jit_prefill,
+                    (params_a, cache_a, counts_a, counts_a, i32,
+                     sds((b,), jnp.int32), blocks_fixed, i32, i32, row_a,
+                     key_a, *tail(1), cos_a, sin_a),
+                )
+        tables_a = sds((lanes, self.max_blocks_per_seq), jnp.int32)
+        lanes_i = sds((lanes,), jnp.int32)
+        if cfg.decode_steps > 1:
+            jobs[("decode",)] = (
+                self._jit_decode,
+                (params_a, cache_a, counts_a, counts_a, lanes_i, tables_a,
+                 lanes_i, keys_a, *tail(lanes), cos_a, sin_a),
+            )
+        else:
+            jobs[("decode",)] = (
+                self._jit_decode,
+                (params_a, cache_a, counts_a, counts_a, lanes_i, tables_a,
+                 lanes_i, lanes_i, keys_a, *tail(lanes), cos_a, sin_a),
+            )
+        if self._jit_verify is not None:
+            w = cfg.spec_tokens + 1
+            win_a = sds((lanes, w), jnp.int32)
+            jobs[("verify",)] = (
+                self._jit_verify,
+                (params_a, cache_a, counts_a, counts_a, win_a, tables_a,
+                 lanes_i, win_a, sds((lanes,), jnp.bool_), keys_a,
+                 *tail(lanes), cos_a, sin_a),
+            )
+
+        import concurrent.futures as cf
+
+        t0 = time.monotonic()
+
+        def compile_one(item):
+            name, (jit_fn, avals) = item
+            t = time.monotonic()
+            jit_fn.lower(*avals).compile()
+            logger.info("aot_precompile: %s in %.1fs", name, time.monotonic() - t)
+            if on_program is not None:
+                on_program(name)
+
+        with cf.ThreadPoolExecutor(max_workers=max(1, parallel)) as ex:
+            list(ex.map(compile_one, jobs.items()))
+        logger.info(
+            "aot_precompile: %d programs in %.1fs", len(jobs), time.monotonic() - t0
+        )
+        return len(jobs)
+
     async def clear_kv_blocks(self) -> None:
         """Admin flush: drop published prefix-cache state (runs on the device
         thread to serialize with the allocator)."""

@@ -1,0 +1,96 @@
+"""aot_precompile contract: the concurrently-compiled programs must be the
+EXACT programs the serving loop dispatches — an aval mismatch would
+silently compile useless twins and the real path would recompile serially,
+erasing the cold-start win.  The persistent compilation cache is the
+bridge (and the detector: a matched program produces zero new entries)."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.engine import Context
+
+
+def _step_entries(cache_dir) -> set:
+    # serving programs: prefill/prefix/verify jits are named "step",
+    # the fused multi-step decode is named "multi"
+    return {
+        f for f in os.listdir(cache_dir)
+        if f.startswith(("jit_step-", "jit_multi-"))
+    }
+
+
+async def _drive(engine, n_tokens, max_tokens=12, seed=0):
+    # distinct seeds per call: a shared prefix would prefix-hit and
+    # dispatch a continued-prefill variant the AOT cold-start set
+    # intentionally does not cover (those compile lazily as traffic warms)
+    req = PreprocessedRequest(
+        token_ids=[
+            int(x)
+            for x in np.random.default_rng(seed).integers(10, 250, n_tokens)
+        ],
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        eos_token_ids=[],
+    )
+    req.sampling.use_greedy = True
+    stream = await engine.generate(Context(req.to_wire()))
+    count = 0
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data and ann.data.token_ids:
+            count += len(ann.data.token_ids)
+    return count
+
+
+@pytest.mark.slow
+def test_aot_precompile_matches_serving_programs(tmp_path):
+    import jax
+
+    cache_dir = tmp_path / "jcache"
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        engine = JaxLlmEngine(
+            EngineConfig(
+                model=LlamaConfig.tiny(), num_blocks=128, block_size=4,
+                max_batch_size=4, prefill_buckets=(16,), max_model_len=96,
+                prefill_chunk_tokens=16, decode_steps=2,
+                top_logprobs_k=0, logit_bias_k=4,
+            )
+        )
+        n = engine.aot_precompile([40, 12], parallel=4)
+        assert n >= 3  # chunked-prefix variants + short prefill + decode
+        before = _step_entries(cache_dir)
+        assert len(before) == n
+
+        async def main():
+            engine.start()
+            try:
+                # long prompt → chunked prefix windows; short → whole
+                # prefill; both → the fused decode program
+                assert await _drive(engine, 40, seed=0) == 12
+                assert await _drive(engine, 12, seed=1) == 12
+            finally:
+                engine.stop()
+
+        asyncio.run(main())
+        after = _step_entries(cache_dir)
+        assert after == before, (
+            f"serving dispatched {len(after - before)} program(s) the AOT "
+            f"pass missed: aval drift between aot_precompile and the "
+            f"_run_prefill/_run_decode call sites"
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
