@@ -53,6 +53,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--tensor-parallel-size", type=int, default=1)
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
+    run.add_argument("--compilation-cache", default=None, metavar="DIR",
+                     help="persistent JAX compilation cache directory; with "
+                          "--warmup the serving programs also AOT-compile "
+                          "in parallel (cold restarts reuse the cache)")
     run.add_argument("--speculative", choices=["ngram"], default=None,
                      help="speculative decoding (ngram = prompt-lookup "
                           "self-drafting with exact greedy verification)")
@@ -83,6 +87,10 @@ def parse_args(argv=None) -> argparse.Namespace:
 
 async def _run(args) -> int:
     configure_logging()
+    if args.compilation_cache:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", args.compilation_cache)
     control_plane = args.control_plane or "memory"
     runtime = await DistributedRuntime.create(
         RuntimeConfig(control_plane=control_plane, namespace=args.namespace)

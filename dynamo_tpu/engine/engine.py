@@ -1064,6 +1064,7 @@ class JaxLlmEngine:
             async for _ in stream:
                 pass
 
+        plans: list[tuple[int, int]] = []
         prev = 0
         for bucket in self.buckets:
             if self.chunk_tokens is not None and bucket > self.chunk_tokens:
@@ -1082,12 +1083,22 @@ class JaxLlmEngine:
                 prev = bucket
                 continue
             prev = bucket
-            await drive(n, min(want_tokens, self.max_len - n))
+            plans.append((n, min(want_tokens, self.max_len - n)))
         if self.chunk_tokens is not None and self.max_len > self.chunk_tokens + 1:
             # one longer prompt compiles the chunk + continued-prefill jits
             n = min(2 * self.chunk_tokens, self.max_len - want_tokens)
             if n > self.chunk_tokens:
-                await drive(n, min(want_tokens, self.max_len - n))
+                plans.append((n, min(want_tokens, self.max_len - n)))
+        if jax.config.jax_compilation_cache_dir and self.mesh is None:
+            # compile the planned programs concurrently first; the drives
+            # below then hit the persistent cache instead of compiling
+            # one-by-one on the device thread
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(
+                None, partial(self.aot_precompile, [n for n, _ in plans])
+            )
+        for n, toks in plans:
+            await drive(n, toks)
         if self.spec_enabled:
             # warmup's random prompts never draft, so the verify program
             # would otherwise pay its compile on the first real accepting

@@ -30,6 +30,14 @@ def _step_entries(cache_dir) -> set:
     }
 
 
+def _reset_cache():
+    # jax's compilation-cache singleton binds the directory at first use;
+    # re-pointing jax_compilation_cache_dir between tests needs a reset
+    from jax._src import compilation_cache as cc
+
+    cc.reset_cache()
+
+
 async def _drive(engine, n_tokens, max_tokens=12, seed=0):
     # distinct seeds per call: a shared prefix would prefix-hit and
     # dispatch a continued-prefill variant the AOT cold-start set
@@ -60,6 +68,7 @@ def test_aot_precompile_matches_serving_programs(tmp_path):
     prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
     jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _reset_cache()
     try:
         engine = JaxLlmEngine(
             EngineConfig(
@@ -94,3 +103,43 @@ def test_aot_precompile_matches_serving_programs(tmp_path):
     finally:
         jax.config.update("jax_compilation_cache_dir", None)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+        _reset_cache()
+
+
+@pytest.mark.slow
+def test_warmup_uses_aot_when_cache_configured(tmp_path):
+    """With a compilation cache configured, warmup AOT-compiles its planned
+    programs in parallel and the warmup drives are pure cache hits."""
+    import jax
+
+    cache_dir = tmp_path / "jcache"
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _reset_cache()
+    try:
+        engine = JaxLlmEngine(
+            EngineConfig(
+                model=LlamaConfig.tiny(), num_blocks=128, block_size=4,
+                max_batch_size=4, prefill_buckets=(16,), max_model_len=96,
+                prefill_chunk_tokens=16, decode_steps=2,
+                top_logprobs_k=0, logit_bias_k=4,
+            )
+        )
+
+        async def main():
+            engine.start()
+            try:
+                await engine.warmup()
+                after_warmup = _step_entries(cache_dir)
+                assert len(after_warmup) >= 3
+                assert await _drive(engine, 12, seed=7) == 12
+                assert _step_entries(cache_dir) == after_warmup
+            finally:
+                engine.stop()
+
+        asyncio.run(main())
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+        _reset_cache()
