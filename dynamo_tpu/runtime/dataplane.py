@@ -132,7 +132,13 @@ class ResponseStreamServer:
         from dynamo_tpu.native import load_native
 
         await asyncio.to_thread(load_native, "dataplane")
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        # backlog: asyncio's default (100) overflows under request bursts —
+        # a few hundred concurrent generates all dial connect-backs at
+        # once, the kernel RSTs the overflow, and those requests die
+        # (found by the runtime soak test)
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, backlog=1024
+        )
         self.port = self._server.sockets[0].getsockname()[1]
         logger.debug("response stream server on %s:%d", self.host, self.port)
 
@@ -211,8 +217,24 @@ class ResponseStreamSender:
         self._writer: asyncio.StreamWriter | None = None
         self._control_task: asyncio.Task | None = None
 
-    async def connect(self) -> None:
-        self._reader, self._writer = await asyncio.open_connection(self.info.host, self.info.port)
+    async def connect(self, attempts: int = 5) -> None:
+        # bounded retry: under a connect burst the frontend's accept queue
+        # can momentarily overflow and the kernel RSTs the dial; without a
+        # retry that request is silently lost and the frontend waits out
+        # its rendezvous timeout (found by the runtime soak test)
+        delay = 0.05
+        for attempt in range(attempts):
+            try:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self.info.host, self.info.port),
+                    timeout=5.0,
+                )
+                break
+            except (OSError, asyncio.TimeoutError):
+                if attempt + 1 == attempts:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
         self._writer.write(
             encode_frame(TwoPartMessage(header={"t": "prologue", "stream_id": self.info.stream_id}))
         )

@@ -45,6 +45,7 @@ class EndpointService:
         self._loop_task: asyncio.Task | None = None
         self._stats_task: asyncio.Task | None = None
         self._in_flight = 0
+        self._arrived_total = 0
         self._handled_total = 0
         self._errors_total = 0
         self._drained = asyncio.Event()
@@ -65,23 +66,48 @@ class EndpointService:
         logger.info("serving %s (instance %x)", self.instance.subject, self.instance.instance_id)
 
     async def shutdown(self, *, drain_timeout: float | None = None) -> None:
-        """Deregister, stop accepting, drain in-flight requests."""
+        """Deregister, drain in-flight requests, stop accepting.
+
+        Ordering matters: deregistering stops NEW routing decisions, but
+        clients with a stale instance view keep publishing to this subject
+        until their watch catches up — the subscription must stay open
+        through the drain window or those requests are silently dropped
+        and their callers wait out the rendezvous timeout (found by the
+        runtime soak test's churn wave)."""
         plane = self.runtime.plane
         await plane.kv.delete(instance_key(self.instance))
-        if self._sub is not None:
-            await self._sub.unsubscribe()
         if self._stats_sub is not None:
             await self._stats_sub.unsubscribe()
         if drain_timeout is None:
             drain_timeout = self.runtime.config.graceful_shutdown_timeout
-        try:
-            await asyncio.wait_for(self._drained.wait(), drain_timeout)
-        except asyncio.TimeoutError:
-            logger.warning(
-                "drain timeout: %d requests still in flight on %s",
-                self._in_flight,
-                self.instance.subject,
-            )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + drain_timeout
+        while True:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                logger.warning(
+                    "drain timeout: %d requests still in flight on %s",
+                    self._in_flight,
+                    self.instance.subject,
+                )
+                break
+            try:
+                await asyncio.wait_for(self._drained.wait(), remaining)
+            except asyncio.TimeoutError:
+                continue
+            if self._arrived_total == 0:
+                break  # never served a request: nothing can be mid-burst
+            # quiet period: in_flight hitting zero mid-burst is not done —
+            # stale-view clients may still be publishing; only close the
+            # subject once no new request ARRIVED for a beat (arrivals, not
+            # completions: a request that arrives and fails connect-back
+            # inside the window must still count as activity)
+            before = self._arrived_total
+            await asyncio.sleep(min(0.25, max(deadline - loop.time(), 0.0)))
+            if self._in_flight == 0 and self._arrived_total == before:
+                break
+        if self._sub is not None:
+            await self._sub.unsubscribe()
         for task in (self._loop_task, self._stats_task):
             if task is not None:
                 task.cancel()
@@ -109,10 +135,14 @@ class EndpointService:
         ctx = EngineContext(control["id"])
         sender = ResponseStreamSender(ConnectionInfo.from_dict(control["ci"]), ctx)
         self._in_flight += 1
+        self._arrived_total += 1
         self._drained.clear()
         try:
             await sender.connect()
-        except (ConnectionError, OSError) as exc:
+        # asyncio.TimeoutError: on py3.10 it is NOT an OSError subclass, and
+        # connect()'s retry loop re-raises it after exhausting attempts — it
+        # must not leak _in_flight
+        except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("connect-back failed for %s: %r", control["id"], exc)
             self._request_done()
             return

@@ -216,3 +216,44 @@ async def test_remote_engine_facade_and_stats(runtime_factory):
         await service.shutdown(drain_timeout=1)
     finally:
         await rt.close()
+
+
+@pytest.mark.slow
+async def test_soak_concurrent_streams_with_worker_churn(runtime_factory):
+    """Reference parity with the runtime soak tier (lib/runtime/tests/
+    soak.rs:160): sustained concurrent request waves through the full
+    push-ingress / TCP-response path, with a worker draining away mid-wave.
+    Every request must complete with its exact payload — drain means
+    in-flight streams finish and new requests fail over."""
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"))
+        s2 = await ep.serve(EchoEngine("w2"))
+        router = await PushRouter.from_endpoint(ep, mode=RouterMode.ROUND_ROBIN)
+        await router.client.wait_for_instances(2, timeout=5)
+
+        async def one(i: int) -> str:
+            toks = list(range(i % 7 + 1))
+            stream = await router.generate(Context({"tokens": toks}))
+            out = [o async for o in stream]
+            assert [o["token"] for o in out] == toks
+            return out[0]["worker"]
+
+        # 400 concurrent: above the old default listen backlog (100) —
+        # guards the backlog + connect-back-retry fixes
+        workers = await asyncio.gather(*[one(i) for i in range(400)])
+        assert {"w1", "w2"} == set(workers)  # load actually spread
+
+        # churn: drain w2 while a wave is in flight
+        wave = asyncio.gather(*[one(i) for i in range(200)])
+        await asyncio.sleep(0)  # let the wave start routing
+        await s2.shutdown(drain_timeout=5)
+        await wave
+
+        # post-churn wave lands entirely on the survivor
+        workers = await asyncio.gather(*[one(i) for i in range(100)])
+        assert set(workers) == {"w1"}
+        await s1.shutdown(drain_timeout=2)
+    finally:
+        await rt.close()
