@@ -8,7 +8,6 @@ lib/llm/src/kv_router.rs:104 KvRouter, :220 KvPushRouter).
 from __future__ import annotations
 
 import asyncio
-
 from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -20,7 +19,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
     RouterEvent,
 )
 from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
-from dynamo_tpu.runtime.client import PushRouter
+from dynamo_tpu.runtime.client import InstanceNotFound, PushRouter
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
@@ -109,9 +108,45 @@ class KvPushRouter:
         self.push_router = push_router
         self.kv_router = kv_router
 
+    def _candidates(self, tried: set[int]) -> list[int]:
+        """Schedulable workers under PushRouter's shared routing policy
+        (exclusion hard, quarantine soft): a dead worker stays in the
+        instance view until its lease is reaped and would win tie-breaks
+        again, costing every affine request a connect timeout."""
+        return self.push_router.healthy_ids(tried)
+
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         token_ids = request.data.get("token_ids", [])
-        worker_ids = self.push_router.client.instance_ids
-        worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
-        request.data["estimated_prefix_hit_blocks"] = matched
-        return await self.push_router.generate(request, instance_id=worker_id)
+        # re-schedule-excluding-failed failover: direct dispatch disables
+        # PushRouter's own re-pick (affinity must stay with the scheduler),
+        # so a silently-dead worker — lease not yet reaped, subject dark —
+        # is excluded here and the scheduler picks the next-best cache fit
+        tried: set[int] = set()
+        last_err: Exception | None = None
+        while True:
+            worker_ids = self._candidates(tried)
+            if not worker_ids:
+                raise last_err or RuntimeError(
+                    "no instances available for kv-routed dispatch"
+                )
+            worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+            request.data["estimated_prefix_hit_blocks"] = matched
+            try:
+                return await self.push_router.generate(request, instance_id=worker_id)
+            # InstanceNotFound: the worker deregistered between the
+            # instance_ids snapshot and dispatch — same remedy as a dark
+            # worker (which PushRouter already quarantined): reschedule.
+            # Deliberately NOT a broad RuntimeError — a systemic plane
+            # failure must surface, not darken the whole fleet worker by
+            # worker.
+            except (TimeoutError, InstanceNotFound) as err:
+                tried.add(worker_id)
+                last_err = err
+                # drop the worker's blocks/load from the router state so
+                # FOLLOWING requests don't also pay the timeout to discover
+                # it (self-healing: a live worker's next KV event / metrics
+                # publish re-adds it)
+                self.kv_router.remove_worker(worker_id)
+                logger.warning(
+                    "kv-routed worker %x dark (%s); rescheduling", worker_id, err
+                )

@@ -14,6 +14,7 @@ import asyncio
 import os
 import enum
 import random
+import time
 import uuid
 
 import msgpack
@@ -118,6 +119,11 @@ class Client:
         return self.instances
 
 
+class InstanceNotFound(RuntimeError):
+    """Directly-addressed instance is no longer registered (deregistered or
+    lease-reaped between scheduling and dispatch)."""
+
+
 class PushRouter:
     """Routes requests to instances and returns the response stream."""
 
@@ -125,6 +131,13 @@ class PushRouter:
         self.client = client
         self.mode = mode
         self._rr = 0
+        # quarantine shared by ALL routing modes: a worker that failed a
+        # rendezvous is skipped until its deadline passes (a dead worker
+        # stays in the instance view until its lease is reaped — or forever
+        # if the watch was lost — and per-request exclusion alone would
+        # re-pay the connect timeout on every other request)
+        self.dark_ttl_s = float(os.environ.get("DYN_DARK_WORKER_TTL_S", "30"))
+        self._dark: dict[int, float] = {}  # instance_id -> retry-after monotonic
 
     @classmethod
     async def from_endpoint(
@@ -133,15 +146,44 @@ class PushRouter:
         client = await endpoint.client()
         return cls(client, mode)
 
-    def _pick(self, instance_id: int | None) -> Instance:
+    def quarantine(self, instance_id: int) -> None:
+        self._dark[instance_id] = time.monotonic() + self.dark_ttl_s
+
+    def dark_instances(self) -> set[int]:
+        """Currently-quarantined instance ids (expired entries dropped)."""
+        now = time.monotonic()
+        self._dark = {w: t for w, t in self._dark.items() if t > now}
+        return set(self._dark)
+
+    def healthy_ids(self, exclude: set[int] | None = None) -> list[int]:
+        """Candidate instance ids under the shared routing policy:
+        exclusion (failed THIS request) is hard; quarantine is soft —
+        when every remaining candidate is quarantined, retry them rather
+        than hard-failing a servable request."""
+        ids = [
+            w for w in self.client.instance_ids if w not in (exclude or set())
+        ]
+        if not ids:
+            return []
+        dark = self.dark_instances()
+        healthy = [w for w in ids if w not in dark]
+        return healthy or ids
+
+    def _pick(
+        self, instance_id: int | None, exclude: set[int] | None = None
+    ) -> Instance | None:
         instances = self.client.instances
         if instance_id is not None:
             inst = self.client._instances.get(instance_id)
             if inst is None:
-                raise RuntimeError(f"instance {instance_id:x} not found")
+                raise InstanceNotFound(f"instance {instance_id:x} not found")
             return inst
         if not instances:
             raise RuntimeError(f"no instances available for {self.client.endpoint.path}")
+        ids = set(self.healthy_ids(exclude))
+        if not ids:
+            return None  # every live instance already failed this request
+        instances = [i for i in instances if i.instance_id in ids]
         if self.mode == RouterMode.ROUND_ROBIN:
             inst = instances[self._rr % len(instances)]
             self._rr += 1
@@ -151,44 +193,82 @@ class PushRouter:
     async def generate(
         self, request: Context[dict], *, instance_id: int | None = None
     ) -> ResponseStream[dict]:
-        """Push ``request`` (a wire-dict) to an instance, return its stream."""
+        """Push ``request`` (a wire-dict) to an instance, return its stream.
+
+        A rendezvous timeout fails over to another instance (reference:
+        router modes re-pick per request, push_router.rs:111-155): a
+        worker that died with its lease not yet reaped would otherwise
+        surface a timeout to the caller while healthy peers sit idle.
+        Safe because nothing has streamed before the rendezvous completes.
+        Direct routing (explicit ``instance_id``) never fails over.
+        """
         runtime = self.client.runtime
         server = await runtime.data_server()
         ctx = request.ctx
-        # stream ids are per-hop (a pipeline stage calling downstream reuses
-        # the request ctx, so ctx.id alone would collide on the shared server)
-        stream_id = uuid.uuid4().hex
-        pending = server.register(stream_id, ctx)
-        envelope = msgpack.packb(
-            {
-                "c": {"id": ctx.id, "ci": server.connection_info(stream_id).to_dict()},
-                "p": request.data,
-            },
-            use_bin_type=True,
-        )
-        inst = self._pick(instance_id)
-        try:
-            await runtime.plane.bus.publish(inst.subject, envelope)
-            # rendezvous: wait for the worker to connect back before
-            # returning the stream (the reference awaits the prologue)
-            connect_timeout = float(os.environ.get("DYN_CONNECT_TIMEOUT_S", "30"))
+        connect_timeout = float(os.environ.get("DYN_CONNECT_TIMEOUT_S", "30"))
+        tried: set[int] = set()
+        last_err: Exception | None = None
+        while True:
+            # bounded by exclusion, not a count: every live instance gets
+            # one shot (3 dark + 2 healthy must reach the healthy ones)
+            inst = self._pick(instance_id, exclude=tried)
+            if inst is None:
+                break
+            # stream ids are per-hop AND per-attempt (a pipeline stage
+            # reuses the request ctx, so ctx.id alone would collide on the
+            # shared server; a late connect-back from a failed-over attempt
+            # must find nothing and get killed)
+            stream_id = uuid.uuid4().hex
+            pending = server.register(stream_id, ctx)
+            envelope = msgpack.packb(
+                {
+                    "c": {"id": ctx.id, "ci": server.connection_info(stream_id).to_dict()},
+                    "p": request.data,
+                },
+                use_bin_type=True,
+            )
             try:
+                await runtime.plane.bus.publish(inst.subject, envelope)
+                # rendezvous: wait for the worker to connect back before
+                # returning the stream (the reference awaits the prologue)
                 await asyncio.wait_for(pending.connected.wait(), timeout=connect_timeout)
             except asyncio.TimeoutError:
+                if pending.connected.is_set():
+                    # the connect-back won the race with wait_for's timer
+                    # (both fire in the same loop pass): the stream is
+                    # live — failing over here would run the request twice
+                    self._dark.pop(inst.instance_id, None)
+                    return ResponseStream(pending, ctx)
+                server.unregister(stream_id)
+                tried.add(inst.instance_id)
+                self.quarantine(inst.instance_id)
                 # a bare TimeoutError is undiagnosable from the frontend;
                 # name the instance and the usual causes (observed: a
                 # request envelope the worker's codec rejected)
-                raise TimeoutError(
+                last_err = TimeoutError(
                     f"no data-plane connect-back from instance "
                     f"{inst.instance_id:x} ({inst.subject}) within "
                     f"{connect_timeout:.0f}s — worker dead/overloaded, or it "
                     "rejected the request envelope (check worker logs for "
                     "'malformed request')"
-                ) from None
-        except Exception:
-            server.unregister(stream_id)
-            raise
-        return ResponseStream(pending, ctx)
+                )
+                if instance_id is not None:
+                    raise last_err from None
+                logger.warning("%s; failing over", last_err)
+                continue
+            except BaseException:
+                # includes caller cancellation mid-rendezvous: the pending
+                # registration must not leak (a later connect-back to an
+                # unknown stream gets killed instead of streaming into an
+                # orphaned queue)
+                server.unregister(stream_id)
+                raise
+            # successful rendezvous clears any quarantine: one transient
+            # overload blip must not idle a recovered worker for the TTL
+            self._dark.pop(inst.instance_id, None)
+            return ResponseStream(pending, ctx)
+        assert last_err is not None
+        raise last_err
 
     async def generate_direct(self, request: Context[dict], instance_id: int) -> ResponseStream[dict]:
         return await self.generate(request, instance_id=instance_id)

@@ -46,6 +46,7 @@ class EndpointService:
         self._stats_task: asyncio.Task | None = None
         self._in_flight = 0
         self._arrived_total = 0
+        self._last_arrival = 0.0  # event-loop time of the newest request
         self._handled_total = 0
         self._errors_total = 0
         self._drained = asyncio.Event()
@@ -95,16 +96,28 @@ class EndpointService:
                 await asyncio.wait_for(self._drained.wait(), remaining)
             except asyncio.TimeoutError:
                 continue
-            if self._arrived_total == 0:
-                break  # never served a request: nothing can be mid-burst
+            # an envelope may already sit in the subscription queue with no
+            # handler task yet (invisible to in_flight/arrival counters):
+            # yield so _serve_loop can spawn it, then require no live tasks
+            await asyncio.sleep(0)
+            if self._tasks or self._in_flight:
+                continue
             # quiet period: in_flight hitting zero mid-burst is not done —
             # stale-view clients may still be publishing; only close the
             # subject once no new request ARRIVED for a beat (arrivals, not
             # completions: a request that arrives and fails connect-back
-            # inside the window must still count as activity)
+            # inside the window must still count as activity).  A service
+            # whose last arrival is already older than the beat — including
+            # one that never served — skips the sleep entirely.
+            if loop.time() - self._last_arrival > 0.25:
+                break
             before = self._arrived_total
             await asyncio.sleep(min(0.25, max(deadline - loop.time(), 0.0)))
-            if self._in_flight == 0 and self._arrived_total == before:
+            if (
+                self._in_flight == 0
+                and self._arrived_total == before
+                and not self._tasks
+            ):
                 break
         if self._sub is not None:
             await self._sub.unsubscribe()
@@ -136,6 +149,7 @@ class EndpointService:
         sender = ResponseStreamSender(ConnectionInfo.from_dict(control["ci"]), ctx)
         self._in_flight += 1
         self._arrived_total += 1
+        self._last_arrival = asyncio.get_running_loop().time()
         self._drained.clear()
         try:
             await sender.connect()

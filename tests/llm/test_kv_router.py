@@ -231,3 +231,50 @@ async def test_kv_router_end_to_end():
         await s2.shutdown(drain_timeout=1)
     finally:
         await rt.close()
+
+
+async def test_kv_routed_dispatch_fails_over_when_affine_worker_dark(monkeypatch):
+    """The cache-affine worker died silently (lease unreaped, subject
+    dark): KvPushRouter must reschedule excluding it instead of surfacing
+    the rendezvous timeout while a healthy peer sits idle."""
+    monkeypatch.setenv("DYN_CONNECT_TIMEOUT_S", "1")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://kvfo"))
+    try:
+        component = rt.namespace("ns").component("backend")
+        ep = component.endpoint("generate")
+        s1 = await ep.serve(TaggedEcho("w1"), instance_id=101)
+        s2 = await ep.serve(TaggedEcho("w2"), instance_id=202)
+
+        kv_router = KvRouter(component, block_size=BS)
+        await kv_router.start()
+        pub1 = KvEventPublisher(component, worker_id=101)
+        pub1.start()
+        seq_a = list(range(1, 17))
+        from dynamo_tpu.engine.kv_manager import KvEvent
+
+        pub1.sink(KvEvent(kind="stored", block_hashes=compute_block_hashes(seq_a, BS)))
+        await asyncio.sleep(0.1)
+
+        push = await PushRouter.from_endpoint(ep, RouterMode.KV)
+        await push.client.wait_for_instances(2, timeout=5)
+        engine = KvPushRouter(push, kv_router)
+
+        # 101 holds the cache but went dark without deregistering
+        await s1._sub.unsubscribe()
+
+        out = await (await engine.generate(Context({"token_ids": seq_a}))).collect()
+        assert out[0]["worker"] == "w2"  # rescheduled to the healthy peer
+
+        # the timeout quarantined 101 (shared PushRouter dark set) and
+        # evicted its blocks from the router state: the next affine request
+        # must schedule straight to w2 without re-trying the dark worker
+        assert 101 in push.dark_instances()
+        assert engine._candidates(set()) == [202]
+        out = await (await engine.generate(Context({"token_ids": seq_a}))).collect()
+        assert out[0]["worker"] == "w2"
+
+        await kv_router.stop()
+        await s2.shutdown(drain_timeout=1)
+    finally:
+        await rt.close()

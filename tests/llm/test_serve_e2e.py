@@ -479,3 +479,57 @@ async def test_n_choices_fanout():
         if worker:
             await worker.shutdown()
         await rt.close()
+
+
+@pytest.mark.slow
+async def test_http_soak_concurrent_chats():
+    """Frontend soak: 150 concurrent chat completions (unary + SSE mixed)
+    through preprocessor → router → mocker worker → detokenizer; every
+    request must complete with tokens.  Guards the full serving path's
+    behavior under burst load (the runtime-level twin lives in
+    tests/runtime/test_runtime_e2e.py)."""
+    rt = await make_runtime()
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="mocker")
+        service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+        limits = httpx.Limits(max_connections=200, max_keepalive_connections=200)
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}", limits=limits
+        ) as client:
+            await wait_for_model(client, "tiny")
+            body = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "soak wave"}],
+                "max_tokens": 8,
+            }
+
+            async def chat(i: int) -> None:
+                if i % 3 == 0:
+                    async with client.stream(
+                        "POST", "/v1/chat/completions",
+                        json={**body, "stream": True}, timeout=60,
+                    ) as r:
+                        assert r.status_code == 200
+                        lines = [
+                            line async for line in r.aiter_lines()
+                            if line.startswith("data: ")
+                        ]
+                    assert lines[-1] == "data: [DONE]"
+                    assert len(lines) > 1
+                else:
+                    r = await client.post(
+                        "/v1/chat/completions", json=body, timeout=60
+                    )
+                    assert r.status_code == 200
+                    assert r.json()["usage"]["completion_tokens"] >= 1
+
+            await asyncio.gather(*[chat(i) for i in range(150)])
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()

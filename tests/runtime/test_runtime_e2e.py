@@ -257,3 +257,37 @@ async def test_soak_concurrent_streams_with_worker_churn(runtime_factory):
         await s1.shutdown(drain_timeout=2)
     finally:
         await rt.close()
+
+
+async def test_rendezvous_timeout_fails_over_to_healthy_instance(
+    runtime_factory, monkeypatch
+):
+    """A worker that died silently (lease not yet reaped, subject dark)
+    must not surface a connect-back timeout while a healthy peer exists:
+    the router re-picks (reference: push_router.rs re-pick per request)."""
+    monkeypatch.setenv("DYN_CONNECT_TIMEOUT_S", "1")
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"))
+        s2 = await ep.serve(EchoEngine("w2"))
+        router = await PushRouter.from_endpoint(ep, mode=RouterMode.ROUND_ROBIN)
+        await router.client.wait_for_instances(2, timeout=5)
+
+        # simulate silent death: w2 stops listening but stays registered
+        await s2._sub.unsubscribe()
+
+        for _ in range(4):  # round robin hits the dark instance repeatedly
+            stream = await router.generate(Context({"tokens": [7]}))
+            out = [o async for o in stream]
+            assert [o["token"] for o in out] == [7]
+            assert out[0]["worker"] == "w1"
+
+        # direct routing must NOT fail over: the dark instance times out
+        with pytest.raises(TimeoutError):
+            await router.generate_direct(
+                Context({"tokens": [7]}), s2.instance.instance_id
+            )
+        await s1.shutdown(drain_timeout=2)
+    finally:
+        await rt.close()
