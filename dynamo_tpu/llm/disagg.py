@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import time
 import uuid
 from dataclasses import dataclass
 
@@ -152,6 +153,7 @@ class DisaggDecodeEngine:
         # observability
         self.remote_prefills = 0
         self.local_prefills = 0
+        self.remote_prefill_timeouts = 0
 
     async def start(self) -> None:
         await self.transfer_server.start()
@@ -217,20 +219,39 @@ class DisaggDecodeEngine:
                 "request": request.data,
                 "dst_block_ids": block_ids[:n_kv_blocks],
                 "transfer_address": self.transfer_server.address,
+                # past this wall-clock instant the requester has timed out
+                # and prefilled locally — a worker dequeuing later must
+                # drop the item, not burn a prefill whose transfer would be
+                # discarded (coarse cross-host clock agreement suffices:
+                # the timeout is tens of seconds)
+                "deadline_ts": time.time() + self.prefill_timeout_s,
             }
         )
         try:
             first_token, first_lp, first_top = await asyncio.wait_for(
                 fut, timeout=self.prefill_timeout_s
             )
-        except (asyncio.TimeoutError, asyncio.CancelledError):
+        except (asyncio.TimeoutError, asyncio.CancelledError) as err:
             if self._pending.pop(seq_id, None) is not None:
                 # we still own the landing blocks — a transfer that arrives
                 # from here on finds no pending entry and is dropped
                 self.engine.release_blocks(block_ids)
             # else: _on_transfer claimed the entry; it observes the
             # cancelled future and releases the blocks itself
-            raise RuntimeError(f"remote prefill for {seq_id} timed out")
+            if isinstance(err, asyncio.CancelledError):
+                raise  # caller went away; nothing to serve
+            # the prefill fleet is slow/dead, but this worker still owns
+            # the request and a whole engine: serve it locally (slower
+            # TTFT beats a failed request — the reference's disagg also
+            # degrades to aggregated serving when remote prefill is
+            # unavailable)
+            self.remote_prefill_timeouts += 1
+            self.local_prefills += 1  # counted like the no-blocks fallback
+            logger.warning(
+                "remote prefill for %s timed out after %.1fs; prefilling locally",
+                seq_id, self.prefill_timeout_s,
+            )
+            return await self.engine.generate(request)
         except Exception:
             # inject failed after the transfer claimed the entry; blocks
             # were never handed to a sequence — release here
@@ -246,6 +267,7 @@ class DisaggDecodeEngine:
         stats = self.engine.stats()
         stats["remote_prefills"] = self.remote_prefills
         stats["local_prefills"] = self.local_prefills
+        stats["remote_prefill_timeouts"] = self.remote_prefill_timeouts
         return stats
 
 
@@ -260,6 +282,7 @@ class PrefillWorker:
         self.client = KvTransferClient()
         self._task: asyncio.Task | None = None
         self.prefills_done = 0
+        self.stale_dropped = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -285,13 +308,20 @@ class PrefillWorker:
                 continue
             try:
                 await self._handle(item)
-                self.prefills_done += 1
             except Exception:  # noqa: BLE001
                 logger.exception("remote prefill failed for %s", item.get("seq_id"))
 
     async def _handle(self, item: dict) -> None:
         from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
 
+        deadline = item.get("deadline_ts")
+        if deadline is not None and time.time() > deadline:
+            # the requester already timed out and served itself locally; a
+            # prefill now would be pure waste amplifying the overload that
+            # caused the timeout (its transfer would be dropped anyway)
+            self.stale_dropped += 1
+            logger.warning("dropping stale prefill request %s", item.get("seq_id"))
+            return
         pre = PreprocessedRequest.from_wire(item["request"])
         # strategy selection by destination locality (reference:
         # block/transfer/strategy.rs:345): same-process destinations keep
@@ -311,3 +341,4 @@ class PrefillWorker:
                 blocks=blocks,
             ),
         )
+        self.prefills_done += 1  # actual prefills only, not dropped items

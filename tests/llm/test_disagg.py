@@ -406,12 +406,13 @@ async def test_late_transfer_after_timeout_is_dropped(monkeypatch):
         disagg = DisaggDecodeEngine(rt, engine, router, queue)
         disagg.prefill_timeout_s = 0.2
         await disagg.start()
-        # no prefill worker running → the wait must time out
+        # no prefill worker running → the wait times out and the request
+        # serves locally (fallback details covered by
+        # test_remote_prefill_timeout_falls_back_to_local); this test is
+        # about what happens to the LATE transfer afterwards
         prompt = list(range(3, 13))
-        used_before = engine.allocator.used_blocks
-        with pytest.raises(RuntimeError, match="timed out"):
-            await disagg.generate(Context(request(prompt, max_tokens=4)))
-        assert engine.allocator.used_blocks == used_before  # released once
+        stream = await disagg.generate(Context(request(prompt, max_tokens=4)))
+        await collect(stream)
         assert not disagg._pending
 
         # the transfer limps in late: it must not touch the cache
@@ -475,4 +476,59 @@ async def test_claimed_transfer_with_cancelled_waiter_releases():
         if disagg:
             await disagg.stop()
         engine.stop()
+        await rt.close()
+
+
+async def test_remote_prefill_timeout_falls_back_to_local(monkeypatch):
+    """Dead prefill fleet: the decode worker owns the request and a whole
+    engine, so a remote-prefill timeout degrades to a local prefill (exact
+    same output), not a failed request."""
+    monkeypatch.setenv("DYN_DISAGG_PREFILL_TIMEOUT_S", "0.5")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disaggto"))
+    decode_engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        # no PrefillWorker anywhere: the queue just grows
+
+        prompt = list(range(3, 13))  # > threshold 4 → tries remote first
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        tokens = await collect(stream)
+
+        assert tokens == greedy_reference(prompt, 6)
+        stats = disagg.stats()
+        assert stats["remote_prefill_timeouts"] == 1
+        assert stats["local_prefills"] == 1  # counted like other fallbacks
+        # the reserved landing blocks were released before the local path
+        # allocated its own; after the request drains, everything is free
+        for _ in range(100):
+            if decode_engine.allocator.used_blocks == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert decode_engine.allocator.used_blocks == 0
+
+        # a worker coming up AFTER the timeout must drop the stale queue
+        # item (deadline passed) instead of burning a prefill whose
+        # transfer would be discarded
+        prefill_engine = make_engine()
+        worker = PrefillWorker(rt, prefill_engine, queue)
+        worker.start()
+        try:
+            for _ in range(100):
+                if worker.stale_dropped:
+                    break
+                await asyncio.sleep(0.02)
+            assert worker.stale_dropped == 1
+            assert worker.prefills_done == 0
+        finally:
+            await worker.stop()
+            prefill_engine.stop()
+    finally:
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
         await rt.close()
