@@ -327,3 +327,70 @@ async def test_live_watch_fails_when_connection_drops():
     finally:
         await plane.close()
         await server.stop()
+
+
+@pytest.mark.slow
+async def test_soak_many_clients_against_tcp_server():
+    """Control-plane soak (tcp only): many concurrent client connections
+    doing interleaved KV puts/gets, watches, bus publishes, and queue
+    work against ONE dynctl server — the topology every distributed
+    deployment rides.  Everything must complete and watches must observe
+    every put."""
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    planes = []
+    n_workers, n_ops = 23, 20  # + 1 watcher connection
+    total = n_workers * n_ops
+    try:
+        # ≈ a 16-worker + frontends deployment
+        for _ in range(n_workers + 1):
+            p = RemoteControlPlane("127.0.0.1", server.port)
+            await p.connect()
+            planes.append(p)
+
+        watcher = planes[0]
+        seen: set[str] = set()
+        watch = watcher.kv.watch_prefix("soak/")
+
+        async def watch_loop():
+            async for ev in watch:
+                if ev.type == WatchEventType.PUT:
+                    seen.add(ev.entry.key)
+                    if len(seen) >= total:
+                        return
+
+        wtask = asyncio.ensure_future(watch_loop())
+        await watch.ready()
+
+        async def client_work(i: int, plane) -> int:
+            done = 0
+            for j in range(n_ops):
+                await plane.kv.put(f"soak/{i}/{j}", f"{i}:{j}".encode())
+                entry = await plane.kv.get(f"soak/{i}/{j}")
+                assert entry is not None
+                await plane.bus.publish(f"soak.topic.{i % 4}", b"x")
+                await plane.bus.queue_publish("soak.work", f"{i}/{j}".encode())
+                done += 1
+            return done
+
+        totals = await asyncio.gather(
+            *[client_work(i, p) for i, p in enumerate(planes[1:], start=1)]
+        )
+        assert sum(totals) == total
+
+        # queue integrity: exactly every published item pops exactly once
+        popped = set()
+        for _ in range(total):
+            raw = await planes[0].bus.queue_pop("soak.work", timeout=5)
+            assert raw is not None
+            popped.add(raw.decode())
+        assert len(popped) == total
+        assert await planes[0].bus.queue_pop("soak.work", timeout=0.1) is None
+
+        # the single watcher saw every key from every client
+        await asyncio.wait_for(wtask, timeout=10)
+        assert len(seen) == total
+    finally:
+        for p in planes:
+            await p.close()
+        await server.stop()
