@@ -339,6 +339,7 @@ async def test_soak_many_clients_against_tcp_server():
     server = ControlPlaneServer(port=0)
     await server.start()
     planes = []
+    wtask = None
     n_workers, n_ops = 23, 20  # + 1 watcher connection
     total = n_workers * n_ops
     try:
@@ -391,6 +392,8 @@ async def test_soak_many_clients_against_tcp_server():
         await asyncio.wait_for(wtask, timeout=10)
         assert len(seen) == total
     finally:
+        if wtask is not None:
+            wtask.cancel()  # an assertion mid-test must not leak the watcher
         for p in planes:
             await p.close()
         await server.stop()
