@@ -115,3 +115,21 @@ def test_bench_rejects_unknown_quant_env(monkeypatch):
     monkeypatch.setenv("DYN_BENCH_QUANT", "fp8")  # typo'd value
     with pytest.raises(ValueError, match="DYN_BENCH_QUANT"):
         asyncio.run(bench.run_bench())
+
+
+def test_bench_rejects_bad_aot_parallel_env(monkeypatch):
+    """bench.py env contract: a malformed DYN_BENCH_AOT_PARALLEL fails fast
+    (outside the aot try/except) instead of silently ignoring the knob."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test2", pathlib.Path(__file__).parents[2] / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("DYN_BENCH_AOT_PARALLEL", "full")  # not an int
+    import asyncio
+
+    with pytest.raises(ValueError):
+        asyncio.run(bench._run_model("tiny", None, fallback_cpu=False))
