@@ -79,7 +79,9 @@ class DoesNotFit(Exception):
     """Pre-flight estimate: params+cache exceed this chip's HBM."""
 
 
-async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) -> dict:
+async def _run_model(
+    model_name: str, quant: str | None, *, fallback_cpu: bool, aot_parallel: int = 6
+) -> dict:
     import jax
     import numpy as np
 
@@ -198,9 +200,6 @@ async def _run_model(model_name: str, quant: str | None, *, fallback_cpu: bool) 
     # programs concurrently instead of one-per-first-dispatch (results
     # reach the serving path through the persistent compilation cache)
     if not fallback_cpu:
-        # parse OUTSIDE the try: a bad env value must fail fast (bench env
-        # contract), not read as "aot failed, lazy compiles"
-        aot_parallel = int(os.environ.get("DYN_BENCH_AOT_PARALLEL", "6"))
         try:
             t0 = time.monotonic()
             n = engine.aot_precompile(
@@ -503,6 +502,15 @@ async def run_bench() -> dict:
         raise ValueError(
             f"DYN_BENCH_QUANT={forced_quant!r} not understood (want int8|none)"
         )
+    # validate up front (bench env contract): a bad value must fail fast,
+    # not burn one full engine construction per ladder rung before erroring
+    try:
+        aot_parallel = int(os.environ.get("DYN_BENCH_AOT_PARALLEL", "6"))
+    except ValueError:
+        raise ValueError(
+            f"DYN_BENCH_AOT_PARALLEL="
+            f"{os.environ['DYN_BENCH_AOT_PARALLEL']!r} is not an integer"
+        ) from None
     if fallback_cpu:
         ladder = [(forced or "tiny", None)]
     elif forced:
@@ -518,7 +526,10 @@ async def run_bench() -> dict:
     last_err: BaseException | None = None
     for i, (model_name, quant) in enumerate(ladder):
         try:
-            return await _run_model(model_name, quant, fallback_cpu=fallback_cpu)
+            return await _run_model(
+                model_name, quant,
+                fallback_cpu=fallback_cpu, aot_parallel=aot_parallel,
+            )
         except Exception as err:
             # ANY failure steps down while rungs remain (an OOM wants a
             # smaller model; a quantized-path compile failure wants the bf16

@@ -100,18 +100,24 @@ async def test_profile_concurrency_grid_and_sla_planner():
         engine.stop()
 
 
-def test_bench_rejects_unknown_quant_env(monkeypatch):
-    """bench.py env contract: unknown DYN_BENCH_QUANT fails fast instead of
-    silently running the wrong ladder."""
-    import asyncio
+def _load_bench(name: str = "bench_under_test"):
     import importlib.util
     import pathlib
 
     spec = importlib.util.spec_from_file_location(
-        "bench_under_test", pathlib.Path(__file__).parents[2] / "bench.py"
+        name, pathlib.Path(__file__).parents[2] / "bench.py"
     )
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+def test_bench_rejects_unknown_quant_env(monkeypatch):
+    """bench.py env contract: unknown DYN_BENCH_QUANT fails fast instead of
+    silently running the wrong ladder."""
+    import asyncio
+
+    bench = _load_bench()
     monkeypatch.setenv("DYN_BENCH_QUANT", "fp8")  # typo'd value
     with pytest.raises(ValueError, match="DYN_BENCH_QUANT"):
         asyncio.run(bench.run_bench())
@@ -119,17 +125,10 @@ def test_bench_rejects_unknown_quant_env(monkeypatch):
 
 def test_bench_rejects_bad_aot_parallel_env(monkeypatch):
     """bench.py env contract: a malformed DYN_BENCH_AOT_PARALLEL fails fast
-    (outside the aot try/except) instead of silently ignoring the knob."""
-    import importlib.util
-    import pathlib
-
-    spec = importlib.util.spec_from_file_location(
-        "bench_under_test2", pathlib.Path(__file__).parents[2] / "bench.py"
-    )
-    bench = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench)
-    monkeypatch.setenv("DYN_BENCH_AOT_PARALLEL", "full")  # not an int
+    in run_bench — before any ladder rung builds an engine."""
     import asyncio
 
-    with pytest.raises(ValueError):
-        asyncio.run(bench._run_model("tiny", None, fallback_cpu=False))
+    bench = _load_bench("bench_under_test2")
+    monkeypatch.setenv("DYN_BENCH_AOT_PARALLEL", "full")  # not an int
+    with pytest.raises(ValueError, match="DYN_BENCH_AOT_PARALLEL"):
+        asyncio.run(bench.run_bench())
