@@ -601,6 +601,109 @@ def child_main() -> None:
     sys.stdout.flush()
 
 
+def _probe_relay(timeout: float = 3.0) -> dict:
+    """Socket-level liveness check of the axon relay (the PJRT plugin's only
+    path to the TPU pool in this zero-egress container).
+
+    Three observable states, each with a distinct meaning for bring-up:
+    - ``held_open``        — upstream is alive and waiting for the protocol
+      handshake: device init has a real chance.
+    - ``accept_then_close`` — the local listener is up but the upstream leg
+      is dead (the round-3 wedge signature: ``jax.devices()`` then hangs
+      forever in the claim loop).  A full attempt would only burn its
+      device-init window.
+    - ``refused``/``error`` — nothing listening at all.
+    """
+    import socket
+
+    host = os.environ.get("AXON_POOL_SVC_OVERRIDE") or "127.0.0.1"
+    try:
+        port = int(os.environ.get("DYN_BENCH_RELAY_PORT", "2024"))
+    except ValueError:
+        # parent-side knob: never let a typo'd env break the one-JSON-line
+        # contract — fall back to the observed relay port and say so
+        print(
+            f"bench: bad DYN_BENCH_RELAY_PORT="
+            f"{os.environ['DYN_BENCH_RELAY_PORT']!r}; using 2024",
+            file=sys.stderr,
+        )
+        port = 2024
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return {"state": "n/a", "note": "no axon pool configured"}
+    t0 = time.monotonic()
+    try:
+        s = socket.create_connection((host, port), timeout=timeout)
+    except OSError as err:
+        return {
+            "state": "refused", "host": host, "port": port,
+            "error": str(err), "elapsed_s": round(time.monotonic() - t0, 2),
+        }
+    try:
+        s.settimeout(2.0)
+        try:
+            data = s.recv(1)
+        except socket.timeout:
+            state = "held_open"
+        except OSError as err:
+            return {
+                "state": "error", "host": host, "port": port, "error": str(err),
+                "elapsed_s": round(time.monotonic() - t0, 2),
+            }
+        else:
+            state = "accept_then_close" if data == b"" else "data"
+    finally:
+        s.close()
+    return {
+        "state": state, "host": host, "port": port,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+    }
+
+
+def _probe_devices(timeout_s: float) -> dict:
+    """Minimal ``jax.devices()`` bring-up probe in a throwaway subprocess.
+
+    Much cheaper to sacrifice than a full measurement child: a probe that
+    never finished device init holds no TPU claim, so killing it at the
+    timeout cannot wedge the tunnel (the round-3 hazard was killing
+    children that were mid-compile ON the device).  Captures the plugin's
+    stderr so a failure leaves evidence, not a mystery.
+    """
+    code = (
+        "import time,sys; t0=time.time(); import jax; "
+        "ds=jax.devices(); "
+        "print('PROBE_OK', [d.device_kind for d in ds], round(time.time()-t0,1))"
+    )
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired as err:
+        stderr = (err.stderr or b"").decode(errors="replace")
+        return {
+            "ok": False, "timed_out": True, "timeout_s": timeout_s,
+            "elapsed_s": round(time.monotonic() - t0, 1),
+            "stderr_tail": stderr.strip().splitlines()[-3:],
+        }
+    stdout = proc.stdout.decode(errors="replace")
+    stderr = proc.stderr.decode(errors="replace")
+    return {
+        "ok": "PROBE_OK" in stdout, "rc": proc.returncode,
+        "timeout_s": timeout_s,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+        "stdout": stdout.strip()[-200:],
+        "stderr_tail": stderr.strip().splitlines()[-3:],
+    }
+
+
+def _plugin_env() -> dict:
+    """The env slice that governs PJRT bring-up, for failure forensics."""
+    return {
+        k: v for k, v in os.environ.items()
+        if k.startswith(("PALLAS_AXON", "AXON", "JAX_PLATFORMS", "TPU_"))
+    }
+
+
 def _try_child(env: dict, timeout: float) -> dict | None:
     """Run one measurement child; return its parsed JSON line or None."""
     try:
@@ -634,16 +737,74 @@ def main() -> None:
 
     attempt_timeout = float(os.environ.get("DYN_BENCH_ATTEMPT_TIMEOUT", "1500"))
     tpu_attempts = int(os.environ.get("DYN_BENCH_ATTEMPTS", "3"))
+    # Bring-up is a debuggable system, not a black box: before spending a
+    # full attempt window, check the relay socket (seconds) and then run a
+    # minimal jax.devices() probe with escalating timeouts.  Every probe's
+    # evidence lands in the fallback payload so a device-less round records
+    # WHY (wedged relay vs slow init vs crash), not just that it fell back.
+    try:
+        probe_timeouts = [
+            float(x) for x in os.environ.get(
+                "DYN_BENCH_PROBE_TIMEOUTS", "90,180,300"
+            ).split(",")
+        ]
+    except ValueError:
+        # parent-side knob: never break the one-JSON-line contract
+        print(
+            f"bench: bad DYN_BENCH_PROBE_TIMEOUTS="
+            f"{os.environ['DYN_BENCH_PROBE_TIMEOUTS']!r}; using 90,180,300",
+            file=sys.stderr,
+        )
+        probe_timeouts = [90.0, 180.0, 300.0]
+    bringup: dict = {"plugin_env": _plugin_env(), "attempts": []}
     for attempt in range(tpu_attempts):
         print(f"bench: attempt {attempt + 1}/{tpu_attempts}", file=sys.stderr)
-        result = _try_child(dict(os.environ), attempt_timeout)
-        if result is not None:
-            print(json.dumps(result))
-            return
+        last = attempt + 1 == tpu_attempts
+        relay = _probe_relay()
+        print(f"bench: relay probe: {relay}", file=sys.stderr)
+        evidence: dict = {"relay": relay}
+        bringup["attempts"].append(evidence)
+        run_full = False
+        if relay["state"] in ("held_open", "data", "n/a"):
+            # upstream looks alive — confirm with a cheap device-init probe
+            # before committing the full window
+            probe = _probe_devices(probe_timeouts[min(attempt, len(probe_timeouts) - 1)])
+            evidence["device_probe"] = probe
+            print(f"bench: device probe: {probe}", file=sys.stderr)
+            run_full = probe["ok"]
+        else:
+            # accept-then-close / refused: device init WILL hang in the
+            # claim loop; don't burn a device-init window proving it
+            print(
+                f"bench: relay {relay['state']}; skipping full attempt",
+                file=sys.stderr,
+            )
+        if not run_full and last:
+            # escape hatch: the probes are advisory, not authoritative — a
+            # relay on a nonstandard port or a probe artifact must not
+            # convert a working TPU into CPU fallback.  One unconditional
+            # full attempt; the child's own device-init watchdog bounds
+            # the cost of a truly dead tunnel.
+            print(
+                "bench: probes failed; final unconditional full attempt",
+                file=sys.stderr,
+            )
+            run_full = True
+            evidence["unconditional"] = True
+        if run_full:
+            result = _try_child(dict(os.environ), attempt_timeout)
+            evidence["full_attempt"] = result is not None
+            if result is not None:
+                probe = evidence.get("device_probe") or {}
+                result.setdefault("detail", {})["bringup_probe_s"] = probe.get(
+                    "elapsed_s"
+                )
+                print(json.dumps(result))
+                return
         if attempt + 1 < tpu_attempts:
-            # a wedged tunnel fails fast via the child watchdog; give it a
-            # real chance to recover before the next attempt (observed:
-            # a child killed mid-compile can wedge device init for minutes)
+            # a wedged tunnel fails fast via the probes; give it a real
+            # chance to recover before the next attempt (observed: a child
+            # killed mid-compile can wedge device init for minutes)
             time.sleep(float(os.environ.get("DYN_BENCH_RETRY_SLEEP", "90")))
 
     # accelerator never produced a result: CPU fallback so the round still
@@ -664,6 +825,7 @@ def main() -> None:
             "vs_baseline": 0.0,
             "detail": {"error": "all bench attempts failed"},
         }
+    result.setdefault("detail", {})["bringup"] = bringup
     print(json.dumps(result))
 
 

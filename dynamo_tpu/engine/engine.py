@@ -1092,11 +1092,19 @@ class JaxLlmEngine:
         if jax.config.jax_compilation_cache_dir and self.mesh is None:
             # compile the planned programs concurrently first; the drives
             # below then hit the persistent cache instead of compiling
-            # one-by-one on the device thread
+            # one-by-one on the device thread.  Best-effort: a compile
+            # failure here must not abort warmup — the lazy drive loop
+            # below still compiles whatever serving actually needs.
             loop = asyncio.get_running_loop()
-            await loop.run_in_executor(
-                None, partial(self.aot_precompile, [n for n, _ in plans])
-            )
+            try:
+                await loop.run_in_executor(
+                    None, partial(self.aot_precompile, [n for n, _ in plans])
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "aot_precompile failed during warmup; falling through "
+                    "to lazy compiles"
+                )
         for n, toks in plans:
             await drive(n, toks)
         if self.spec_enabled:
@@ -1178,25 +1186,35 @@ class JaxLlmEngine:
         blocks_fixed = sds((self.max_blocks_per_seq,), jnp.int32)
         for n in prompt_lens:
             n = min(int(n), self.max_len - 1)
-            if self.chunk_tokens is not None and n > self.chunk_tokens:
-                # chunked path: every window runs the continued-prefill
-                # program; shapes depend only on (window bucket, table
-                # bucket for the full prompt)
-                # mirror _run_prefill's table sizing exactly
+            if self.chunk_tokens is not None:
+                # chunked serving runs the continued-prefill program for
+                # every window; shapes depend only on (window bucket,
+                # table bucket for the full prompt) — mirror _run_prefill's
+                # table sizing exactly
                 table_len = self.allocator.blocks_needed(
                     self._bucket_len(min(n + 1, self.max_len))
                 )
                 table_a = sds((table_len,), jnp.int32)
-                windows = {self.chunk_tokens, n % self.chunk_tokens or self.chunk_tokens}
-                for w in windows:
-                    b = self._bucket_len(w)
+                # reachable window buckets: under concurrent prefills the
+                # scheduler's _plan_chunk shrinks windows block-aligned to
+                # fit the shared budget, so ANY bucket up to the largest
+                # window's bucket can appear — including for prompts
+                # shorter than the chunk budget (they chunk too when
+                # admitted with leftover budget).  The bucket set is
+                # small; compiling them all keeps the concurrent-load
+                # path off the lazy device-thread compiler.
+                cap = self._bucket_len(min(n, self.chunk_tokens))
+                for b in (x for x in self.buckets if x <= cap):
                     jobs[("prefix", b, table_len)] = (
                         self._jit_prefill_prefix,
                         (params_a, cache_a, counts_a, counts_a, i32,
                          sds((b,), jnp.int32), table_a, table_a, i32, i32, i32,
                          row_a, row_a, i32, key_a, *tail(1), cos_a, sin_a),
                     )
-            else:
+            if self.chunk_tokens is None or n <= self.chunk_tokens:
+                # whole-prompt program: the only path when chunking is off,
+                # and still the uncontended path for prompts within the
+                # chunk budget
                 b = self._bucket_len(n)
                 jobs[("prefill", b)] = (
                     self._jit_prefill,

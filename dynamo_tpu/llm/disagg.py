@@ -117,6 +117,17 @@ class PrefillQueue:
         raw = await self.runtime.plane.bus.queue_pop(self.queue_name, timeout)
         return json.loads(raw) if raw is not None else None
 
+    async def dequeue_with_age(
+        self, timeout: float | None = None
+    ) -> tuple[dict, float | None] | None:
+        """Dequeue plus the broker-measured queue age (None when the bus
+        doesn't track enqueue times)."""
+        item = await self.runtime.plane.bus.queue_pop_meta(self.queue_name, timeout)
+        if item is None:
+            return None
+        raw, age = item
+        return json.loads(raw), age
+
     async def size(self) -> int:
         return await self.runtime.plane.bus.queue_len(self.queue_name)
 
@@ -219,11 +230,15 @@ class DisaggDecodeEngine:
                 "request": request.data,
                 "dst_block_ids": block_ids[:n_kv_blocks],
                 "transfer_address": self.transfer_server.address,
-                # past this wall-clock instant the requester has timed out
-                # and prefilled locally — a worker dequeuing later must
-                # drop the item, not burn a prefill whose transfer would be
-                # discarded (coarse cross-host clock agreement suffices:
-                # the timeout is tens of seconds)
+                # staleness contract: a worker dequeuing after the requester
+                # has timed out (and prefilled locally) must drop the item
+                # rather than burn a prefill whose transfer would be
+                # discarded.  ``ttl_s`` is a duration (skew-free); the
+                # worker compares it against the queue broker's own
+                # enqueue→pop age measurement.  ``deadline_ts`` is the
+                # wall-clock fallback for buses without age metadata,
+                # applied with a skew margin.
+                "ttl_s": self.prefill_timeout_s,
                 "deadline_ts": time.time() + self.prefill_timeout_s,
             }
         )
@@ -283,6 +298,13 @@ class PrefillWorker:
         self._task: asyncio.Task | None = None
         self.prefills_done = 0
         self.stale_dropped = 0
+        # tolerated cross-host clock disagreement: a dequeued item is only
+        # dropped as stale once it is past its TTL by MORE than this margin,
+        # so a skewed requester clock degrades to the occasional wasted
+        # prefill instead of silently dropping all disagg traffic
+        self.clock_skew_margin_s = float(
+            os.environ.get("DYN_DISAGG_CLOCK_SKEW_S", "30")
+        )
 
     def start(self) -> None:
         if self._task is None:
@@ -297,30 +319,56 @@ class PrefillWorker:
     async def _loop(self) -> None:
         while True:
             try:
-                item = await self.queue.dequeue(timeout=1.0)
+                popped = await self.queue.dequeue_with_age(timeout=1.0)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001
                 logger.exception("prefill queue pop failed")
                 await asyncio.sleep(0.5)
                 continue
-            if item is None:
+            if popped is None:
                 continue
+            item, age = popped
             try:
-                await self._handle(item)
+                await self._handle(item, age)
             except Exception:  # noqa: BLE001
                 logger.exception("remote prefill failed for %s", item.get("seq_id"))
 
-    async def _handle(self, item: dict) -> None:
+    def _is_stale(self, item: dict, queue_age_s: float | None) -> bool:
+        """True iff the requester has certainly timed out already.
+
+        Preferred signal: the broker-measured queue age (enqueue→pop on the
+        broker's own clock) against the item's relative TTL — two durations,
+        no cross-host wall-clock comparison anywhere.  Buses without age
+        metadata fall back to the absolute ``deadline_ts`` with a skew
+        margin, which errs toward the wasted prefill (whose transfer the
+        decode side drops harmlessly) rather than toward dropping live
+        traffic when clocks disagree.
+        """
+        ttl = item.get("ttl_s")
+        if queue_age_s is not None and ttl is not None:
+            return queue_age_s > ttl
+        deadline = item.get("deadline_ts")
+        return deadline is not None and time.time() > deadline + self.clock_skew_margin_s
+
+    def stats(self) -> dict:
+        return {
+            "prefills_done": self.prefills_done,
+            "stale_dropped": self.stale_dropped,
+        }
+
+    async def _handle(self, item: dict, queue_age_s: float | None = None) -> None:
         from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
 
-        deadline = item.get("deadline_ts")
-        if deadline is not None and time.time() > deadline:
+        if self._is_stale(item, queue_age_s):
             # the requester already timed out and served itself locally; a
             # prefill now would be pure waste amplifying the overload that
             # caused the timeout (its transfer would be dropped anyway)
             self.stale_dropped += 1
-            logger.warning("dropping stale prefill request %s", item.get("seq_id"))
+            logger.warning(
+                "dropping stale prefill request %s (stale_dropped=%d)",
+                item.get("seq_id"), self.stale_dropped,
+            )
             return
         pre = PreprocessedRequest.from_wire(item["request"])
         # strategy selection by destination locality (reference:

@@ -7,7 +7,8 @@ Pure-python binary parser for GGUF v2/v3 plus:
 - :func:`tokenizer_from_gguf` — ``tokenizer.ggml.*`` vocab/merges → a HF
   ``tokenizers`` BPE tokenizer (gpt2-style byte-level);
 - :func:`load_gguf_weights` — F32/F16 tensors → the layer-stacked llama
-  param pytree (quantized GGML types are recognized but not dequantized);
+  param pytree; quantized GGML types (Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 and
+  Q4_K/Q5_K/Q6_K) are dequantized to float on load;
 - :func:`write_gguf` — writer used by tests and for exporting small models.
 
 GGML stores dims fastest-varying-first; numpy shapes here are the reverse.

@@ -236,6 +236,8 @@ class RemoteKV(KeyValueStore):
 class RemoteBus(MessageBus):
     def __init__(self, conn: RpcConnection):
         self._conn = conn
+        # set once a server rejects bus.queue_pop_meta (older dynctl)
+        self._pop_meta_unsupported = False
 
     async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
         await self._conn.call("bus.publish", subject, payload, reply_to)
@@ -266,6 +268,32 @@ class RemoteBus(MessageBus):
     async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
         rpc_timeout = None if timeout is None else timeout + 5
         return await self._conn.call("bus.queue_pop", queue, timeout, timeout=rpc_timeout)
+
+    async def queue_pop_meta(
+        self, queue: str, timeout: float | None = None
+    ) -> tuple[bytes, float | None] | None:
+        rpc_timeout = None if timeout is None else timeout + 5
+        if not self._pop_meta_unsupported:
+            try:
+                item = await self._conn.call(
+                    "bus.queue_pop_meta", queue, timeout, timeout=rpc_timeout
+                )
+            except RuntimeError as err:
+                if "unknown method" not in str(err):
+                    raise
+                # pre-queue_pop_meta dynctl server: degrade to the
+                # documented (payload, None) contract and remember, so a
+                # mixed-version fleet pays one failed round trip, not one
+                # per pop
+                self._pop_meta_unsupported = True
+            else:
+                # age is measured on the server's clock at pop time; the
+                # reply's transit adds a little un-counted staleness, which
+                # errs toward treating items as fresh (a wasted prefill,
+                # never dropped traffic)
+                return None if item is None else (item[0], item[1])
+        payload = await self.queue_pop(queue, timeout)
+        return None if payload is None else (payload, None)
 
     async def queue_len(self, queue: str) -> int:
         return await self._conn.call("bus.queue_len", queue)

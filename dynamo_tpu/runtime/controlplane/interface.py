@@ -237,6 +237,20 @@ class MessageBus(ABC):
     async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
         """Pop one item; None on timeout. Exactly-one-consumer semantics."""
 
+    async def queue_pop_meta(
+        self, queue: str, timeout: float | None = None
+    ) -> tuple[bytes, float | None] | None:
+        """Pop one item with its broker-measured age in seconds.
+
+        The age is enqueue→pop elapsed ON THE BROKER'S OWN CLOCK (NATS
+        JetStream exposes the same via server-side message timestamps), so
+        consumers can bound item staleness without trusting cross-host
+        wall-clock agreement.  Backends that don't track enqueue times
+        return ``(payload, None)`` — this default just wraps queue_pop.
+        """
+        payload = await self.queue_pop(queue, timeout)
+        return None if payload is None else (payload, None)
+
     @abstractmethod
     async def queue_len(self, queue: str) -> int:
         ...

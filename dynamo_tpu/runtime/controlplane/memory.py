@@ -143,7 +143,10 @@ class MemoryBus(MessageBus):
         # subject pattern -> {queue_group_or_None -> [subscriptions]}
         self._subs: list[tuple[str, str | None, Subscription]] = []
         self._rr: dict[tuple[str, str], int] = defaultdict(int)
-        self._queues: dict[str, asyncio.Queue[bytes]] = defaultdict(asyncio.Queue)
+        # work-queue items: (payload, enqueue instant on this bus's clock)
+        self._queues: dict[str, asyncio.Queue[tuple[bytes, float]]] = defaultdict(
+            asyncio.Queue
+        )
         self._objects: dict[str, dict[str, bytes]] = defaultdict(dict)
 
     async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
@@ -178,16 +181,28 @@ class MemoryBus(MessageBus):
             await sub.unsubscribe()
 
     async def queue_publish(self, queue: str, payload: bytes) -> None:
-        self._queues[queue].put_nowait(payload)
+        # items carry their enqueue instant (this bus's monotonic clock) so
+        # queue_pop_meta can report broker-measured age: when this bus lives
+        # in a dynctl server, publish and pop both happen here, making the
+        # age immune to producer/consumer wall-clock skew
+        self._queues[queue].put_nowait((payload, time.monotonic()))
 
     async def queue_pop(self, queue: str, timeout: float | None = None) -> bytes | None:
+        item = await self.queue_pop_meta(queue, timeout)
+        return None if item is None else item[0]
+
+    async def queue_pop_meta(
+        self, queue: str, timeout: float | None = None
+    ) -> tuple[bytes, float | None] | None:
         q = self._queues[queue]
         try:
             if timeout is None:
-                return await q.get()
-            return await asyncio.wait_for(q.get(), timeout)
+                payload, enq = await q.get()
+            else:
+                payload, enq = await asyncio.wait_for(q.get(), timeout)
         except asyncio.TimeoutError:
             return None
+        return payload, time.monotonic() - enq
 
     async def queue_len(self, queue: str) -> int:
         return self._queues[queue].qsize()

@@ -145,6 +145,11 @@ class ControlPlaneServer:
                 return True
             if method == "bus.queue_pop":
                 return await bus.queue_pop(args[0], args[1])
+            if method == "bus.queue_pop_meta":
+                item = await bus.queue_pop_meta(args[0], args[1])
+                # tuple → list for the codec; age is the SERVER's own
+                # enqueue→pop measurement (skew-free for remote consumers)
+                return None if item is None else [item[0], item[1]]
             if method == "bus.queue_len":
                 return await bus.queue_len(args[0])
             if method == "bus.object_put":

@@ -132,3 +132,81 @@ def test_bench_rejects_bad_aot_parallel_env(monkeypatch):
     monkeypatch.setenv("DYN_BENCH_AOT_PARALLEL", "full")  # not an int
     with pytest.raises(ValueError, match="DYN_BENCH_AOT_PARALLEL"):
         asyncio.run(bench.run_bench())
+
+
+class _FakeRelay:
+    """Local TCP listener reproducing the three relay behaviors bench.py's
+    bring-up probe distinguishes (round-3 postmortem: 'accepts-then-closes'
+    was the dead-tunnel signature that hung device init for three rounds)."""
+
+    def __init__(self, behavior: str):
+        import socket
+        import threading
+
+        self.behavior = behavior
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._held: list = []
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            if self.behavior == "close":
+                conn.close()
+            elif self.behavior == "data":
+                conn.sendall(b"x")
+                self._held.append(conn)
+            else:  # hold open silently
+                self._held.append(conn)
+
+    def stop(self):
+        self._stop.set()
+        self.sock.close()
+        for c in self._held:
+            c.close()
+
+
+@pytest.mark.parametrize(
+    "behavior,expected",
+    [("close", "accept_then_close"), ("hold", "held_open"), ("data", "data")],
+)
+def test_bench_relay_probe_states(monkeypatch, behavior, expected):
+    bench = _load_bench(f"bench_probe_{behavior}")
+    relay = _FakeRelay(behavior)
+    try:
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+        monkeypatch.setenv("DYN_BENCH_RELAY_PORT", str(relay.port))
+        out = bench._probe_relay(timeout=2.0)
+        assert out["state"] == expected, out
+    finally:
+        relay.stop()
+
+
+def test_bench_relay_probe_refused(monkeypatch):
+    bench = _load_bench("bench_probe_refused")
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listening there now
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+    monkeypatch.setenv("DYN_BENCH_RELAY_PORT", str(port))
+    out = bench._probe_relay(timeout=2.0)
+    assert out["state"] == "refused"
+
+
+def test_bench_relay_probe_unconfigured(monkeypatch):
+    bench = _load_bench("bench_probe_na")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    assert bench._probe_relay()["state"] == "n/a"

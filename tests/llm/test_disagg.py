@@ -4,6 +4,7 @@ single-engine greedy output.  Plus decision logic and queue behavior.
 """
 
 import asyncio
+import time
 
 import jax
 import jax.numpy as jnp
@@ -484,6 +485,7 @@ async def test_remote_prefill_timeout_falls_back_to_local(monkeypatch):
     engine, so a remote-prefill timeout degrades to a local prefill (exact
     same output), not a failed request."""
     monkeypatch.setenv("DYN_DISAGG_PREFILL_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("DYN_DISAGG_CLOCK_SKEW_S", "0")  # test-speed staleness
     MemoryControlPlane.reset_named()
     rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disaggto"))
     decode_engine = make_engine()
@@ -524,6 +526,7 @@ async def test_remote_prefill_timeout_falls_back_to_local(monkeypatch):
                 await asyncio.sleep(0.02)
             assert worker.stale_dropped == 1
             assert worker.prefills_done == 0
+            assert worker.stats() == {"prefills_done": 0, "stale_dropped": 1}
         finally:
             await worker.stop()
             prefill_engine.stop()
@@ -531,4 +534,44 @@ async def test_remote_prefill_timeout_falls_back_to_local(monkeypatch):
         if disagg:
             await disagg.stop()
         decode_engine.stop()
+        await rt.close()
+
+
+def test_staleness_tolerates_clock_skew():
+    """A requester clock running AHEAD of the worker by more than the TTL
+    must not make the worker drop every item: with broker-measured queue
+    age the decision compares two DURATIONS (age vs ttl_s) and never mixes
+    the two hosts' wall clocks; without age metadata, the wall-clock
+    fallback gets a skew margin so gross skew degrades to the occasional
+    wasted prefill instead of dropped traffic."""
+    worker = PrefillWorker.__new__(PrefillWorker)
+    worker.clock_skew_margin_s = 30.0
+    now = time.time()
+    # requester clock 120s ahead: its deadline_ts looks long-passed on the
+    # worker's clock, but the broker saw the item for only 2s → fresh
+    skewed = {"ttl_s": 10, "deadline_ts": now - 110}
+    assert not worker._is_stale(skewed, queue_age_s=2.0)
+    # genuinely stale by broker age, regardless of any wall clock
+    assert worker._is_stale({"ttl_s": 10, "deadline_ts": now + 300}, queue_age_s=11.0)
+    # no age metadata → wall-clock fallback, margin applied
+    assert not worker._is_stale({"ttl_s": 10, "deadline_ts": now - 10}, None)
+    assert worker._is_stale({"ttl_s": 10, "deadline_ts": now - 40}, None)
+    # no ttl on the item (legacy sender) → deadline fallback even with age
+    assert worker._is_stale({"deadline_ts": now - 40}, queue_age_s=1.0)
+
+
+async def test_queue_pop_meta_reports_broker_age():
+    """The memory bus stamps enqueue and measures age on ITS clock."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://qage"))
+    try:
+        queue = PrefillQueue(rt, "ns", "backend")
+        await queue.enqueue({"seq_id": "x"})
+        await asyncio.sleep(0.05)
+        popped = await queue.dequeue_with_age(timeout=1.0)
+        assert popped is not None
+        item, age = popped
+        assert item["seq_id"] == "x"
+        assert age is not None and 0.04 <= age < 5.0
+    finally:
         await rt.close()

@@ -203,6 +203,47 @@ async def test_work_queue(plane_factory):
         await teardown(plane, server)
 
 
+async def test_work_queue_pop_meta_age(plane_factory):
+    """queue_pop_meta reports the broker's own enqueue→pop age — the
+    skew-free staleness signal the disagg prefill worker consumes."""
+    plane, server = await make_plane(plane_factory)
+    try:
+        await plane.bus.queue_publish("prefill", b"req1")
+        await asyncio.sleep(0.05)
+        item = await plane.bus.queue_pop_meta("prefill", timeout=1)
+        assert item is not None
+        payload, age = item
+        assert payload == b"req1"
+        assert age is not None and 0.04 <= age < 5.0
+        assert await plane.bus.queue_pop_meta("prefill", timeout=0.1) is None
+    finally:
+        await teardown(plane, server)
+
+
+async def test_queue_pop_meta_degrades_on_old_server():
+    """A new client against a pre-queue_pop_meta dynctl server must fall
+    back to queue_pop with age=None (one failed round trip, then cached),
+    not error-loop."""
+    from dynamo_tpu.runtime.controlplane.client import RemoteBus
+
+    calls = []
+
+    class FakeConn:
+        async def call(self, method, *args, timeout=None):
+            calls.append(method)
+            if method == "bus.queue_pop_meta":
+                raise RuntimeError("ValueError('unknown method bus.queue_pop_meta')")
+            assert method == "bus.queue_pop"
+            return b"req1"
+
+    bus = RemoteBus(FakeConn())
+    assert await bus.queue_pop_meta("q", timeout=1) == (b"req1", None)
+    assert await bus.queue_pop_meta("q", timeout=1) == (b"req1", None)
+    # the unsupported method was tried exactly once
+    assert calls.count("bus.queue_pop_meta") == 1
+    assert calls.count("bus.queue_pop") == 2
+
+
 async def test_object_store(plane_factory):
     plane, server = await make_plane(plane_factory)
     try:
