@@ -101,7 +101,7 @@ class FakeKube(KubeClient):
                 continue
             obj_labels = obj.get("metadata", {}).get("labels", {})
             if all(obj_labels.get(lk) == lv for lk, lv in labels.items()):
-                out.append(obj)
+                out.append(json.loads(json.dumps(obj)))
         return out
 
     async def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -111,10 +111,18 @@ class FakeKube(KubeClient):
             self._notify("DELETED", obj)
 
     async def get(self, kind: str, namespace: str, name: str) -> dict | None:
-        return self.objects.get((kind, namespace, name))
+        # return a COPY, like the API server serializes a response: a caller
+        # mutating the result in place must not silently edit the store
+        # (that made apply's no-op detection eat a planner scale decision)
+        obj = self.objects.get((kind, namespace, name))
+        return None if obj is None else json.loads(json.dumps(obj))
 
     async def list_all(self, kind: str) -> list[dict]:
-        return [obj for (k, _, _), obj in self.objects.items() if k == kind]
+        return [
+            json.loads(json.dumps(obj))
+            for (k, _, _), obj in self.objects.items()
+            if k == kind
+        ]
 
     async def update_status(
         self, kind: str, namespace: str, name: str, status: dict

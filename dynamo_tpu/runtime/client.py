@@ -206,14 +206,43 @@ class PushRouter:
         server = await runtime.data_server()
         ctx = request.ctx
         connect_timeout = float(os.environ.get("DYN_CONNECT_TIMEOUT_S", "30"))
+        # quarantined instances get a short probe window instead of the
+        # full connect timeout: during a full-fleet outage healthy_ids
+        # returns the dark set rather than hard-failing, and without this a
+        # request would serially re-pay 30s per dark instance — a latency
+        # storm instead of a fast, diagnosable failure
+        dark_probe_timeout = min(
+            connect_timeout, float(os.environ.get("DYN_DARK_PROBE_TIMEOUT_S", "5"))
+        )
+        # hard cap on TOTAL rendezvous time across failovers; generation
+        # time is unbounded as ever — this only bounds how long a request
+        # can hunt for a worker that will talk to it
+        budget = float(os.environ.get("DYN_RENDEZVOUS_BUDGET_S", "90"))
+        t_start = time.monotonic()
         tried: set[int] = set()
         last_err: Exception | None = None
         while True:
+            remaining = budget - (time.monotonic() - t_start)
+            if remaining <= 0 and last_err is not None:
+                logger.warning(
+                    "rendezvous budget %.0fs exhausted after %d instance(s)",
+                    budget, len(tried),
+                )
+                break
             # bounded by exclusion, not a count: every live instance gets
             # one shot (3 dark + 2 healthy must reach the healthy ones)
             inst = self._pick(instance_id, exclude=tried)
             if inst is None:
                 break
+            attempt_timeout = (
+                dark_probe_timeout
+                if inst.instance_id in self._dark
+                else connect_timeout
+            )
+            # every attempt (including the first) honors the budget: an
+            # operator setting a budget below the connect timeout chose
+            # fail-fast semantics deliberately
+            attempt_timeout = min(attempt_timeout, max(remaining, 0.1))
             # stream ids are per-hop AND per-attempt (a pipeline stage
             # reuses the request ctx, so ctx.id alone would collide on the
             # shared server; a late connect-back from a failed-over attempt
@@ -231,7 +260,7 @@ class PushRouter:
                 await runtime.plane.bus.publish(inst.subject, envelope)
                 # rendezvous: wait for the worker to connect back before
                 # returning the stream (the reference awaits the prologue)
-                await asyncio.wait_for(pending.connected.wait(), timeout=connect_timeout)
+                await asyncio.wait_for(pending.connected.wait(), timeout=attempt_timeout)
             except asyncio.TimeoutError:
                 if pending.connected.is_set():
                     # the connect-back won the race with wait_for's timer
@@ -248,7 +277,7 @@ class PushRouter:
                 last_err = TimeoutError(
                     f"no data-plane connect-back from instance "
                     f"{inst.instance_id:x} ({inst.subject}) within "
-                    f"{connect_timeout:.0f}s — worker dead/overloaded, or it "
+                    f"{attempt_timeout:.0f}s — worker dead/overloaded, or it "
                     "rejected the request envelope (check worker logs for "
                     "'malformed request')"
                 )

@@ -83,20 +83,82 @@ async def test_chip_budget_clamps():
     assert d.num_prefill + d.num_decode <= 4
 
 
-async def test_kubernetes_connector_renders_patches():
-    patches = []
+async def test_kubernetes_connector_drives_operator():
+    """planner → k8s is ONE path: the connector patches the GRAPH CR's
+    service replicas through the KubeClient (reference: planner
+    kubernetes_connector.py update_graph_replicas), and the operator's
+    watch reconciles the patched graph into child Deployments with the new
+    replica counts."""
+    import asyncio
 
-    async def apply(p):
-        patches.append(p)
+    from dynamo_tpu.deploy.crds import ComponentSpec, DynamoGraphDeployment
+    from dynamo_tpu.deploy.operator import FakeKube, Operator
 
-    connector = KubernetesConnector(apply, deployment="graph")
-    planner = Planner(profile(), connector, PlannerConfig(
-        predictor="constant", scale_down_headroom=1.0))
-    await planner.step(WorkloadSample(request_rate=10, avg_isl=512, avg_osl=64))
-    assert len(patches) == 2
-    names = {p["metadata"]["name"] for p in patches}
-    assert names == {"graph-prefill-worker", "graph-decode-worker"}
-    assert all(p["spec"]["replicas"] >= 1 for p in patches)
+    kube = FakeKube()
+    graph = DynamoGraphDeployment(
+        name="graph",
+        services={
+            "prefill-worker": ComponentSpec(component_type="worker", replicas=1),
+            "decode-worker": ComponentSpec(component_type="worker", replicas=1),
+        },
+    )
+    op = Operator(kube, resync_s=600)
+    op.start()
+
+    async def deployment_replicas(name):
+        for _ in range(200):
+            obj = kube.objects.get(("Deployment", "default", name))
+            if obj is not None:
+                return obj["spec"]["replicas"]
+            await asyncio.sleep(0.02)
+        raise AssertionError(f"Deployment {name} never rendered")
+
+    try:
+        await kube.apply(graph.to_manifest())
+        assert await deployment_replicas("graph-prefill-worker") == 1
+        assert await deployment_replicas("graph-decode-worker") == 1
+
+        connector = KubernetesConnector(kube, graph="graph")
+        planner = Planner(profile(), connector, PlannerConfig(
+            predictor="constant", max_prefill=3, max_decode=2,
+            scale_down_headroom=1.0))
+        decision = await planner.step(
+            WorkloadSample(request_rate=1000, avg_isl=512, avg_osl=64)
+        )
+        # guard against a vacuous pass: the decision must differ from the
+        # initial replicas or the assertions below prove nothing
+        assert (decision.num_prefill, decision.num_decode) != (1, 1)
+
+        async def scaled():
+            for _ in range(200):
+                pre = kube.objects.get(("Deployment", "default", "graph-prefill-worker"))
+                dec = kube.objects.get(("Deployment", "default", "graph-decode-worker"))
+                if (
+                    pre is not None and dec is not None
+                    and pre["spec"]["replicas"] == decision.num_prefill
+                    and dec["spec"]["replicas"] == decision.num_decode
+                ):
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await scaled(), "operator never applied the planner's replicas"
+        # the graph CR itself records the desired counts (durable across
+        # operator resyncs, unlike a child-level patch)
+        spec = kube.objects[("DynamoGraphDeployment", "default", "graph")]["spec"]
+        assert spec["services"]["prefill-worker"]["replicas"] == decision.num_prefill
+        assert spec["services"]["decode-worker"]["replicas"] == decision.num_decode
+    finally:
+        await op.stop()
+
+
+async def test_kubernetes_connector_missing_graph_raises():
+    from dynamo_tpu.deploy.operator import FakeKube
+    from dynamo_tpu.planner.planner import PlannerDecision
+
+    connector = KubernetesConnector(FakeKube(), graph="absent")
+    with pytest.raises(ValueError, match="absent"):
+        await connector.scale(PlannerDecision(num_prefill=1, num_decode=1))
 
 
 def test_profile_save_load(tmp_path):

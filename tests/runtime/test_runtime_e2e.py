@@ -7,6 +7,7 @@ ResponseStream.
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -289,5 +290,39 @@ async def test_rendezvous_timeout_fails_over_to_healthy_instance(
                 Context({"tokens": [7]}), s2.instance.instance_id
             )
         await s1.shutdown(drain_timeout=2)
+    finally:
+        await rt.close()
+
+
+async def test_full_fleet_outage_fails_fast(runtime_factory, monkeypatch):
+    """When EVERY instance is quarantined, requests must fail within the
+    short dark-probe window per instance (bounded overall by the rendezvous
+    budget) — not serially re-pay the full connect timeout per instance
+    (the round-3 advisory's latency-storm scenario)."""
+    monkeypatch.setenv("DYN_CONNECT_TIMEOUT_S", "30")   # full window: huge
+    monkeypatch.setenv("DYN_DARK_PROBE_TIMEOUT_S", "0.3")
+    monkeypatch.setenv("DYN_RENDEZVOUS_BUDGET_S", "5")
+    rt = await runtime_factory()
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        s1 = await ep.serve(EchoEngine("w1"))
+        s2 = await ep.serve(EchoEngine("w2"))
+        router = await PushRouter.from_endpoint(ep)
+        await router.client.wait_for_instances(2, timeout=5)
+        # both workers die silently and are already quarantined (as after
+        # one prior failed request)
+        await s1._sub.unsubscribe()
+        await s2._sub.unsubscribe()
+        router.quarantine(s1.instance.instance_id)
+        router.quarantine(s2.instance.instance_id)
+
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            stream = await router.generate(Context({"tokens": [7]}))
+            async for _ in stream:
+                pass
+        elapsed = time.monotonic() - t0
+        # two dark probes at 0.3s each, far below one 30s connect timeout
+        assert elapsed < 5.0, f"latency storm: {elapsed:.1f}s"
     finally:
         await rt.close()
