@@ -597,8 +597,39 @@ def child_main() -> None:
     _progress(f"devices {devs} in {time.monotonic()-t0:.1f}s")
 
     result = asyncio.run(run_bench())
+    try:
+        result.setdefault("detail", {})["kv_routing"] = asyncio.run(
+            _measure_kv_routing()
+        )
+        _progress("kv-routing fleet microbench done")
+    except Exception as err:  # noqa: BLE001 — auxiliary metric only
+        print(f"bench: kv-routing microbench failed ({err!r:.200})", file=sys.stderr)
     print(json.dumps(result))
     sys.stdout.flush()
+
+
+async def _measure_kv_routing() -> dict:
+    """KV-aware vs random routing TTFT on multi-turn traffic — the
+    reference's 3x-TTFT routing claim (docs/architecture/architecture.md:
+    86-91), measured through the real router/indexer/dispatch stack over a
+    mocker fleet (device-independent; the full artifact is
+    ROUTED_FLEET.json via `python -m dynamo_tpu.bench.routed_fleet`)."""
+    from dynamo_tpu.bench.data_generator import SessionConfig, generate_sessions
+    from dynamo_tpu.bench.routed_fleet import FleetConfig, run_fleet
+
+    cfg = SessionConfig(num_sessions=24, turns_per_session=4)
+    fleet = FleetConfig()
+    sessions = generate_sessions(cfg)
+    rnd = await run_fleet("random", sessions, fleet)
+    kv = await run_fleet("kv", sessions, fleet)
+    return {
+        "ttft_p50_speedup": round(rnd["ttft_p50_ms"] / kv["ttft_p50_ms"], 2),
+        "followup_ttft_p50_speedup": round(
+            rnd["followup_ttft_p50_ms"] / kv["followup_ttft_p50_ms"], 2
+        ),
+        "kv_prefix_hits": kv["prefix_hits_total"],
+        "random_prefix_hits": rnd["prefix_hits_total"],
+    }
 
 
 def _probe_relay(timeout: float = 3.0) -> dict:

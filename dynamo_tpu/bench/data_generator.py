@@ -85,6 +85,73 @@ class TraceSynthesizer:
         return requests
 
 
+@dataclass
+class SessionTurn:
+    arrival_gap_s: float        # gap after the previous turn's last token
+    user_tokens: list[int]      # this turn's new user input
+    osl: int                    # assistant tokens to generate
+
+
+@dataclass
+class Session:
+    session_id: int
+    start_s: float
+    system_tokens: list[int]    # session prefix (system prompt / doc context)
+    turns: list[SessionTurn]
+
+
+@dataclass
+class SessionConfig:
+    """Multi-turn chat workload (reference: the KV-routing 3x-TTFT claim is
+    demonstrated on multi-turn traffic, docs/architecture/architecture.md:86-91):
+    each session's growing history is ITS OWN prefix, so sessions spread load
+    across workers while an affine router turns every follow-up turn into a
+    tail-only prefill."""
+
+    num_sessions: int = 40
+    turns_per_session: int = 5
+    session_rate: float = 3.0          # Poisson session starts/s
+    system_tokens: int = 768           # per-session shared prefix
+    user_tokens_per_turn: int = 64
+    turn_gap_mean_s: float = 3.0       # think time between turns
+    osl: int = 24
+    vocab_size: int = 32_000
+    seed: int = 0
+
+
+def generate_sessions(cfg: SessionConfig) -> list[Session]:
+    rng = random.Random(cfg.seed)
+    sessions = []
+    t = 0.0
+    for sid in range(cfg.num_sessions):
+        t += rng.expovariate(cfg.session_rate)
+        turns = [
+            SessionTurn(
+                arrival_gap_s=(
+                    0.0 if i == 0 else rng.expovariate(1.0 / cfg.turn_gap_mean_s)
+                ),
+                user_tokens=[
+                    rng.randrange(10, cfg.vocab_size)
+                    for _ in range(cfg.user_tokens_per_turn)
+                ],
+                osl=cfg.osl,
+            )
+            for i in range(cfg.turns_per_session)
+        ]
+        sessions.append(
+            Session(
+                session_id=sid,
+                start_s=t,
+                system_tokens=[
+                    rng.randrange(10, cfg.vocab_size)
+                    for _ in range(cfg.system_tokens)
+                ],
+                turns=turns,
+            )
+        )
+    return sessions
+
+
 def load_trace(path: str | Path) -> list[TraceRequest]:
     out = []
     with open(path) as f:

@@ -134,21 +134,39 @@ class MockerEngine:
             decision = self.scheduler.schedule()
             cost = 0.0
             for seq in decision.prefills:
-                n = seq.context_len
-                cost += cfg.prefill_linear_s * n + cfg.prefill_quadratic_s * n * n
-                self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)
-                self._emit_next(seq)
+                # prefix-cache hits only pay for the NEW tokens, attending
+                # over the full context (reference: mocker/scheduler.rs:31
+                # "prefill compute = (cached_tokens + new_tokens) *
+                # new_tokens") — this is the mechanism a KV-aware router
+                # exploits, so the simulation must credit it
+                cached = seq.cached_tokens
+                new = max(seq.context_len - cached, 0)
+                cost += (
+                    cfg.prefill_linear_s * new
+                    + cfg.prefill_quadratic_s * (cached + new) * new
+                )
             decodes = [s for s in self.scheduler.running if s.status == SeqStatus.RUNNING]
             if decodes:
                 cost += cfg.decode_base_s + cfg.decode_per_block_s * self.allocator.used_blocks
-                for seq in decodes:
-                    slot = self.scheduler.ensure_slot(seq)
-                    if slot is None:
-                        self.scheduler.preempt(seq)
-                        continue
-                    self._emit_next(seq)
+            # simulate the compute FIRST, then emit: a request's first token
+            # must arrive after its prefill cost (TTFT is the whole point of
+            # the simulation — emitting before sleeping made every TTFT ~0
+            # regardless of prompt length or cache state)
             self._iterations += 1
             await asyncio.sleep(cost / cfg.speedup)
+            for seq in decision.prefills:
+                if seq.status == SeqStatus.FINISHED:  # cancelled mid-sleep
+                    continue
+                self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)
+                self._emit_next(seq)
+            for seq in decodes:
+                if seq.status == SeqStatus.FINISHED:
+                    continue
+                slot = self.scheduler.ensure_slot(seq)
+                if slot is None:
+                    self.scheduler.preempt(seq)
+                    continue
+                self._emit_next(seq)
 
     def _emit_next(self, seq: Sequence) -> None:
         # deterministic "generation": next token = (last + 1) mod 1000

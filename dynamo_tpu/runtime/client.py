@@ -216,8 +216,13 @@ class PushRouter:
         )
         # hard cap on TOTAL rendezvous time across failovers; generation
         # time is unbounded as ever — this only bounds how long a request
-        # can hunt for a worker that will talk to it
-        budget = float(os.environ.get("DYN_RENDEZVOUS_BUDGET_S", "90"))
+        # can hunt for a worker that will talk to it.  The default scales
+        # with the connect timeout so raising DYN_CONNECT_TIMEOUT_S (e.g.
+        # for first-compile rendezvous on a loaded CI box) is never
+        # silently undone by a smaller fixed budget.
+        budget = float(
+            os.environ.get("DYN_RENDEZVOUS_BUDGET_S", "0")
+        ) or 3.0 * connect_timeout
         t_start = time.monotonic()
         tried: set[int] = set()
         last_err: Exception | None = None
@@ -234,9 +239,12 @@ class PushRouter:
             inst = self._pick(instance_id, exclude=tried)
             if inst is None:
                 break
+            # expiry-aware: an EXPIRED quarantine entry must not demote a
+            # recovered worker to the probe window (direct dispatch skips
+            # healthy_ids, so nothing else prunes on this path)
             attempt_timeout = (
                 dark_probe_timeout
-                if inst.instance_id in self._dark
+                if self._dark.get(inst.instance_id, 0.0) > time.monotonic()
                 else connect_timeout
             )
             # every attempt (including the first) honors the budget: an

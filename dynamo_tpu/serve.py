@@ -115,8 +115,22 @@ async def serve_worker(
         from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
 
         engine = MockerEngine(MockerConfig(block_size=mdc.kv_block_size))
-        engine.start()
         service = await ep.serve(engine, stats_handler=engine.stats)
+        # mockers exist to exercise routers at scale, so they publish the
+        # same KV events + load metrics as the real engine (the mocker's
+        # allocator is the real BlockAllocator — its stored/removed events
+        # feed the KV router's radix index exactly like serving traffic).
+        # Same wiring order as the jax branch: sink attached BEFORE the
+        # engine loop starts, so no early request's events are dropped.
+        kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)
+        kv_pub.start()
+        engine._event_sink = kv_pub.sink
+        metrics_pub = WorkerMetricsPublisher(
+            ep.component, service.instance.instance_id, engine.stats
+        )
+        metrics_pub.start()
+        publishers = [kv_pub, metrics_pub]
+        engine.start()
     elif engine_kind == "jax":
         # publishers are wired before the engine so allocator events flow
         engine = build_jax_engine(model_dir, mdc, **engine_overrides)
