@@ -72,6 +72,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                      help="weight-only quantization (all served families; "
                           "halves decode HBM traffic — the TPU analog of "
                           "the reference's FP8 serving)")
+    run.add_argument("--host-offload-blocks", type=int, default=0,
+                     help="G2 host-DRAM KV tier size (0 = off): HBM "
+                          "evictions offload here and restore on prefix hit")
+    run.add_argument("--disk-offload-blocks", type=int, default=0,
+                     help="G3 SSD KV tier size (needs --host-offload-blocks)")
+    run.add_argument("--remote-kv-store", default=None, metavar="HOST:PORT",
+                     help="G4 remote KV tier: a block-store server "
+                          "(python -m dynamo_tpu.llm.block_manager.remote); "
+                          "bottom-tier evictions cascade there over DCN")
     args = parser.parse_args(argv)
 
     args.input, args.output = "http", "jax"
@@ -124,6 +133,12 @@ async def _run(args) -> int:
                 overrides["speculative"] = args.speculative
                 overrides["spec_tokens"] = args.spec_tokens
                 overrides["spec_ngram"] = args.spec_ngram
+            if args.host_offload_blocks:
+                overrides["host_offload_blocks"] = args.host_offload_blocks
+            if args.disk_offload_blocks:
+                overrides["disk_offload_blocks"] = args.disk_offload_blocks
+            if args.remote_kv_store:
+                overrides["remote_store_addr"] = args.remote_kv_store
         worker = await serve_worker(
             runtime,
             args.model_path,

@@ -97,6 +97,12 @@ class EngineConfig:
     # restore from there (0 = off; needs host_offload_blocks > 0).
     disk_offload_blocks: int = 0
     disk_offload_path: str | None = None
+    # G4 remote tier: "host:port" of a BlockStoreServer
+    # (llm/block_manager/remote.py) — bottom-tier evictions cascade there
+    # over DCN and prefix hits restore from it (None = off; needs
+    # host_offload_blocks > 0).  Reference: the remote tier of the block
+    # manager, lib/llm/src/block_manager.rs:68-81.
+    remote_store_addr: str | None = None
     # Compile-time K for per-token top-k alternatives (OpenAI
     # top_logprobs caps at 20).  K>0 adds one lax.top_k over [lanes, vocab]
     # to every step (the host transfer of the rows is skipped unless a
@@ -367,12 +373,28 @@ class JaxLlmEngine:
                 {k: np.dtype(v.dtype) for k, v in leaves.items()},
                 disk_blocks=config.disk_offload_blocks,
                 disk_path=config.disk_offload_path,
+                remote_addr=config.remote_store_addr,
             )
             offload_sink = self._offload_blocks
-            # a hash that left EVERY tier (fell off the host LRU with no
-            # disk spill, or off the disk LRU) while no longer device-
-            # resident: routers must forget it
+            # a hash that left EVERY tier (fell off the bottom of the
+            # G2→G3→G4 cascade) while no longer device-resident: routers
+            # must forget it
             self.host_tier.evict_observer = self._host_evicted
+        elif (
+            config.host_offload_blocks
+            or config.disk_offload_blocks
+            or config.remote_store_addr
+        ):
+            # a silently-ignored tier config is worse than a loud one: the
+            # operator believes offload is on while nothing mounts
+            raise ValueError(
+                "KV offload tiers configured but unusable: "
+                + (
+                    "disk/remote tiers need host_offload_blocks > 0"
+                    if not config.host_offload_blocks
+                    else "this model family/config has no prefix caching"
+                )
+            )
         self.allocator = BlockAllocator(
             config.num_blocks, config.block_size, event_sink=self._sink_event,
             enable_prefix_caching=self.prefix_caching,
@@ -1564,8 +1586,11 @@ class JaxLlmEngine:
             k: np.zeros((v.shape[0], nb, *v.shape[2:]), np.dtype(v.dtype))
             for k, v in dict(self.cache).items()
         }
+        # one batched read per tier (a G4-resident prefix costs one DCN
+        # round trip for the whole plan, not one per block)
+        contents = self.host_tier.read_pinned_many([h for h, _ in plan])
         for i, (h, bid) in enumerate(plan):
-            content = self.host_tier.read_pinned(h)
+            content = contents.get(h)
             assert content is not None, "pinned host block vanished"
             ids[i] = bid
             for name, arr in content.items():

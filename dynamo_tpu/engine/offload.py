@@ -1,17 +1,27 @@
-"""G2 host-DRAM offload tier for the serving engine.
+"""Tiered KV offload for the serving engine: G2 host → G3 disk → G4 remote.
 
-Built on the KV block manager's pool machinery (``llm/block_manager``:
-BlockPool lifecycle/LRU/registry + HostStorage) — the reference's engine
-cache IS its block manager (lib/llm/src/block_manager.rs:90, G1→G2 offload
-offload.rs:77-80); here the device tier is the engine's paged cache and this
-tier catches blocks evicted from it:
+Built ON the KV block manager (``llm/block_manager``): the tiers are a
+:class:`KvBlockManager` (host / disk / remote BlockPools over the uniform
+Storage interface) and every block movement goes through
+:meth:`OffloadManager.insert_sync` — the reference's engine cache IS its
+block manager (lib/llm/src/block_manager.rs:90; offload chain
+offload.rs:77-80; G4 remote tier block_manager.rs:68-81), and this adapter
+is the serving-side mount of the same machinery.
 
 - **offload**: when the allocator evicts a registered block from device HBM,
   the engine serializes that block's cache-pytree slice (works for any
   family layout, llama k/v or DeepSeek latent/rope) into one host block;
-- **restore**: prompt matching extends past device-resident blocks into this
-  tier; hits are pinned at match time and scattered into freshly-allocated
-  device blocks right before the tail prefill.
+  host-LRU evictions cascade down-tier (disk, then a remote
+  ``BlockStoreServer`` over DCN) read-before-overwrite, so content only
+  disappears when it falls off the BOTTOM tier.
+- **restore**: prompt matching extends past device-resident blocks into
+  these tiers; hits are pinned at match time (whichever tier holds them)
+  and scattered into freshly-allocated device blocks right before the tail
+  prefill.  All calls are synchronous — this runs on the engine's device
+  thread (RemoteStorage is blocking-socket by design).
+
+Payload layout: per block, the concatenated raw bytes of each cache leaf
+slice ``leaf[:, block_id]`` in sorted leaf-name order.
 """
 
 from __future__ import annotations
@@ -20,27 +30,18 @@ import pathlib
 
 import numpy as np
 
-from dynamo_tpu.llm.block_manager.pool import BlockPool
-from dynamo_tpu.llm.block_manager.storage import HostStorage
+from dynamo_tpu.llm.block_manager.manager import KvbmConfig, KvBlockManager
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger("engine.offload")
 
 
 class HostOffloadTier:
-    """Hash-addressed host pool of serialized KV blocks (G2), with an
-    optional G3 spill: blocks evicted from the host LRU cascade to a
-    disk-backed pool (np.memmap SSD tier) and restore from there on a
-    later prefix hit — the reference's G1→G2→G3 offload chain
-    (lib/llm/src/block_manager/offload.rs).
-
-    Payload layout: per block, the concatenated raw bytes of each cache leaf
-    slice ``leaf[:, block_id]`` in sorted leaf-name order.
-    """
+    """Serving-side mount of the tiered block manager (G2/G3/G4)."""
 
     def __init__(
         self, num_blocks: int, leaf_shapes: dict, leaf_dtypes: dict,
-        *, disk_blocks: int = 0, disk_path=None,
+        *, disk_blocks: int = 0, disk_path=None, remote_addr: str | None = None,
     ):
         self._names = sorted(leaf_shapes)
         self._shapes = {n: tuple(leaf_shapes[n]) for n in self._names}
@@ -50,16 +51,10 @@ class HostOffloadTier:
             for n in self._names
         }
         self.block_nbytes = sum(self._sizes.values())
-        self.pool = BlockPool(
-            HostStorage(num_blocks, (self.block_nbytes,), np.uint8), tier_name="g2"
-        )
-        self.disk: BlockPool | None = None
         self._disk_path = None
         if disk_blocks:
             import os
             import uuid
-
-            from dynamo_tpu.llm.block_manager.storage import DiskStorage
 
             # unique per tier: a fixed shared path would let a second
             # engine's mode="w+" memmap truncate this engine's live pool
@@ -67,120 +62,113 @@ class HostOffloadTier:
                 disk_path
                 or f"/tmp/dynamo_tpu_g3.{os.getpid()}.{uuid.uuid4().hex[:8]}.blocks"
             )
-            self.disk = BlockPool(
-                DiskStorage(
-                    disk_blocks, (self.block_nbytes,), np.uint8,
-                    path=self._disk_path,
-                ),
-                tier_name="g3",
+        self.kvbm = KvBlockManager(
+            KvbmConfig(
+                dtype=np.uint8,
+                payload_shape=(self.block_nbytes,),
+                device_blocks=0,  # G1 is the engine's own paged cache
+                host_blocks=num_blocks,
+                disk_blocks=disk_blocks,
+                disk_path=None if self._disk_path is None else str(self._disk_path),
+                remote_address=remote_addr,
             )
-            self.disk.evict_sink = self._on_disk_evict
-        self._host_evicted_hash: int | None = None
-        self.pool.evict_sink = self._on_host_evict
+        )
+        self.tiers = [self.kvbm.pools[t] for t in self.kvbm.tier_order]
+        self.tier_names = [t.value for t in self.kvbm.tier_order]
+        logger.info(
+            "offload tiers %s (block payload %d bytes — size a G4 store "
+            "with --nbytes %d)",
+            "→".join(self.tier_names), self.block_nbytes, self.block_nbytes,
+        )
         self.evict_observer = None  # engine hook: hash left EVERY tier
         self.offloads = 0
         self.restores = 0
-        self.disk_spills = 0
-        self.disk_restores = 0
+        self._tier_restores = [0] * len(self.tiers)
 
-    # -- eviction cascade ----------------------------------------------------
-    def _on_host_evict(self, seq_hash: int) -> None:
-        # allocate() evicted this hash; the caller (put) spills its bytes
-        # to disk before overwriting the host block
-        self._host_evicted_hash = seq_hash
+    # convenience views (existing tests/benchmarks address the host pool)
+    @property
+    def pool(self):
+        return self.tiers[0]
 
-    def _on_disk_evict(self, seq_hash: int) -> None:
-        if self.evict_observer is not None:
-            self.evict_observer(seq_hash)
+    @property
+    def disk(self):
+        return self.tiers[1] if "g3" in self.tier_names else None
 
-    def _spill_to_disk(self, seq_hash: int, host_bid: int) -> None:
-        """Copy an evicted host block's (still-resident) bytes down-tier."""
-        if self.disk is None or self.disk.has_hash(seq_hash):
-            self._notify_if_gone(seq_hash)
-            return
-        dbid = self.disk.allocate()
-        if dbid is None:
-            self._notify_if_gone(seq_hash)
-            return
-        self.disk.write([dbid], self.pool.read([host_bid]))
-        self.disk.complete(dbid, 0)
-        self.disk.register(dbid, seq_hash)
-        self.disk.release(dbid)
-        self.disk_spills += 1
-
-    def _notify_if_gone(self, seq_hash: int) -> None:
-        if not self.has(seq_hash) and self.evict_observer is not None:
-            self.evict_observer(seq_hash)
-
-    # -- offload (device eviction → host) -----------------------------------
+    # -- offload (device eviction → host, cascading further down) -----------
     def put(self, seq_hash: int, leaves: dict) -> bool:
-        """Store one evicted block's content; dedupes by hash.  False when
-        the tier is full of pinned blocks (offload skipped).  A host block
-        this put evicts cascades to the disk tier first."""
-        if self.pool.has_hash(seq_hash):
+        """Store one evicted block's content; dedupes against the HOST tier
+        only — a hash that previously cascaded to disk/remote gets a fresh
+        host copy here, so a hot prefix that keeps cycling through device
+        eviction is re-promoted to the fastest tier instead of being pinned
+        to the bottom of the cascade forever (the stale lower-tier copy
+        ages out of its LRU).  False when no tier can take it (full of
+        pinned blocks).  A host block this put evicts cascades down-tier
+        before being overwritten (OffloadManager.insert_sync)."""
+        if self.tiers[0].has_hash(seq_hash):
             return True
-        self._host_evicted_hash = None
-        bid = self.pool.allocate()  # evicts host LRU if needed
-        if bid is None:
-            return False
-        if self._host_evicted_hash is not None:
-            self._spill_to_disk(self._host_evicted_hash, bid)
-            self._host_evicted_hash = None
         buf = np.concatenate(
             [
                 np.ascontiguousarray(np.asarray(leaves[n])).view(np.uint8).ravel()
                 for n in self._names
             ]
         )
-        self.pool.write([bid], buf[None])
-        self.pool.complete(bid, 0)
-        self.pool.register(bid, seq_hash)
-        self.pool.release(bid)  # park in the inactive LRU (evictable)
-        self.offloads += 1
-        return True
-
-    # -- restore (host/disk → device) ----------------------------------------
-    def has(self, seq_hash: int) -> bool:
-        return self.pool.has_hash(seq_hash) or (
-            self.disk is not None and self.disk.has_hash(seq_hash)
+        ok = self.kvbm.offload.insert_sync(
+            self.kvbm.tier_order[0], buf[None], seq_hash,
+            on_fully_evicted=self._on_fully_evicted,
         )
+        if ok:
+            self.offloads += 1
+        return ok
+
+    def _on_fully_evicted(self, seq_hash: int) -> None:
+        if self.evict_observer is not None:
+            self.evict_observer(seq_hash)
+
+    # -- restore (any tier → device) -----------------------------------------
+    def has(self, seq_hash: int) -> bool:
+        return any(p.has_hash(seq_hash) for p in self.tiers)
 
     def pin(self, seq_hash: int) -> bool:
         """Claim a block for an upcoming restore so interleaved offloads
         can't evict it between match and prefill (whichever tier holds it)."""
-        if self.pool.match_hash(seq_hash) is not None:
-            return True
-        return self.disk is not None and self.disk.match_hash(seq_hash) is not None
+        return any(p.match_hash(seq_hash) is not None for p in self.tiers)
 
     def unpin(self, seq_hash: int) -> None:
-        bid = self.pool.peek_hash(seq_hash)
-        if bid is not None:
-            self.pool.release(bid)
-            return
-        if self.disk is not None:
-            dbid = self.disk.peek_hash(seq_hash)
-            if dbid is not None:
-                self.disk.release(dbid)
+        for p in self.tiers:
+            bid = p.peek_hash(seq_hash)
+            if bid is not None:
+                p.release(bid)
+                return
 
     def read_pinned(self, seq_hash: int) -> dict | None:
-        """Deserialize a pinned block's leaves and release the pin; disk
-        hits count as restores from G3."""
-        bid = self.pool.peek_hash(seq_hash)
-        if bid is None:
-            if self.disk is None:
-                return None
-            dbid = self.disk.peek_hash(seq_hash)
-            if dbid is None:
-                return None
-            buf = self.disk.read([dbid])[0]
-            self.disk.release(dbid)
-            self.disk_restores += 1
-            self.restores += 1
-            return self._deserialize(buf)
-        buf = self.pool.read([bid])[0]
-        self.pool.release(bid)
-        self.restores += 1
-        return self._deserialize(buf)
+        """Deserialize a pinned block's leaves and release the pin, from
+        whichever tier holds it (host, disk memmap, or the remote store
+        over DCN — RemoteStorage reads are blocking by design)."""
+        out = self.read_pinned_many([seq_hash])
+        return out.get(seq_hash)
+
+    def read_pinned_many(self, seq_hashes: list[int]) -> dict[int, dict]:
+        """Batched restore: ONE storage read per tier for all the hashes it
+        holds (a 32-block G4 prefix costs one DCN round trip, not 32), pins
+        released.  Missing hashes are absent from the result."""
+        out: dict[int, dict] = {}
+        remaining = list(seq_hashes)
+        for i, p in enumerate(self.tiers):
+            if not remaining:
+                break
+            held = [(h, p.peek_hash(h)) for h in remaining]
+            held = [(h, bid) for h, bid in held if bid is not None]
+            if not held:
+                continue
+            bufs = p.read([bid for _, bid in held])
+            for (h, bid), buf in zip(held, bufs):
+                p.release(bid)
+                out[h] = self._deserialize(buf)
+            self._tier_restores[i] += len(held)
+            self.restores += len(held)
+            got = {h for h, _ in held}
+            remaining = [h for h in remaining if h not in got]
+        return out
 
     def _deserialize(self, buf: np.ndarray) -> dict:
         out = {}
@@ -197,40 +185,43 @@ class HostOffloadTier:
         """Admin flush: forget everything except blocks pinned for an
         in-flight restore (clear_kv_blocks keeps running sequences' state,
         mirroring the allocator's clear_published)."""
-        for h in self.pool.registered_hashes():
-            if self.pool.ref_count(h) > 0:
-                continue
-            self.pool.drop_hash(h)
-        if self.disk is not None:
-            for h in self.disk.registered_hashes():
-                if self.disk.ref_count(h) > 0:
+        for p in self.tiers:
+            for h in p.registered_hashes():
+                if p.ref_count(h) > 0:
                     continue
-                self.disk.drop_hash(h)
+                p.drop_hash(h)
 
     def close(self) -> None:
-        """Release the disk memmap and delete its backing file."""
-        if self.disk is not None:
+        """Release every tier's backing (disk memmap deleted, remote
+        connections closed)."""
+        for p in self.tiers:
             try:
-                self.disk.storage.close()
+                p.storage.close()
             except Exception:  # noqa: BLE001
                 pass
-            if self._disk_path is not None:
-                self._disk_path.unlink(missing_ok=True)
-            self.disk = None
+        if self._disk_path is not None:
+            self._disk_path.unlink(missing_ok=True)
 
     def stats(self) -> dict:
+        host = self.tiers[0]
         out = {
-            "host_blocks_total": self.pool.num_blocks,
-            "host_blocks_used": self.pool.num_blocks - self.pool.free_count,
+            "host_blocks_total": host.num_blocks,
+            "host_blocks_used": host.num_blocks - host.free_count,
             "host_offloads_total": self.offloads,
             "host_restores_total": self.restores,
-            "host_evictions": self.pool.evictions,
+            "host_evictions": host.evictions,
         }
-        if self.disk is not None:
+        inserts = self.kvbm.offload.tier_inserts
+        for name, p, restores in zip(
+            self.tier_names[1:], self.tiers[1:], self._tier_restores[1:]
+        ):
+            label = {"g3": "disk", "g4": "remote"}.get(name, name)
             out.update(
-                disk_blocks_total=self.disk.num_blocks,
-                disk_spills_total=self.disk_spills,
-                disk_restores_total=self.disk_restores,
-                disk_evictions=self.disk.evictions,
+                {
+                    f"{label}_blocks_total": p.num_blocks,
+                    f"{label}_spills_total": inserts.get(name, 0),
+                    f"{label}_restores_total": restores,
+                    f"{label}_evictions": p.evictions,
+                }
             )
         return out

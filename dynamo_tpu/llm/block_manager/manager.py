@@ -52,12 +52,18 @@ class KvbmConfig:
     disk_path: str | None = None
     remote_address: str | None = None  # "host:port" of a BlockStoreServer (G4)
     null_storage: bool = False      # metadata-only pools (fast logic tests)
+    # raw-payload mode: tiers hold pre-serialized blocks of this exact shape
+    # (the serving engine's offload tier serializes each cache-pytree slice
+    # to one uint8 vector), bypassing the structured layers/heads layout
+    payload_shape: tuple | None = None
 
 
 class KvBlockManager:
     def __init__(self, config: KvbmConfig):
         self.config = config
-        shape = block_shape(config.num_layers, config.block_size, config.kv_heads, config.head_dim)
+        shape = tuple(config.payload_shape) if config.payload_shape else block_shape(
+            config.num_layers, config.block_size, config.kv_heads, config.head_dim
+        )
         self.pools: dict[str, BlockPool] = {}
 
         def make_storage(n: int, kind: str):

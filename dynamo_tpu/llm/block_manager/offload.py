@@ -54,6 +54,7 @@ class OffloadManager:
         self.completed = 0
         self.failed = 0
         self.skipped = 0
+        self.tier_inserts: dict[str, int] = {}  # per-tier insert_sync counts
 
     def start(self, workers: int = MAX_CONCURRENT_TRANSFERS) -> None:
         if not self._workers:
@@ -125,6 +126,60 @@ class OffloadManager:
             src.release(bid)
         self.completed += len(seq_hashes)
         return dst_ids
+
+    def insert_sync(
+        self,
+        tier,
+        data,
+        seq_hash: int,
+        token_count: int = 0,
+        *,
+        on_fully_evicted=None,
+    ) -> bool:
+        """Synchronously insert one serialized block into ``tier``, cascading
+        any LRU eviction the insertion causes one tier further down
+        (read-before-overwrite: the evicted block's bytes survive in storage
+        until the new write lands, so they are copied down FIRST).
+
+        This is the serving engine's path — it runs on the device thread,
+        where the async worker machinery above can't be awaited.  Returns
+        False when the tier (and thus the chain) cannot take the block;
+        ``on_fully_evicted`` fires for any hash the cascade pushed out of
+        the bottom tier (it no longer exists anywhere).
+        """
+        pool = self.pools[tier]
+        if pool.has_hash(seq_hash):
+            return True
+        captured: list[int] = []
+        prev_sink = pool.evict_sink
+        pool.evict_sink = captured.append
+        try:
+            bid = pool.allocate()
+        finally:
+            pool.evict_sink = prev_sink
+        if bid is None:
+            return False
+        nxt = None
+        if tier in self.tier_order:
+            idx = self.tier_order.index(tier)
+            if idx + 1 < len(self.tier_order):
+                nxt = self.tier_order[idx + 1]
+        for ev in captured:
+            # the evicted block's bytes still live at ``bid`` until the
+            # write below — copy them down-tier now or lose them
+            placed = nxt is not None and self.insert_sync(
+                nxt, pool.read([bid]), ev, on_fully_evicted=on_fully_evicted
+            )
+            if not placed and on_fully_evicted is not None:
+                on_fully_evicted(ev)
+        pool.write([bid], data)
+        pool.complete(bid, token_count)
+        pool.register(bid, seq_hash)
+        pool.release(bid)  # park in the inactive LRU, discoverable + evictable
+        self.completed += 1
+        key = tier.value if hasattr(tier, "value") else str(tier)
+        self.tier_inserts[key] = self.tier_inserts.get(key, 0) + 1
+        return True
 
     # -- workers ---------------------------------------------------------------
     async def _worker(self) -> None:

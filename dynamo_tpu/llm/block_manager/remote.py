@@ -224,13 +224,22 @@ def main() -> int:
     parser.add_argument("--shape", default="2,2,16,2,16",
                         help="block shape layers,kv,block_size,kv_heads,head_dim")
     parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--nbytes", type=int, default=None,
+                        help="serve RAW uint8 payload blocks of this size "
+                             "instead of structured --shape/--dtype blocks "
+                             "(what a serving engine's G4 tier mounts; the "
+                             "engine logs its block_nbytes at startup and "
+                             "errors with both sizes on mismatch)")
     parser.add_argument("--disk-path", default=None,
                         help="back the store with an SSD memmap instead of DRAM")
     args = parser.parse_args()
 
     configure_logging()
-    shape = tuple(int(x) for x in args.shape.split(","))
-    dtype = _resolve_dtype(args.dtype)
+    if args.nbytes:
+        shape, dtype = (args.nbytes,), np.dtype(np.uint8)
+    else:
+        shape = tuple(int(x) for x in args.shape.split(","))
+        dtype = _resolve_dtype(args.dtype)
     if args.disk_path:
         backing: Storage = DiskStorage(args.num_blocks, shape, dtype, path=args.disk_path)
     else:

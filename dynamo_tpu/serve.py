@@ -132,8 +132,13 @@ async def serve_worker(
         publishers = [kv_pub, metrics_pub]
         engine.start()
     elif engine_kind == "jax":
-        # publishers are wired before the engine so allocator events flow
-        engine = build_jax_engine(model_dir, mdc, **engine_overrides)
+        # publishers are wired before the engine so allocator events flow.
+        # Built off the event loop: weight loading takes seconds and a G4
+        # remote tier's mount does blocking TCP (RemoteStorage info RPC) —
+        # heartbeats/endpoints on this loop must keep running meanwhile.
+        engine = await asyncio.to_thread(
+            build_jax_engine, model_dir, mdc, **engine_overrides
+        )
         do_warmup = engine.wants_warmup
         service = await ep.serve(engine, stats_handler=engine.stats)
         kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)

@@ -192,3 +192,139 @@ async def test_engine_restores_through_disk_tier(tmp_path):
         assert stats["disk_restores_total"] >= 1, stats
     finally:
         engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# G4 remote tier (BlockStoreServer over DCN)
+# ---------------------------------------------------------------------------
+
+
+async def make_remote_store(nbytes: int, num_blocks: int = 8):
+    from dynamo_tpu.llm.block_manager.remote import BlockStoreServer
+    from dynamo_tpu.llm.block_manager.storage import HostStorage
+
+    server = BlockStoreServer(HostStorage(num_blocks, (nbytes,), np.uint8))
+    await server.start()
+    return server
+
+
+async def test_tier_cascade_reaches_remote(tmp_path):
+    """G2→G3→G4: blocks pushed off host AND disk land in the remote store;
+    read_pinned restores them over the wire; the evict observer only fires
+    when a hash falls off the BOTTOM tier (G4)."""
+    sample = _leaves()
+    nbytes = sum(v.nbytes for v in sample.values())
+    server = await make_remote_store(nbytes, num_blocks=1)
+    tier = None
+    try:
+        import functools
+        import asyncio as _aio
+        tier = await _aio.to_thread(functools.partial(HostOffloadTier,
+            1,
+            {k: v.shape for k, v in sample.items()},
+            {k: v.dtype for k, v in sample.items()},
+            disk_blocks=1, disk_path=tmp_path / "g3.blocks",
+            remote_addr=server.address,
+        ))
+        gone: list[int] = []
+        tier.evict_observer = gone.append
+        # production calls these from the engine's device thread; in this
+        # in-process test the blocking socket ops must hop off the event
+        # loop or they starve the server coroutine
+        import asyncio
+        await asyncio.to_thread(tier.put, 1, _leaves(1))   # host
+        await asyncio.to_thread(tier.put, 2, _leaves(2))   # 1 → disk
+        await asyncio.to_thread(tier.put, 3, _leaves(3))   # 2 → disk, 1 → REMOTE
+        assert gone == []
+        assert tier.has(1) and tier.has(2) and tier.has(3)
+        stats = tier.stats()
+        assert stats["remote_spills_total"] == 1, stats
+
+        # restore from G4 over the wire
+        assert tier.pin(1)
+        out = await asyncio.to_thread(tier.read_pinned, 1)
+        for name in sample:
+            np.testing.assert_array_equal(out[name], _leaves(1)[name])
+        assert tier.stats()["remote_restores_total"] == 1
+
+        # one more put pushes a hash off the bottom of the world
+        await asyncio.to_thread(tier.put, 4, _leaves(4))  # 3→disk, 2→remote evicting 1
+        assert gone == [1]
+        assert not tier.has(1)
+    finally:
+        if tier is not None:
+            tier.close()
+        await server.stop()
+
+
+async def test_engine_restores_through_remote_tier(tmp_path):
+    """VERDICT r3 #3 e2e: fill HBM+host+disk, evict to remote (G4), and a
+    prefix hit restores from G4 via config alone — the reference's
+    four-tier block-manager chain reached from serving
+    (lib/llm/src/block_manager.rs:68-81)."""
+    # engine cache leaves: one block's serialized size depends on the model;
+    # compute it the same way the engine does
+    probe = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                        prefill_buckets=(16,))
+    leaves = dict(probe.cache)
+    nbytes = sum(
+        int(np.prod((v.shape[0], *v.shape[2:]))) * v.dtype.itemsize
+        for v in leaves.values()
+    )
+    probe.stop()
+    server = await make_remote_store(nbytes, num_blocks=32)
+    engine = None
+    try:
+        import asyncio
+        import functools
+        # engine construction mounts the G4 store (blocking info RPC):
+        # off-loop, like serve.py's to_thread engine build
+        engine = await asyncio.to_thread(functools.partial(
+            make_engine,
+            num_blocks=6, max_batch_size=2, max_model_len=24,
+            host_offload_blocks=2, disk_offload_blocks=2,
+            disk_offload_path=str(tmp_path / "g3.blocks"),
+            remote_store_addr=server.address,
+            prefill_buckets=(16,),
+        ))
+        prompt_a = list(range(3, 15))
+        ref_a = greedy_reference(prompt_a, 2)
+        out_a, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a == ref_a
+        # churn: push A's blocks through host and disk into the remote store
+        for base in (40, 60, 80, 100):
+            await collect(
+                engine, request(list(range(base, base + 16)), max_tokens=2,
+                                ignore_eos=True)
+            )
+        stats = engine.stats()
+        assert stats["remote_spills_total"] >= 1, stats
+
+        out_a2, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a2 == ref_a
+        stats = engine.stats()
+        assert stats["remote_restores_total"] >= 1, stats
+    finally:
+        if engine is not None:
+            engine.stop()
+        await server.stop()
+
+
+def test_hot_prefix_repromotes_to_host(tmp_path):
+    """A hash that cascaded to disk must get a fresh HOST copy on its next
+    put (device re-eviction of a restored hot prefix) — dedupe is
+    host-tier-only, so hot content is never pinned to the slowest tier."""
+    tier = make_disk_tier(tmp_path, host_n=2, disk_n=4)
+    tier.put(1, _leaves(1))
+    tier.put(2, _leaves(2))
+    tier.put(3, _leaves(3))   # 1 spills to disk
+    assert tier.disk.has_hash(1) and not tier.pool.has_hash(1)
+    # hash 1 comes back (restored to device, then evicted again)
+    assert tier.put(1, _leaves(1))
+    assert tier.pool.has_hash(1), "hot prefix must be re-promoted to host"
+    # and reads prefer the host copy
+    assert tier.pin(1)
+    out = tier.read_pinned(1)
+    np.testing.assert_array_equal(out["k"], _leaves(1)["k"])
+    assert tier.stats()["host_restores_total"] == 1
+    assert tier.stats()["disk_restores_total"] == 0
