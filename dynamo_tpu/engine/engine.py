@@ -193,7 +193,12 @@ class JaxLlmEngine:
             self.buckets.append(self.max_len)
 
         self.mesh = None
-        if config.mesh is not None and config.mesh.total() > 1:
+        if config.mesh is not None and (
+            config.mesh.total() > 1 or config.mesh.device_offset
+        ):
+            # a 1-device mesh with a device_offset still matters: it pins
+            # this engine to a specific device partition (disagg with one
+            # chip per role) instead of silently landing on device 0
             self.mesh = make_mesh(config.mesh)
             # static-shape constraints: fail at init, not at first jit
             # trace mid-serving
@@ -1491,10 +1496,18 @@ class JaxLlmEngine:
                 # arrays (same-process transfer) pad on device — no host hop
                 def pad(leaf, incoming):
                     if isinstance(incoming, jax.Array):
-                        out = jnp.zeros(
-                            (leaf.shape[0], nb, *leaf.shape[2:]), incoming.dtype
-                        )
-                        return out.at[:, :n].set(incoming)
+                        if incoming.devices() <= leaf.devices():
+                            out = jnp.zeros(
+                                (leaf.shape[0], nb, *leaf.shape[2:]),
+                                incoming.dtype,
+                            )
+                            return out.at[:, :n].set(incoming)
+                        # same-process transfer from an engine on a
+                        # DIFFERENT device partition (disagg prefill mesh →
+                        # decode mesh): this engine owns placement, so hop
+                        # through host and let the jit place the result on
+                        # OUR devices
+                        incoming = jax.device_get(incoming)
                     incoming = np.asarray(incoming)
                     out = np.zeros((leaf.shape[0], nb, *leaf.shape[2:]), incoming.dtype)
                     out[:, :n] = incoming

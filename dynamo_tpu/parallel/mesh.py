@@ -28,6 +28,10 @@ class MeshConfig:
     ep: int = 1
     tp: int = 1
     sp: int = 1
+    # first device index this mesh claims — lets two engines in one process
+    # own DISJOINT partitions of the device set (disaggregated prefill and
+    # decode engines each on their own sub-mesh)
+    device_offset: int = 0
 
     def total(self) -> int:
         return self.dp * self.pp * self.ep * self.tp * self.sp
@@ -50,9 +54,12 @@ def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
     if config is None:
         config = MeshConfig(tp=len(devices))
     n = config.total()
-    if n > len(devices):
-        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    device_array = np.asarray(devices[:n]).reshape(
+    off = config.device_offset
+    if off + n > len(devices):
+        raise ValueError(
+            f"mesh needs devices [{off}, {off + n}), have {len(devices)}"
+        )
+    device_array = np.asarray(devices[off : off + n]).reshape(
         [config.axis_sizes()[a] for a in AXIS_ORDER]
     )
     return Mesh(device_array, AXIS_ORDER)

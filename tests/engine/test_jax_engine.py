@@ -583,3 +583,25 @@ async def test_soak_random_load_cancellations_preemption():
         assert len(tokens) == 3 and finish == FinishReason.LENGTH
     finally:
         engine.stop()
+
+
+async def test_single_device_mesh_offset_pins_device():
+    """MeshConfig(tp=1, device_offset=k) must actually pin the engine to
+    device k (disagg with one chip per role), not silently land on the
+    default device."""
+    import jax
+
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    engine = make_engine(mesh=MeshConfig(tp=1, device_offset=1))
+    try:
+        assert engine.mesh is not None
+        cache_devices = set().union(
+            *(leaf.devices() for leaf in jax.tree.leaves(dict(engine.cache)))
+        )
+        assert cache_devices == {jax.devices()[1]}, cache_devices
+        prompt = list(range(3, 11))
+        out, _ = await collect(engine, request(prompt, max_tokens=3, ignore_eos=True))
+        assert out == greedy_reference(prompt, 3)
+    finally:
+        engine.stop()
