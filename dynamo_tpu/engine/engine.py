@@ -58,6 +58,51 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger("engine")
 
 
+def _measured_attention_preference(device_kind: str | None = None) -> str | None:
+    """Consult a measured kernel-perf table (scripts/tpu_validate.py --bench
+    → KERNEL_PERF.json at the repo root, or DYN_KERNEL_PERF=path).
+
+    Returns "pallas" or "jax" when a REAL-hardware measurement for this
+    platform exists (interpret-mode tables are ignored: Mosaic interpret
+    timings say nothing about hardware; tables from a DIFFERENT TPU
+    generation are ignored too when ``device_kind`` is known), else None so
+    the caller keeps the static heuristic.  Decision: median pallas-vs-XLA
+    speedup across the measured paged-attention decode shapes.  The table
+    is purely advisory — any malformed content degrades to None, never to
+    a startup crash.
+    """
+    import json
+    import os
+    import statistics
+
+    path = os.environ.get("DYN_KERNEL_PERF") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "KERNEL_PERF.json",
+    )
+    try:
+        with open(path) as f:
+            table = json.load(f)
+        if table.get("interpret") or table.get("platform") != "tpu":
+            return None
+        if device_kind and table.get("device_kind") not in (None, device_kind):
+            logger.info(
+                "kernel-perf table is from %r, this chip is %r; ignoring",
+                table.get("device_kind"), device_kind,
+            )
+            return None
+        speedups = [
+            float(r["pallas_speedup"])
+            for r in table.get("rows", [])
+            if r.get("bench") == "paged_attention_decode"
+            and "pallas_speedup" in r
+        ]
+    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+        return None
+    if not speedups:
+        return None
+    return "pallas" if statistics.median(speedups) >= 1.0 else "jax"
+
+
 @dataclass
 class EngineConfig:
     model: LlamaConfig                 # any registered family's config
@@ -273,7 +318,22 @@ class JaxLlmEngine:
                 and getattr(cfg, "num_kv_heads", 0) % config.mesh.tp == 0
                 and getattr(cfg, "num_heads", 0) % config.mesh.tp == 0
             )
-            self.attention_impl = "pallas" if (backend == "tpu" and mesh_ok) else "jax"
+            if backend == "tpu" and mesh_ok:
+                # a real-hardware kernel-perf table (scripts/tpu_validate.py
+                # --bench) outranks the static pallas-on-TPU assumption
+                try:
+                    kind = jax.devices()[0].device_kind
+                except Exception:  # noqa: BLE001
+                    kind = None
+                measured = _measured_attention_preference(kind)
+                self.attention_impl = measured or "pallas"
+                if measured:
+                    logger.info(
+                        "attention_impl=auto resolved to %r from measured "
+                        "kernel-perf table", measured,
+                    )
+            else:
+                self.attention_impl = "jax"
         else:
             self.attention_impl = config.attention_impl
 

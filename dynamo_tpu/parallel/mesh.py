@@ -55,7 +55,7 @@ def make_mesh(config: MeshConfig | None = None, devices=None) -> Mesh:
         config = MeshConfig(tp=len(devices))
     n = config.total()
     off = config.device_offset
-    if off + n > len(devices):
+    if off < 0 or off + n > len(devices):
         raise ValueError(
             f"mesh needs devices [{off}, {off + n}), have {len(devices)}"
         )

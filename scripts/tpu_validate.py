@@ -7,6 +7,14 @@ seconds once compiles land.
 
 Usage:  python scripts/tpu_validate.py            # real device
         JAX_PLATFORMS=cpu python scripts/...      # CPU (interpret off)
+        python scripts/tpu_validate.py --bench [--out KERNEL_PERF.json]
+            # kernel microbenchmarks: Pallas paged attention vs the XLA
+            # gather fallback, gather_blocks vs fancy indexing — per-shape
+            # us/iter + effective GB/s, written as a kernel-perf table that
+            # the engine's attention_impl="auto" consults (engine.py).
+            # Off-TPU results are recorded with interpret=true and are
+            # NEVER consulted by the engine (Mosaic interpret-mode timings
+            # say nothing about hardware).
 """
 
 from __future__ import annotations
@@ -156,8 +164,171 @@ def _fp8():
     return {"rel": round(rel, 4)}
 
 
-def main() -> int:
+# ---------------------------------------------------------------------------
+# kernel microbenchmarks (--bench)
+# ---------------------------------------------------------------------------
+
+
+def _time_us(fn, *args, iters: int) -> float:
+    """Median-of-3 timing of ``iters`` back-to-back dispatches (one final
+    sync), after a warmup call that eats the compile."""
     import jax
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        samples.append((time.perf_counter() - t0) / iters)
+    return sorted(samples)[1] * 1e6
+
+
+def bench_attention(iters: int) -> list[dict]:
+    """Pallas paged-attention decode vs the XLA gather fallback — the
+    measurement behind engine.py's attention_impl="auto" choice."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.ops.attention import paged_decode_attention
+    from dynamo_tpu.ops.pallas import paged_attention_decode
+
+    rows = []
+    # (batch, ctx) — decode-regime shapes bracketing the headline geometry
+    # (ISL 3000, batch 16, 8B-class heads).  Interpret mode (off-TPU) runs
+    # a token small set: those timings are placeholders, never consulted.
+    shapes = ((2, 128),) if INTERPRET else ((4, 1024), (16, 1024), (16, 3072))
+    for batch, ctx in shapes:
+        kvh, d, bs = 8, 128, 16
+        nblocks_seq = (ctx + bs - 1) // bs
+        pool = batch * nblocks_seq + 8
+        rng = np.random.default_rng(0)
+        k = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jnp.bfloat16)
+        q = jnp.asarray(rng.standard_normal((batch, 32, d)), jnp.bfloat16)
+        tables = jnp.asarray(
+            rng.permutation(pool)[: batch * nblocks_seq].reshape(batch, nblocks_seq),
+            jnp.int32,
+        )
+        ctx_lens = jnp.full((batch,), ctx, jnp.int32)
+
+        pallas_fn = jax.jit(
+            lambda q, k, v, t, c: paged_attention_decode(
+                q, k, v, t, c, interpret=INTERPRET
+            )
+        )
+        xla_fn = jax.jit(paged_decode_attention)
+        us_p = _time_us(pallas_fn, q, k, v, tables, ctx_lens, iters=iters)
+        us_x = _time_us(xla_fn, q, k, v, tables, ctx_lens, iters=iters)
+        # effective bandwidth: every decode step streams the context's K+V
+        bytes_kv = 2 * batch * ctx * kvh * d * 2  # bf16
+        rows.append(
+            {
+                "bench": "paged_attention_decode",
+                "batch": batch,
+                "ctx": ctx,
+                "pallas_us": round(us_p, 1),
+                "xla_us": round(us_x, 1),
+                "pallas_gbps": round(bytes_kv / us_p / 1e3, 1),
+                "xla_gbps": round(bytes_kv / us_x / 1e3, 1),
+                "pallas_speedup": round(us_x / us_p, 3),
+            }
+        )
+    return rows
+
+
+def bench_block_copy(iters: int) -> list[dict]:
+    """gather_blocks (Pallas) vs XLA fancy indexing — the extract path of
+    KV transfer/offload (engine._jit_extract uses the XLA form today)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.ops.pallas import gather_blocks
+
+    rows = []
+    for n_gather in (4,) if INTERPRET else (16, 64, 256):
+        pool_n, bs, kvh, d = (64, 16, 8, 128) if INTERPRET else (2048, 16, 8, 128)
+        rng = np.random.default_rng(1)
+        pool = jnp.asarray(
+            rng.standard_normal((pool_n, bs, kvh, d)), jnp.bfloat16
+        )
+        ids = jnp.asarray(rng.permutation(pool_n)[:n_gather], jnp.int32)
+
+        pallas_fn = jax.jit(lambda p, i: gather_blocks(p, i, interpret=INTERPRET))
+        xla_fn = jax.jit(lambda p, i: p[i])
+        us_p = _time_us(pallas_fn, pool, ids, iters=iters)
+        us_x = _time_us(xla_fn, pool, ids, iters=iters)
+        bytes_moved = n_gather * bs * kvh * d * 2 * 2  # read + write, bf16
+        rows.append(
+            {
+                "bench": "gather_blocks",
+                "n_blocks": n_gather,
+                "pallas_us": round(us_p, 1),
+                "xla_us": round(us_x, 1),
+                "pallas_gbps": round(bytes_moved / us_p / 1e3, 1),
+                "xla_gbps": round(bytes_moved / us_x / 1e3, 1),
+                "pallas_speedup": round(us_x / us_p, 3),
+            }
+        )
+    return rows
+
+
+def run_bench(out_path: str | None) -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    global INTERPRET
+    INTERPRET = dev.platform != "tpu"
+    # interpret-mode Pallas is orders of magnitude slower than compiled
+    # XLA — keep iteration counts sane there; the numbers are labeled and
+    # never consulted for real decisions
+    iters = 2 if INTERPRET else 50
+    table = {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "interpret": INTERPRET,
+        "note": (
+            "interpret-mode timings: NOT hardware-representative; the "
+            "engine ignores this table" if INTERPRET else
+            "compiled on real hardware; attention_impl=auto consults this"
+        ),
+        "rows": [],
+    }
+    for fn in (bench_attention, bench_block_copy):
+        try:
+            rows = fn(iters)
+        except Exception as exc:  # noqa: BLE001 — independent benches
+            rows = [{"bench": fn.__name__, "ok": False,
+                     "error": f"{type(exc).__name__}: {exc}"[:300]}]
+        for row in rows:
+            print(json.dumps(row))
+            sys.stdout.flush()
+        table["rows"].extend(rows)
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(table, f, indent=2)
+        print(json.dumps({"wrote": out_path}))
+    return 0
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--bench", action="store_true",
+                        help="kernel microbenchmarks instead of validation")
+    parser.add_argument("--out", default=None,
+                        help="write the kernel-perf table JSON here")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.bench:
+        return run_bench(args.out)
 
     dev = jax.devices()[0]
     global INTERPRET

@@ -605,3 +605,64 @@ async def test_single_device_mesh_offset_pins_device():
         assert out == greedy_reference(prompt, 3)
     finally:
         engine.stop()
+
+
+def test_measured_attention_preference(monkeypatch, tmp_path):
+    """attention_impl=auto consults KERNEL_PERF.json: real-TPU tables
+    decide pallas-vs-jax by median measured speedup; interpret-mode and
+    foreign-platform tables are ignored."""
+    import json
+
+    from dynamo_tpu.engine.engine import _measured_attention_preference
+
+    def table(rows, platform="tpu", interpret=False):
+        p = tmp_path / "perf.json"
+        p.write_text(json.dumps(
+            {"platform": platform, "interpret": interpret, "rows": rows}
+        ))
+        monkeypatch.setenv("DYN_KERNEL_PERF", str(p))
+
+    row = lambda s: {"bench": "paged_attention_decode", "pallas_speedup": s}
+
+    table([row(1.4), row(2.1), row(0.9)])          # median 1.4 → pallas
+    assert _measured_attention_preference() == "pallas"
+    table([row(0.6), row(0.8), row(1.2)])          # median 0.8 → jax
+    assert _measured_attention_preference() == "jax"
+    table([row(2.0)], interpret=True)              # interpret → ignored
+    assert _measured_attention_preference() is None
+    table([row(2.0)], platform="cpu")              # wrong platform → ignored
+    assert _measured_attention_preference() is None
+    table([])                                      # no attention rows
+    assert _measured_attention_preference() is None
+    monkeypatch.setenv("DYN_KERNEL_PERF", str(tmp_path / "absent.json"))
+    assert _measured_attention_preference() is None
+
+
+def test_measured_attention_preference_robust(monkeypatch, tmp_path):
+    """The perf table is advisory: malformed content, wrong device kind,
+    and even-length row sets must never crash or mis-decide."""
+    import json
+
+    from dynamo_tpu.engine.engine import _measured_attention_preference
+
+    def table(rows, **extra):
+        p = tmp_path / "perf.json"
+        p.write_text(json.dumps({"platform": "tpu", "interpret": False,
+                                 "rows": rows, **extra}))
+        monkeypatch.setenv("DYN_KERNEL_PERF", str(p))
+
+    row = lambda s: {"bench": "paged_attention_decode", "pallas_speedup": s}
+
+    # true median on even-length lists: [0.4, 0.6, 1.05, 1.1] → 0.825 → jax
+    table([row(0.4), row(1.05), row(1.1), row(0.6)])
+    assert _measured_attention_preference() == "jax"
+    # malformed values degrade to None, never crash
+    table([row("not-a-number")])
+    assert _measured_attention_preference() is None
+    (tmp_path / "perf.json").write_text("[1, 2, 3]")  # not even a dict
+    assert _measured_attention_preference() is None
+    # different TPU generation → ignored when current kind is known
+    table([row(2.0)], device_kind="TPU v4")
+    assert _measured_attention_preference("TPU v5e") is None
+    assert _measured_attention_preference("TPU v4") == "pallas"
+    assert _measured_attention_preference() == "pallas"  # kind unknown: accept
