@@ -75,7 +75,8 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
     import os
     import statistics
 
-    path = os.environ.get("DYN_KERNEL_PERF") or os.path.join(
+    explicit = os.environ.get("DYN_KERNEL_PERF")
+    path = explicit or os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         "KERNEL_PERF.json",
     )
@@ -96,7 +97,15 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
             if r.get("bench") == "paged_attention_decode"
             and "pallas_speedup" in r
         ]
-    except (OSError, ValueError, TypeError, AttributeError, KeyError):
+    except (OSError, ValueError, TypeError, AttributeError, KeyError) as err:
+        if explicit:
+            # the operator EXPLICITLY pointed here — a typo'd path or a
+            # truncated file silently reverting to the static heuristic
+            # would look exactly like measured selection working
+            logger.warning(
+                "DYN_KERNEL_PERF=%s unusable (%s); falling back to the "
+                "static attention heuristic", explicit, err,
+            )
         return None
     if not speedups:
         return None
@@ -251,16 +260,17 @@ class JaxLlmEngine:
             if pp > 1:
                 others = {
                     a: getattr(config.mesh, a)
-                    for a in ("dp", "tp", "ep", "sp")
+                    for a in ("dp", "ep", "sp")
                     if getattr(config.mesh, a) > 1
                 }
                 if others:
-                    # the pipeline's shard_map specs carry only the pp axis;
-                    # composing with tp/ep would silently all-gather every
-                    # weight shard inside the stages
+                    # pp composes with tp (partial-manual shard_map: pp is
+                    # the manual stage axis, tp stays automatic inside each
+                    # stage — parallel/pipeline.py); dp/ep/sp composition
+                    # with the pipeline runner remains unimplemented
                     raise ValueError(
-                        f"pp={pp} must be the only >1 mesh axis for now "
-                        f"(got {others}); run tp/ep via GSPMD without pp"
+                        f"pp={pp} composes only with tp for now "
+                        f"(got {others}); run dp/ep/sp via GSPMD without pp"
                     )
                 if config.max_batch_size % pp:
                     raise ValueError(

@@ -102,6 +102,12 @@ def pipeline_layer_stack(
         return ys.reshape(batch, *x_full.shape[1:]), cache_local
 
     layer_spec = P(axis)
+    # PARTIAL-manual shard_map: only the pp axis is manual (explicit
+    # ppermute/psum between stages); every other mesh axis — tp in a
+    # pp×tp mesh — stays automatic, so tp-sharded stage weights keep their
+    # sharding inside the stage body and GSPMD inserts the tensor-parallel
+    # collectives there.  This is what composes pipeline stages WITH
+    # tensor-parallel weights instead of forcing pp to be the sole axis.
     fn = jax.shard_map(
         stage_fn,
         mesh=mesh,
@@ -112,6 +118,11 @@ def pipeline_layer_stack(
             jax.tree.map(lambda _: layer_spec, layer_cache),
         ),
         out_specs=(P(), jax.tree.map(lambda _: layer_spec, layer_cache)),
+        axis_names=frozenset({axis}),
         check_vma=False,
     )
-    return fn(x, aux, layer_params, layer_cache)
+    # always trace through jit: the eager impl path of a PARTIAL-manual
+    # shard_map trips an internal spec-unmatch check in jax 0.9 when
+    # microbatches != stages; under jit (how serving always runs — this is
+    # inlined into the engine's decode program) the same program is valid
+    return jax.jit(fn)(x, aux, layer_params, layer_cache)

@@ -666,3 +666,21 @@ def test_measured_attention_preference_robust(monkeypatch, tmp_path):
     assert _measured_attention_preference("TPU v5e") is None
     assert _measured_attention_preference("TPU v4") == "pallas"
     assert _measured_attention_preference() == "pallas"  # kind unknown: accept
+
+
+async def test_pp_tp_mesh_engine_matches_dense_reference():
+    """Serving through a pp=2 x tp=2 mesh: pipeline stages carry
+    tp-sharded weights (partial-manual shard_map — pp manual, tp auto
+    inside each stage) and greedy output is exactly the single-device
+    reference."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    engine = make_engine(mesh=MeshConfig(pp=2, tp=2), attention_impl="jax")
+    try:
+        assert engine.mesh.shape["pp"] == 2 and engine.mesh.shape["tp"] == 2
+        prompt = [5, 6, 7, 8, 9, 10]
+        tokens, finish = await collect(engine, request(prompt, max_tokens=6))
+        assert finish in (FinishReason.LENGTH, FinishReason.STOP)
+        assert tokens == greedy_reference(prompt, len(tokens))
+    finally:
+        engine.stop()
