@@ -80,11 +80,24 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         "KERNEL_PERF.json",
     )
+    def skip(why: str) -> None:
+        # the operator EXPLICITLY pointed here — silently reverting to the
+        # static heuristic would look exactly like measured selection
+        # working, so every rejection of an explicit table is loud
+        if explicit:
+            logger.warning(
+                "DYN_KERNEL_PERF=%s ignored (%s); using the static "
+                "attention heuristic", explicit, why,
+            )
+        return None
+
     try:
         with open(path) as f:
             table = json.load(f)
-        if table.get("interpret") or table.get("platform") != "tpu":
-            return None
+        if table.get("interpret"):
+            return skip("recorded in interpret mode")
+        if table.get("platform") != "tpu":
+            return skip(f"platform {table.get('platform')!r} is not tpu")
         if device_kind and table.get("device_kind") not in (None, device_kind):
             logger.info(
                 "kernel-perf table is from %r, this chip is %r; ignoring",
@@ -97,18 +110,10 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
             if r.get("bench") == "paged_attention_decode"
             and "pallas_speedup" in r
         ]
+        if not speedups:
+            return skip("no paged_attention_decode rows")
     except (OSError, ValueError, TypeError, AttributeError, KeyError) as err:
-        if explicit:
-            # the operator EXPLICITLY pointed here — a typo'd path or a
-            # truncated file silently reverting to the static heuristic
-            # would look exactly like measured selection working
-            logger.warning(
-                "DYN_KERNEL_PERF=%s unusable (%s); falling back to the "
-                "static attention heuristic", explicit, err,
-            )
-        return None
-    if not speedups:
-        return None
+        return skip(f"unusable: {err}")
     return "pallas" if statistics.median(speedups) >= 1.0 else "jax"
 
 

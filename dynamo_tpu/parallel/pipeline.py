@@ -124,5 +124,8 @@ def pipeline_layer_stack(
     # always trace through jit: the eager impl path of a PARTIAL-manual
     # shard_map trips an internal spec-unmatch check in jax 0.9 when
     # microbatches != stages; under jit (how serving always runs — this is
-    # inlined into the engine's decode program) the same program is valid
+    # inlined into the engine's decode program, no extra compile) the same
+    # program is valid.  NOTE for eager callers (tests, diagnostics): this
+    # wrapper is fresh per call, so each eager invocation re-traces — wrap
+    # your own jit around the model-level fn if you loop.
     return jax.jit(fn)(x, aux, layer_params, layer_cache)

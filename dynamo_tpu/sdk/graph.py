@@ -46,6 +46,9 @@ class ServiceConfig:
     workers: int = 1
     resources: dict[str, Any] = field(default_factory=dict)
     namespace: str = "dynamo"
+    # deploy-plane kind (deploy/crds.py COMPONENT_KINDS): how the builder
+    # renders this service into a DynamoComponentDeployment
+    component_type: str = "worker"
 
 
 @dataclass
@@ -76,13 +79,15 @@ class Depends:
 
 
 def service(name: str | None = None, *, workers: int = 1, resources: dict | None = None,
-            namespace: str = "dynamo") -> Callable[[type], type]:
+            namespace: str = "dynamo",
+            component_type: str = "worker") -> Callable[[type], type]:
     def wrap(cls: type) -> type:
         cls._dyn_service = ServiceConfig(
             name=name or cls.__name__.lower(),
             workers=workers,
             resources=resources or {},
             namespace=namespace,
+            component_type=component_type,
         )
         cls._dyn_endpoints = [
             EndpointDef(name=m._dyn_endpoint_name, method_name=attr)
@@ -112,6 +117,20 @@ def depends(target: type) -> Depends:
 def async_on_start(fn):
     fn._dyn_on_start = True
     return fn
+
+
+def resolve_entry(entry: str) -> type:
+    """``pkg.module:ClassName`` → the class object (shared by the runner
+    and the deploy-plane builder so the two paths cannot drift)."""
+    import importlib
+
+    module_name, _, qualname = entry.partition(":")
+    if not qualname:
+        raise ValueError(f"entry {entry!r} must look like module:ClassName")
+    obj = importlib.import_module(module_name)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
 
 
 def dependency_closure(entry: type) -> list[type]:

@@ -6,11 +6,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
-import importlib
 import signal
 
 from dynamo_tpu.runtime.distributed import DistributedRuntime
-from dynamo_tpu.sdk.graph import deploy_service
+from dynamo_tpu.sdk.graph import deploy_service, resolve_entry
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import configure_logging, get_logger
 
@@ -19,10 +18,7 @@ logger = get_logger("sdk.runner")
 
 async def amain(target: str, control_plane: str) -> int:
     configure_logging()
-    module_name, _, qualname = target.partition(":")
-    cls = importlib.import_module(module_name)
-    for part in qualname.split("."):
-        cls = getattr(cls, part)
+    cls = resolve_entry(target)
 
     runtime = await DistributedRuntime.create(RuntimeConfig(control_plane=control_plane))
     loop = asyncio.get_running_loop()

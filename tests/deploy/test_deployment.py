@@ -1,0 +1,101 @@
+"""Deployment plane e2e: SDK graph → built artifact → api-store → graph CR
+→ operator-reconciled manifests (VERDICT r3 #7; reference:
+deploy/sdk/src/dynamo/sdk/cli/deployment.py build/deploy pair)."""
+
+import asyncio
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from dynamo_tpu.deploy.api_store import ArtifactStore, make_app
+from dynamo_tpu.deploy.deployment import (
+    build_graph_manifest,
+    deploy_artifact,
+    fetch_artifact,
+    push_artifact,
+    resolve_entry,
+)
+from dynamo_tpu.deploy.operator import FakeKube, Operator
+from dynamo_tpu.sdk.graph import depends, endpoint, service
+
+
+@service(name="chat-worker", workers=3, resources={"tpu": 4})
+class Worker:
+    @endpoint()
+    async def generate(self, request, ctx):
+        yield {"ok": True}
+
+
+@service(name="chat-frontend", component_type="frontend")
+class Frontend:
+    worker = depends(Worker)
+
+    @endpoint()
+    async def generate(self, request, ctx):
+        yield {"ok": True}
+
+
+def test_build_graph_manifest_renders_closure():
+    manifest = build_graph_manifest(Frontend, name="chat", image="img:1")
+    services = manifest["spec"]["services"]
+    assert set(services) == {"chat-frontend", "chat-worker"}
+    worker = services["chat-worker"]
+    assert worker["replicas"] == 3
+    assert worker["componentType"] == "worker"
+    assert worker["resources"]["tpu"] == 4
+    assert worker["command"] == ["python", "-m", "dynamo_tpu.sdk.runner"]
+    assert worker["args"][0].endswith(":Worker")
+    assert services["chat-frontend"]["componentType"] == "frontend"
+    assert manifest["metadata"]["name"] == "chat"
+
+
+def test_resolve_entry_roundtrip():
+    cls = resolve_entry(f"{Frontend.__module__}:Frontend")
+    assert cls is Frontend
+    with pytest.raises(ValueError, match="module:ClassName"):
+        resolve_entry("no-colon-here")
+
+
+async def test_sdk_graph_to_reconciled_deployments(tmp_path):
+    """The whole path in one test: build the SDK graph, push to a LIVE
+    api-store, fetch the artifact, deploy it through FakeKube with the
+    operator running, and watch the operator render Deployments with the
+    @service replica counts."""
+    client = TestClient(TestServer(make_app(ArtifactStore(tmp_path))))
+    await client.start_server()
+    store_url = str(client.make_url("")).rstrip("/")
+    kube = FakeKube()
+    op = Operator(kube, resync_s=600)
+    op.start()
+    try:
+        manifest = build_graph_manifest(Frontend, name="chat", namespace="default")
+        await push_artifact(store_url, "chat", "v1", manifest)
+
+        record = await fetch_artifact(store_url, "chat", "v1")
+        assert record["manifest"]["metadata"]["name"] == "chat"
+        applied = await deploy_artifact(kube, record)
+        assert applied["metadata"]["name"] == "chat"
+
+        async def deployment(name):
+            for _ in range(200):
+                obj = kube.objects.get(("Deployment", "default", name))
+                if obj is not None:
+                    return obj
+                await asyncio.sleep(0.02)
+            raise AssertionError(f"operator never rendered Deployment {name}")
+
+        worker = await deployment("chat-chat-worker")
+        assert worker["spec"]["replicas"] == 3
+        tmpl = worker["spec"]["template"]["spec"]["containers"][0]
+        assert tmpl["command"] == ["python", "-m", "dynamo_tpu.sdk.runner"]
+        frontend = await deployment("chat-chat-frontend")
+        assert frontend["spec"]["replicas"] == 1
+        # the graph CR itself is in the store, status written by the operator
+        assert ("DynamoGraphDeployment", "default", "chat") in kube.objects
+
+        # missing artifact fails loudly
+        with pytest.raises(KeyError, match="absent:v9"):
+            await fetch_artifact(store_url, "absent", "v9")
+    finally:
+        await op.stop()
+        await client.close()
