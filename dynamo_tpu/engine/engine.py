@@ -191,15 +191,22 @@ class EngineConfig:
     # accepted drafts are nearly free tokens.  Verification is exact: a
     # lane emits beyond one token only while drafts match what plain
     # greedy decode would have produced (sampled/penalized lanes fall back
-    # to one token per step).  Incompatible with decode_steps > 1 and pp.
+    # to one token per step).  Composes with decode_steps > 1 (iterations
+    # without enough drafts run the fused multi-step program — measured in
+    # docs/SPEC_VS_FUSED.json); incompatible with pp.
     speculative: str | None = None
     spec_tokens: int = 4
     spec_ngram: int = 2
     # Minimum fraction of running lanes that must have a draft for the
     # w-wide verify program to run; below it, plain decode serves the step.
-    # Non-drafting lanes in a verify step still pay w× the logits/sampling
-    # work while emitting one token — one self-drafting chat request must
-    # not tax a whole mixed batch.
+    # Cost model (decode is weight-bandwidth-bound): one verify launch
+    # streams the weights ONCE (plus the w-wide logits/sampling tax) while
+    # a fused plain launch streams them decode_steps times — so a
+    # non-drafting lane advances ~1 token per weight stream under EITHER
+    # program, and choosing verify costs that lane only the w-wide
+    # logits/sampling overhead and per-launch dispatch, not a decode_steps×
+    # slowdown.  The fraction gate bounds exactly that overhead: one
+    # self-drafting chat request must not tax a whole mixed batch.
     spec_min_fraction: float = 0.25
 
     def resolved_max_len(self) -> int:
@@ -516,11 +523,13 @@ class JaxLlmEngine:
                     f"model family {config.model_family!r} has no verification "
                     "forward (speculative decoding unsupported)"
                 )
-            if config.decode_steps > 1:
-                raise ValueError(
-                    "speculative decoding is incompatible with decode_steps > 1 "
-                    "(the verify window already fuses multiple tokens per launch)"
-                )
+            # decode_steps > 1 COMPOSES with speculation: iterations where
+            # enough lanes drafted run the verify program (its window
+            # already fuses up to spec_tokens+1 tokens per launch); the
+            # rest — sampled/penalized lanes, draft misses — run the fused
+            # multi-step decode program instead of single-token launches.
+            # Measured on both regimes: scripts/spec_vs_fused.py →
+            # docs/SPEC_VS_FUSED.json.
             if config.mesh is not None and config.mesh.pp > 1:
                 raise ValueError("speculative decoding does not support pp meshes")
             if config.spec_tokens < 1:

@@ -192,12 +192,64 @@ def test_mixed_batch_sampled_and_greedy_lanes():
 
 
 def test_speculative_config_validation():
-    with pytest.raises(ValueError, match="decode_steps"):
-        _engine(speculative="ngram", decode_steps=4)
     with pytest.raises(ValueError, match="speculative"):
         _engine(speculative="medusa")
     with pytest.raises(ValueError, match="spec_ngram"):
         _engine(speculative="ngram", spec_ngram=0)
+
+
+def test_speculative_composes_with_fused_decode_greedy():
+    """spec × decode_steps>1: greedy output stays exactly the plain
+    single-step output, and drafts still accept (verify path runs on
+    drafting iterations, fused multi-step on the rest)."""
+    plain = _engine()
+    spec4 = _engine(speculative="ngram", spec_tokens=3, decode_steps=4)
+    try:
+        for prompt in (PATTERN, [5, 9, 13, 17, 21], list(range(30, 60))):
+            a = _generate(plain, prompt)
+            b = _generate(spec4, prompt)
+            assert a == b, f"spec×fused diverged on {prompt}: {a} vs {b}"
+        assert spec4.stats()["spec_accepted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec4.stop()
+
+
+def test_speculative_composes_with_fused_decode_sampled():
+    """A sampled request on a spec engine with decode_steps=4 takes the
+    FUSED plain path (no draft eligibility) and must match a plain
+    decode_steps=4 engine token-for-token under the same seed."""
+    plain4 = _engine(decode_steps=4)
+    spec4 = _engine(speculative="ngram", spec_tokens=3, decode_steps=4)
+    try:
+        kw = dict(temperature=0.8, seed=1234)
+        a = _generate(plain4, PATTERN, n=16, **kw)
+        b = _generate(spec4, PATTERN, n=16, **kw)
+        assert a == b
+        # no greedy lane → nothing drafted: the fused program served it
+        assert spec4.stats()["spec_drafted_tokens_total"] == 0
+    finally:
+        plain4.stop()
+        spec4.stop()
+
+
+def test_mixed_batch_with_fused_decode():
+    """Greedy drafting lane + seeded sampled lane, decode_steps=4: both
+    outputs match the plain single-step engine exactly."""
+    mixed = [
+        (PATTERN, SamplingOptions(use_greedy=True)),
+        ([40, 41, 42, 43, 44], SamplingOptions(temperature=0.8, seed=77)),
+    ]
+    plain = _engine()
+    spec4 = _engine(speculative="ngram", spec_tokens=3, decode_steps=4)
+    try:
+        a = _generate_pair(plain, mixed)
+        b = _generate_pair(spec4, mixed)
+        assert a == b
+        assert spec4.stats()["spec_drafted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec4.stop()
 
 
 @pytest.mark.parametrize(
