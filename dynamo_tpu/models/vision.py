@@ -133,6 +133,41 @@ def patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
     return x.reshape(b, gh * gw, patch * patch * c)
 
 
+def vit_encode_video(
+    params: dict,
+    cfg: VisionConfig,
+    frames: jnp.ndarray,
+    *,
+    temporal_pool: int = 2,
+) -> jnp.ndarray:
+    """[T, H, W, 3] video frames → [ceil(T/pool) * num_patches, projector_dim].
+
+    LLaVA-video-style: every frame runs the SAME ViT+projector as a batch
+    (one compiled program, frames on the batch axis — the MXU-friendly
+    form), then groups of ``temporal_pool`` consecutive frames mean-pool
+    per patch position to bound the token budget before the embeddings
+    splice into the text stream (reference: the multimodal video variants
+    under examples/multimodal/ — video frames → encode worker → embedding
+    transfer to the LLM worker)."""
+    if temporal_pool < 1:
+        raise ValueError(f"temporal_pool must be >= 1, got {temporal_pool}")
+    t = frames.shape[0]
+    per_frame = vit_encode(params, cfg, frames)  # [T, P, D]
+    if temporal_pool > 1:
+        pad = (-t) % temporal_pool
+        if pad:
+            # pad by repeating the last frame so partial tail groups pool
+            # over real content
+            per_frame = jnp.concatenate(
+                [per_frame, jnp.repeat(per_frame[-1:], pad, axis=0)], axis=0
+            )
+        groups = per_frame.reshape(
+            -1, temporal_pool, cfg.num_patches, cfg.projector_dim
+        )
+        per_frame = groups.mean(axis=1)
+    return per_frame.reshape(-1, cfg.projector_dim)
+
+
 def vit_encode(params: dict, cfg: VisionConfig, images: jnp.ndarray) -> jnp.ndarray:
     """[B, H, W, 3] images → [B, num_patches, projector_dim] embeddings."""
     b = images.shape[0]

@@ -21,7 +21,17 @@ import asyncio
 import jax
 import numpy as np
 
-from dynamo_tpu.models.vision import VisionConfig, init_vit_params, vit_encode
+from dynamo_tpu.models.vision import (
+    VisionConfig,
+    init_vit_params,
+    vit_encode,
+    vit_encode_video,
+)
+
+# wire-facing bound: temporal_pool is a jit STATIC argument, so each
+# distinct value compiles its own program — a clamp keeps a fuzzing client
+# from growing the compile cache without bound
+MAX_TEMPORAL_POOL = 8
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import configure_logging, get_logger
 
@@ -29,7 +39,7 @@ logger = get_logger("examples.multimodal")
 
 
 class JaxVisionEncoder:
-    """The encode worker's engine: images → projected patch embeddings."""
+    """The encode worker's engine: images/video → projected embeddings."""
 
     def __init__(self, cfg: VisionConfig, params=None, seed: int = 0):
         self.cfg = cfg
@@ -37,15 +47,47 @@ class JaxVisionEncoder:
             cfg, jax.random.PRNGKey(seed)
         )
         self._encode = jax.jit(lambda p, imgs: vit_encode(p, cfg, imgs))
+        self._encode_video = jax.jit(
+            lambda p, frames, temporal_pool: vit_encode_video(
+                p, cfg, frames, temporal_pool=temporal_pool
+            ),
+            static_argnames=("temporal_pool",),
+        )
 
     def encode(self, image: np.ndarray) -> np.ndarray:
         """[H, W, 3] float image → [num_patches, projector_dim] float32."""
         out = self._encode(self.params, jax.numpy.asarray(image[None], self.cfg.dtype))
         return np.asarray(out[0], np.float32)
 
+    def encode_video(self, frames: np.ndarray, *, temporal_pool: int = 2) -> np.ndarray:
+        """[T, H, W, 3] frames → [ceil(T/pool)*num_patches, dim] float32."""
+        if not 1 <= temporal_pool <= MAX_TEMPORAL_POOL:
+            raise ValueError(
+                f"temporal_pool must be in [1, {MAX_TEMPORAL_POOL}], "
+                f"got {temporal_pool}"
+            )
+        out = self._encode_video(
+            self.params, jax.numpy.asarray(frames, self.cfg.dtype), temporal_pool
+        )
+        return np.asarray(out, np.float32)
+
+    # async surface shared with components.RemoteEncoder (the LLM worker
+    # awaits the same methods whether encoding is in-process or remote)
+    async def aencode(self, image: np.ndarray) -> np.ndarray:
+        return await asyncio.to_thread(self.encode, np.asarray(image, np.float32))
+
+    async def aencode_video(
+        self, frames: np.ndarray, *, temporal_pool: int = 2
+    ) -> np.ndarray:
+        return await asyncio.to_thread(
+            lambda: self.encode_video(
+                np.asarray(frames, np.float32), temporal_pool=temporal_pool
+            )
+        )
+
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         image = np.asarray(request.data["image"], np.float32)
-        embeds = await asyncio.to_thread(self.encode, image)
+        embeds = await self.aencode(image)
 
         async def gen():
             yield {"embeds": embeds.tolist()}
@@ -54,27 +96,46 @@ class JaxVisionEncoder:
 
 
 class MultimodalEngine:
-    """AsyncEngine wrapper: routes image-carrying requests through the
-    encoder, text-only requests straight to the LLM engine."""
+    """AsyncEngine wrapper: image- and video-carrying requests go through
+    the encoder (in-process JaxVisionEncoder or a RemoteEncoder component),
+    text-only requests straight to the LLM engine."""
 
-    def __init__(self, llm_engine, encoder: JaxVisionEncoder):
+    def __init__(self, llm_engine, encoder):
         self.llm = llm_engine
         self.encoder = encoder
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         data = dict(request.data)
         image = data.pop("image", None)
+        video = data.pop("video", None)
+        temporal_pool = int(data.pop("video_temporal_pool", 2))
+        if image is not None and video is not None:
+            raise ValueError(
+                "request carries both 'image' and 'video'; attach one "
+                "modality per request"
+            )
+        if not 1 <= temporal_pool <= MAX_TEMPORAL_POOL:
+            raise ValueError(
+                f"video_temporal_pool must be in [1, {MAX_TEMPORAL_POOL}], "
+                f"got {temporal_pool}"
+            )
         inner = Context(data, request.ctx)
-        if image is None:
+        if image is None and video is None:
             return await self.llm.generate(inner)
-        embeds = await asyncio.to_thread(self.encoder.encode, np.asarray(image, np.float32))
+        if video is not None:
+            embeds = await self.encoder.aencode_video(
+                np.asarray(video, np.float32), temporal_pool=temporal_pool
+            )
+        else:
+            embeds = await self.encoder.aencode(np.asarray(image, np.float32))
         return await self.llm.generate_multimodal(inner, embeds)
 
     def stats(self) -> dict:
         return self.llm.stats()
 
 
-async def amain(model_dir: str) -> int:
+async def amain(model_dir: str, *, remote_encode: bool = False,
+                video: bool = False) -> int:
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
     from dynamo_tpu.llm.protocols.common import (
         Annotated,
@@ -94,34 +155,74 @@ async def amain(model_dir: str) -> int:
     vision_cfg = VisionConfig(
         **{**vision_cfg.__dict__, "projector_dim": llm.config.model.hidden_size}
     )
-    engine = MultimodalEngine(llm, JaxVisionEncoder(vision_cfg))
+    local_encoder = JaxVisionEncoder(vision_cfg)
 
-    rng = np.random.default_rng(0)
-    image = rng.random((vision_cfg.image_size, vision_cfg.image_size, 3), np.float32)
-    request = PreprocessedRequest(
-        token_ids=[5, 6, 7],
-        sampling=SamplingOptions(use_greedy=True),
-        stop=StopConditions(max_tokens=8),
-        eos_token_ids=[],
-    ).to_wire()
-    request["image"] = image.tolist()
-    stream = await engine.generate(Context(request))
-    tokens = []
-    async for item in stream:
-        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
-        if ann.data is not None:
-            tokens.extend(ann.data.token_ids)
-    print("generated (image-conditioned):", tokens)
-    llm.stop()
+    runtime = encode_service = remote = None
+    try:
+        if remote_encode:
+            # the reference's separate-encode-worker shape: the encoder
+            # serves its own component; the LLM side talks to it through
+            # the runtime
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            from dynamo_tpu.utils.config import RuntimeConfig
+            from examples.multimodal.components import (
+                RemoteEncoder,
+                serve_encode_worker,
+            )
+
+            runtime = await DistributedRuntime.create(
+                RuntimeConfig(control_plane="memory://mm-demo")
+            )
+            encode_service = await serve_encode_worker(runtime, local_encoder)
+            remote = await RemoteEncoder.connect(runtime)
+            engine = MultimodalEngine(llm, remote)
+        else:
+            engine = MultimodalEngine(llm, local_encoder)
+
+        rng = np.random.default_rng(0)
+        request = PreprocessedRequest(
+            token_ids=[5, 6, 7],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=8),
+            eos_token_ids=[],
+        ).to_wire()
+        size = vision_cfg.image_size
+        if video:
+            request["video"] = rng.random((4, size, size, 3), np.float32).tolist()
+        else:
+            request["image"] = rng.random((size, size, 3), np.float32).tolist()
+        stream = await engine.generate(Context(request))
+        tokens = []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None:
+                tokens.extend(ann.data.token_ids)
+        kind = "video" if video else "image"
+        via = "remote encode worker" if remote_encode else "in-process encoder"
+        print(f"generated ({kind}-conditioned, {via}):", tokens)
+    finally:
+        if remote is not None:
+            await remote.close()
+        if encode_service is not None:
+            await encode_service.shutdown(drain_timeout=2)
+        if runtime is not None:
+            await runtime.close()
+        llm.stop()
     return 0
 
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--model", default="tests/data/tiny-chat-model")
+    parser.add_argument("--remote-encode", action="store_true",
+                        help="serve the encoder as its own runtime component")
+    parser.add_argument("--video", action="store_true",
+                        help="condition on 4 video frames instead of one image")
     args = parser.parse_args()
     configure_logging()
-    return asyncio.run(amain(args.model))
+    return asyncio.run(
+        amain(args.model, remote_encode=args.remote_encode, video=args.video)
+    )
 
 
 if __name__ == "__main__":

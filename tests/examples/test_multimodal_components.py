@@ -1,0 +1,140 @@
+"""Encode worker as a separate runtime component (reference:
+examples/multimodal/components/encode_worker.py — a dedicated encode
+process shipping embeddings to the LLM worker by descriptor; here raw
+bytes over the runtime's data plane)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models.vision import VisionConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+from examples.multimodal.components import RemoteEncoder, serve_encode_worker
+from examples.multimodal.pipeline import JaxVisionEncoder, MultimodalEngine
+
+
+@pytest.fixture
+def encoder():
+    return JaxVisionEncoder(VisionConfig.tiny())
+
+
+async def _runtime():
+    MemoryControlPlane.reset_named()
+    return await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://mm-test")
+    )
+
+
+async def test_remote_encoder_matches_local_exactly(encoder):
+    """Embeddings surviving the bytes round trip through the encode worker
+    component must be BIT-identical to in-process encoding — the transfer
+    is a descriptor/copy, never a re-computation or lossy serialization."""
+    rt = await _runtime()
+    service = remote = None
+    try:
+        service = await serve_encode_worker(rt, encoder)
+        remote = await RemoteEncoder.connect(rt)
+        rng = np.random.default_rng(1)
+        size = encoder.cfg.image_size
+        image = rng.random((size, size, 3)).astype(np.float32)
+        np.testing.assert_array_equal(
+            await remote.aencode(image), await encoder.aencode(image)
+        )
+        frames = rng.random((4, size, size, 3)).astype(np.float32)
+        np.testing.assert_array_equal(
+            await remote.aencode_video(frames, temporal_pool=2),
+            await encoder.aencode_video(frames, temporal_pool=2),
+        )
+    finally:
+        if remote is not None:
+            await remote.close()
+        if service is not None:
+            await service.shutdown(drain_timeout=2)
+        await rt.close()
+
+
+async def test_multimodal_engine_with_remote_encoder(encoder):
+    """End-to-end: image and VIDEO requests served through the remote
+    encode worker produce exactly the tokens the in-process encoder
+    produces (same weights, same splice)."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.llama import LlamaConfig, init_params
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = LlamaConfig.tiny()
+    vcfg = VisionConfig(
+        **{**VisionConfig.tiny().__dict__, "projector_dim": cfg.hidden_size}
+    )
+    enc = JaxVisionEncoder(vcfg)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def make_llm():
+        e = JaxLlmEngine(
+            EngineConfig(model=cfg, num_blocks=64, block_size=4,
+                         max_batch_size=4, prefill_buckets=(32,),
+                         max_model_len=64),
+            params=jax.tree.map(np.copy, params),
+        )
+        e.start()
+        return e
+
+    async def drive(engine, payload_key, payload) -> list[int]:
+        req = PreprocessedRequest(
+            token_ids=[5, 6, 7],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+        req[payload_key] = payload
+        stream = await engine.generate(Context(req))
+        out = []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None:
+                assert ann.data.error is None, ann.data.error
+                out.extend(ann.data.token_ids)
+        return out
+
+    rng = np.random.default_rng(2)
+    size = vcfg.image_size
+    image = rng.random((size, size, 3)).astype(np.float32).tolist()
+    video = rng.random((4, size, size, 3)).astype(np.float32).tolist()
+
+    llm_local = make_llm()
+    try:
+        local = MultimodalEngine(llm_local, enc)
+        want_img = await drive(local, "image", image)
+        want_vid = await drive(local, "video", video)
+    finally:
+        llm_local.stop()
+
+    rt = await _runtime()
+    llm_remote = make_llm()
+    service = remote = None
+    try:
+        service = await serve_encode_worker(rt, enc)
+        remote = await RemoteEncoder.connect(rt)
+        eng = MultimodalEngine(llm_remote, remote)
+        assert await drive(eng, "image", image) == want_img
+        assert await drive(eng, "video", video) == want_vid
+        assert service.engine.encodes == 2
+    finally:
+        if remote is not None:
+            await remote.close()
+        if service is not None:
+            await service.shutdown(drain_timeout=2)
+        llm_remote.stop()
+        await rt.close()

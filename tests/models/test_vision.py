@@ -60,3 +60,41 @@ def test_from_hf_config_vision_section():
     # bare vision_config: caller supplies the LLM width
     cfg = VisionConfig.from_hf_config(vision_section, llm_hidden_size=320)
     assert cfg.projector_dim == 320
+
+
+def test_vit_encode_video_shapes_and_pooling():
+    """Video: frames batch through the same ViT; temporal_pool mean-pools
+    groups of consecutive frames per patch position."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.models.vision import (
+        VisionConfig,
+        init_vit_params,
+        vit_encode,
+        vit_encode_video,
+    )
+
+    cfg = VisionConfig.tiny()
+    params = init_vit_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    frames = rng.random((4, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+
+    out = np.asarray(vit_encode_video(params, cfg, frames, temporal_pool=2))
+    assert out.shape == (2 * cfg.num_patches, cfg.projector_dim)
+    # pooling groups average the per-frame encodings exactly
+    per_frame = np.asarray(vit_encode(params, cfg, frames))
+    expect = per_frame.reshape(2, 2, cfg.num_patches, cfg.projector_dim).mean(1)
+    np.testing.assert_allclose(
+        out, expect.reshape(-1, cfg.projector_dim), rtol=1e-5, atol=1e-5
+    )
+
+    # pool=1 is plain concatenation; odd frame counts pad with the last frame
+    flat = np.asarray(vit_encode_video(params, cfg, frames, temporal_pool=1))
+    assert flat.shape == (4 * cfg.num_patches, cfg.projector_dim)
+    odd = np.asarray(vit_encode_video(params, cfg, frames[:3], temporal_pool=2))
+    assert odd.shape == (2 * cfg.num_patches, cfg.projector_dim)
+    tail = per_frame[2]  # frames[2] pooled with its own repeat == itself
+    np.testing.assert_allclose(
+        odd[cfg.num_patches:], tail, rtol=1e-5, atol=1e-5
+    )
