@@ -58,6 +58,12 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger("engine")
 
 
+def _round_chunk_tokens(chunk_tokens: int, block_size: int) -> int:
+    """Chunk windows round UP to whole blocks (one definition: the sp
+    validation and the serving bucket must agree on the number)."""
+    return max(1, (chunk_tokens + block_size - 1) // block_size) * block_size
+
+
 def _measured_attention_preference(device_kind: str | None = None) -> str | None:
     """Consult a measured kernel-perf table (scripts/tpu_validate.py --bench
     → KERNEL_PERF.json at the repo root, or DYN_KERNEL_PERF=path).
@@ -296,30 +302,46 @@ class JaxLlmEngine:
                         f"pp axis ({pp}): layers split evenly into stages"
                     )
             sp = config.mesh.sp
-            if sp > 1:
-                # ring attention covers whole-prompt prefill only: the
-                # continued-prefill jit (chunked prefill, prefix hits) runs
-                # dense attention, so those modes must not silently bypass
-                # the sequence parallelism the mesh was configured for
+            if sp > 1 and not self.family.prefix_prefill_accepts_sp:
+                # this family's continued-prefill jit (chunked prefill,
+                # prefix hits) runs dense attention only: those modes must
+                # not silently bypass the sequence parallelism the mesh
+                # was configured for.  (llama-family composes: its prefix
+                # forward rings the tail and merges the resident prefix.)
                 if config.prefill_chunk_tokens is not None:
                     raise ValueError(
-                        "prefill_chunk_tokens is incompatible with an sp mesh: "
-                        "chunked prefill bypasses ring attention"
+                        "prefill_chunk_tokens is incompatible with an sp "
+                        f"mesh for family {config.model_family!r}: its "
+                        "continued-prefill path has no ring attention"
                     )
                 if config.enable_prefix_caching:
                     logger.warning(
-                        "sp mesh: disabling prefix caching (the continued-"
-                        "prefill path does not run ring attention)"
+                        "sp mesh: disabling prefix caching (family %r's "
+                        "continued-prefill path does not run ring attention)",
+                        config.model_family,
                     )
                     config = self.config = dataclasses.replace(
                         config, enable_prefix_caching=False
                     )
+            if sp > 1:
+                # every sp mesh (chunked or not) rings over padded bucket
+                # lengths — fail at construction, not at first jit trace
                 bad = [b for b in self.buckets if b % sp]
                 if bad:
                     raise ValueError(
                         f"prefill buckets {bad} not divisible by the sp axis "
                         f"({sp}): ring attention shards the sequence evenly"
                     )
+                if config.prefill_chunk_tokens is not None:
+                    rounded = _round_chunk_tokens(
+                        config.prefill_chunk_tokens, config.block_size
+                    )
+                    if rounded % sp:
+                        raise ValueError(
+                            f"prefill_chunk_tokens (block-rounded to {rounded}) "
+                            f"must be divisible by the sp axis ({sp}): chunk "
+                            "windows ring-shard the sequence evenly"
+                        )
 
         if config.attention_impl == "auto":
             # a wedged accelerator plugin must not crash engine construction
@@ -441,8 +463,9 @@ class JaxLlmEngine:
             config.prefill_chunk_tokens is not None
             and self.family.forward_prefill_with_prefix is not None
         ):
-            bs = config.block_size
-            self.chunk_tokens = max(1, (config.prefill_chunk_tokens + bs - 1) // bs) * bs
+            self.chunk_tokens = _round_chunk_tokens(
+                config.prefill_chunk_tokens, config.block_size
+            )
             # chunks run as their own compile bucket (otherwise every chunk
             # pads up to the next full-prompt bucket)
             if self.chunk_tokens < self.max_len:
@@ -644,13 +667,23 @@ class JaxLlmEngine:
         cfg = self.config.model
         topk_k = self.config.top_logprobs_k
 
+        # sequence parallelism: the tail rings over the sp axis with the
+        # resident prefix merged per shard (same gate as _build_prefill)
+        prefix_kwargs = {}
+        if (
+            self.mesh is not None
+            and self.mesh.shape.get("sp", 1) > 1
+            and self.family.prefix_prefill_accepts_sp
+        ):
+            prefix_kwargs["sp_mesh"] = self.mesh
+
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
                  prompt_row, gen_row, sample_gate, key, temp, top_k, top_p,
                  greedy, pres, freq, rep, bias_ids, bias_vals, cos, sin):
             logits, cache = self.family.forward_prefill_with_prefix(
                 params, cfg, token_ids, cache, full_block_ids, tail_block_ids,
-                tail_len, start_pos, cos, sin,
+                tail_len, start_pos, cos, sin, **prefix_kwargs,
             )
             prompt_counts = prompt_counts.at[lane].set(prompt_row)
             gen_counts = gen_counts.at[lane].set(gen_row)

@@ -351,15 +351,25 @@ def llama_forward_prefill_with_prefix(
     start_pos: jnp.ndarray,       # scalar int32: cached prefix length (block-aligned)
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    *,
+    sp_mesh=None,
 ) -> tuple[jnp.ndarray, dict]:
     """Continued prefill over a reused prefix: the tail's queries attend to
     the resident prefix KV (gathered from the paged cache) plus themselves,
     and only the tail's K/V are written.  Serves both prefix-cache hits and
     chunked prefill (reference intent: vLLM prefix caching / chunked
-    prefill; block reuse lib/llm/src/block_manager/pool.rs:447-466)."""
+    prefill; block reuse lib/llm/src/block_manager/pool.rs:447-466).
+
+    ``sp_mesh``: the tail attends via ring attention over the ``sp`` axis
+    while each shard merges the replicated resident prefix into its online
+    softmax (ops/ring_attention.ring_attention_with_prefix) — prefix
+    caching and chunked prefill compose with sequence parallelism."""
     s = token_ids.shape[0]
     x = params["embed"][token_ids].astype(cfg.dtype)
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+
+    if sp_mesh is not None:
+        from dynamo_tpu.ops.ring_attention import ring_attention_with_prefix
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in
@@ -371,9 +381,15 @@ def llama_forward_prefill_with_prefix(
         # the attention op drops everything past start_pos anyway)
         k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
         k_layer, v_layer = write_prefill_kv(k_layer, v_layer, k, v, tail_block_ids, tail_len)
-        attn = prefill_attention_with_prefix(
-            q, k, v, k_prefix, v_prefix, start_pos, tail_len
-        )
+        if sp_mesh is not None:
+            attn = ring_attention_with_prefix(
+                q[None], k[None], v[None], k_prefix[None], v_prefix[None],
+                start_pos, tail_len, sp_mesh,
+            )[0]
+        else:
+            attn = prefill_attention_with_prefix(
+                q, k, v, k_prefix, v_prefix, start_pos, tail_len
+            )
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])

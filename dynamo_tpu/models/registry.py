@@ -34,6 +34,10 @@ class ModelFamily:
     forward_prefill_embeds: Callable | None = None
     # forward_prefill accepts sp_mesh= (ring-attention sequence parallelism)
     supports_sp: bool = False
+    # forward_prefill_with_prefix accepts sp_mesh (ring attention over the
+    # tail + merged resident prefix) — what lets prefix caching and
+    # chunked prefill compose with a sequence-parallel mesh
+    prefix_prefill_accepts_sp: bool = False
     # pipelined decode over the pp mesh axis (parallel/pipeline.py)
     forward_decode_pp: Callable | None = None
     # HF safetensors loader: (cfg, model_dir) -> params pytree
@@ -106,6 +110,7 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
         forward_prefill_embeds=llama.llama_forward_prefill_embeds,
         supports_sp=True,
+        prefix_prefill_accepts_sp=True,
         forward_decode_pp=llama.llama_forward_decode_pp,
         load_weights=llama.load_hf_weights,
         decode_accepts_tp_mesh=True,

@@ -100,11 +100,105 @@ def _ring_body(q, k, v, seq_len, axis_name: str, num_chunks: int, chunk: int):
     return out.reshape(b, sq, h, d).astype(q.dtype)
 
 
+def _ring_body_with_prefix(
+    q, k, v, k_prefix, v_prefix, prefix_len, tail_len,
+    axis_name: str, num_chunks: int, chunk: int,
+):
+    """Per-device body: the tail ring PLUS one flash-merged pass over a
+    resident prefix (every valid prefix position is visible to every valid
+    tail query, so the prefix pass needs no rotation — each shard attends
+    the full replicated prefix once and merges it into the online
+    softmax)."""
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    groups = h // kvh
+    my_idx = jax.lax.axis_index(axis_name)
+
+    qf = q.reshape(b, sq, kvh, groups, d).astype(jnp.float32)
+    q_offset = my_idx * chunk
+    q_valid = q_offset + jnp.arange(sq) < tail_len
+
+    # prefix pass: absolute positions put every valid tail query after
+    # every valid prefix position, so the causal mask inside
+    # _chunk_attention reduces to the validity masks
+    p_valid = jnp.arange(k_prefix.shape[1]) < prefix_len
+    # no pcast needed: these derive from the sharded q (and axis_index),
+    # so they are already device-varying over the ring axis
+    m0, l0, acc0 = _chunk_attention(
+        qf,
+        k_prefix.astype(jnp.float32),
+        v_prefix.astype(jnp.float32),
+        q_offset=prefix_len + q_offset,
+        kv_offset=0,
+        q_valid=q_valid,
+        kv_valid=p_valid,
+    )
+    perm = [(i, (i + 1) % num_chunks) for i in range(num_chunks)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        kv_idx = (my_idx - i) % num_chunks
+        kv_offset = kv_idx * chunk
+        kv_valid = kv_offset + jnp.arange(k_cur.shape[1]) < tail_len
+        mc, lc, accc = _chunk_attention(
+            qf, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
+            q_offset, kv_offset, q_valid, kv_valid,
+        )
+        m, l, acc = _merge(m, l, acc, mc, lc, accc)
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(num_chunks)
+    )
+    out = acc / jnp.maximum(l, 1e-20)
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def ring_attention_with_prefix(
+    q: jnp.ndarray,         # [B, S, H, D] tail queries, S divisible by sp
+    k: jnp.ndarray,         # [B, S, KVH, D] tail keys
+    v: jnp.ndarray,
+    k_prefix: jnp.ndarray,  # [B, P, KVH, D] resident prefix (replicated)
+    v_prefix: jnp.ndarray,
+    prefix_len: jnp.ndarray,  # scalar int32: valid prefix tokens
+    tail_len: jnp.ndarray,    # scalar int32: valid tail tokens
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+) -> jnp.ndarray:
+    """Continued-prefill attention under sequence parallelism: the TAIL is
+    sharded over ``axis_name`` and runs the usual ring; the resident
+    prefix (gathered from the paged cache, replicated — it already fits as
+    KV pages) merges into each shard's online softmax in one extra pass.
+    This is what lets prefix caching and chunked prefill compose with an
+    sp mesh instead of disabling it."""
+    num_chunks = mesh.shape[axis_name]
+    s = q.shape[1]
+    if s % num_chunks:
+        raise ValueError(f"sequence {s} not divisible by {axis_name}={num_chunks}")
+    chunk = s // num_chunks
+    spec = P(None, axis_name, None, None)
+
+    body = functools.partial(
+        _ring_body_with_prefix,
+        axis_name=axis_name, num_chunks=num_chunks, chunk=chunk,
+    )
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(), P(), P(), P()),
+        out_specs=spec,
+    )
+    return fn(q, k, v, k_prefix, v_prefix, prefix_len, tail_len)
+
+
 def ring_attention(
     q: jnp.ndarray,   # [B, S, H, D], S divisible by sp size
     k: jnp.ndarray,   # [B, S, KVH, D]
     v: jnp.ndarray,
-    seq_len: jnp.ndarray,  # scalar int32 valid length (padding masked)
+    seq_len: jnp.ndarray,  # scalar int32 valid length (padding mask)
     mesh: Mesh,
     *,
     axis_name: str = "sp",

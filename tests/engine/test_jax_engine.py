@@ -392,17 +392,46 @@ async def test_pp_mesh_engine_matches_dense_reference():
 
 async def test_sp_mesh_engine_matches_dense_reference():
     """Serving through an sp=2 mesh: ring-attention prefill (sequence
-    sharded over sp) produces exactly the single-device greedy output, and
-    prefix caching auto-disables (the continued-prefill path has no ring)."""
+    sharded over sp) produces exactly the single-device greedy output —
+    and for the llama family prefix caching STAYS ON (the continued-
+    prefill path rings the tail and merges the resident prefix)."""
     from dynamo_tpu.parallel.mesh import MeshConfig
 
     engine = make_engine(mesh=MeshConfig(sp=2))
     try:
-        assert not engine.prefix_caching
+        assert engine.prefix_caching
         prompt = [5, 6, 7, 8, 9, 10]
         tokens, finish = await collect(engine, request(prompt, max_tokens=6))
         assert finish in (FinishReason.LENGTH, FinishReason.STOP)
         assert tokens == greedy_reference(prompt, len(tokens))
+    finally:
+        engine.stop()
+
+
+async def test_sp_mesh_prefix_hit_and_chunked_prefill_exact():
+    """sp × prefix caching × chunked prefill (the round-3 composition
+    hole): a repeated prompt must prefix-HIT (tail-only ring prefill with
+    the resident prefix merged) and long prompts must chunk — all
+    token-exact vs the single-device reference."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    engine = make_engine(
+        mesh=MeshConfig(sp=2), num_blocks=64, block_size=4,
+        prefill_buckets=(16, 32), max_model_len=64,
+        prefill_chunk_tokens=16,
+    )
+    try:
+        assert engine.prefix_caching
+        assert engine.chunk_tokens == 16
+        # long prompt: chunks of 16 through the ring'd continued-prefill
+        prompt = list(range(3, 3 + 24))
+        ref = greedy_reference(prompt, 4)
+        tokens, _ = await collect(engine, request(prompt, max_tokens=4, ignore_eos=True))
+        assert tokens == ref
+        # identical prompt again: block-aligned prefix resident → hit
+        tokens2, _ = await collect(engine, request(prompt, max_tokens=4, ignore_eos=True))
+        assert tokens2 == ref
+        assert engine.allocator.prefix_hits_total > 0
     finally:
         engine.stop()
 
@@ -536,7 +565,17 @@ def test_embedding_engine_rope_tables_sliced_and_passed_as_args():
 
 
 @pytest.mark.slow
-async def test_soak_random_load_cancellations_preemption():
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {},
+        # the newly-composable mode: speculative drafting + fused
+        # multi-step decode under preemption/cancellation churn
+        {"speculative": "ngram", "spec_tokens": 3, "decode_steps": 4},
+    ],
+    ids=["plain", "spec_fused"],
+)
+async def test_soak_random_load_cancellations_preemption(extra):
     """Engine soak: 48 requests with random lengths and budgets, a third
     cancelled mid-stream, over a KV pool far too small for the offered
     load (constant preemption + recompute).  Afterwards: zero leaked
@@ -545,7 +584,7 @@ async def test_soak_random_load_cancellations_preemption():
 
     engine = make_engine(
         num_blocks=24, block_size=4, max_batch_size=4,
-        prefill_buckets=(16, 64), max_model_len=64,
+        prefill_buckets=(16, 64), max_model_len=64, **extra,
     )
     try:
         async def one(i: int) -> int:
@@ -684,3 +723,19 @@ async def test_pp_tp_mesh_engine_matches_dense_reference():
         assert tokens == greedy_reference(prompt, len(tokens))
     finally:
         engine.stop()
+
+
+def test_sp_mesh_rejects_bad_buckets_at_construction():
+    """sp bucket divisibility fails at engine construction (fail-fast
+    config validation), never as a mid-serving jit trace error."""
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    with pytest.raises(ValueError, match="not divisible by the sp axis"):
+        JaxLlmEngine(
+            EngineConfig(
+                model=CFG, num_blocks=32, block_size=4, max_batch_size=2,
+                prefill_buckets=(16, 33), max_model_len=33,
+                mesh=MeshConfig(sp=2),
+            ),
+            params=PARAMS,
+        )

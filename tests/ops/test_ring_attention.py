@@ -97,3 +97,46 @@ def test_llama_prefill_with_sp_mesh_matches_dense():
         np.testing.assert_allclose(
             np.asarray(cache_sp[key]), np.asarray(cache_ref[key]), rtol=1e-5, atol=1e-5
         )
+
+
+def test_ring_with_prefix_matches_dense_prefix():
+    """ring_attention_with_prefix (tail ring + merged resident prefix) must
+    match the dense continued-prefill attention, including padding in both
+    the prefix buffer and the tail."""
+    from dynamo_tpu.ops.attention import prefill_attention_with_prefix
+    from dynamo_tpu.ops.ring_attention import ring_attention_with_prefix
+
+    mesh = make_mesh(MeshConfig(sp=4), devices=jax.devices()[:4])
+    rng = jax.random.PRNGKey(7)
+    s, h, kvh, d = 16, 4, 2, 8
+    prefix_pad, prefix_len, tail_len = 24, 13, 11
+    keys = jax.random.split(rng, 5)
+    q = jax.random.normal(keys[0], (s, h, d), jnp.float32)
+    k = jax.random.normal(keys[1], (s, kvh, d), jnp.float32)
+    v = jax.random.normal(keys[2], (s, kvh, d), jnp.float32)
+    kp = jax.random.normal(keys[3], (prefix_pad, kvh, d), jnp.float32)
+    vp = jax.random.normal(keys[4], (prefix_pad, kvh, d), jnp.float32)
+
+    ref = prefill_attention_with_prefix(
+        q, k, v, kp, vp, jnp.int32(prefix_len), jnp.int32(tail_len)
+    )
+    out = ring_attention_with_prefix(
+        q[None], k[None], v[None], kp[None], vp[None],
+        jnp.int32(prefix_len), jnp.int32(tail_len), mesh,
+    )[0]
+    # valid tail rows must match; padded rows are don't-care
+    np.testing.assert_allclose(
+        np.asarray(out)[:tail_len], np.asarray(ref)[:tail_len],
+        rtol=2e-5, atol=2e-5,
+    )
+
+    # zero-length prefix degenerates to plain ring/causal attention
+    ref0 = dense_causal_attention(q[None], k[None], v[None], jnp.asarray([tail_len]))[0]
+    out0 = ring_attention_with_prefix(
+        q[None], k[None], v[None], kp[None], vp[None],
+        jnp.int32(0), jnp.int32(tail_len), mesh,
+    )[0]
+    np.testing.assert_allclose(
+        np.asarray(out0)[:tail_len], np.asarray(ref0)[:tail_len],
+        rtol=2e-5, atol=2e-5,
+    )
