@@ -284,11 +284,17 @@ class JaxLlmEngine:
                 if others:
                     # pp composes with tp (partial-manual shard_map: pp is
                     # the manual stage axis, tp stays automatic inside each
-                    # stage — parallel/pipeline.py); dp/ep/sp composition
-                    # with the pipeline runner remains unimplemented
+                    # stage — parallel/pipeline.py, and the engine's jits
+                    # shard weights/cache over tp).  The engine never
+                    # shards its decode batch over dp — data parallelism in
+                    # this architecture is worker REPLICATION behind the
+                    # router (like the reference) — so a dp axis on an
+                    # engine mesh would silently replicate compute, and
+                    # ep/sp×pp are unimplemented in the pipeline runner.
                     raise ValueError(
                         f"pp={pp} composes only with tp for now "
-                        f"(got {others}); run dp/ep/sp via GSPMD without pp"
+                        f"(got {others}); use router-level worker "
+                        "replication for dp, and GSPMD without pp for ep/sp"
                     )
                 if config.max_batch_size % pp:
                     raise ValueError(

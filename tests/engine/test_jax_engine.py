@@ -739,3 +739,4 @@ def test_sp_mesh_rejects_bad_buckets_at_construction():
             ),
             params=PARAMS,
         )
+
