@@ -308,6 +308,11 @@ class JaxLlmEngine:
                         f"pp axis ({pp}): layers split evenly into stages"
                     )
             sp = config.mesh.sp
+            if sp > 1 and getattr(cfg, "sliding_window", None):
+                raise ValueError(
+                    "sliding-window attention is incompatible with an sp "
+                    "mesh: the ring path has no window mask yet"
+                )
             if sp > 1 and not self.family.prefix_prefill_accepts_sp:
                 # this family's continued-prefill jit (chunked prefill,
                 # prefix hits) runs dense attention only: those modes must
@@ -561,6 +566,11 @@ class JaxLlmEngine:
             # docs/SPEC_VS_FUSED.json.
             if config.mesh is not None and config.mesh.pp > 1:
                 raise ValueError("speculative decoding does not support pp meshes")
+            if getattr(cfg, "sliding_window", None):
+                raise ValueError(
+                    "speculative decoding is incompatible with sliding-window "
+                    "attention: the verify window has no window mask yet"
+                )
             if config.spec_tokens < 1:
                 raise ValueError("spec_tokens must be >= 1")
             if config.spec_ngram < 1:

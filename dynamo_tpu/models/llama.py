@@ -60,6 +60,11 @@ class LlamaConfig:
     qk_norm: bool = False
     # HF rope_scaling dict: "linear" | "llama3" | "yarn" (ops/rope.py)
     rope_scaling: Any = None
+    # Mistral-style sliding-window attention: each token attends at most
+    # the last `sliding_window` positions (None = full attention).  v1
+    # keeps all KV blocks resident (correctness first); freeing blocks
+    # that scrolled out of the window is a future memory optimization.
+    sliding_window: int | None = None
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -82,6 +87,14 @@ class LlamaConfig:
             attention_bias=config.get("attention_bias", False),
             qk_norm=config.get("qk_norm", config.get("model_type") == "qwen3"),
             rope_scaling=config.get("rope_scaling"),
+            # qwen2-family checkpoints ship sliding_window alongside
+            # use_sliding_window: false — only honor the window when HF
+            # transformers would (otherwise full attention + Pallas kernel)
+            sliding_window=(
+                (config.get("sliding_window") or None)
+                if config.get("use_sliding_window", True)
+                else None
+            ),
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or
@@ -250,7 +263,10 @@ def llama_forward_trunk(
         q, k, v = _qkv(attn_in, w, cfg)
         q = apply_rope(q, positions, cos, sin)
         k = apply_rope(k, positions, cos, sin)
-        attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+        attn = dense_causal_attention(
+            q[None], k[None], v[None], seq_len[None],
+            sliding_window=cfg.sliding_window,
+        )[0]
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
@@ -325,7 +341,10 @@ def llama_forward_prefill_embeds(
         if sp_mesh is not None:
             attn = ring_attention(q[None], k[None], v[None], seq_len, sp_mesh)[0]
         else:
-            attn = dense_causal_attention(q[None], k[None], v[None], seq_len[None])[0]
+            attn = dense_causal_attention(
+                q[None], k[None], v[None], seq_len[None],
+                sliding_window=cfg.sliding_window,
+            )[0]
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
@@ -388,7 +407,8 @@ def llama_forward_prefill_with_prefix(
             )[0]
         else:
             attn = prefill_attention_with_prefix(
-                q, k, v, k_prefix, v_prefix, start_pos, tail_len
+                q, k, v, k_prefix, v_prefix, start_pos, tail_len,
+                sliding_window=cfg.sliding_window,
             )
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
@@ -432,6 +452,13 @@ def llama_forward_decode(
     positions = jnp.maximum(context_lens - 1, 0)      # this token's position
 
     def attend(q, k_layer, v_layer):
+        if cfg.sliding_window is not None:
+            # the Pallas kernel has no window mask yet: sliding-window
+            # models take the gather path regardless of `attention`
+            return paged_decode_attention(
+                q, k_layer, v_layer, block_tables, context_lens,
+                sliding_window=cfg.sliding_window,
+            )
         if attention.startswith("pallas"):
             from dynamo_tpu.ops.pallas import paged_attention_decode
 
@@ -570,7 +597,10 @@ def llama_forward_decode_pp(
         q = apply_rope(q[:, None], pos_mb[:, None], cos, sin)[:, 0]
         k = apply_rope(k[:, None], pos_mb[:, None], cos, sin)[:, 0]
         k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slots_mb)
-        attn = paged_decode_attention(q, k_layer, v_layer, tables_mb, lens_mb)
+        attn = paged_decode_attention(
+            q, k_layer, v_layer, tables_mb, lens_mb,
+            sliding_window=cfg.sliding_window,
+        )
         x_mb = x_mb + mm(attn.reshape(x_mb.shape[0], -1), w["wo"])
         mlp_in = rms_norm(x_mb, w["mlp_norm"], cfg.rms_norm_eps)
         x_mb = x_mb + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])

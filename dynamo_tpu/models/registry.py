@@ -199,6 +199,10 @@ def _deepseek_family() -> ModelFamily:
 
 _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "llama": _llama_family,
+    # Mistral = llama geometry + sliding-window attention; the window comes
+    # from config.json's sliding_window and threads through the llama
+    # forwards (models/llama.py)
+    "mistral": _llama_family,
     "qwen2": _qwen2_family,
     "qwen3": _qwen3_family,
     "mixtral": _mixtral_family,

@@ -61,6 +61,8 @@ def dense_causal_attention(
     k: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
     v: jnp.ndarray,
     seq_len: jnp.ndarray | None = None,  # [batch] valid lengths (padding mask)
+    *,
+    sliding_window: int | None = None,   # Mistral-style: attend the last W only
 ) -> jnp.ndarray:
     """Causal self-attention for prefill (GQA-aware, fp32 softmax)."""
     b, s, h, d = q.shape
@@ -72,6 +74,9 @@ def dense_causal_attention(
     logits = logits * scale
     pos = jnp.arange(s)
     causal = pos[None, :] <= pos[:, None]  # [q, s]
+    if sliding_window is not None:
+        # each query sees only the last `sliding_window` positions
+        causal = causal & (pos[:, None] - pos[None, :] < sliding_window)
     mask = causal[None, None, None, :, :]
     if seq_len is not None:
         valid = pos[None, :] < seq_len[:, None]  # [b, s]
@@ -88,6 +93,8 @@ def paged_decode_attention(
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # [batch, max_blocks] int32
     context_lens: jnp.ndarray,  # [batch] int32 (0 ⇒ inactive lane)
+    *,
+    sliding_window: int | None = None,  # attend only the last W positions
 ) -> jnp.ndarray:
     """Decode-step attention: gather each sequence's pages and attend.
 
@@ -108,7 +115,11 @@ def paged_decode_attention(
     qg = q.reshape(b, kvh, groups, d).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(jnp.float32(d))
     logits = jnp.einsum("bkgd,blkd->bkgl", qg, k.astype(jnp.float32)) * scale
-    valid = jnp.arange(length)[None, :] < context_lens[:, None]  # [b, l]
+    pos = jnp.arange(length)[None, :]
+    valid = pos < context_lens[:, None]  # [b, l]
+    if sliding_window is not None:
+        # the query sits at position ctx-1; it sees [ctx-W, ctx)
+        valid = valid & (pos >= context_lens[:, None] - sliding_window)
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     # fully-masked (inactive) lanes produce uniform weights; output is junk
@@ -203,6 +214,8 @@ def prefill_attention_with_prefix(
     v_prefix: jnp.ndarray,
     prefix_len: jnp.ndarray,  # scalar: valid prefix tokens
     seq_len: jnp.ndarray,     # scalar: valid new tokens
+    *,
+    sliding_window: int | None = None,  # attend only the last W positions
 ) -> jnp.ndarray:
     """Chunked/continued prefill: queries attend to reused prefix + themselves."""
     s, h, d = q.shape
@@ -224,7 +237,12 @@ def prefill_attention_with_prefix(
     q_pos = prefix_len + jnp.arange(s)
     kv_pos = jnp.arange(p + s)
     kv_valid = (kv_pos < prefix_len) | ((kv_pos >= p) & (kv_pos - p < seq_len))
-    causal = kv_pos[None, :] - jnp.where(kv_pos[None, :] >= p, p - prefix_len, 0) <= q_pos[:, None]
+    # absolute kv position: prefix entries sit at their own index, tail
+    # entries at prefix_len + (index - p)
+    kv_abs = kv_pos - jnp.where(kv_pos >= p, p - prefix_len, 0)
+    causal = kv_abs[None, :] <= q_pos[:, None]
+    if sliding_window is not None:
+        causal = causal & (q_pos[:, None] - kv_abs[None, :] < sliding_window)
     mask = causal & kv_valid[None, :]
     logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
