@@ -452,13 +452,6 @@ def llama_forward_decode(
     positions = jnp.maximum(context_lens - 1, 0)      # this token's position
 
     def attend(q, k_layer, v_layer):
-        if cfg.sliding_window is not None:
-            # the Pallas kernel has no window mask yet: sliding-window
-            # models take the gather path regardless of `attention`
-            return paged_decode_attention(
-                q, k_layer, v_layer, block_tables, context_lens,
-                sliding_window=cfg.sliding_window,
-            )
         if attention.startswith("pallas"):
             from dynamo_tpu.ops.pallas import paged_attention_decode
 
@@ -466,7 +459,8 @@ def llama_forward_decode(
             if tp_mesh is not None and tp_mesh.shape.get("tp", 1) > 1:
                 kernel = jax.shard_map(
                     lambda q_, k_, v_, bt, cl: paged_attention_decode(
-                        q_, k_, v_, bt, cl, interpret=interpret
+                        q_, k_, v_, bt, cl, interpret=interpret,
+                        sliding_window=cfg.sliding_window,
                     ),
                     mesh=tp_mesh,
                     in_specs=(
@@ -482,9 +476,12 @@ def llama_forward_decode(
                 return kernel(q, k_layer, v_layer, block_tables, context_lens)
             return paged_attention_decode(
                 q, k_layer, v_layer, block_tables, context_lens,
-                interpret=interpret,
+                interpret=interpret, sliding_window=cfg.sliding_window,
             )
-        return paged_decode_attention(q, k_layer, v_layer, block_tables, context_lens)
+        return paged_decode_attention(
+            q, k_layer, v_layer, block_tables, context_lens,
+            sliding_window=cfg.sliding_window,
+        )
 
     def layer(x, layer_in):
         w, k_layer, v_layer = layer_in

@@ -48,10 +48,12 @@ def _window_kernel(
     head_dim: int,
     max_blocks: int,
     window: int,
+    sliding_window: int | None,
 ):
     """Online-softmax page loop over flat [bs*KVH, D] pages.  The W window
     queries (W=1 for plain decode) fold into the row axis; each query row
-    masks to its own absolute position."""
+    masks to its own absolute position.  ``sliding_window`` (Mistral-style)
+    additionally drops positions more than W_s-1 behind each query."""
     seq = pl.program_id(0)
     page = pl.program_id(1)
     ctx = context_lens_ref[seq]
@@ -67,7 +69,15 @@ def _window_kernel(
 
     page_start = page * block_size
 
-    @pl.when(page_start < ctx)
+    active = page_start < ctx
+    if sliding_window is not None:
+        # pages entirely below every query's window contribute nothing —
+        # skip their compute (their DMA is also deduped: the index_map
+        # clamps them to the first in-window page).  Lowest visible
+        # absolute position = (ctx - window) - (sliding_window - 1).
+        active &= page_start + block_size > ctx - window - (sliding_window - 1)
+
+    @pl.when(active)
     def _compute():
         q = q_ref[0].astype(jnp.float32)        # [W*H, D]
         k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
@@ -85,6 +95,8 @@ def _window_kernel(
         kv_of_row = (row % h_all) // groups
         q_pos = ctx - window + row // h_all              # [W*H, 1]
         mask = (kv_of_col == kv_of_row) & (pos <= q_pos)
+        if sliding_window is not None:
+            mask = mask & (pos > q_pos - sliding_window)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:, :1]
@@ -108,7 +120,7 @@ def _window_kernel(
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def paged_window_attention_decode(
     q: jnp.ndarray,            # [B, W, H, D]
     k_cache: jnp.ndarray,      # [N, bs, KVH, D]
@@ -117,6 +129,7 @@ def paged_window_attention_decode(
     context_lens: jnp.ndarray,  # [B] int32 — INCLUDING the window's last token
     *,
     interpret: bool = False,
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     """Pallas multi-query paged attention for speculative verification
     (pure-JAX twin: ops/attention.py paged_window_attention)."""
@@ -127,13 +140,25 @@ def paged_window_attention_decode(
     rows = bs * kvh
     wh = w * h
 
+    if sliding_window is None:
+        def kv_map(s, p, bt, cl):
+            return (bt[s, p], 0, 0)
+    else:
+        def kv_map(s, p, bt, cl):
+            # clamp below-window pages to the first in-window page: the
+            # pipeline then re-fetches the same block instead of streaming
+            # pages whose compute is skipped
+            lowest = cl[s] - w - (sliding_window - 1)
+            p_min = jnp.maximum(lowest, 0) // bs
+            return (bt[s, jnp.maximum(p, p_min)], 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, maxb),
         in_specs=[
             pl.BlockSpec((1, wh, d), lambda s, p, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, rows, d), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
-            pl.BlockSpec((1, rows, d), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+            pl.BlockSpec((1, rows, d), kv_map),
+            pl.BlockSpec((1, rows, d), kv_map),
         ],
         out_specs=pl.BlockSpec((1, wh, d), lambda s, p, bt, cl: (s, 0, 0)),
         scratch_shapes=[
@@ -150,6 +175,7 @@ def paged_window_attention_decode(
         head_dim=d,
         max_blocks=maxb,
         window=w,
+        sliding_window=sliding_window,
     )
     out = pl.pallas_call(
         kernel,
@@ -165,7 +191,7 @@ def paged_window_attention_decode(
     return out.reshape(b, w, h, d)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "sliding_window"))
 def paged_attention_decode(
     q: jnp.ndarray,            # [B, H, D]
     k_cache: jnp.ndarray,      # [N, bs, KVH, D]
@@ -174,10 +200,11 @@ def paged_attention_decode(
     context_lens: jnp.ndarray,  # [B] int32
     *,
     interpret: bool = False,
+    sliding_window: int | None = None,
 ) -> jnp.ndarray:
     # plain decode is the window kernel at W=1: `pos <= ctx - 1` ≡ `pos < ctx`
     out = paged_window_attention_decode(
         q[:, None], k_cache, v_cache, block_tables, context_lens,
-        interpret=interpret,
+        interpret=interpret, sliding_window=sliding_window,
     )
     return out[:, 0]

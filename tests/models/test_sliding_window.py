@@ -214,3 +214,17 @@ def test_qwen2_use_sliding_window_false_is_full_attention():
         "sliding_window": 32768, "use_sliding_window": False,
     })
     assert cfg.sliding_window is None
+
+
+async def test_engine_sliding_window_pallas_kernel():
+    """The Pallas decode kernel's window mask (interpret on CPU) serves the
+    windowed model with exactly the windowed reference output."""
+    engine = make_engine(attention_impl="pallas_interpret", block_size=8,
+                         num_blocks=32)
+    try:
+        prompt = list(range(3, 17))
+        ref = windowed_greedy_reference(prompt, 4)
+        tokens, _ = await collect(engine, request(prompt, max_tokens=4))
+        assert tokens == ref
+    finally:
+        engine.stop()

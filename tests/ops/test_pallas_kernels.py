@@ -253,3 +253,27 @@ def test_mla_window_attention_kernel_matches_reference():
         np.testing.assert_allclose(
             np.asarray(out[:, i]), np.asarray(ref), rtol=2e-5, atol=2e-5
         )
+
+
+def test_paged_attention_sliding_window_matches_fallback():
+    """Pallas decode kernel with a sliding window (interpret mode) must
+    match the XLA gather fallback's windowed mask exactly."""
+    rng = np.random.default_rng(11)
+    k = jnp.asarray(rng.standard_normal((8, 8, 2, 128)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((8, 8, 2, 128)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((2, 8, 128)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, 8, (2, 4)), jnp.int32)
+    ctx = jnp.asarray([29, 13], jnp.int32)
+    for w in (4, 16):
+        out = np.asarray(paged_attention_decode(
+            q, k, v, tables, ctx, interpret=True, sliding_window=w,
+        ))
+        ref = np.asarray(paged_decode_attention(
+            q, k, v, tables, ctx, sliding_window=w,
+        ))
+        rel = np.linalg.norm(out - ref) / max(np.linalg.norm(ref), 1e-9)
+        assert rel < 1e-5, (w, rel)
+    # and the windowed result must differ from full attention (mask live)
+    full = np.asarray(paged_decode_attention(q, k, v, tables, ctx))
+    win = np.asarray(paged_decode_attention(q, k, v, tables, ctx, sliding_window=4))
+    assert not np.allclose(full, win)
