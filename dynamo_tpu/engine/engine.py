@@ -274,6 +274,20 @@ class JaxLlmEngine:
             self.mesh = make_mesh(config.mesh)
             # static-shape constraints: fail at init, not at first jit
             # trace mid-serving
+            if config.mesh.dp > 1:
+                # data parallelism in this architecture is worker
+                # REPLICATION behind the (KV-aware) router, like the
+                # reference — the engine's jits never shard their batch
+                # over dp, so a dp axis on an engine mesh would silently
+                # replicate compute on every dp shard.  The dp axis exists
+                # for model-level callers only (pipeline_layer_stack, the
+                # dryrun).
+                raise ValueError(
+                    f"dp={config.mesh.dp} is not an engine mesh axis: "
+                    "scale decode throughput by replicating workers behind "
+                    "the router (components/router_service.py), not by "
+                    "adding dp to one engine's mesh"
+                )
             pp = config.mesh.pp
             if pp > 1:
                 others = {

@@ -90,11 +90,37 @@ class LlamaConfig:
             # qwen2-family checkpoints ship sliding_window alongside
             # use_sliding_window: false — only honor the window when HF
             # transformers would (otherwise full attention + Pallas kernel)
-            sliding_window=(
-                (config.get("sliding_window") or None)
-                if config.get("use_sliding_window", True)
-                else None
-            ),
+            sliding_window=cls._resolve_sliding_window(config),
+        )
+
+    @staticmethod
+    def _resolve_sliding_window(config: dict) -> int | None:
+        """Match HF transformers' per-layer window semantics, uniformly.
+
+        qwen2-family configs pair ``sliding_window`` with
+        ``use_sliding_window`` and ``max_window_layers``: layers with index
+        >= max_window_layers use the window, layers below it use full
+        attention.  This model applies ONE attention pattern to every layer
+        (the layer body is a single ``lax.scan``), so:
+        - use_sliding_window false, or max_window_layers >= num layers
+          (no layer windowed): full attention everywhere;
+        - max_window_layers <= 0 (every layer windowed), or the key absent
+          (mistral-style configs window every layer): uniform window;
+        - a genuine mixed split: refuse loudly rather than compute wrong
+          logits on the full-attention layers.
+        """
+        window = config.get("sliding_window") or None
+        if window is None or not config.get("use_sliding_window", True):
+            return None
+        mwl = config.get("max_window_layers")
+        if mwl is None or mwl <= 0:
+            return window
+        if mwl >= config["num_hidden_layers"]:
+            return None
+        raise NotImplementedError(
+            f"per-layer sliding-window split (max_window_layers={mwl} < "
+            f"num_hidden_layers={config['num_hidden_layers']}) is not "
+            "supported: every layer shares one attention pattern"
         )
 
     # --- presets (geometries for serving + bench; weights are loaded or
@@ -329,6 +355,14 @@ def llama_forward_prefill_embeds(
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
 
     if sp_mesh is not None:
+        if cfg.sliding_window is not None:
+            # ring attention has no sliding-window mask: shards would
+            # silently compute full attention (the engine fences this too,
+            # but direct model-level callers deserve the same guard)
+            raise NotImplementedError(
+                "sequence parallelism does not compose with sliding-window "
+                "attention: ring attention computes the full causal mask"
+            )
         from dynamo_tpu.ops.ring_attention import ring_attention
 
     def layer(x, layer_in):
@@ -388,6 +422,11 @@ def llama_forward_prefill_with_prefix(
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
 
     if sp_mesh is not None:
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                "sequence parallelism does not compose with sliding-window "
+                "attention: ring attention computes the full causal mask"
+            )
         from dynamo_tpu.ops.ring_attention import ring_attention_with_prefix
 
     def layer(x, layer_in):

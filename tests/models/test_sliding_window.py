@@ -216,6 +216,72 @@ def test_qwen2_use_sliding_window_false_is_full_attention():
     assert cfg.sliding_window is None
 
 
+def test_qwen2_max_window_layers_semantics():
+    """HF qwen2 windows only layers >= max_window_layers.  Uniform cases map
+    cleanly; a genuine per-layer split must refuse, not mis-mask."""
+    base = {
+        "model_type": "qwen2", "vocab_size": 32000, "hidden_size": 64,
+        "intermediate_size": 128, "num_hidden_layers": 4,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "sliding_window": 1024, "use_sliding_window": True,
+    }
+    # no layer windowed → full attention
+    assert LlamaConfig.from_hf_config({**base, "max_window_layers": 4}).sliding_window is None
+    assert LlamaConfig.from_hf_config({**base, "max_window_layers": 9}).sliding_window is None
+    # every layer windowed → uniform window
+    assert LlamaConfig.from_hf_config({**base, "max_window_layers": 0}).sliding_window == 1024
+    # key absent (mistral-style) → uniform window
+    assert LlamaConfig.from_hf_config(base).sliding_window == 1024
+    # mixed split → loud refusal
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        LlamaConfig.from_hf_config({**base, "max_window_layers": 2})
+
+
+def test_sliding_window_rejects_sequence_parallel_mesh():
+    """ring attention has no window mask — the model-level forwards fence
+    sp×sliding-window themselves (not only the engine)."""
+    import jax.numpy as jnp
+    from dynamo_tpu.models.llama import (
+        init_kv_cache, init_params, llama_forward_prefill,
+        llama_forward_prefill_with_prefix, make_rope_tables,
+    )
+
+    cfg = LlamaConfig.tiny()
+    cfg = LlamaConfig(**{**cfg.__dict__, "sliding_window": 8})
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cache = init_kv_cache(cfg, 8, 4)
+    cos, sin = make_rope_tables(cfg)
+    ids = jnp.zeros((8,), jnp.int32)
+    blocks = jnp.arange(4, dtype=jnp.int32)
+
+    class FakeMesh:  # the guard must fire before the mesh is touched
+        pass
+
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        llama_forward_prefill(
+            params, cfg, ids, cache, blocks, jnp.int32(8), jnp.int32(0),
+            cos, sin, sp_mesh=FakeMesh(),
+        )
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        llama_forward_prefill_with_prefix(
+            params, cfg, ids, cache, blocks, blocks, jnp.int32(8),
+            jnp.int32(0), cos, sin, sp_mesh=FakeMesh(),
+        )
+
+
+def test_engine_rejects_dp_mesh_axis():
+    """dp is worker replication behind the router, never an engine mesh
+    axis — the engine must reject dp>1 at init unconditionally."""
+    from dynamo_tpu.engine.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    with pytest.raises(ValueError, match="dp=2"):
+        JaxLlmEngine(EngineConfig(
+            model=LlamaConfig.tiny(), model_family="llama",
+            mesh=MeshConfig(dp=2),
+        ))
+
+
 async def test_engine_sliding_window_pallas_kernel():
     """The Pallas decode kernel's window mask (interpret on CPU) serves the
     windowed model with exactly the windowed reference output."""
