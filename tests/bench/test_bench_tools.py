@@ -134,6 +134,52 @@ def test_bench_rejects_bad_aot_parallel_env(monkeypatch):
         asyncio.run(bench.run_bench())
 
 
+def test_bench_measured_peak_flops_fills_mfu_denominator():
+    """MFU must never be null for want of a spec sheet: off-TPU the
+    denominator is a measured matmul peak, and it must be positive."""
+    import jax.numpy as jnp
+
+    bench = _load_bench("bench_peak")
+    peak = bench._measured_peak_flops(jnp.float32)
+    assert peak is not None and peak > 0
+
+
+def test_bench_finalize_reheadlines_cpu_fallback():
+    """On CPU fallback the headline becomes the device-independent routing
+    score vs the reference's 3x claim; TPU results pass through."""
+    bench = _load_bench("bench_finalize")
+    cpu = {
+        "metric": "decode_tok_s_per_chip", "value": 12.3,
+        "unit": "tok/s/chip", "vs_baseline": 0.085,
+        "detail": {
+            "cpu_fallback": True,
+            "kv_routing": {"ttft_p50_speedup": 2.9, "vs_baseline": 0.967},
+        },
+    }
+    out = bench._finalize_result(cpu)
+    assert out["metric"] == "kv_routing_ttft_p50_speedup"
+    assert out["value"] == 2.9 and out["vs_baseline"] == 0.967
+    assert out["detail"]["cpu_decode_tok_s"] == 12.3
+
+    tpu = {
+        "metric": "decode_tok_s_per_chip", "value": 150.0,
+        "unit": "tok/s/chip", "vs_baseline": 1.034,
+        "detail": {"cpu_fallback": False, "kv_routing": {"vs_baseline": 1.0}},
+    }
+    assert bench._finalize_result(tpu) is tpu
+
+    # CPU fallback AND the routing microbench failed: the toy tok/s must
+    # not keep a scored-looking ratio against the H100 number
+    no_routing = {
+        "metric": "decode_tok_s_per_chip", "value": 12.3,
+        "unit": "tok/s/chip", "vs_baseline": 0.085,
+        "detail": {"cpu_fallback": True},
+    }
+    out = bench._finalize_result(no_routing)
+    assert out["vs_baseline"] == 0.0
+    assert "unscored" in out["detail"]["vs_baseline_basis"]
+
+
 class _FakeRelay:
     """Local TCP listener reproducing the three relay behaviors bench.py's
     bring-up probe distinguishes (round-3 postmortem: 'accepts-then-closes'
