@@ -209,14 +209,25 @@ async def deploy_inprocess(entry: type, runtime) -> dict[type, list]:
     return handles
 
 
-def to_process_specs(entry: type, *, control_plane: str, python=None) -> list:
-    """One ProcessSpec per service for the supervisor (subprocess mode)."""
+def to_process_specs(
+    entry: type, *, control_plane: str, python=None, chip_inventory=None,
+) -> list:
+    """One ProcessSpec per service for the supervisor (subprocess mode).
+
+    Services declaring ``resources={"tpu": n}`` get per-replica
+    ``TPU_VISIBLE_CHIPS`` overlays from the resource allocator
+    (sdk/allocator.py) so replicas claim disjoint chips; ``chip_inventory``
+    overrides host detection (tests, explicit topologies).  Spec replica
+    targets come from the @service ``workers`` count."""
     import sys
 
+    from dynamo_tpu.sdk.allocator import plan_resource_envs
     from dynamo_tpu.sdk.supervisor import ProcessSpec
 
+    closure = dependency_closure(entry)
+    chip_envs = plan_resource_envs(closure, inventory=chip_inventory)
     specs = []
-    for cls in dependency_closure(entry):
+    for cls in closure:
         config: ServiceConfig = cls._dyn_service
         specs.append(
             ProcessSpec(
@@ -226,6 +237,8 @@ def to_process_specs(entry: type, *, control_plane: str, python=None) -> list:
                     f"{cls.__module__}:{cls.__qualname__}",
                     "--control-plane", control_plane,
                 ],
+                replica_env=chip_envs.get(config.name, []),
+                replicas=config.workers,
             )
         )
     return specs

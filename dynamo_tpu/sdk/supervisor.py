@@ -25,6 +25,14 @@ class ProcessSpec:
     name: str
     cmd: list[str]                      # argv; {replica} substituted
     env: dict[str, str] = field(default_factory=dict)
+    # per-replica env overlays on top of ``env`` (index → vars) — how the
+    # TPU allocator pins each replica to its disjoint chip set
+    # (sdk/allocator.py; reference allocator.py's per-worker
+    # CUDA_VISIBLE_DEVICES list).  A replica index past the list's end gets
+    # no overlay; a restart of replica i re-applies overlay i, so the
+    # restarted process reclaims the SAME chips.
+    replica_env: list[dict[str, str]] = field(default_factory=list)
+    replicas: int = 1                   # default target for add_watcher
     cwd: str | None = None
     restart: bool = True
     max_restarts: int = 5
@@ -47,10 +55,10 @@ class ProcessSupervisor:
         self._monitor: asyncio.Task | None = None
         self._stopping = False
 
-    def add_watcher(self, spec: ProcessSpec, replicas: int = 1) -> None:
+    def add_watcher(self, spec: ProcessSpec, replicas: int | None = None) -> None:
         self._specs[spec.name] = spec
         self._replicas.setdefault(spec.name, {})
-        self._targets[spec.name] = replicas
+        self._targets[spec.name] = spec.replicas if replicas is None else replicas
 
     async def start(self) -> None:
         self._stopping = False
@@ -95,6 +103,19 @@ class ProcessSupervisor:
         cmd = [arg.replace("{replica}", str(index)) for arg in spec.cmd]
         env = dict(os.environ)
         env.update(spec.env)
+        if spec.replica_env:
+            if index >= len(spec.replica_env):
+                # scaling past the planned overlays would spawn a replica
+                # seeing the WHOLE chip inventory — exactly the libtpu
+                # claim collision the allocator exists to prevent.  Fail
+                # the scale-up loudly; re-plan with more workers (or set
+                # DYN_DISABLE_AUTO_TPU_ALLOCATION=1) to go further.
+                raise RuntimeError(
+                    f"{spec.name}[{index}]: no chip-env overlay planned for "
+                    f"this replica ({len(spec.replica_env)} were allocated); "
+                    "re-plan the deployment with more workers"
+                )
+            env.update(spec.replica_env[index])
         env["DYN_REPLICA_INDEX"] = str(index)
         process = await asyncio.create_subprocess_exec(
             *cmd, env=env, cwd=spec.cwd,
