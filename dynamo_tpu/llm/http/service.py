@@ -67,10 +67,37 @@ class ModelManager:
         )
 
 
-def _error(status: int, message: str, err_type: str = "invalid_request_error") -> web.Response:
+def _error(
+    status: int,
+    message: str,
+    err_type: str = "invalid_request_error",
+    *,
+    param: str | None = None,
+    code: str | None = None,
+) -> web.Response:
+    """Structured OpenAI-shaped error body: ``{"error": {message, type,
+    param, code}}`` with ``param`` naming the offending field and ``code``
+    a machine-readable string (the reference returns the same typed shape,
+    lib/llm/src/http/service/error.rs)."""
     return web.json_response(
-        {"error": {"message": message, "type": err_type, "code": status}}, status=status
+        {"error": {"message": message, "type": err_type, "param": param, "code": code}},
+        status=status,
     )
+
+
+def _validation_error(exc: Exception) -> web.Response:
+    """Pydantic ValidationError → 400 with the first violation's field as
+    ``param`` (contract-tested in tests/llm/test_protocol_validation.py)."""
+    try:
+        first = exc.errors()[0]
+        loc = [str(p) for p in first.get("loc", ()) if not isinstance(p, int)]
+        # union branches show up as synthetic loc tails (e.g. "str",
+        # "list[str]") — keep the leading concrete field path
+        param = loc[0] if loc else None
+        message = f"{'.'.join(loc) or 'request'}: {first.get('msg', 'invalid')}"
+    except (AttributeError, IndexError, TypeError):
+        param, message = None, f"invalid request: {exc}"
+    return _error(400, message, param=param, code="invalid_value")
 
 
 class HttpService:
@@ -147,16 +174,23 @@ class HttpService:
             body = await request.json()
             if self.request_template is not None:
                 body = self.request_template.apply(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request body: {exc}", code="invalid_json")
+        try:
             chat_request = ChatCompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
-            return _error(400, f"invalid request: {exc}")
+            return _validation_error(exc)
         if chat_request.top_logprobs and not chat_request.logprobs:
-            return _error(400, "top_logprobs requires logprobs=true")
-        if chat_request.top_logprobs and chat_request.top_logprobs > 20:
-            return _error(400, "top_logprobs must be <= 20")
+            return _error(
+                400, "top_logprobs requires logprobs=true", param="top_logprobs",
+                code="invalid_value",
+            )
         engine = self.manager.chat_engines.get(chat_request.model)
         if engine is None:
-            return _error(404, f"model '{chat_request.model}' not found", "model_not_found")
+            return _error(
+                404, f"model '{chat_request.model}' not found",
+                param="model", code="model_not_found",
+            )
 
         guard = self.metrics.guard(chat_request.model, "chat_completions", "stream" if chat_request.stream else "unary")
         if not chat_request.stream:
@@ -190,26 +224,30 @@ class HttpService:
             body = await request.json()
             if self.request_template is not None:
                 body = self.request_template.apply(body)
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request body: {exc}", code="invalid_json")
+        try:
             completion_request = CompletionRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
-            return _error(400, f"invalid request: {exc}")
-        if completion_request.logprobs is not None and completion_request.logprobs > 5:
-            return _error(400, "logprobs must be <= 5")
+            return _validation_error(exc)
         if completion_request.echo:
             # echo prepends the prompt to the completion text (OpenAI
             # completions semantics); supported for unary string prompts
             if completion_request.stream:
-                return _error(400, "echo is not supported with stream")
+                return _error(400, "echo is not supported with stream", param="echo")
             if not isinstance(completion_request.prompt, str):
-                return _error(400, "echo requires a string prompt")
+                return _error(400, "echo requires a string prompt", param="echo")
             if completion_request.logprobs:
                 # prompt-token logprobs are not computed, and prepending the
                 # prompt would desync text_offset; reject rather than return
                 # silently-wrong scoring data
-                return _error(400, "echo is not supported with logprobs")
+                return _error(400, "echo is not supported with logprobs", param="echo")
         engine = self.manager.completion_engines.get(completion_request.model)
         if engine is None:
-            return _error(404, f"model '{completion_request.model}' not found", "model_not_found")
+            return _error(
+                404, f"model '{completion_request.model}' not found",
+                param="model", code="model_not_found",
+            )
 
         guard = self.metrics.guard(
             completion_request.model, "completions", "stream" if completion_request.stream else "unary"
@@ -245,12 +283,18 @@ class HttpService:
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
+        except Exception as exc:  # noqa: BLE001
+            return _error(400, f"invalid request body: {exc}", code="invalid_json")
+        try:
             embedding_request = EmbeddingRequest.model_validate(body)
         except Exception as exc:  # noqa: BLE001
-            return _error(400, f"invalid request: {exc}")
+            return _validation_error(exc)
         engine = self.manager.embedding_engines.get(embedding_request.model)
         if engine is None:
-            return _error(404, f"model '{embedding_request.model}' not found", "model_not_found")
+            return _error(
+                404, f"model '{embedding_request.model}' not found",
+                param="model", code="model_not_found",
+            )
         guard = self.metrics.guard(embedding_request.model, "embeddings", "unary")
         try:
             try:

@@ -63,7 +63,12 @@ class PromptFormatter:
         return self._template.render(
             messages=messages,
             add_generation_prompt=True,
-            tools=request.tools,
+            # HF chat templates index tools as dicts ({{ tool['function'] }});
+            # the typed ToolDef models dump back to the wire shape
+            tools=(
+                [t.model_dump(exclude_none=True) for t in request.tools]
+                if request.tools else None
+            ),
         )
 
 

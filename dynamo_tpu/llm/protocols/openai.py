@@ -12,7 +12,7 @@ import time
 import uuid
 from typing import Any, Literal, Union
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, field_validator
 
 from dynamo_tpu.llm.protocols.common import (
     FinishReason,
@@ -38,6 +38,92 @@ class ContentPart(BaseModel):
     image_url: dict[str, Any] | None = None
 
 
+class FunctionDef(BaseModel):
+    """A callable tool's schema (OpenAI function-calling surface)."""
+
+    model_config = ConfigDict(extra="allow")
+    name: str
+    description: str | None = None
+    parameters: dict[str, Any] | None = None
+    strict: bool | None = None
+
+
+class ToolDef(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    type: Literal["function"]
+    function: FunctionDef
+
+
+class NamedToolChoice(BaseModel):
+    """``tool_choice={"type": "function", "function": {"name": ...}}``."""
+
+    model_config = ConfigDict(extra="allow")
+    type: Literal["function"]
+    function: FunctionDef
+
+
+# "none" | "auto" | "required" | a specific named function — typed instead
+# of Any so a malformed tool_choice is a structured 400 at the protocol
+# boundary, not a downstream surprise (reference validates in
+# lib/llm/src/protocols/openai/chat_completions.rs via typed serde enums)
+ToolChoice = Union[Literal["none", "auto", "required"], NamedToolChoice]
+
+
+class _SamplingValidators(BaseModel):
+    """Shared range checks for the sampling fields both request surfaces
+    carry.  Ranges follow the OpenAI API contract (the reference enforces
+    the same bounds in its typed request structs,
+    lib/llm/src/protocols/common.rs); violations become structured 400s
+    with the offending ``param`` named (llm/http/service.py)."""
+
+    temperature: float | None = Field(None, ge=0.0, le=2.0)
+    top_p: float | None = Field(None, ge=0.0, le=1.0)
+    # extension accepted by most servers; -1 = disabled (vLLM convention)
+    top_k: int | None = None
+    presence_penalty: float | None = Field(None, ge=-2.0, le=2.0)
+    frequency_penalty: float | None = Field(None, ge=-2.0, le=2.0)
+    n: int | None = Field(1, ge=1, le=16)
+    logit_bias: dict[str, float] | None = None
+    stop: Union[str, list[str], None] = None
+
+    @field_validator("top_k")
+    @classmethod
+    def _top_k_range(cls, v):
+        if v is not None and v != -1 and v < 1:
+            raise ValueError("top_k must be -1 (disabled) or >= 1")
+        return v
+
+    @field_validator("logit_bias")
+    @classmethod
+    def _logit_bias_range(cls, v):
+        if v is None:
+            return v
+        for key, bias in v.items():
+            try:
+                int(key)
+            except ValueError:
+                raise ValueError(
+                    f"logit_bias keys must be token ids, got {key!r}"
+                ) from None
+            if not -100.0 <= bias <= 100.0:
+                raise ValueError(
+                    f"logit_bias values must be in [-100, 100], got {bias}"
+                )
+        return v
+
+    @field_validator("stop")
+    @classmethod
+    def _stop_shape(cls, v):
+        if isinstance(v, list):
+            if len(v) > 4:
+                raise ValueError("stop accepts at most 4 sequences")
+            if any(not s for s in v):
+                raise ValueError("stop sequences must be non-empty")
+        elif v == "":
+            raise ValueError("stop sequences must be non-empty")
+        return v
+
+
 class ChatMessage(BaseModel):
     model_config = ConfigDict(extra="allow")
     role: Literal["system", "user", "assistant", "tool", "developer"]
@@ -54,28 +140,20 @@ class ChatMessage(BaseModel):
         return "".join(p.text or "" for p in self.content if p.type == "text")
 
 
-class ChatCompletionRequest(BaseModel):
+class ChatCompletionRequest(_SamplingValidators):
     model_config = ConfigDict(extra="allow")
     model: str
-    messages: list[ChatMessage]
-    temperature: float | None = None
-    top_p: float | None = None
-    top_k: int | None = None  # extension accepted by most servers
-    n: int | None = 1
+    messages: list[ChatMessage] = Field(min_length=1)
     stream: bool = False
     stream_options: dict[str, Any] | None = None
-    stop: Union[str, list[str], None] = None
-    max_tokens: int | None = None
-    max_completion_tokens: int | None = None
-    presence_penalty: float | None = None
-    frequency_penalty: float | None = None
+    max_tokens: int | None = Field(None, ge=1)
+    max_completion_tokens: int | None = Field(None, ge=1)
     seed: int | None = None
     logprobs: bool | None = None
-    top_logprobs: int | None = None
-    logit_bias: dict[str, float] | None = None
+    top_logprobs: int | None = Field(None, ge=0, le=20)
     user: str | None = None
-    tools: list[dict[str, Any]] | None = None
-    tool_choice: Any | None = None
+    tools: list[ToolDef] | None = None
+    tool_choice: ToolChoice | None = None
     response_format: dict[str, Any] | None = None
     ext: Ext | None = None
 
@@ -109,24 +187,16 @@ class ChatCompletionRequest(BaseModel):
         )
 
 
-class CompletionRequest(BaseModel):
+class CompletionRequest(_SamplingValidators):
     model_config = ConfigDict(extra="allow")
     model: str
     prompt: Union[str, list[str], list[int], list[list[int]]]
     suffix: str | None = None
-    max_tokens: int | None = 16
-    temperature: float | None = None
-    top_p: float | None = None
-    top_k: int | None = None
-    n: int | None = 1
+    max_tokens: int | None = Field(16, ge=1)
     stream: bool = False
     stream_options: dict[str, Any] | None = None
-    logprobs: int | None = None
-    logit_bias: dict[str, float] | None = None
+    logprobs: int | None = Field(None, ge=0, le=5)
     echo: bool | None = None
-    stop: Union[str, list[str], None] = None
-    presence_penalty: float | None = None
-    frequency_penalty: float | None = None
     seed: int | None = None
     user: str | None = None
     ext: Ext | None = None

@@ -1,0 +1,173 @@
+"""Protocol-boundary validation contract: sampling-field range checks,
+typed tool_choice, and the structured OpenAI error shape ``{"error":
+{message, type, param, code}}`` (reference surface:
+lib/llm/src/protocols/common.rs typed request structs +
+http/service/error.rs typed error bodies)."""
+
+import httpx
+import pytest
+from pydantic import ValidationError
+
+from dynamo_tpu.llm.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    NamedToolChoice,
+)
+
+BASE = {"model": "tiny", "messages": [{"role": "user", "content": "hi"}]}
+
+
+@pytest.mark.parametrize(
+    "field,value",
+    [
+        ("temperature", -0.1),
+        ("temperature", 2.5),
+        ("top_p", 1.5),
+        ("top_p", -0.2),
+        ("top_k", 0),
+        ("top_k", -5),
+        ("n", 0),
+        ("n", 17),
+        ("presence_penalty", 3.0),
+        ("frequency_penalty", -2.5),
+        ("max_tokens", 0),
+        ("max_completion_tokens", -1),
+        ("top_logprobs", 21),
+        ("logit_bias", {"50256": 150.0}),
+        ("logit_bias", {"not_a_token": 1.0}),
+        ("stop", ["a", "b", "c", "d", "e"]),
+        ("stop", [""]),
+        ("messages", []),
+    ],
+)
+def test_chat_request_range_violations(field, value):
+    with pytest.raises(ValidationError):
+        ChatCompletionRequest.model_validate({**BASE, field: value})
+
+
+@pytest.mark.parametrize(
+    "field,value",
+    [
+        ("temperature", 0.0),
+        ("temperature", 2.0),
+        ("top_p", 1.0),
+        ("top_k", -1),
+        ("top_k", 40),
+        ("n", 16),
+        ("logit_bias", {"50256": -100.0}),
+        ("stop", ["a", "b", "c", "d"]),
+    ],
+)
+def test_chat_request_boundary_values_accepted(field, value):
+    ChatCompletionRequest.model_validate({**BASE, field: value})
+
+
+def test_completion_request_shares_ranges():
+    base = {"model": "tiny", "prompt": "hi"}
+    CompletionRequest.model_validate({**base, "logprobs": 5})
+    with pytest.raises(ValidationError):
+        CompletionRequest.model_validate({**base, "logprobs": 6})
+    with pytest.raises(ValidationError):
+        CompletionRequest.model_validate({**base, "temperature": 99})
+    with pytest.raises(ValidationError):
+        CompletionRequest.model_validate({**base, "max_tokens": 0})
+
+
+def test_tool_choice_typed():
+    for ok in ("none", "auto", "required"):
+        req = ChatCompletionRequest.model_validate({**BASE, "tool_choice": ok})
+        assert req.tool_choice == ok
+    req = ChatCompletionRequest.model_validate({
+        **BASE,
+        "tools": [{"type": "function", "function": {"name": "get_weather",
+                                                    "parameters": {"type": "object"}}}],
+        "tool_choice": {"type": "function", "function": {"name": "get_weather"}},
+    })
+    assert isinstance(req.tool_choice, NamedToolChoice)
+    assert req.tool_choice.function.name == "get_weather"
+    assert req.tools[0].function.name == "get_weather"
+
+    with pytest.raises(ValidationError):
+        ChatCompletionRequest.model_validate({**BASE, "tool_choice": "sometimes"})
+    with pytest.raises(ValidationError):
+        ChatCompletionRequest.model_validate(
+            {**BASE, "tools": [{"type": "retrieval"}]}
+        )
+
+
+# ---------------------------------------------------------------------------
+# HTTP error-shape contract
+# ---------------------------------------------------------------------------
+
+
+async def _service():
+    from pathlib import Path
+
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.engines import EchoEngineCore
+    from dynamo_tpu.llm.http import HttpService, ModelManager
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import ChatPreprocessor
+    from dynamo_tpu.llm.tokenizer import HfTokenizer
+
+    model_dir = Path(__file__).parent.parent / "data" / "tiny-chat-model"
+    mdc = ModelDeploymentCard.from_local_path(model_dir, name="tiny")
+    tokenizer = HfTokenizer.from_file(model_dir / "tokenizer.json")
+    manager = ModelManager()
+    manager.add_chat_model(
+        "tiny", ChatPreprocessor(mdc, tokenizer).wrap(Backend(tokenizer).wrap(EchoEngineCore()))
+    )
+    service = HttpService(manager, host="127.0.0.1", port=0)
+    await service.start()
+    return service
+
+
+def _assert_error_shape(body: dict):
+    err = body["error"]
+    assert set(err) == {"message", "type", "param", "code"}
+    assert isinstance(err["message"], str) and err["message"]
+    assert isinstance(err["type"], str)
+
+
+async def test_http_400_names_offending_param():
+    service = await _service()
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            r = await client.post(
+                "/v1/chat/completions", json={**BASE, "temperature": 9.0}
+            )
+            assert r.status_code == 400
+            _assert_error_shape(r.json())
+            err = r.json()["error"]
+            assert err["param"] == "temperature"
+            assert err["type"] == "invalid_request_error"
+            assert err["code"] == "invalid_value"
+
+            r = await client.post(
+                "/v1/chat/completions",
+                json={**BASE, "tool_choice": {"type": "function"}},
+            )
+            assert r.status_code == 400
+            assert r.json()["error"]["param"] == "tool_choice"
+
+            # malformed JSON body: still the structured shape
+            r = await client.post(
+                "/v1/chat/completions", content=b"{not json",
+                headers={"Content-Type": "application/json"},
+            )
+            assert r.status_code == 400
+            _assert_error_shape(r.json())
+            assert r.json()["error"]["code"] == "invalid_json"
+
+            # unknown model: 404 with machine-readable code
+            r = await client.post(
+                "/v1/chat/completions", json={**BASE, "model": "nope"}
+            )
+            assert r.status_code == 404
+            _assert_error_shape(r.json())
+            err = r.json()["error"]
+            assert err["code"] == "model_not_found" and err["param"] == "model"
+    finally:
+        await service.stop()
