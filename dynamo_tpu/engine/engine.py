@@ -1013,6 +1013,15 @@ class JaxLlmEngine:
 
     # -- async engine interface -------------------------------------------
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        if request.data.get("image") is not None or request.data.get("video") is not None:
+            # modality payloads are consumed by a MultimodalEngine wrapper
+            # BEFORE delegation (examples/multimodal/pipeline.py); reaching
+            # the text engine with one still attached means this deployment
+            # has no encoder — refuse rather than silently answer from the
+            # text alone
+            raise ValueError(
+                "this model deployment does not accept image/video input"
+            )
         pre = PreprocessedRequest.from_wire(request.data)
         ctx = request.ctx
         if len(pre.token_ids) >= self.max_len:

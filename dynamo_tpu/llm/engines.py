@@ -32,6 +32,13 @@ class EchoEngineCore:
         self.token_delay_s = token_delay_s
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        if request.data.get("image") is not None or request.data.get("video") is not None:
+            # same contract as JaxLlmEngine.generate: a modality payload
+            # reaching a text-only engine is a deployment without an
+            # encoder, not a payload to silently drop
+            raise ValueError(
+                "this model deployment does not accept image/video input"
+            )
         pre = PreprocessedRequest.from_wire(request.data)
         ctx = request.ctx
 

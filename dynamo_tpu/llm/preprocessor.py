@@ -150,12 +150,26 @@ class ChatPreprocessor(Operator):
         self.core = _PreprocessorCore(mdc, tokenizer)
 
     async def preprocess(self, request: Context[ChatCompletionRequest]) -> Context[dict]:
+        from dynamo_tpu.llm.multimodal import (
+            encode_image_wire,
+            extract_image_url,
+            resolve_image,
+        )
+
         req = request.data
         prompt = self.core.formatter.render(req)
         token_ids = self.core.tokenizer.encode(prompt)
         annotations = list(req.ext.annotations) if req.ext else []
         pre = self.core.build_preprocessed(token_ids, req, annotations)
         ctx_data = pre.to_wire()
+        # image_url content parts: fetch/decode here (host I/O belongs at
+        # the frontend), ship the normalized array to the engine, which
+        # encodes + splices patch embeddings (examples/multimodal/
+        # pipeline.py MultimodalEngine; reference processor.py:107-217)
+        image_url = extract_image_url(req)
+        if image_url is not None:
+            image = await resolve_image(image_url)
+            ctx_data["image"] = encode_image_wire(image)
         # stash state for postprocess on the context object
         request.ctx._pre_state = {  # type: ignore[attr-defined]
             "prompt": prompt,

@@ -55,9 +55,25 @@ class JaxVisionEncoder:
         )
 
     def encode(self, image: np.ndarray) -> np.ndarray:
-        """[H, W, 3] float image → [num_patches, projector_dim] float32."""
+        """[H, W, 3] float image → [num_patches, projector_dim] float32.
+
+        Arbitrary [H, W] inputs (the frontend ships decoded images
+        unresized — geometry belongs next to the encoder that knows its
+        ``image_size``) are bilinearly resized to the ViT's square input."""
+        image = self._fit(image)
         out = self._encode(self.params, jax.numpy.asarray(image[None], self.cfg.dtype))
         return np.asarray(out[0], np.float32)
+
+    def _fit(self, image: np.ndarray) -> np.ndarray:
+        size = self.cfg.image_size
+        if image.shape[:2] == (size, size):
+            return image
+        return np.asarray(
+            jax.image.resize(
+                jax.numpy.asarray(image, jax.numpy.float32),
+                (size, size, image.shape[-1]), method="bilinear",
+            )
+        )
 
     def encode_video(self, frames: np.ndarray, *, temporal_pool: int = 2) -> np.ndarray:
         """[T, H, W, 3] frames → [ceil(T/pool)*num_patches, dim] float32."""
@@ -66,6 +82,13 @@ class JaxVisionEncoder:
                 f"temporal_pool must be in [1, {MAX_TEMPORAL_POOL}], "
                 f"got {temporal_pool}"
             )
+        size = self.cfg.image_size
+        if frames.shape[1:3] != (size, size):
+            frames = np.asarray(jax.image.resize(
+                jax.numpy.asarray(frames, jax.numpy.float32),
+                (frames.shape[0], size, size, frames.shape[-1]),
+                method="bilinear",
+            ))
         out = self._encode_video(
             self.params, jax.numpy.asarray(frames, self.cfg.dtype), temporal_pool
         )
@@ -105,8 +128,14 @@ class MultimodalEngine:
         self.encoder = encoder
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
+        from dynamo_tpu.llm.multimodal import decode_image_wire
+
         data = dict(request.data)
         image = data.pop("image", None)
+        if image is not None:
+            # the frontend ships the compact b64 wire form; direct API
+            # callers may still attach raw arrays/lists
+            image = decode_image_wire(image)
         video = data.pop("video", None)
         temporal_pool = int(data.pop("video_temporal_pool", 2))
         if image is not None and video is not None:
