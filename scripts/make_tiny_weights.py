@@ -1,0 +1,88 @@
+"""Generate deterministic demo weights for tests/data/tiny-chat-model.
+
+Random-initialized weights on a 106k-param model produce a DEGENERATE
+serving demo: logits are near-one-hot on an arbitrary token (often a
+special that detokenizes to ""), and since decode conditions only on the
+last token the engine self-loops on it forever — `curl` against the
+runnable examples streamed 8 empty deltas.
+
+These weights make the tiny model a **token counter**: attention and MLP
+outputs are zeroed (wo = w_down = 0, so the residual stream carries the
+input embedding through unchanged), embeddings are random unit rows, and
+the untied unembedding is the embedding table rolled by one row — so
+logits after last token t peak sharply at t+1.  Every decode emits the
+next token id: deterministic, visibly textful, and exactness-friendly
+(disagg/parallel parity tests get bit-stable references).
+
+Run from the repo root (rewrites model.safetensors in place):
+
+    python scripts/make_tiny_weights.py
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+MODEL_DIR = Path(__file__).parent.parent / "tests" / "data" / "tiny-chat-model"
+# sharpness of the one-hot logit peak; 8.0 gives a ~1e-12 runner-up after
+# softmax yet keeps finite logprobs for the logprobs-surface tests
+UNEMBED_SCALE = 8.0
+
+
+def build_tensors() -> dict[str, np.ndarray]:
+    cfg = json.loads((MODEL_DIR / "config.json").read_text())
+    vocab, hidden = cfg["vocab_size"], cfg["hidden_size"]
+    inter, layers = cfg["intermediate_size"], cfg["num_hidden_layers"]
+    q_dim = cfg["num_attention_heads"] * cfg["head_dim"]
+    kv_dim = cfg["num_key_value_heads"] * cfg["head_dim"]
+
+    rng = np.random.default_rng(0)
+    embed = rng.standard_normal((vocab, hidden)).astype(np.float32)
+    embed /= np.linalg.norm(embed, axis=1, keepdims=True)
+
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": embed,
+        "model.norm.weight": np.ones(hidden, np.float32),
+        # unembed row j = embedding of j-1: logits(last=t) peak at t+1
+        "lm_head.weight": UNEMBED_SCALE * np.roll(embed, 1, axis=0),
+    }
+    for i in range(layers):
+        p = f"model.layers.{i}"
+        small = lambda *s: (  # noqa: E731
+            rng.standard_normal(s).astype(np.float32) * 0.02
+        )
+        tensors.update({
+            f"{p}.input_layernorm.weight": np.ones(hidden, np.float32),
+            f"{p}.post_attention_layernorm.weight": np.ones(hidden, np.float32),
+            f"{p}.self_attn.q_proj.weight": small(q_dim, hidden),
+            f"{p}.self_attn.k_proj.weight": small(kv_dim, hidden),
+            f"{p}.self_attn.v_proj.weight": small(kv_dim, hidden),
+            # zero out the residual writes: the stream stays the embedding
+            f"{p}.self_attn.o_proj.weight": np.zeros((hidden, q_dim), np.float32),
+            f"{p}.mlp.gate_proj.weight": small(inter, hidden),
+            f"{p}.mlp.up_proj.weight": small(inter, hidden),
+            f"{p}.mlp.down_proj.weight": np.zeros((hidden, inter), np.float32),
+        })
+    return tensors
+
+
+def main() -> None:
+    from safetensors.numpy import save_file
+
+    cfg_path = MODEL_DIR / "config.json"
+    cfg = json.loads(cfg_path.read_text())
+    if cfg.get("tie_word_embeddings"):
+        # the counter needs an untied unembedding (a tied one's logit
+        # profile <norm(e_t), e_j> is symmetric in j-t: it cannot prefer
+        # t+1 over t-1)
+        cfg["tie_word_embeddings"] = False
+        cfg_path.write_text(json.dumps(cfg, indent=2) + "\n")
+    save_file(build_tensors(), MODEL_DIR / "model.safetensors")
+    print(f"wrote {MODEL_DIR / 'model.safetensors'}")
+
+
+if __name__ == "__main__":
+    main()
