@@ -290,25 +290,31 @@ class JaxLlmEngine:
                 )
             pp = config.mesh.pp
             if pp > 1:
-                others = {
-                    a: getattr(config.mesh, a)
-                    for a in ("dp", "ep", "sp")
-                    if getattr(config.mesh, a) > 1
-                }
-                if others:
-                    # pp composes with tp (partial-manual shard_map: pp is
-                    # the manual stage axis, tp stays automatic inside each
-                    # stage — parallel/pipeline.py, and the engine's jits
-                    # shard weights/cache over tp).  The engine never
-                    # shards its decode batch over dp — data parallelism in
-                    # this architecture is worker REPLICATION behind the
-                    # router (like the reference) — so a dp axis on an
-                    # engine mesh would silently replicate compute, and
-                    # ep/sp×pp are unimplemented in the pipeline runner.
+                # pp composes with the AUTOMATIC GSPMD axes (partial-manual
+                # shard_map: pp is the manual stage axis; tp — and ep for
+                # MoE families with a pipelined decode — stay automatic
+                # inside each stage, parallel/pipeline.py).  sp is
+                # prefill-only and has no pipelined variant; dp is never an
+                # engine axis (rejected above).
+                ep_ok = (
+                    config.mesh.ep == 1
+                    or (
+                        self.family.forward_decode_pp is not None
+                        and getattr(cfg, "num_experts", 0) > 1
+                    )
+                )
+                if config.mesh.sp > 1 or not ep_ok:
+                    # name only the axes actually at fault (a valid ep on a
+                    # MoE family must not appear in the complaint)
+                    offending = {}
+                    if not ep_ok:
+                        offending["ep"] = config.mesh.ep
+                    if config.mesh.sp > 1:
+                        offending["sp"] = config.mesh.sp
                     raise ValueError(
-                        f"pp={pp} composes only with tp for now "
-                        f"(got {others}); use router-level worker "
-                        "replication for dp, and GSPMD without pp for ep/sp"
+                        f"pp={pp} composes with tp (all families) and ep "
+                        f"(MoE families with a pipelined decode); got "
+                        f"{offending} for family {config.model_family!r}"
                     )
                 if config.max_batch_size % pp:
                     raise ValueError(

@@ -162,13 +162,17 @@ def param_specs(cfg: MixtralConfig) -> dict:
     return specs
 
 
-def _block(cfg: MixtralConfig, w, x, attn_fn):
+def _block(cfg: MixtralConfig, w, x, attn_fn, *, capacity_scale: float = 1.0):
+    # capacity_scale: callers that split the batch before routing (the
+    # pp-pipelined decode routes per MICROBATCH) scale the factor back up
+    # so per-expert capacity matches what full-batch routing would allocate
     attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
     x = x + attn_fn(attn_in)
     mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
     moe_out = moe_ffn(
         mlp_in, w["w_router"], w["w_gate"], w["w_up"], w["w_down"],
-        top_k=cfg.experts_per_token, capacity_factor=cfg.capacity_factor,
+        top_k=cfg.experts_per_token,
+        capacity_factor=cfg.capacity_factor * capacity_scale,
         norm_topk_prob=cfg.norm_topk_prob,
     )
     return x + moe_out
@@ -291,6 +295,71 @@ def mixtral_forward_decode(
         return x, state["kv"]
 
     x, (new_k, new_v) = jax.lax.scan(layer, x, (params["layers"], kv_cache["k"], kv_cache["v"]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    logits = (
+        x @ params["embed"].T.astype(x.dtype)
+        if cfg.tie_word_embeddings
+        else mm(x, params["lm_head"])
+    )
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def mixtral_forward_decode_pp(
+    params, cfg: MixtralConfig, token_ids, kv_cache, block_tables,
+    context_lens, slot_ids, cos, sin, *, pp_mesh, microbatches: int | None = None,
+):
+    """Batched MoE decode with the layer stack pipelined over the ``pp``
+    mesh axis (parallel/pipeline.py), composing with expert parallelism:
+    the pp axis is manual inside the pipeline runner's partial-manual
+    shard_map while the expert-stacked weights keep their ``P(..., "ep",
+    ...)`` shardings — GSPMD inserts the expert all-to-alls INSIDE each
+    stage exactly as it does for tp in the llama path
+    (llama_forward_decode_pp).  BASELINE.json's Mixtral-on-v5p config
+    implies this composition.
+
+    MoE drop semantics vs the non-pp decode: routing runs per MICROBATCH,
+    with capacity_factor scaled by the microbatch count so each expert's
+    per-call capacity equals what full-batch routing would allocate.
+    Tokens therefore only compete for slots within their own microbatch —
+    outputs match the plain decode exactly whenever no drops occur (the
+    served regime capacity_factor is sized for), and under extreme routing
+    skew the pp path drops no earlier than full-batch routing would."""
+    b = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(context_lens - 1, 0)
+    m_count = microbatches or pp_mesh.shape["pp"]
+
+    def body(x_mb, aux_mb, w, layer_cache):
+        k_layer, v_layer = layer_cache
+        pos_mb, slots_mb, tables_mb, lens_mb = aux_mb
+        bmb = x_mb.shape[0]
+        state = {}
+
+        def attn(attn_in):
+            q = mm(attn_in, w["wq"]).reshape(bmb, cfg.num_heads, cfg.head_dim)
+            k = mm(attn_in, w["wk"]).reshape(bmb, cfg.num_kv_heads, cfg.head_dim)
+            v = mm(attn_in, w["wv"]).reshape(bmb, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:
+                q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q[:, None], pos_mb[:, None], cos, sin)[:, 0]
+            k = apply_rope(k[:, None], pos_mb[:, None], cos, sin)[:, 0]
+            state["kv"] = write_decode_kv(k_layer, v_layer, k, v, slots_mb)
+            attn_out = paged_decode_attention(
+                q, state["kv"][0], state["kv"][1], tables_mb, lens_mb
+            )
+            return mm(attn_out.reshape(bmb, -1), w["wo"])
+
+        x_mb = _block(cfg, w, x_mb, attn, capacity_scale=float(m_count))
+        return x_mb, state["kv"]
+
+    from dynamo_tpu.parallel.pipeline import pipeline_layer_stack
+
+    x, (new_k, new_v) = pipeline_layer_stack(
+        body, x, (positions, slot_ids, block_tables, context_lens),
+        params["layers"], (kv_cache["k"], kv_cache["v"]), pp_mesh,
+        microbatches=microbatches,
+    )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = (
         x @ params["embed"].T.astype(x.dtype)

@@ -146,6 +146,7 @@ def _mixtral_family() -> ModelFamily:
         forward_prefill=mixtral.mixtral_forward_prefill,
         forward_decode=mixtral.mixtral_forward_decode,
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
+        forward_decode_pp=mixtral.mixtral_forward_decode_pp,
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=mixtral.mixtral_forward_verify,
@@ -166,6 +167,7 @@ def _qwen3_moe_family() -> ModelFamily:
         forward_prefill=mixtral.mixtral_forward_prefill,
         forward_decode=mixtral.mixtral_forward_decode,
         forward_prefill_with_prefix=mixtral.mixtral_forward_prefill_with_prefix,
+        forward_decode_pp=mixtral.mixtral_forward_decode_pp,
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=mixtral.mixtral_forward_verify,

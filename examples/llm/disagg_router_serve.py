@@ -67,32 +67,34 @@ async def orchestrate(args: argparse.Namespace) -> int:
     logger.info("control plane on %s", args.control_plane)
 
     sup = ProcessSupervisor()
-    # workers first: the frontend's model watcher picks the model up
-    # whenever registration lands, so strict ordering is not required —
-    # but starting engines early overlaps their compile time
-    sup.add_watcher(ProcessSpec(name="decode", cmd=_role_cmd(args, "decode")))
-    sup.add_watcher(
-        ProcessSpec(name="prefill", cmd=_role_cmd(args, "prefill")),
-        replicas=args.prefill_workers,
-    )
-    sup.add_watcher(ProcessSpec(name="frontend", cmd=_role_cmd(args, "frontend")))
-    await sup.start()
-
-    print(
-        f"\ndisagg_router up — {1 + 1 + args.prefill_workers} processes + "
-        "control plane.\nTry:\n"
-        f"  curl -N http://127.0.0.1:{args.port}/v1/chat/completions \\\n"
-        "    -H 'Content-Type: application/json' \\\n"
-        f"    -d '{{\"model\": \"{args.model_name}\", \"stream\": true, "
-        '"messages": [{"role": "user", "content": "hello"}]}}\'\n',
-        flush=True,
-    )
-
-    stop = asyncio.Event()
-    loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+    # everything from the first spawn onward runs under the finally, so a
+    # SIGINT/exception during bring-up still tears the fleet down instead
+    # of orphaning worker processes on the HTTP port
     try:
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        # workers first: the frontend's model watcher picks the model up
+        # whenever registration lands, so strict ordering is not required —
+        # but starting engines early overlaps their compile time
+        sup.add_watcher(ProcessSpec(name="decode", cmd=_role_cmd(args, "decode")))
+        sup.add_watcher(
+            ProcessSpec(name="prefill", cmd=_role_cmd(args, "prefill")),
+            replicas=args.prefill_workers,
+        )
+        sup.add_watcher(ProcessSpec(name="frontend", cmd=_role_cmd(args, "frontend")))
+        await sup.start()
+
+        print(
+            f"\ndisagg_router up — {1 + 1 + args.prefill_workers} processes + "
+            "control plane.\nTry:\n"
+            f"  curl -N http://127.0.0.1:{args.port}/v1/chat/completions \\\n"
+            "    -H 'Content-Type: application/json' \\\n"
+            f"    -d '{{\"model\": \"{args.model_name}\", \"stream\": true, "
+            '"messages": [{"role": "user", "content": "hello"}]}}\'\n',
+            flush=True,
+        )
         await stop.wait()
     finally:
         await sup.stop()

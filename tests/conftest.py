@@ -82,3 +82,15 @@ def pytest_pyfunc_call(pyfuncitem):
 @pytest.fixture
 def anyio_backend():
     return "asyncio"
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (shared test helper: subprocess servers
+    that cannot bind port 0 themselves)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

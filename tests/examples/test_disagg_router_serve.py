@@ -7,29 +7,22 @@ served via `dynamo serve`)."""
 import asyncio
 import os
 import signal
-import socket
 import sys
 from pathlib import Path
 
 import httpx
 import pytest
 
+from tests.conftest import free_port
+
 REPO_ROOT = Path(__file__).parent.parent.parent
 MODEL_DIR = REPO_ROOT / "tests" / "data" / "tiny-chat-model"
-
-
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 @pytest.mark.integration
 @pytest.mark.slow
 async def test_disagg_router_serve_streams_tokens(tmp_path):
-    port = _free_port()
+    port = free_port()
     env = dict(os.environ)
     env.update(
         PYTHONPATH=str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", ""),

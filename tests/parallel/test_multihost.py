@@ -8,7 +8,6 @@ MultiNodeConfig lib/llm/src/engines.rs:44-60).
 
 import asyncio
 import os
-import socket
 import sys
 import textwrap
 from pathlib import Path
@@ -65,12 +64,7 @@ RANK_SCRIPT = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+from tests.conftest import free_port as _free_port
 
 
 @pytest.mark.integration
