@@ -62,14 +62,31 @@ class FleetConfig:
     # service time leaves the router's load view stale and lets affine
     # traffic herd onto busy workers)
     metrics_period_sim_s: float = 0.25
+    # "mocker" (reference-style cost-model sim — how the reference validates
+    # routing, lib/llm/src/mocker/) or "jax": REAL JaxLlmEngine workers
+    # whose TTFT deltas come from actual prefill compute saved by prefix
+    # caching.  jax mode requires speedup=1.0 — service time is real, so
+    # compressed arrivals would measure queue saturation, not routing.
+    engine: str = "mocker"
+    # jax mode: model config (None = LlamaConfig.tiny, the CPU geometry);
+    # on TPU pass a real model for the on-device routing artifact
+    model_config: object = None
+    # jax mode: engine context window; size it to the workload's longest
+    # history (main() computes this from the session config)
+    max_model_len: int = 512
+
+    def __post_init__(self) -> None:
+        if self.engine == "jax" and self.speedup != 1.0:
+            raise ValueError(
+                "engine='jax' requires speedup=1.0: real engines serve in "
+                "real time, so compressed arrivals measure queue depth "
+                "instead of routing"
+            )
 
 
-async def _serve_fleet(rt: DistributedRuntime, cfg: FleetConfig):
-    comp = rt.namespace("fleet").component("backend")
-    ep = comp.endpoint("generate")
-    handles = []
-    for _ in range(cfg.num_workers):
-        engine = MockerEngine(
+def _make_fleet_engine(cfg: FleetConfig, params_cache: dict):
+    if cfg.engine == "mocker":
+        return MockerEngine(
             MockerConfig(
                 num_blocks=cfg.num_blocks,
                 block_size=cfg.block_size,
@@ -77,12 +94,52 @@ async def _serve_fleet(rt: DistributedRuntime, cfg: FleetConfig):
                 speedup=cfg.speedup,
             )
         )
+    if cfg.engine == "jax":
+        import jax as _jax
+
+        from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+        from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+        mcfg = cfg.model_config or LlamaConfig.tiny()
+        if "params" not in params_cache:
+            # one host init shared by every worker: engines never mutate
+            # params, and N tiny random inits would dominate bring-up
+            params_cache["params"] = init_params(mcfg, _jax.random.PRNGKey(0))
+        # bucket ladder sized to the context window: every serving program
+        # is warmed BEFORE the measured replay (run_fleet), so fewer
+        # buckets = faster bring-up, and the top bucket covers max_model_len
+        buckets = tuple(
+            b for b in (128, 256, 512, 1024, 2048) if b < cfg.max_model_len
+        ) + (cfg.max_model_len,)
+        return JaxLlmEngine(
+            EngineConfig(
+                model=mcfg,
+                num_blocks=cfg.num_blocks,
+                block_size=cfg.block_size,
+                max_batch_size=cfg.max_batch_size,
+                prefill_buckets=buckets,
+                max_model_len=cfg.max_model_len,
+            ),
+            params=params_cache["params"],
+        )
+    raise ValueError(f"unknown fleet engine {cfg.engine!r} (want mocker|jax)")
+
+
+async def _serve_fleet(rt: DistributedRuntime, cfg: FleetConfig):
+    comp = rt.namespace("fleet").component("backend")
+    ep = comp.endpoint("generate")
+    handles = []
+    params_cache: dict = {}
+    for _ in range(cfg.num_workers):
+        engine = _make_fleet_engine(cfg, params_cache)
         service = await ep.serve(engine, stats_handler=engine.stats)
         kv_pub = KvEventPublisher(comp, worker_id=service.instance.instance_id)
         kv_pub.start()
         # sink attached before the engine loop starts (serve.py invariant):
         # no early request's stored-block events may be dropped
         engine._event_sink = kv_pub.sink
+        # jax mode forces speedup=1.0 (FleetConfig.__post_init__), so this
+        # division is the identity there and sim-compression for the mocker
         metrics_pub = WorkerMetricsPublisher(
             comp, service.instance.instance_id, engine.stats,
             period_s=cfg.metrics_period_sim_s / cfg.speedup,
@@ -134,6 +191,12 @@ async def run_fleet(
         else:
             dispatcher = push
         await push.client.wait_for_instances(cfg.num_workers, timeout=10)
+        if cfg.engine == "jax":
+            # compile every serving program before the clock starts: lazy
+            # compiles inside the replay would dominate first-turn TTFT and
+            # drown the routing signal entirely
+            for engine, *_ in handles:
+                await engine.warmup()
 
         t_start = time.monotonic()
         first_ttfts: list[float] = []    # turn 0: cold for both policies
@@ -173,10 +236,13 @@ async def run_fleet(
         wall = time.monotonic() - t_start
 
         all_ttfts = first_ttfts + follow_ttfts
+        # both engine kinds expose the same allocator counter (the mocker
+        # reuses the REAL BlockAllocator)
         prefix_hits = sum(h[0].allocator.prefix_hits_total for h in handles)
         ms = lambda x: None if x is None else round(x * 1000, 2)  # noqa: E731
         return {
             "policy": policy,
+            "engine": cfg.engine,
             "num_workers": cfg.num_workers,
             "num_sessions": len(sessions),
             "num_turns": len(all_ttfts),
@@ -236,16 +302,47 @@ def main() -> int:
     from dataclasses import replace
 
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--out", default="ROUTED_FLEET.json")
+    parser.add_argument("--out", default=None)
     parser.add_argument("--num-workers", type=int, default=4)
     parser.add_argument("--num-sessions", type=int, default=32)
     parser.add_argument("--turns", type=int, default=4)
+    parser.add_argument(
+        "--engine", default="mocker", choices=["mocker", "jax"],
+        help="mocker = cost-model sim (reference-style); jax = real engines"
+    )
     args = parser.parse_args()
+    if args.out is None:
+        args.out = (
+            "ROUTED_FLEET.json" if args.engine == "mocker"
+            else "ROUTED_FLEET_JAX.json"
+        )
     session_cfg = replace(
         SessionConfig(), num_sessions=args.num_sessions,
         turns_per_session=args.turns,
+        # real engines prefill the real history: keep the workload inside
+        # the tiny geometry's bucket ladder (mocker scales are unaffected)
+        **(
+            dict(system_tokens=256, user_tokens_per_turn=48, osl=16,
+                 vocab_size=480)
+            if args.engine == "jax" else {}
+        ),
     )
-    fleet_cfg = FleetConfig(num_workers=args.num_workers)
+    # jax mode: real-time arrivals (FleetConfig enforces it) and a context
+    # window sized to the longest session history so any --turns fits
+    extra = {}
+    if args.engine == "jax":
+        longest = (
+            session_cfg.system_tokens
+            + args.turns * (session_cfg.user_tokens_per_turn + session_cfg.osl)
+            + 32
+        )
+        extra = {
+            "speedup": 1.0,
+            "max_model_len": (longest + 127) // 128 * 128,
+        }
+    fleet_cfg = FleetConfig(
+        num_workers=args.num_workers, engine=args.engine, **extra,
+    )
     result = asyncio.run(compare_policies(session_cfg, fleet_cfg))
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
