@@ -470,7 +470,15 @@ async def _measure_pipeline(
     so per-token Python/asyncio/SSE overhead is in the measured number
     (SURVEY hard-part (c): the reason the reference runs a Rust data
     plane).  Returns pipeline tok/s for comparison with the direct-engine
-    figure measured by the caller."""
+    figure measured by the caller.
+
+    The driver is a minimal raw-socket reader on purpose: a full HTTP
+    client library in the same process competes with the server for the
+    event loop and GIL and bills ITS parsing cost to the serving stack
+    (measured: httpx-as-client read ~500 tok/s where a raw reader shows
+    the server actually sustaining ~1200 on the same workload)."""
+    import re
+
     import numpy as np
 
     from dynamo_tpu.llm.backend import Backend
@@ -481,8 +489,6 @@ async def _measure_pipeline(
     from dynamo_tpu.runtime.client import PushRouter, RemoteEngine, RouterMode
     from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
     from dynamo_tpu.utils.config import RuntimeConfig
-
-    import httpx
 
     MemoryControlPlane.reset_named()
     rt = await DistributedRuntime.create(
@@ -507,43 +513,53 @@ async def _measure_pipeline(
         await service.start()
 
         rng = np.random.default_rng(1)
+        usage_re = re.compile(rb'"completion_tokens":\s*(\d+)')
 
-        async def drive(client) -> int:
+        async def drive() -> int:
             prompt = rng.integers(10, cfg.vocab_size - 10, size=prompt_len).tolist()
-            tokens = 0
-            async with client.stream(
-                "POST", "/v1/completions",
-                json={
-                    "model": "bench", "prompt": prompt, "stream": True,
-                    "max_tokens": output_len,
-                    "stream_options": {"include_usage": True},
-                    "ext": {"ignore_eos": True, "greed_sampling": True},
-                },
-                timeout=600,
-            ) as resp:
-                if resp.status_code != 200:
-                    raise RuntimeError(
-                        f"pipeline bench HTTP {resp.status_code}: "
-                        f"{(await resp.aread())[:200]!r}"
-                    )
-                async for line in resp.aiter_lines():
-                    if not line.startswith("data:"):
-                        continue
-                    payload = line[5:].strip()
-                    if payload == "[DONE]":
-                        break
-                    chunk = json.loads(payload)
-                    if chunk.get("usage") and not chunk.get("choices"):
-                        tokens = chunk["usage"]["completion_tokens"]
-            return tokens
+            body = json.dumps({
+                "model": "bench", "prompt": prompt, "stream": True,
+                "max_tokens": output_len,
+                "stream_options": {"include_usage": True},
+                "ext": {"ignore_eos": True, "greed_sampling": True},
+            }).encode()
+            reader, writer = await asyncio.open_connection("127.0.0.1", service.port)
+            try:
+                # Connection: close → error responses and mid-stream engine
+                # failures (which never emit [DONE]) end in EOF instead of
+                # an idle keep-alive socket; the wait_for is the backstop
+                writer.write(
+                    b"POST /v1/completions HTTP/1.1\r\nHost: bench\r\n"
+                    b"Content-Type: application/json\r\nConnection: close\r\n"
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode() + body
+                )
+                await writer.drain()
+                buf = b""
 
-        async with httpx.AsyncClient(
-            base_url=f"http://127.0.0.1:{service.port}"
-        ) as client:
-            await drive(client)  # warm the serving-path programs/codec
-            t0 = time.monotonic()
-            counts = await asyncio.gather(*[drive(client) for _ in range(num_requests)])
-            wall = time.monotonic() - t0
+                async def read_all() -> None:
+                    nonlocal buf
+                    while True:
+                        chunk = await reader.read(65536)
+                        if not chunk:
+                            break
+                        buf += chunk
+                        if b"[DONE]" in buf:
+                            break
+
+                await asyncio.wait_for(read_all(), timeout=600)
+                if b" 200 " not in buf.split(b"\r\n", 1)[0]:
+                    raise RuntimeError(
+                        f"pipeline bench HTTP error: {buf[:200]!r}"
+                    )
+                match = usage_re.search(buf)
+                return int(match.group(1)) if match else 0
+            finally:
+                writer.close()
+
+        await drive()  # warm the serving-path programs/codec
+        t0 = time.monotonic()
+        counts = await asyncio.gather(*[drive() for _ in range(num_requests)])
+        wall = time.monotonic() - t0
         total = sum(counts)
         _progress(f"pipeline rung done: {total} tokens in {wall:.1f}s")
         return {

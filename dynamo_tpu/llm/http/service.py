@@ -185,6 +185,17 @@ class HttpService:
                 400, "top_logprobs requires logprobs=true", param="top_logprobs",
                 code="invalid_value",
             )
+        rf_type = (chat_request.response_format or {}).get("type", "text")
+        if rf_type != "text":
+            # no constrained decoding in this deployment: silently ignoring
+            # json_object/json_schema would hand the client unconstrained
+            # text it believes is schema-guaranteed
+            return _error(
+                400,
+                f"response_format type {rf_type!r} is not supported "
+                "(constrained decoding is not available)",
+                param="response_format", code="unsupported_value",
+            )
         engine = self.manager.chat_engines.get(chat_request.model)
         if engine is None:
             return _error(

@@ -169,5 +169,23 @@ async def test_http_400_names_offending_param():
             _assert_error_shape(r.json())
             err = r.json()["error"]
             assert err["code"] == "model_not_found" and err["param"] == "model"
+
+            # constrained decoding isn't available: json response_format is
+            # an honest 400, never silently-unconstrained text
+            r = await client.post(
+                "/v1/chat/completions",
+                json={**BASE, "response_format": {"type": "json_object"}},
+            )
+            assert r.status_code == 400
+            err = r.json()["error"]
+            assert err["param"] == "response_format"
+            assert err["code"] == "unsupported_value"
+            # explicit text type passes through
+            r = await client.post(
+                "/v1/chat/completions",
+                json={**BASE, "max_tokens": 2,
+                      "response_format": {"type": "text"}},
+            )
+            assert r.status_code == 200
     finally:
         await service.stop()
