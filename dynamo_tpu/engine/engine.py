@@ -472,6 +472,21 @@ class JaxLlmEngine:
             self.cos = jax.device_put(cos)
             self.sin = jax.device_put(sin)
 
+        # guided decoding: disabled until enable_guided_json() installs a
+        # compiled mask table.  The dummy one-row all-true table keeps the
+        # jit signatures stable so enabling guidance never recompiles the
+        # unguided programs' SHAPES for lanes that stay unguided (it does
+        # change the table aval — enable before warmup).
+        self.guided_masks = None
+        self._guided_strings: list[str] | None = None
+        self._guided_eos: list[int] = []
+        vocab = cfg.vocab_size
+        self._guided_table = jnp.ones((1, vocab), jnp.bool_)
+        self._guided_true_row = jnp.ones((vocab,), jnp.bool_)
+        if self.mesh is not None:
+            self._guided_table = jax.device_put(self._guided_table, repl)
+            self._guided_true_row = jax.device_put(self._guided_true_row, repl)
+
         # per-lane sampling state: generated-token counts (presence/frequency
         # penalties), prompt-token counts (repetition penalty scope), and
         # per-lane PRNG keys (OpenAI `seed` reproducibility).  Lane keys are
@@ -637,6 +652,44 @@ class JaxLlmEngine:
             return raw_params
         return quantize_params(raw_params, self.family.quant_leaves)
 
+    # -- guided decoding ---------------------------------------------------
+    def enable_guided_json(self, tokenizer) -> None:
+        """Install the compiled JSON admissible-token table for guided
+        requests (``output_format="json"``).  Call before warmup so the
+        table's aval is part of the AOT-compiled programs.
+
+        Vocab-size note: model vocabs are often padded past the tokenizer
+        vocab; padding columns are masked False (a padded id is never a
+        valid JSON continuation)."""
+        from dynamo_tpu.llm.guided import build_for_tokenizer
+
+        masks, strings = build_for_tokenizer(tokenizer)
+        self.set_guided(masks, strings, tokenizer.eos_token_ids)
+
+    def set_guided(self, masks, strings: list[str], eos_ids: list[int]) -> None:
+        """Lower-level install (tests / pre-built tables)."""
+        vocab = self.config.model.vocab_size
+        table = np.zeros((masks.mask.shape[0], vocab), bool)
+        table[:, : masks.mask.shape[1]] = masks.mask[:, :vocab]
+        self.guided_masks = masks
+        self._guided_strings = strings
+        self._guided_eos = list(eos_ids)
+        table_j = jnp.asarray(table)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            table_j = jax.device_put(
+                table_j, NamedSharding(self.mesh, PartitionSpec())
+            )
+        self._guided_table = table_j
+
+    def _guided_row(self, seq) -> jnp.ndarray:
+        """The prefill-time mask row for one sequence (all-true when the
+        sequence is unguided or its cursor bailed out)."""
+        if seq.guided is None or seq.guided.mode_id < 0:
+            return self._guided_true_row
+        return self._guided_table[seq.guided.mode_id]
+
     # -- jitted steps ------------------------------------------------------
     def _build_prefill(self):
         cfg = self.config.model
@@ -659,7 +712,7 @@ class JaxLlmEngine:
         # is what the remote compile service chokes on)
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  block_ids, seq_len, start_pos, gen_row, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep, bias_ids, bias_vals, cos, sin):
+                 greedy, pres, freq, rep, bias_ids, bias_vals, grow, cos, sin):
             logits, cache = self.family.forward_prefill(
                 params, cfg, token_ids, cache, block_ids, seq_len, start_pos,
                 cos, sin, **prefill_kwargs,
@@ -679,6 +732,9 @@ class JaxLlmEngine:
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
             plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
+            # guided decoding: inadmissible tokens → -inf (all-true row for
+            # unguided sequences)
+            plogits = jnp.where(grow[None], plogits, -jnp.inf)
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -716,7 +772,7 @@ class JaxLlmEngine:
         def step(params, cache, gen_counts, prompt_counts, lane, token_ids,
                  full_block_ids, tail_block_ids, tail_len, start_pos, total_len,
                  prompt_row, gen_row, sample_gate, key, temp, top_k, top_p,
-                 greedy, pres, freq, rep, bias_ids, bias_vals, cos, sin):
+                 greedy, pres, freq, rep, bias_ids, bias_vals, grow, cos, sin):
             logits, cache = self.family.forward_prefill_with_prefix(
                 params, cfg, token_ids, cache, full_block_ids, tail_block_ids,
                 tail_len, start_pos, cos, sin, **prefix_kwargs,
@@ -727,6 +783,7 @@ class JaxLlmEngine:
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
             plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
+            plogits = jnp.where(grow[None], plogits, -jnp.inf)
             step_key = jax.random.fold_in(key, total_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -756,7 +813,7 @@ class JaxLlmEngine:
         def step(params, cache, gen_counts, prompt_counts, lane, embeds,
                  token_ids, n_patch, block_ids, seq_len, gen_row, key, temp,
                  top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
-                 cos, sin):
+                 grow, cos, sin):
             s = token_ids.shape[0]
             pos = jnp.arange(s)
             x_text = params["embed"][token_ids].astype(cfg.dtype)
@@ -775,6 +832,7 @@ class JaxLlmEngine:
                 logits[None], gen_row[None], prompt_row[None], pres, freq, rep
             )
             plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
+            plogits = jnp.where(grow[None], plogits, -jnp.inf)
             step_key = jax.random.fold_in(key, seq_len)
             token = sample_tokens(plogits, step_key[None], temp, top_k, top_p, greedy)[0]
             lp = token_logprobs(plogits, token[None])[0]
@@ -837,13 +895,19 @@ class JaxLlmEngine:
             def step(params, cache, gen_counts, prompt_counts, token_ids,
                      block_tables, context_lens, slot_ids, keys, temp, top_k,
                      top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
-                     cos, sin):
+                     gtable, gmodes, cos, sin):
                 logits, cache = fwd_decode(
                     params, cache, token_ids, block_tables, context_lens,
                     slot_ids, cos, sin,
                 )
                 logits = apply_penalties(logits, gen_counts, prompt_counts, pres, freq, rep)
                 logits = apply_logit_bias(logits, bias_ids, bias_vals)
+                # guided decoding: each lane's mode id selects its
+                # admissible-token row from the resident table; mode -1 =
+                # unguided (all tokens allowed)
+                rows = gtable[jnp.clip(gmodes, 0, gtable.shape[0] - 1)]
+                allowed = jnp.where((gmodes < 0)[:, None], True, rows)
+                logits = jnp.where(allowed, logits, -jnp.inf)
                 step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
                 tokens = sample_tokens(logits, step_keys, temp, top_k, top_p, greedy)
                 lps = token_logprobs(logits, tokens)
@@ -1035,7 +1099,43 @@ class JaxLlmEngine:
                 f"prompt length {len(pre.token_ids)} exceeds engine max length {self.max_len}"
             )
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
+        if pre.output_format is not None:
+            seq.guided = self._make_guided_cursor(pre.output_format)
         return self._start_sequence(seq, ctx)
+
+    def _make_guided_cursor(self, output_format: str):
+        """Validate a guided request against this deployment and return a
+        fresh cursor — loud 400-class errors beat silently-unconstrained
+        output the client believes is schema-guaranteed."""
+        if output_format not in ("json", "json_object"):
+            raise ValueError(
+                f"unsupported output_format {output_format!r} (want 'json')"
+            )
+        if self.guided_masks is None:
+            raise ValueError(
+                "guided JSON decoding is not enabled on this worker "
+                "(engine.enable_guided_json(tokenizer) at serve time)"
+            )
+        if self.config.decode_steps > 1:
+            # the fused scan feeds tokens back on-device; the automaton
+            # advances on the host between launches, so the mask would lag
+            # the generated text by up to decode_steps-1 tokens
+            raise ValueError(
+                "guided JSON decoding requires decode_steps=1 "
+                f"(engine runs fused decode_steps={self.config.decode_steps})"
+            )
+        if self.spec_enabled:
+            # the verify program samples the whole draft window with one
+            # mask state; drafts would need per-position automaton advances
+            raise ValueError(
+                "guided JSON decoding does not compose with speculative "
+                "decoding on this engine"
+            )
+        from dynamo_tpu.llm.guided import JsonCursor
+
+        return JsonCursor(
+            self.guided_masks, self._guided_strings, eos_ids=self._guided_eos
+        )
 
     def _start_sequence(self, seq: Sequence, ctx) -> ResponseStream[dict]:
         """Shared streaming tail for every entry point: wire the emit
@@ -1364,6 +1464,9 @@ class JaxLlmEngine:
         key_a = sds((2,), jnp.uint32)
         keys_a = sds((lanes, 2), jnp.uint32)
         cos_a, sin_a = aval(self.cos), aval(self.sin)
+        grow_a = aval(self._guided_true_row)
+        gtable_a = aval(self._guided_table)
+        gmodes_a = sds((lanes,), jnp.int32)
 
         def tail(n):
             f32 = lambda: sds((n,), jnp.float32)  # noqa: E731
@@ -1398,7 +1501,8 @@ class JaxLlmEngine:
                         self._jit_prefill_prefix,
                         (params_a, cache_a, counts_a, counts_a, i32,
                          sds((b,), jnp.int32), table_a, table_a, i32, i32, i32,
-                         row_a, row_a, i32, key_a, *tail(1), cos_a, sin_a),
+                         row_a, row_a, i32, key_a, *tail(1), grow_a,
+                         cos_a, sin_a),
                     )
             if self.chunk_tokens is None or n <= self.chunk_tokens:
                 # whole-prompt program: the only path when chunking is off,
@@ -1409,7 +1513,7 @@ class JaxLlmEngine:
                     self._jit_prefill,
                     (params_a, cache_a, counts_a, counts_a, i32,
                      sds((b,), jnp.int32), blocks_fixed, i32, i32, row_a,
-                     key_a, *tail(1), cos_a, sin_a),
+                     key_a, *tail(1), grow_a, cos_a, sin_a),
                 )
         tables_a = sds((lanes, self.max_blocks_per_seq), jnp.int32)
         lanes_i = sds((lanes,), jnp.int32)
@@ -1423,7 +1527,8 @@ class JaxLlmEngine:
             jobs[("decode",)] = (
                 self._jit_decode,
                 (params_a, cache_a, counts_a, counts_a, lanes_i, tables_a,
-                 lanes_i, lanes_i, keys_a, *tail(lanes), cos_a, sin_a),
+                 lanes_i, lanes_i, keys_a, *tail(lanes), gtable_a, gmodes_a,
+                 cos_a, sin_a),
             )
         if self._jit_verify is not None:
             w = cfg.spec_tokens + 1
@@ -1910,7 +2015,7 @@ class JaxLlmEngine:
                 jnp.int32(lane), jnp.asarray(emb_pad), jnp.asarray(tok_arr),
                 jnp.int32(seq.mm_len), jnp.asarray(block_ids), jnp.int32(total),
                 jnp.asarray(gen_row), jnp.asarray(key), *sampling_tail,
-                self.cos, self.sin,
+                self._guided_row(seq), self.cos, self.sin,
             )
             seq.prefilled_tokens = total
             want_top = seq.request.sampling.top_logprobs > 0
@@ -1945,6 +2050,8 @@ class JaxLlmEngine:
                 jnp.asarray(tail_ids), jnp.int32(t), jnp.int32(start),
                 jnp.int32(n), jnp.asarray(prompt_row), jnp.asarray(gen_row),
                 jnp.int32(1 if final else 0), jnp.asarray(key), *sampling_tail,
+                # intermediate chunks discard their sample: no constraint
+                self._guided_row(seq) if final else self._guided_true_row,
                 self.cos, self.sin,
             )
         else:
@@ -1956,7 +2063,7 @@ class JaxLlmEngine:
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.int32(lane), jnp.asarray(padded), jnp.asarray(block_ids),
                 jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
-                *sampling_tail, self.cos, self.sin,
+                *sampling_tail, self._guided_row(seq), self.cos, self.sin,
             )
         seq.prefilled_tokens = end
         if not final:
@@ -2102,10 +2209,15 @@ class JaxLlmEngine:
             jnp.asarray(bias_vals),
         )
         if steps <= 1:
+            gmodes = np.full((lanes,), -1, np.int32)
+            for seq in active:
+                if seq.guided is not None:
+                    gmodes[seq.lane] = seq.guided.mode_id
             tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
+                self._guided_table, jnp.asarray(gmodes),
                 self.cos, self.sin,
             )
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
@@ -2248,7 +2360,13 @@ class JaxLlmEngine:
         top=None,
     ) -> None:
         seq.output_ids.append(token)
+        if seq.guided is not None:
+            seq.guided.advance(token)
         finish = seq.hit_stop(token)
+        if finish is None and seq.guided is not None and seq.guided.complete:
+            # the document just closed: stop rather than sample trailing
+            # whitespace until max_tokens
+            finish = FinishReason.STOP
         if finish is None and seq.context_len >= self.max_len:
             finish = FinishReason.LENGTH
         if seq.emit:

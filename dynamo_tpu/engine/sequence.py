@@ -36,6 +36,10 @@ class Sequence:
     mm_embeds: object = None
     # per-lane sampling state (penalty counts, rng key) initialized?
     sampling_seeded: bool = False
+    # guided decoding: host-side automaton (llm/guided.JsonCursor) whose
+    # mode id selects the admissible-token mask row each step (None =
+    # unconstrained)
+    guided: object = None
     # prompt tokens reused from the prefix cache at allocation (the engine
     # prefills only the tail past this point)
     cached_tokens: int = 0

@@ -39,6 +39,12 @@ class EchoEngineCore:
             raise ValueError(
                 "this model deployment does not accept image/video input"
             )
+        if request.data.get("output_format"):
+            # echoed prompt tokens are not constrained output — reject like
+            # an engine without a mask table would
+            raise ValueError(
+                "this model deployment does not support guided decoding"
+            )
         pre = PreprocessedRequest.from_wire(request.data)
         ctx = request.ctx
 

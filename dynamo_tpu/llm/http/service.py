@@ -186,14 +186,16 @@ class HttpService:
                 code="invalid_value",
             )
         rf_type = (chat_request.response_format or {}).get("type", "text")
-        if rf_type != "text":
-            # no constrained decoding in this deployment: silently ignoring
-            # json_object/json_schema would hand the client unconstrained
-            # text it believes is schema-guaranteed
+        if rf_type not in ("text", "json_object"):
+            # json_object rides guided decoding (llm/guided.py; workers
+            # without the mask table reject and this surfaces as a 400
+            # below).  json_schema is not implemented: silently ignoring it
+            # would hand the client unconstrained text it believes is
+            # schema-guaranteed
             return _error(
                 400,
                 f"response_format type {rf_type!r} is not supported "
-                "(constrained decoding is not available)",
+                "(json_object is; schema-constrained decoding is not)",
                 param="response_format", code="unsupported_value",
             )
         engine = self.manager.chat_engines.get(chat_request.model)

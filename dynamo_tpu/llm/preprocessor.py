@@ -170,6 +170,11 @@ class ChatPreprocessor(Operator):
         if image_url is not None:
             image = await resolve_image(image_url)
             ctx_data["image"] = encode_image_wire(image)
+        # guided decoding: json_object constrains sampling to valid-JSON
+        # prefixes; the engine rejects when its mask table is not enabled
+        # (llm/guided.py; engine/engine.py enable_guided_json)
+        if (req.response_format or {}).get("type") == "json_object":
+            ctx_data["output_format"] = "json"
         # stash state for postprocess on the context object
         request.ctx._pre_state = {  # type: ignore[attr-defined]
             "prompt": prompt,

@@ -89,6 +89,10 @@ class PreprocessedRequest:
     estimated_prefix_hit_blocks: int | None = None
     disagg_mode: str | None = None  # None | "prefill" | "decode"
     mdc_sum: str | None = None
+    # guided decoding: "json" constrains sampling to valid-JSON prefixes
+    # (OpenAI response_format json_object; engines without the compiled
+    # mask table reject rather than silently ignore)
+    output_format: str | None = None
 
     def to_wire(self) -> dict:
         return {
@@ -101,6 +105,7 @@ class PreprocessedRequest:
             "estimated_prefix_hit_blocks": self.estimated_prefix_hit_blocks,
             "disagg_mode": self.disagg_mode,
             "mdc_sum": self.mdc_sum,
+            "output_format": self.output_format,
         }
 
     @classmethod
@@ -115,6 +120,7 @@ class PreprocessedRequest:
             estimated_prefix_hit_blocks=d.get("estimated_prefix_hit_blocks"),
             disagg_mode=d.get("disagg_mode"),
             mdc_sum=d.get("mdc_sum"),
+            output_format=d.get("output_format"),
         )
 
 

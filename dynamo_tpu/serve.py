@@ -21,6 +21,7 @@ from dynamo_tpu.llm.kv_router.publisher import (
     WorkerMetricsPublisher,
 )
 from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.tokenizer import HfTokenizer
 from dynamo_tpu.runtime.client import RouterMode
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.utils.logging import get_logger
@@ -139,6 +140,18 @@ async def serve_worker(
         engine = await asyncio.to_thread(
             build_jax_engine, model_dir, mdc, **engine_overrides
         )
+        # guided JSON decoding needs the tokenizer-compiled mask table;
+        # best-effort (decode_steps>1 / spec engines still serve, they just
+        # reject guided requests per-request) and BEFORE warmup so the
+        # table aval is part of the AOT-compiled programs
+        if engine.config.decode_steps <= 1 and not engine.spec_enabled:
+            try:
+                tokenizer = await asyncio.to_thread(
+                    HfTokenizer.from_model_dir, model_dir
+                )
+                await asyncio.to_thread(engine.enable_guided_json, tokenizer)
+            except Exception as exc:  # noqa: BLE001 — serving works unguided
+                logger.warning("guided-json table build failed: %r", exc)
         do_warmup = engine.wants_warmup
         service = await ep.serve(engine, stats_handler=engine.stats)
         kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)

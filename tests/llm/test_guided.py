@@ -1,0 +1,146 @@
+"""Guided JSON decoding: the compiled mask table must admit exactly the
+tokens that keep the output a valid-JSON prefix, and a mask-constrained
+greedy walk must always terminate in a document json.loads accepts."""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.llm.guided import JsonCursor, token_strings
+from dynamo_tpu.llm.tokenizer import HfTokenizer
+
+MODEL_DIR = Path(__file__).parent.parent / "data" / "tiny-chat-model"
+
+
+@pytest.fixture(scope="module")
+def tokenizer():
+    return HfTokenizer.from_file(MODEL_DIR / "tokenizer.json")
+
+
+@pytest.fixture(scope="module")
+def masks(tokenizer, tmp_path_factory):
+    from dynamo_tpu.llm.guided import build_for_tokenizer
+
+    cache = tmp_path_factory.mktemp("guided-cache")
+    return build_for_tokenizer(tokenizer, cache_dir=str(cache))[0]
+
+
+@pytest.fixture(scope="module")
+def strings(tokenizer):
+    return token_strings(tokenizer)
+
+
+def _cursor(masks, strings, tokenizer):
+    return JsonCursor(masks, strings, eos_ids=tokenizer.eos_token_ids)
+
+
+def _feed_text(cursor, tokenizer, text: str):
+    for tid in tokenizer.encode(text):
+        cursor.advance(tid)
+
+
+def test_valid_json_prefixes_keep_admissible_tokens(masks, strings, tokenizer):
+    """Feeding a valid document prefix never fails the cursor, and at each
+    point the actually-next token is admitted by the mask."""
+    doc = '{"name": "bob", "nums": [1, -2.5e3, true, null], "o": {"k": false}}'
+    ids = tokenizer.encode(doc)
+    cursor = _cursor(masks, strings, tokenizer)
+    for tid in ids:
+        mode = cursor.mode_id
+        assert mode >= 0
+        assert masks.mask[mode, tid], (
+            f"token {tid} ({strings[tid]!r}) rejected at {cursor.kind}"
+        )
+        cursor.advance(tid)
+        assert not cursor.failed
+    assert cursor.complete
+
+
+def test_invalid_continuations_are_masked(masks, strings, tokenizer):
+    cases = [
+        ("", "}"),                 # document cannot start with a close
+        ('{"k": 1', "]"),          # wrong closer for an object
+        ('{"a"', "5"),             # digit where ':' is required
+        ("[1", "{"),               # value start right after a value
+        ('{"a": 1}', ","),         # trailing garbage after completion
+    ]
+    for prefix, bad in cases:
+        cursor = _cursor(masks, strings, tokenizer)
+        _feed_text(cursor, tokenizer, prefix)
+        assert not cursor.failed
+        for tid in tokenizer.encode(bad):
+            assert not masks.mask[cursor.mode_id, tid], (
+                f"{bad!r} admitted after {prefix!r}"
+            )
+            break
+
+
+def test_specials_only_in_terminal_mode(masks, strings, tokenizer):
+    eos = tokenizer.eos_token_ids[0]
+    cursor = _cursor(masks, strings, tokenizer)
+    assert not masks.mask[cursor.mode_id, eos]  # not before a value
+    _feed_text(cursor, tokenizer, '{"a": [")("]}')
+    assert cursor.complete
+    assert masks.mask[cursor.mode_id, eos]      # admissible once complete
+    # markup-looking text IS legal inside strings…
+    mid = _cursor(masks, strings, tokenizer)
+    _feed_text(mid, tokenizer, '{"a": "<')
+    # …but the special TOKEN is still masked there
+    assert not masks.mask[mid.mode_id, eos]
+
+
+def test_unbounded_nesting_via_host_stack(masks, strings, tokenizer):
+    depth = 40  # far beyond anything a finite mode table could encode
+    cursor = _cursor(masks, strings, tokenizer)
+    _feed_text(cursor, tokenizer, "[" * depth + "1" + "]" * depth)
+    assert cursor.complete
+    # one more close is NOT admitted
+    for tid in tokenizer.encode("]"):
+        assert not masks.mask[cursor.mode_id, tid]
+
+
+def test_mask_constrained_greedy_walk_yields_valid_json(masks, strings, tokenizer):
+    """Adversarial decode: at every step pick the WORST-looking admissible
+    token (max id), bounded length; the forced-close property isn't
+    guaranteed mid-flight, but every completed cursor must parse."""
+    rng = np.random.default_rng(0)
+    for trial in range(25):
+        cursor = _cursor(masks, strings, tokenizer)
+        out = []
+        for _ in range(60):
+            row = np.flatnonzero(masks.mask[cursor.mode_id])
+            assert row.size, f"wedged at {cursor.kind}/{cursor.extra}"
+            tid = int(rng.choice(row))
+            if tid in set(tokenizer.eos_token_ids):
+                break
+            cursor.advance(tid)
+            assert not cursor.failed
+            out.append(tid)
+            if cursor.complete:
+                break
+        if cursor.complete:
+            text = tokenizer.decode(out, skip_special_tokens=False)
+            json.loads(text)  # must parse
+
+
+def test_trailing_commas_inadmissible(masks, strings, tokenizer):
+    """A close is never admissible right after a comma — '[1,]' and
+    '{"a":1,}' pass json.loads nowhere, so finish=stop must never produce
+    them — while genuinely-empty containers stay admissible."""
+    cursor = _cursor(masks, strings, tokenizer)
+    _feed_text(cursor, tokenizer, "[1,")
+    close = tokenizer.encode("]")[0]
+    assert not masks.mask[cursor.mode_id, close]
+
+    cursor = _cursor(masks, strings, tokenizer)
+    _feed_text(cursor, tokenizer, '{"a": 1,')
+    close = tokenizer.encode("}")[0]
+    assert not masks.mask[cursor.mode_id, close]
+
+    # empty containers: '[]' and '{}' remain admissible
+    for doc in ("[]", "{}", "[ ]", "{ }"):
+        cursor = _cursor(masks, strings, tokenizer)
+        _feed_text(cursor, tokenizer, doc)
+        assert cursor.complete, doc

@@ -170,11 +170,20 @@ async def test_http_400_names_offending_param():
             err = r.json()["error"]
             assert err["code"] == "model_not_found" and err["param"] == "model"
 
-            # constrained decoding isn't available: json response_format is
-            # an honest 400, never silently-unconstrained text
+            # json_object on a deployment WITHOUT guided decoding (echo
+            # engine, no mask table): honest 400 from the engine, never
+            # silently-unconstrained text
             r = await client.post(
                 "/v1/chat/completions",
                 json={**BASE, "response_format": {"type": "json_object"}},
+            )
+            assert r.status_code == 400
+            assert "guided decoding" in r.json()["error"]["message"]
+            # json_schema is not implemented anywhere: structured 400 at
+            # the protocol gate with the offending param named
+            r = await client.post(
+                "/v1/chat/completions",
+                json={**BASE, "response_format": {"type": "json_schema"}},
             )
             assert r.status_code == 400
             err = r.json()["error"]
@@ -189,3 +198,82 @@ async def test_http_400_names_offending_param():
             assert r.status_code == 200
     finally:
         await service.stop()
+
+
+async def test_json_mode_e2e_through_http():
+    """response_format json_object rides guided decoding end to end: the
+    streamed text is a valid-JSON prefix (and parses when finish=stop)."""
+    import json as _json
+    from pathlib import Path
+
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+    from dynamo_tpu.serve import serve_frontend, serve_worker
+    from dynamo_tpu.utils.config import RuntimeConfig
+
+    model_dir = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://json-mode")
+    )
+    service = watcher = worker = None
+    try:
+        worker = await serve_worker(
+            rt, model_dir, model_name="tiny", engine_kind="jax",
+            num_blocks=64, max_batch_size=4, max_model_len=128,
+            prefill_buckets=(32, 64),
+        )
+        assert worker.engine.guided_masks is not None  # auto-enabled
+        service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}", timeout=120
+        ) as client:
+            for _ in range(100):
+                r = await client.get("/v1/models")
+                if any(m["id"] == "tiny" for m in r.json().get("data", [])):
+                    break
+                import asyncio
+
+                await asyncio.sleep(0.1)
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny", "max_tokens": 48,
+                    "response_format": {"type": "json_object"},
+                    "messages": [{"role": "user", "content": "give me json"}],
+                },
+            )
+            assert r.status_code == 200, r.text
+            body = r.json()
+            content = body["choices"][0]["message"]["content"]
+            assert content.strip()
+            if body["choices"][0]["finish_reason"] == "stop":
+                _json.loads(content)
+            else:
+                # length-capped: still a valid JSON prefix — closing every
+                # open bracket must yield a parseable document for simple
+                # shapes, but the robust check is that the engine-side
+                # cursor admitted every token, which the engine enforces
+                # by construction; assert the text at least STARTS like
+                # JSON
+                assert content.lstrip()[0] in "{[-0123456789tfn\""
+
+            # json_schema stays a structured 400
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "response_format": {"type": "json_schema"},
+                    "messages": [{"role": "user", "content": "x"}],
+                },
+            )
+            assert r.status_code == 400
+            assert r.json()["error"]["param"] == "response_format"
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await rt.close()
