@@ -154,3 +154,43 @@ async def test_unguided_lanes_unaffected(guided_parts, tokenizer):
     finally:
         guided.stop()
     assert got == expected
+
+
+@pytest.mark.parametrize("mode", ["chunked", "prefix_hit"])
+async def test_guided_composes_with_continued_prefill(guided_parts, tokenizer, mode):
+    """The continued-prefill program carries its own mask row: only the
+    FINAL chunk's sample is constrained (intermediate chunks discard
+    theirs), and a prefix-cache hit's tail prefill samples constrained."""
+    masks, strings = guided_parts
+    kwargs = (
+        {"prefill_chunk_tokens": 16} if mode == "chunked"
+        else {"enable_prefix_caching": True}
+    )
+    engine = make_engine(**kwargs)
+    engine.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        prompt = list(range(3, 40))  # 37 tokens → 3 chunks at 16
+        wire = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=24),
+            eos_token_ids=[1],
+            output_format="json",
+        ).to_wire()
+        if mode == "prefix_hit":
+            # warm the prefix with an UNGUIDED request for the same prompt
+            plain = dict(wire)
+            plain.pop("output_format")
+            await collect(engine, plain)
+        tokens, _ = await collect(engine, wire)
+        assert tokens
+        replay = JsonCursor(masks, strings, eos_ids=tokenizer.eos_token_ids)
+        for tid in tokens:
+            replay.advance(tid)
+            assert not replay.failed, (
+                f"[{mode}] inadmissible token {tid} ({strings[tid]!r})"
+            )
+        if mode == "prefix_hit":
+            assert engine.stats().get("prefix_hits_total", 0) >= 1
+    finally:
+        engine.stop()
