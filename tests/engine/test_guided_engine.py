@@ -194,3 +194,73 @@ async def test_guided_composes_with_continued_prefill(guided_parts, tokenizer, m
             assert engine.stats().get("prefix_hits_total", 0) >= 1
     finally:
         engine.stop()
+
+
+@pytest.mark.slow
+async def test_soak_mixed_guided_unguided_under_preemption(guided_parts, tokenizer):
+    """Soak: guided and unguided requests interleaved over a KV pool far
+    too small for the load (constant preemption/recompute), a third
+    cancelled mid-stream.  Every guided stream that survives must replay
+    admissible; afterwards zero leaked blocks and the engine still serves."""
+    import asyncio
+    import random
+
+    masks, strings = guided_parts
+    engine = make_engine(
+        num_blocks=24, block_size=4, max_batch_size=4,
+        prefill_buckets=(16, 64), max_model_len=64,
+    )
+    engine.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        async def one(i: int):
+            r = random.Random(i)
+            n = r.randint(2, 30)
+            max_toks = r.randint(1, 20)
+            wire = PreprocessedRequest(
+                token_ids=list(range(3, 3 + n)),
+                sampling=SamplingOptions(use_greedy=(i % 2 == 0),
+                                         temperature=None if i % 2 == 0 else 0.8,
+                                         seed=i),
+                stop=StopConditions(max_tokens=max_toks),
+                eos_token_ids=[1],
+                output_format="json" if i % 4 == 0 else None,
+            ).to_wire()
+            ctx = Context(wire)
+            stream = await engine.generate(ctx)
+            cancel_at = r.randint(1, 5) if i % 3 == 1 else None
+            tokens = []
+            async for item in stream:
+                ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+                if ann.data is None:
+                    continue
+                if ann.data.finish_reason is FinishReason.ERROR:
+                    raise RuntimeError(ann.data.error)
+                tokens += ann.data.token_ids
+                if cancel_at is not None and len(tokens) >= cancel_at:
+                    ctx.ctx.stop_generating()
+            return i, tokens
+
+        results = await asyncio.gather(
+            *[one(i) for i in range(48)], return_exceptions=True
+        )
+        errs = [r for r in results if isinstance(r, BaseException)]
+        assert not errs, errs
+        for i, tokens in (r for r in results if not isinstance(r, BaseException)):
+            assert tokens
+            if i % 4 == 0:  # guided: replay must stay admissible
+                replay = JsonCursor(masks, strings, eos_ids=tokenizer.eos_token_ids)
+                for tid in tokens:
+                    replay.advance(tid)
+                    assert not replay.failed, (i, tokens)
+
+        for _ in range(200):
+            if engine.allocator.used_blocks == 0 and engine.scheduler.num_running == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert engine.allocator.used_blocks == 0
+        assert engine.scheduler.num_running == 0
+
+        tokens, _ = await collect(engine, guided_request(max_tokens=6))
+        assert tokens  # liveness after the storm
+    finally:
+        engine.stop()
