@@ -861,14 +861,25 @@ async def _measure_kv_routing() -> dict:
     cfg = SessionConfig(num_sessions=24, turns_per_session=4)
     fleet = FleetConfig()
     sessions = generate_sessions(cfg)
-    rnd = await run_fleet("random", sessions, fleet)
-    kv = await run_fleet("kv", sessions, fleet)
-    speedup = round(rnd["ttft_p50_ms"] / kv["ttft_p50_ms"], 2)
+    # median of 3 repeats: the compressed-sleep sim is sensitive to host
+    # load spikes (observed 1.6x-3.1x for the SAME config depending on
+    # what else the machine ran), and one spike must not become the
+    # recorded headline
+    speedups, followups, last = [], [], None
+    for _ in range(3):
+        rnd = await run_fleet("random", sessions, fleet)
+        kv = await run_fleet("kv", sessions, fleet)
+        speedups.append(rnd["ttft_p50_ms"] / kv["ttft_p50_ms"])
+        followups.append(
+            rnd["followup_ttft_p50_ms"] / kv["followup_ttft_p50_ms"]
+        )
+        last = (rnd, kv)
+    rnd, kv = last
+    speedup = round(sorted(speedups)[1], 2)
     return {
         "ttft_p50_speedup": speedup,
-        "followup_ttft_p50_speedup": round(
-            rnd["followup_ttft_p50_ms"] / kv["followup_ttft_p50_ms"], 2
-        ),
+        "ttft_p50_speedup_runs": [round(x, 2) for x in speedups],
+        "followup_ttft_p50_speedup": round(sorted(followups)[1], 2),
         # scored against the reference's 3x routing claim — this ratio is
         # device-independent, so it is ALWAYS a real vs_baseline
         "vs_baseline": round(speedup / BASELINE_ROUTING_SPEEDUP, 3),

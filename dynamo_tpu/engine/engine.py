@@ -480,6 +480,8 @@ class JaxLlmEngine:
         self.guided_masks = None
         self._guided_strings: list[str] | None = None
         self._guided_eos: list[int] = []
+        self._guided_requests = 0     # guided sequences admitted
+        self._guided_completions = 0  # finished with a COMPLETE document
         vocab = cfg.vocab_size
         self._guided_table = jnp.ones((1, vocab), jnp.bool_)
         self._guided_true_row = jnp.ones((vocab,), jnp.bool_)
@@ -1133,6 +1135,8 @@ class JaxLlmEngine:
             )
         from dynamo_tpu.llm.guided import JsonCursor
 
+        # count AFTER validation: rejected requests are not "admitted"
+        self._guided_requests += 1
         return JsonCursor(
             self.guided_masks, self._guided_strings, eos_ids=self._guided_eos
         )
@@ -1197,6 +1201,11 @@ class JaxLlmEngine:
                 f"exceeds engine max length {self.max_len}"
             )
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, mm_embeds=embeds)
+        if pre.output_format is not None:
+            # same contract as generate(): a guided multimodal request on a
+            # deployment that cannot constrain it must fail loudly (the mm
+            # prefill program already threads the mask row)
+            seq.guided = self._make_guided_cursor(pre.output_format)
         return self._start_sequence(seq, ctx)
 
     async def _watch_cancel(self, ctx, seq: Sequence) -> None:
@@ -1219,6 +1228,11 @@ class JaxLlmEngine:
             seq_id=uuid.uuid4().hex, request=pre, prefill_only=True,
             extract_device=device,
         )
+        if pre.output_format is not None:
+            # constrain the FIRST sampled token on the prefill side so the
+            # decode worker's cursor (generate_prefilled) accepts it — this
+            # is what makes guided decoding compose with disaggregation
+            seq.guided = self._make_guided_cursor(pre.output_format)
 
         def on_done(result) -> None:
             def resolve() -> None:
@@ -1277,6 +1291,42 @@ class JaxLlmEngine:
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, remote_prefilled=True)
+        if pre.output_format is not None:
+            # disagg split: the remote prefill worker sampled first_token —
+            # advance a fresh cursor over it.  A guided-enabled prefill
+            # worker (prefill_extract builds its own cursor) always hands
+            # over an admissible token; an unconstrained one can hand over
+            # anything, including an early EOS — refuse loudly instead of
+            # silently dropping the constraint.  On refusal the caller's
+            # reserved landing blocks must not leak (the sole production
+            # caller, llm/disagg.py, calls this outside its try/except):
+            # adopt + free returns them to the pool before raising.
+            cursor = None
+            try:
+                cursor = self._make_guided_cursor(pre.output_format)
+                cursor.advance(first_token)
+                if cursor.failed or (
+                    first_token in self._guided_eos and not cursor.complete
+                ):
+                    raise ValueError(
+                        "guided JSON decoding over disaggregated prefill "
+                        "needs a guided-enabled prefill worker: the "
+                        "remotely sampled first token is not a valid JSON "
+                        "start"
+                    )
+            except ValueError:
+                if cursor is not None:
+                    # the cursor was admitted-counted, then rejected
+                    self._guided_requests -= 1
+                self.allocator.adopt_sequence(seq.seq_id, block_ids)
+                self.allocator.free_sequence(seq.seq_id)
+                raise
+            seq.guided = cursor
+            if cursor.complete:
+                # a single token closed the whole document (e.g. a "{}"
+                # token): count it here — the transition happened outside
+                # _process_token, which only sees later tokens
+                self._guided_completions += 1
         seq.output_ids.append(first_token)
         self.allocator.adopt_sequence(seq.seq_id, block_ids)
 
@@ -1592,6 +1642,8 @@ class JaxLlmEngine:
             "prefix_cached_tokens_total": self.allocator.prefix_cached_tokens_total,
             "spec_drafted_tokens_total": self._spec_drafted,
             "spec_accepted_tokens_total": self._spec_accepted,
+            "guided_requests_total": self._guided_requests,
+            "guided_completions_total": self._guided_completions,
         }
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
@@ -2361,7 +2413,13 @@ class JaxLlmEngine:
     ) -> None:
         seq.output_ids.append(token)
         if seq.guided is not None:
+            was_complete = seq.guided.complete
             seq.guided.advance(token)
+            if seq.guided.complete and not was_complete:
+                # count the completion on the closing-token TRANSITION: a
+                # document that closes exactly on the max_tokens-th token
+                # (finish=LENGTH below) is still a completed document
+                self._guided_completions += 1
         finish = seq.hit_stop(token)
         if finish is None and seq.guided is not None and seq.guided.complete:
             # the document just closed: stop rather than sample trailing

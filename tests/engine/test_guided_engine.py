@@ -264,3 +264,119 @@ async def test_soak_mixed_guided_unguided_under_preemption(guided_parts, tokeniz
         assert tokens  # liveness after the storm
     finally:
         engine.stop()
+
+
+async def test_guided_counters_in_stats(guided_parts, tokenizer):
+    """Counters must reflect reality even when the closing token coincides
+    with a stop condition: drive to a KNOWN completion by capping
+    max_tokens exactly at the completion length observed in a first run."""
+    masks, strings = guided_parts
+    engine = make_engine()
+    engine.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        # find a sampled walk that COMPLETES (seeded → deterministic); the
+        # automaton guarantees admissibility but not termination, so search
+        # a handful of seeds instead of hoping greedy closes its brackets
+        done = None
+        for seed in range(12):
+            tokens, finish = await collect(
+                engine, guided_request(max_tokens=96, temperature=1.3, seed=seed)
+            )
+            replay = JsonCursor(masks, strings, eos_ids=tokenizer.eos_token_ids)
+            for tid in tokens:
+                replay.advance(tid)
+            if replay.complete:
+                done = (tokens, finish, seed)
+                break
+        assert done is not None, "no seed completed a document in 96 tokens"
+        tokens, finish, seed = done
+        assert finish is FinishReason.STOP
+        stats = engine.stats()
+        assert stats["guided_requests_total"] >= 1
+        completions_now = stats["guided_completions_total"]
+        assert completions_now >= 1
+
+        # same walk with max_tokens == completion length: the closing token
+        # ALSO trips LENGTH, and the completion must still count
+        tokens2, _ = await collect(
+            engine,
+            guided_request(max_tokens=len(tokens), temperature=1.3, seed=seed),
+        )
+        assert tokens2 == tokens
+        assert engine.stats()["guided_completions_total"] == completions_now + 1
+    finally:
+        engine.stop()
+
+
+async def test_guided_composes_with_disagg_split(guided_parts, tokenizer):
+    """Disaggregated prefill/decode with guided JSON: the prefill worker
+    constrains its first sample, the decode worker's cursor adopts it, and
+    the decoded stream stays admissible end to end."""
+    masks, strings = guided_parts
+    prefill = make_engine()
+    prefill.set_guided(masks, strings, tokenizer.eos_token_ids)
+    decode = make_engine()
+    decode.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        pre = PreprocessedRequest(
+            token_ids=[3, 100, 200, 5],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=16),
+            eos_token_ids=[1],
+            output_format="json",
+        )
+        first, _lp, _top, blocks, n_used = await prefill.prefill_extract(pre)
+        target = decode.reserve_blocks(len(pre.token_ids) + 1)
+        assert target is not None
+        await decode.inject_blocks(target[:n_used], blocks)
+        stream = await decode.generate_prefilled(
+            Context(pre.to_wire()), target, first
+        )
+        tokens = [first]
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is None:
+                continue
+            if ann.data.finish_reason is FinishReason.ERROR:
+                raise RuntimeError(ann.data.error)
+            tokens += ann.data.token_ids
+        replay = JsonCursor(masks, strings, eos_ids=tokenizer.eos_token_ids)
+        for tid in tokens:
+            replay.advance(tid)
+            assert not replay.failed, (tid, strings[tid])
+    finally:
+        prefill.stop()
+        decode.stop()
+
+
+@pytest.mark.parametrize("bad_first", ["close_brace", "eos"])
+async def test_disagg_refusal_releases_blocks(guided_parts, tokenizer, bad_first):
+    """An unguided prefill worker handing over an inadmissible first token
+    (or an early EOS) is refused loudly — and the decode worker's reserved
+    landing blocks go back to the pool instead of leaking (the production
+    caller invokes generate_prefilled outside its try/except)."""
+    masks, strings = guided_parts
+    decode = make_engine()
+    decode.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        pre = PreprocessedRequest(
+            token_ids=[3, 100, 200, 5],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=8),
+            eos_token_ids=[1],
+            output_format="json",
+        )
+        token = (
+            tokenizer.encode("}")[0] if bad_first == "close_brace"
+            else tokenizer.eos_token_ids[0]
+        )
+        target = decode.reserve_blocks(len(pre.token_ids) + 1)
+        assert target is not None
+        used_before_release = decode.allocator.used_blocks
+        assert used_before_release > 0
+        with pytest.raises(ValueError, match="guided-enabled prefill"):
+            await decode.generate_prefilled(Context(pre.to_wire()), target, token)
+        assert decode.allocator.used_blocks == 0  # no leak
+        assert decode.stats()["guided_requests_total"] == 0  # not admitted
+    finally:
+        decode.stop()
