@@ -83,6 +83,16 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
             logger.warning("no safetensors in %s — random-initializing weights", model_dir)
     engine = JaxLlmEngine(config, params=params)
     engine.wants_warmup = wants_warmup
+    # guided JSON decoding needs the tokenizer-compiled mask table; enable
+    # here so EVERY launch path (serve_worker, disagg workers, example
+    # graphs) supports response_format json_object.  Best-effort: engines
+    # that cannot guide (fused decode, spec) still serve and reject guided
+    # requests per-request; a table-build failure serves unguided.
+    if config.decode_steps <= 1 and not engine.spec_enabled:
+        try:
+            engine.enable_guided_json(HfTokenizer.from_model_dir(model_dir))
+        except Exception as exc:  # noqa: BLE001 — serving works unguided
+            logger.warning("guided-json table build failed: %r", exc)
     return engine
 
 
@@ -140,18 +150,6 @@ async def serve_worker(
         engine = await asyncio.to_thread(
             build_jax_engine, model_dir, mdc, **engine_overrides
         )
-        # guided JSON decoding needs the tokenizer-compiled mask table;
-        # best-effort (decode_steps>1 / spec engines still serve, they just
-        # reject guided requests per-request) and BEFORE warmup so the
-        # table aval is part of the AOT-compiled programs
-        if engine.config.decode_steps <= 1 and not engine.spec_enabled:
-            try:
-                tokenizer = await asyncio.to_thread(
-                    HfTokenizer.from_model_dir, model_dir
-                )
-                await asyncio.to_thread(engine.enable_guided_json, tokenizer)
-            except Exception as exc:  # noqa: BLE001 — serving works unguided
-                logger.warning("guided-json table build failed: %r", exc)
         do_warmup = engine.wants_warmup
         service = await ep.serve(engine, stats_handler=engine.stats)
         kv_pub = KvEventPublisher(ep.component, worker_id=service.instance.instance_id)
