@@ -65,6 +65,13 @@ class LlamaConfig:
     # keeps all KV blocks resident (correctness first); freeing blocks
     # that scrolled out of the window is a future memory optimization.
     sliding_window: int | None = None
+    # MLP gate activation: "silu" (llama/qwen/mistral) or "gelu_tanh"
+    # (gemma GeGLU)
+    mlp_activation: str = "silu"
+    # input-embedding scale (gemma multiplies by sqrt(hidden_size) at the
+    # input ONLY — the tied unembedding stays unscaled, so this cannot be
+    # baked into the weights)
+    embed_scale: float = 1.0
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -247,8 +254,22 @@ def init_kv_cache(cfg: LlamaConfig, num_blocks: int, block_size: int, dtype=None
 # ---------------------------------------------------------------------------
 
 
-def _mlp(x, gate, up, down):
-    return mm(jax.nn.silu(mm(x, gate)) * mm(x, up), down)
+def _embed(params, cfg: LlamaConfig, token_ids) -> jnp.ndarray:
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+    return x
+
+
+def _mlp(x, gate, up, down, activation: str = "silu"):
+    if activation == "gelu_tanh":  # gemma GeGLU (HF gelu_pytorch_tanh)
+        act = jax.nn.gelu(mm(x, gate), approximate=True)
+    elif activation == "silu":
+        act = jax.nn.silu(mm(x, gate))
+    else:
+        # a typo'd activation must not silently run silu into wrong logits
+        raise ValueError(f"unknown mlp_activation {activation!r}")
+    return mm(act * mm(x, up), down)
 
 
 def _qkv(attn_in, w, cfg: LlamaConfig):
@@ -281,7 +302,7 @@ def llama_forward_trunk(
     """Trunk-only forward (no KV cache, no LM head): final hidden states
     [seq_pad, hidden].  Used by the embedding engine."""
     s = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, token_ids)
     positions = jnp.arange(s, dtype=jnp.int32)
 
     def layer(x, w):
@@ -295,7 +316,7 @@ def llama_forward_trunk(
         )[0]
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
@@ -327,7 +348,7 @@ def llama_forward_prefill(
     attention runs as ring attention (ops/ring_attention.py), K/V chunks
     rotating over ICI, enabling prompts beyond one chip's activation memory
     (sequence/context parallelism; the reference has none, SURVEY.md §2.5)."""
-    x = params["embed"][token_ids].astype(cfg.dtype)  # [s, h]
+    x = _embed(params, cfg, token_ids)  # [s, h]
     return llama_forward_prefill_embeds(
         params, cfg, x, kv_cache, block_ids, seq_len, start_pos, cos, sin,
         sp_mesh=sp_mesh,
@@ -381,7 +402,7 @@ def llama_forward_prefill_embeds(
             )[0]
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -418,7 +439,7 @@ def llama_forward_prefill_with_prefix(
     softmax (ops/ring_attention.ring_attention_with_prefix) — prefix
     caching and chunked prefill compose with sequence parallelism."""
     s = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, token_ids)
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
 
     if sp_mesh is not None:
@@ -451,7 +472,7 @@ def llama_forward_prefill_with_prefix(
             )
         x = x + mm(attn.reshape(s, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -487,7 +508,7 @@ def llama_forward_decode(
     "jax" is the portable gather-based fallback.
     """
     b = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)  # [b, h]
+    x = _embed(params, cfg, token_ids)  # [b, h]
     positions = jnp.maximum(context_lens - 1, 0)      # this token's position
 
     def attend(q, k_layer, v_layer):
@@ -533,7 +554,7 @@ def llama_forward_decode(
         attn = attend(q, k_layer, v_layer)
         x = x + mm(attn.reshape(b, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -566,7 +587,7 @@ def llama_forward_verify(
     speculative decoding on TPU.  ``attention="pallas"`` runs the
     multi-query paged kernel (no materialized page gather)."""
     b, w_len = token_ids.shape
-    x = params["embed"][token_ids.reshape(-1)].astype(cfg.dtype)  # [b*w, h]
+    x = _embed(params, cfg, token_ids.reshape(-1))  # [b*w, h]
     positions = jnp.maximum(
         context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
     )  # [b, w]
@@ -591,7 +612,7 @@ def llama_forward_verify(
         attn = attend(q, k_layer, v_layer)
         x = x + mm(attn.reshape(b * w_len, -1), w["wo"])
         mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x, (k_layer, v_layer)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -622,7 +643,7 @@ def llama_forward_decode_pp(
     ICI.  Embedding and the LM head run replicated outside the pipeline.
     Matches llama_forward_decode exactly (same layer body)."""
     b = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(cfg.dtype)
+    x = _embed(params, cfg, token_ids)
     positions = jnp.maximum(context_lens - 1, 0)
 
     def body(x_mb, aux_mb, w, layer_cache):
@@ -639,7 +660,7 @@ def llama_forward_decode_pp(
         )
         x_mb = x_mb + mm(attn.reshape(x_mb.shape[0], -1), w["wo"])
         mlp_in = rms_norm(x_mb, w["mlp_norm"], cfg.rms_norm_eps)
-        x_mb = x_mb + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"])
+        x_mb = x_mb + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
         return x_mb, (k_layer, v_layer)
 
     from dynamo_tpu.parallel.pipeline import pipeline_layer_stack
@@ -652,6 +673,39 @@ def llama_forward_decode_pp(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def gemma_config_from_hf(config: dict | str | Path) -> LlamaConfig:
+    """Gemma-1 = llama skeleton + GeGLU MLP, sqrt(hidden) input-embedding
+    scale, and (1+w) RMSNorm weights (baked at load time,
+    gemma_load_hf_weights).  Gemma always ties embeddings."""
+    if not isinstance(config, dict):
+        config = json.loads(Path(config).read_text())
+    act = config.get("hidden_activation") or config.get("hidden_act") or "gelu_pytorch_tanh"
+    if act not in ("gelu", "gelu_pytorch_tanh"):
+        raise ValueError(f"unexpected gemma activation {act!r}")
+    # delegate the shared fields (rope scaling, windows, biases, defaults)
+    # and override only the gemma deltas — a field added to from_hf_config
+    # must not silently go missing here
+    import dataclasses
+
+    return dataclasses.replace(
+        LlamaConfig.from_hf_config(config),
+        tie_word_embeddings=True,
+        mlp_activation="gelu_tanh",
+        embed_scale=float(config["hidden_size"]) ** 0.5,
+    )
+
+
+def gemma_load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
+    """Gemma checkpoints store RMSNorm weights as w with runtime (1 + w):
+    bake the +1 in once so every forward path runs unchanged."""
+    params = load_hf_weights(cfg, model_dir)
+    plus_one = lambda t: (t.astype(jnp.float32) + 1.0).astype(t.dtype)  # noqa: E731
+    layers = dict(params["layers"])
+    layers["attn_norm"] = plus_one(layers["attn_norm"])
+    layers["mlp_norm"] = plus_one(layers["mlp_norm"])
+    return {**params, "layers": layers, "final_norm": plus_one(params["final_norm"])}
 
 
 def make_rope_tables(cfg: LlamaConfig) -> tuple[jnp.ndarray, jnp.ndarray]:

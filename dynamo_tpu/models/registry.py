@@ -135,6 +135,23 @@ def _qwen3_family() -> ModelFamily:
     return _llama_like_family("qwen3", lambda c: c.update(qk_norm=True))
 
 
+def _gemma_family() -> ModelFamily:
+    # Gemma-1 = llama skeleton + GeGLU, sqrt(hidden) embedding scale, and
+    # (1+w) RMSNorm baked at load (models/llama.py gemma_* helpers).
+    # Gemma-2/3 (interleaved local/global attention, logit softcapping)
+    # would need per-layer attention patterns — not yet supported.
+    base = _llama_like_family("gemma")
+    from dataclasses import replace as dc_replace
+
+    from dynamo_tpu.models import llama
+
+    return dc_replace(
+        base,
+        config_from_hf=llama.gemma_config_from_hf,
+        load_weights=llama.gemma_load_hf_weights,
+    )
+
+
 def _mixtral_family() -> ModelFamily:
     from dynamo_tpu.models import mixtral
 
@@ -207,6 +224,7 @@ _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "mistral": _llama_family,
     "qwen2": _qwen2_family,
     "qwen3": _qwen3_family,
+    "gemma": _gemma_family,
     "mixtral": _mixtral_family,
     "qwen3_moe": _qwen3_moe_family,
     # HF model_type keys for the MLA architectures only — classic

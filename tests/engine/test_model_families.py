@@ -92,3 +92,32 @@ async def test_deepseek_engine_generates():
         assert finish is not None
     finally:
         engine.stop()
+
+
+async def test_gemma_engine_generates():
+    """Gemma-1 (GeGLU, scaled embeddings, gemma registry entry) serves
+    through the same engine machinery."""
+    fam = get_family("gemma")
+    cfg = fam.config_from_hf({
+        "model_type": "gemma", "vocab_size": 256, "hidden_size": 48,
+        "intermediate_size": 96, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2, "head_dim": 12,
+        "hidden_activation": "gelu_pytorch_tanh",
+    })
+    assert cfg.mlp_activation == "gelu_tanh"
+    assert cfg.embed_scale == pytest.approx(48 ** 0.5)
+    import jax.numpy as jnp
+
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="gemma", num_blocks=32, block_size=4,
+            max_batch_size=2, prefill_buckets=(16,), max_model_len=32,
+        )
+    )
+    engine.start()
+    try:
+        tokens, finish = await collect(engine, request(range(3, 10), max_tokens=4))
+        assert len(tokens) >= 1
+    finally:
+        engine.stop()

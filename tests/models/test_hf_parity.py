@@ -208,3 +208,43 @@ def test_mixtral_matches_hf(tmp_path):
     model = transformers.MixtralForCausalLM(config).eval()
     model.save_pretrained(tmp_path, safe_serialization=True)
     _check(_our_mixtral_logits, model, tmp_path, atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.slow
+def test_gemma_matches_hf(tmp_path):
+    """Gemma-1: GeGLU MLP, sqrt(hidden) input-embedding scale, (1+w)
+    RMSNorm (baked at load), tied unembedding, head_dim != hidden/heads."""
+    config = transformers.GemmaConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=24, max_position_embeddings=256, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh", torch_dtype="float32",
+    )
+    torch.manual_seed(5)
+    model = transformers.GemmaForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    def ours(model_dir, prompt):
+        from dynamo_tpu.models.llama import (
+            init_kv_cache,
+            llama_forward_prefill,
+            make_rope_tables,
+        )
+        from dynamo_tpu.models.registry import get_family
+
+        fam = get_family("gemma")
+        cfg = fam.config_from_hf(f"{model_dir}/config.json")
+        cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+        assert cfg.mlp_activation == "gelu_tanh"
+        assert cfg.embed_scale == pytest.approx(8.0)  # sqrt(64)
+        params = fam.load_weights(cfg, model_dir)
+        cos, sin = make_rope_tables(cfg)
+        cache = init_kv_cache(cfg, 16, 4)
+        blocks = jnp.arange(8, dtype=jnp.int32)
+        logits, _ = llama_forward_prefill(
+            params, cfg, jnp.asarray(prompt, jnp.int32), cache, blocks,
+            jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+        )
+        return np.asarray(logits)
+
+    _check(ours, model, tmp_path)
