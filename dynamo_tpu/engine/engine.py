@@ -818,13 +818,13 @@ class JaxLlmEngine:
                  grow, cos, sin):
             s = token_ids.shape[0]
             pos = jnp.arange(s)
-            x_text = params["embed"][token_ids].astype(cfg.dtype)
-            scale = float(getattr(cfg, "embed_scale", 1.0) or 1.0)
-            if scale != 1.0:
-                # gemma scales INPUT embeddings by sqrt(hidden) (the tied
-                # unembedding stays unscaled); text tokens here must match
-                # the text-only paths' _embed helper (models/llama.py)
-                x_text = x_text * jnp.asarray(scale, cfg.dtype)
+            # the family's embed hook carries input-embedding quirks (gemma
+            # scales by sqrt(hidden)) so this generic splice code never
+            # copies family math inline
+            if self.family.embed is not None:
+                x_text = self.family.embed(params, cfg, token_ids)
+            else:
+                x_text = params["embed"][token_ids].astype(cfg.dtype)
             x = jnp.where((pos < n_patch)[:, None], embeds.astype(cfg.dtype), x_text)
             logits, cache = self.family.forward_prefill_embeds(
                 params, cfg, x, cache, block_ids, seq_len, jnp.int32(0),

@@ -675,6 +675,45 @@ def llama_forward_decode_pp(
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
+def phi3_config_from_hf(config: dict | str | Path) -> LlamaConfig:
+    """Phi-3 = llama math with FUSED checkpoint tensors (qkv_proj,
+    gate_up_proj — split in phi3_load_hf_weights) and an always-on
+    sliding window.  The 128k 'longrope' variants are refused loudly:
+    ops/rope.py has no longrope schedule yet."""
+    if not isinstance(config, dict):
+        config = json.loads(Path(config).read_text())
+    scaling = config.get("rope_scaling") or {}
+    kind = scaling.get("rope_type") or scaling.get("type")
+    if kind in ("longrope", "su"):
+        raise NotImplementedError(
+            "phi3 longrope scaling is not implemented; the 4k-context "
+            "variants (rope_scaling: null) are supported"
+        )
+    return LlamaConfig.from_hf_config(config)
+
+
+def phi3_load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
+    """Split Phi-3's fused qkv_proj [q+k+v, h] and gate_up_proj [2i, h]
+    into the standard per-projection names, then delegate to the base
+    loader — the stacking/transpose/tie logic must not fork."""
+    from dynamo_tpu.models.hf_io import read_safetensors
+
+    tensors = dict(read_safetensors(model_dir))
+    qd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    inter = cfg.intermediate_size
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}"
+        qkv = tensors.pop(f"{p}.self_attn.qkv_proj.weight")
+        tensors[f"{p}.self_attn.q_proj.weight"] = qkv[:qd]
+        tensors[f"{p}.self_attn.k_proj.weight"] = qkv[qd : qd + kvd]
+        tensors[f"{p}.self_attn.v_proj.weight"] = qkv[qd + kvd :]
+        gate_up = tensors.pop(f"{p}.mlp.gate_up_proj.weight")
+        tensors[f"{p}.mlp.gate_proj.weight"] = gate_up[:inter]
+        tensors[f"{p}.mlp.up_proj.weight"] = gate_up[inter:]
+    return load_hf_weights(cfg, model_dir, tensors=tensors)
+
+
 def gemma_config_from_hf(config: dict | str | Path) -> LlamaConfig:
     """Gemma-1 = llama skeleton + GeGLU MLP, sqrt(hidden) input-embedding
     scale, and (1+w) RMSNorm weights (baked at load time,
@@ -732,12 +771,17 @@ _HF_LAYER_MAP = {
 }
 
 
-def load_hf_weights(cfg: LlamaConfig, model_dir: str | Path) -> dict:
+def load_hf_weights(
+    cfg: LlamaConfig, model_dir: str | Path, *, tensors: dict | None = None
+) -> dict:
     """Load and stack HF llama safetensors into our layer-stacked pytree.
-    (HF stores projections as [out, in]; ours are [in, out] → transpose.)"""
-    from dynamo_tpu.models.hf_io import read_safetensors
+    (HF stores projections as [out, in]; ours are [in, out] → transpose.)
+    ``tensors`` overrides the on-disk read for loaders that pre-process the
+    checkpoint (phi3 splits its fused tensors, then delegates here)."""
+    if tensors is None:
+        from dynamo_tpu.models.hf_io import read_safetensors
 
-    tensors = read_safetensors(model_dir)
+        tensors = read_safetensors(model_dir)
 
     def get(name: str, transpose: bool = False):
         t = tensors[name]

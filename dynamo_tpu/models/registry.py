@@ -32,6 +32,12 @@ class ModelFamily:
     # prefill from precomputed input embeddings (multimodal: vision patches
     # spliced before text); None = no multimodal support for this family
     forward_prefill_embeds: Callable | None = None
+    # token-embedding lookup hook: (params, cfg, token_ids) -> [n, hidden].
+    # None = raw table lookup.  Families with input-embedding quirks
+    # (gemma's sqrt(hidden) scale) set this so generic engine code — the
+    # multimodal prefill splices text embeddings itself — stays family-
+    # agnostic instead of copying the quirk inline.
+    embed: Callable | None = None
     # forward_prefill accepts sp_mesh= (ring-attention sequence parallelism)
     supports_sp: bool = False
     # forward_prefill_with_prefix accepts sp_mesh (ring attention over the
@@ -84,13 +90,19 @@ _PROJ_QUANT_LEAVES = (
 )
 
 
-def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
-    """One ModelFamily construction for every llama-geometry variant
-    (llama / qwen2 / qwen3); ``config_tweak(dict)`` mutates the HF config
-    before parsing (biases, qk-norm flags)."""
+def _llama_like_family(
+    name: str, config_tweak=None, *, config_from_hf=None, load_weights=None,
+) -> ModelFamily:
+    """One ModelFamily construction for every llama-geometry variant.
+
+    ``config_tweak(dict)`` mutates the HF config before parsing (biases,
+    qk-norm flags); ``config_from_hf``/``load_weights`` replace the whole
+    parse/load step for families with checkpoint quirks (gemma's baked
+    (1+w) norms, phi3's fused tensors) so each stays a one-line
+    declaration."""
     from dynamo_tpu.models import llama
 
-    def config_from_hf(config):
+    def default_config_from_hf(config):
         import json
 
         if not isinstance(config, dict):
@@ -102,17 +114,18 @@ def _llama_like_family(name: str, config_tweak=None) -> ModelFamily:
 
     return ModelFamily(
         name=name,
-        config_from_hf=config_from_hf,
+        config_from_hf=config_from_hf or default_config_from_hf,
         init_params=llama.init_params,
         param_specs=llama.param_specs,
         forward_prefill=llama.llama_forward_prefill,
         forward_decode=llama.llama_forward_decode,
         forward_prefill_with_prefix=llama.llama_forward_prefill_with_prefix,
         forward_prefill_embeds=llama.llama_forward_prefill_embeds,
+        embed=llama._embed,
         supports_sp=True,
         prefix_prefill_accepts_sp=True,
         forward_decode_pp=llama.llama_forward_decode_pp,
-        load_weights=llama.load_hf_weights,
+        load_weights=load_weights or llama.load_hf_weights,
         decode_accepts_tp_mesh=True,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=llama.llama_forward_verify,
@@ -135,18 +148,28 @@ def _qwen3_family() -> ModelFamily:
     return _llama_like_family("qwen3", lambda c: c.update(qk_norm=True))
 
 
+def _phi3_family() -> ModelFamily:
+    # Phi-3 = llama math with fused checkpoint tensors (split at load) and
+    # an always-on sliding window; longrope variants refused at config
+    # parse (models/llama.py phi3_* helpers)
+    from dynamo_tpu.models import llama
+
+    return _llama_like_family(
+        "phi3",
+        config_from_hf=llama.phi3_config_from_hf,
+        load_weights=llama.phi3_load_hf_weights,
+    )
+
+
 def _gemma_family() -> ModelFamily:
     # Gemma-1 = llama skeleton + GeGLU, sqrt(hidden) embedding scale, and
     # (1+w) RMSNorm baked at load (models/llama.py gemma_* helpers).
     # Gemma-2/3 (interleaved local/global attention, logit softcapping)
     # would need per-layer attention patterns — not yet supported.
-    base = _llama_like_family("gemma")
-    from dataclasses import replace as dc_replace
-
     from dynamo_tpu.models import llama
 
-    return dc_replace(
-        base,
+    return _llama_like_family(
+        "gemma",
         config_from_hf=llama.gemma_config_from_hf,
         load_weights=llama.gemma_load_hf_weights,
     )
@@ -225,6 +248,7 @@ _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "qwen2": _qwen2_family,
     "qwen3": _qwen3_family,
     "gemma": _gemma_family,
+    "phi3": _phi3_family,
     "mixtral": _mixtral_family,
     "qwen3_moe": _qwen3_moe_family,
     # HF model_type keys for the MLA architectures only — classic

@@ -248,3 +248,58 @@ def test_gemma_matches_hf(tmp_path):
         return np.asarray(logits)
 
     _check(ours, model, tmp_path)
+
+
+@pytest.mark.slow
+def test_phi3_matches_hf(tmp_path):
+    """Phi-3: fused qkv_proj/gate_up_proj split at load, and the always-on
+    sliding window — the SMALL window here makes HF's window mask part of
+    the oracle, so an off-by-one in our window convention fails loudly."""
+    config = transformers.Phi3Config(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        sliding_window=8, tie_word_embeddings=False, torch_dtype="float32",
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(6)
+    model = transformers.Phi3ForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    def ours(model_dir, prompt):
+        from dynamo_tpu.models.llama import (
+            init_kv_cache,
+            llama_forward_prefill,
+            make_rope_tables,
+        )
+        from dynamo_tpu.models.registry import get_family
+
+        fam = get_family("phi3")
+        cfg = fam.config_from_hf(f"{model_dir}/config.json")
+        cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+        assert cfg.sliding_window == 8
+        params = fam.load_weights(cfg, model_dir)
+        cos, sin = make_rope_tables(cfg)
+        cache = init_kv_cache(cfg, 16, 4)
+        blocks = jnp.arange(8, dtype=jnp.int32)
+        logits, _ = llama_forward_prefill(
+            params, cfg, jnp.asarray(prompt, jnp.int32), cache, blocks,
+            jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+        )
+        return np.asarray(logits)
+
+    _check(ours, model, tmp_path)
+
+
+def test_phi3_longrope_refused():
+    from dynamo_tpu.models.registry import get_family
+
+    with pytest.raises(NotImplementedError, match="longrope"):
+        get_family("phi3").config_from_hf({
+            "model_type": "phi3", "vocab_size": 128, "hidden_size": 32,
+            "intermediate_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4,
+            "rope_scaling": {"rope_type": "longrope", "short_factor": [1.0],
+                             "long_factor": [1.0]},
+        })
