@@ -163,6 +163,85 @@ class MultimodalEngine:
         return self.llm.stats()
 
 
+async def serve_http(model_dir: str, port: int, *, remote_encode: bool = False) -> int:
+    """OpenAI frontend over the multimodal engine: POST an image-bearing
+    chat completion (``image_url`` data:/http content part) and the image
+    is decoded at the frontend, encoded by the ViT, and embedding-spliced
+    ahead of the text (llm/multimodal.py; the front-door path the e2e
+    tests drive)."""
+    import asyncio as _asyncio
+    import signal as _signal
+
+    from dynamo_tpu.llm.backend import Backend
+    from dynamo_tpu.llm.http import HttpService, ModelManager
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import ChatPreprocessor
+    from dynamo_tpu.llm.tokenizer import HfTokenizer
+    from dynamo_tpu.serve import build_jax_engine
+
+    mdc = ModelDeploymentCard.from_local_path(model_dir, name="mm-demo")
+    tokenizer = HfTokenizer.from_model_dir(model_dir)
+    llm = build_jax_engine(model_dir, mdc, num_blocks=64, max_batch_size=4,
+                           max_model_len=256, prefill_buckets=(64, 128))
+    llm.start()
+    service = runtime = encode_service = remote = None
+    try:
+        vision_cfg = VisionConfig(
+            **{**VisionConfig.tiny().__dict__,
+               "projector_dim": llm.config.model.hidden_size}
+        )
+        local_encoder = JaxVisionEncoder(vision_cfg)
+        if remote_encode:
+            # separate-encode-worker shape (see amain): the encoder serves
+            # its own runtime component and the LLM side calls it remotely
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            from dynamo_tpu.utils.config import RuntimeConfig
+            from examples.multimodal.components import (
+                RemoteEncoder,
+                serve_encode_worker,
+            )
+
+            runtime = await DistributedRuntime.create(
+                RuntimeConfig(control_plane="memory://mm-serve")
+            )
+            encode_service = await serve_encode_worker(runtime, local_encoder)
+            remote = await RemoteEncoder.connect(runtime)
+            engine = MultimodalEngine(llm, remote)
+        else:
+            engine = MultimodalEngine(llm, local_encoder)
+        manager = ModelManager()
+        manager.add_chat_model(
+            "mm-demo",
+            ChatPreprocessor(mdc, tokenizer).wrap(Backend(tokenizer).wrap(engine)),
+        )
+        service = HttpService(manager, host="127.0.0.1", port=port)
+        await service.start()
+        print(
+            f"\nmultimodal frontend on http://127.0.0.1:{service.port} — try:\n"
+            "  curl -s http://127.0.0.1:%d/v1/chat/completions \\\n"
+            "    -H 'Content-Type: application/json' -d '{\"model\": \"mm-demo\", "
+            '"max_tokens": 16, "messages": [{"role": "user", "content": ['
+            '{"type": "text", "text": "describe"}, {"type": "image_url", '
+            '"image_url": {"url": "data:image/png;base64,<...>"}}]}]}\'\n'
+            % service.port,
+            flush=True,
+        )
+        stop = _asyncio.Event()
+        loop = _asyncio.get_running_loop()
+        for sig in (_signal.SIGINT, _signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+    finally:
+        if service is not None:
+            await service.stop()
+        if encode_service is not None:
+            await encode_service.shutdown(drain_timeout=2)
+        if runtime is not None:
+            await runtime.close()
+        llm.stop()
+    return 0
+
+
 async def amain(model_dir: str, *, remote_encode: bool = False,
                 video: bool = False) -> int:
     from dynamo_tpu.llm.model_card import ModelDeploymentCard
@@ -247,8 +326,15 @@ def main() -> int:
                         help="serve the encoder as its own runtime component")
     parser.add_argument("--video", action="store_true",
                         help="condition on 4 video frames instead of one image")
+    parser.add_argument("--serve", type=int, metavar="PORT", default=None,
+                        help="serve the OpenAI frontend instead of the demo "
+                        "request: image_url chat completions end to end")
     args = parser.parse_args()
     configure_logging()
+    if args.serve is not None:
+        return asyncio.run(
+            serve_http(args.model, args.serve, remote_encode=args.remote_encode)
+        )
     return asyncio.run(
         amain(args.model, remote_encode=args.remote_encode, video=args.video)
     )
