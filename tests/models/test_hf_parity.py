@@ -303,3 +303,67 @@ def test_phi3_longrope_refused():
             "rope_scaling": {"rope_type": "longrope", "short_factor": [1.0],
                              "long_factor": [1.0]},
         })
+
+
+@pytest.mark.slow
+def test_llama_decode_path_matches_hf_at_every_position(tmp_path):
+    """The serving hot path against the oracle: prefill a short prompt,
+    then DECODE token by token (write_decode_kv + paged_decode_attention),
+    comparing logits with HF's full-context logits at every position.
+    Pins the paged cache writes, slot arithmetic, and decode attention —
+    none of which the last-token prefill checks exercise."""
+    from dynamo_tpu.models.llama import (
+        LlamaConfig,
+        init_kv_cache,
+        llama_forward_decode,
+        llama_forward_prefill,
+        load_hf_weights,
+        make_rope_tables,
+    )
+
+    config = transformers.LlamaConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=True, torch_dtype="float32",
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    tokens = [3, 17, 99, 250, 7, 42, 200, 11, 85, 301, 12, 13]
+    with torch.no_grad():
+        hf_all = model(
+            torch.tensor([tokens], dtype=torch.long)
+        ).logits[0].float().numpy()  # [len, vocab]
+
+    cfg = LlamaConfig.from_hf_config(f"{tmp_path}/config.json")
+    cfg = LlamaConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = load_hf_weights(cfg, tmp_path)
+    cos, sin = make_rope_tables(cfg)
+    block_size = 4
+    cache = init_kv_cache(cfg, 16, block_size)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prefill_len = 4
+    logits, cache = llama_forward_prefill(
+        params, cfg, jnp.asarray(tokens[:prefill_len], jnp.int32), cache,
+        blocks, jnp.int32(prefill_len), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_all[prefill_len - 1], atol=2e-4, rtol=2e-4
+    )
+
+    # decode the rest one token at a time; position p's logits must match
+    # HF's logits at p (the slot arithmetic crosses block boundaries here)
+    tables = blocks[None, :]
+    for p in range(prefill_len, len(tokens)):
+        slot = jnp.asarray([blocks[p // block_size] * block_size + p % block_size])
+        logits, cache = llama_forward_decode(
+            params, cfg, jnp.asarray([tokens[p]], jnp.int32), cache,
+            tables, jnp.asarray([p + 1], jnp.int32), slot, cos, sin,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
+            err_msg=f"decode position {p}",
+        )
