@@ -367,3 +367,118 @@ def test_llama_decode_path_matches_hf_at_every_position(tmp_path):
             np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
             err_msg=f"decode position {p}",
         )
+
+
+@pytest.mark.slow
+def test_mixtral_decode_path_matches_hf(tmp_path):
+    """MoE decode against the oracle: per-token expert routing in the
+    decode path (mixtral_forward_decode) vs HF's full-context forward."""
+    from dynamo_tpu.models import mixtral as mx
+    from dynamo_tpu.models.llama import init_kv_cache, make_rope_tables
+
+    config = transformers.MixtralConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False, torch_dtype="float32",
+        num_local_experts=4, num_experts_per_tok=2,
+    )
+    torch.manual_seed(8)
+    model = transformers.MixtralForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    tokens = [3, 17, 99, 250, 7, 42, 200, 11, 85, 301]
+    with torch.no_grad():
+        hf_all = model(
+            torch.tensor([tokens], dtype=torch.long)
+        ).logits[0].float().numpy()
+
+    cfg = mx.MixtralConfig.from_hf_config(f"{tmp_path}/config.json")
+    cfg = mx.MixtralConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = mx.load_hf_weights(cfg, tmp_path)
+    cos, sin = make_rope_tables(cfg)
+    block_size = 4
+    cache = init_kv_cache(cfg, 16, block_size)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prefill_len = 4
+    logits, cache = mx.mixtral_forward_prefill(
+        params, cfg, jnp.asarray(tokens[:prefill_len], jnp.int32), cache,
+        blocks, jnp.int32(prefill_len), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_all[prefill_len - 1], atol=5e-4, rtol=5e-4
+    )
+    tables = blocks[None, :]
+    for p in range(prefill_len, len(tokens)):
+        slot = jnp.asarray([blocks[p // block_size] * block_size + p % block_size])
+        logits, cache = mx.mixtral_forward_decode(
+            params, cfg, jnp.asarray([tokens[p]], jnp.int32), cache,
+            tables, jnp.asarray([p + 1], jnp.int32), slot, cos, sin,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], hf_all[p], atol=5e-4, rtol=5e-4,
+            err_msg=f"moe decode position {p}",
+        )
+
+
+@pytest.mark.slow
+def test_phi3_windowed_decode_matches_hf(tmp_path):
+    """Sliding-window DECODE against the oracle: positions past the window
+    must drop old context exactly as HF's eager window mask does (the
+    prefill parity test covers the window only within one forward)."""
+    from dynamo_tpu.models.llama import (
+        init_kv_cache,
+        llama_forward_decode,
+        llama_forward_prefill,
+        make_rope_tables,
+    )
+    from dynamo_tpu.models.registry import get_family
+
+    config = transformers.Phi3Config(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0,
+        sliding_window=6, tie_word_embeddings=False, torch_dtype="float32",
+        pad_token_id=0, bos_token_id=1, eos_token_id=2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(9)
+    model = transformers.Phi3ForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    tokens = [3, 17, 99, 250, 7, 42, 200, 11, 85, 301, 12, 13, 44, 45]
+    with torch.no_grad():
+        hf_all = model(
+            torch.tensor([tokens], dtype=torch.long)
+        ).logits[0].float().numpy()
+
+    fam = get_family("phi3")
+    cfg = fam.config_from_hf(f"{tmp_path}/config.json")
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    assert cfg.sliding_window == 6
+    params = fam.load_weights(cfg, tmp_path)
+    cos, sin = make_rope_tables(cfg)
+    block_size = 4
+    cache = init_kv_cache(cfg, 16, block_size)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prefill_len = 4
+    logits, cache = llama_forward_prefill(
+        params, cfg, jnp.asarray(tokens[:prefill_len], jnp.int32), cache,
+        blocks, jnp.int32(prefill_len), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_all[prefill_len - 1], atol=3e-4, rtol=3e-4
+    )
+    tables = blocks[None, :]
+    for p in range(prefill_len, len(tokens)):  # crosses the window at p>=6
+        slot = jnp.asarray([blocks[p // block_size] * block_size + p % block_size])
+        logits, cache = llama_forward_decode(
+            params, cfg, jnp.asarray([tokens[p]], jnp.int32), cache,
+            tables, jnp.asarray([p + 1], jnp.int32), slot, cos, sin,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
+            err_msg=f"windowed decode position {p}",
+        )
