@@ -549,3 +549,62 @@ def test_deepseek_v2_mla_matches_hf(tmp_path):
             np.asarray(logits)[0], hf_all[p], atol=5e-4, rtol=5e-4,
             err_msg=f"mla decode position {p}",
         )
+
+
+@pytest.mark.slow
+def test_qwen3_moe_matches_hf(tmp_path):
+    """Qwen3-MoE: per-head q/k RMSNorm + routed experts (norm_topk_prob
+    honored by BOTH sides here, unlike the V2 port), prefill and decode."""
+    if not hasattr(transformers, "Qwen3MoeForCausalLM"):
+        pytest.skip("transformers too old for Qwen3Moe")
+    from dynamo_tpu.models import mixtral as mx
+    from dynamo_tpu.models.llama import init_kv_cache, make_rope_tables
+    from dynamo_tpu.models.registry import get_family
+
+    config = transformers.Qwen3MoeConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False, torch_dtype="float32",
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=48,
+        decoder_sparse_step=1, norm_topk_prob=True, mlp_only_layers=[],
+    )
+    torch.manual_seed(11)
+    model = transformers.Qwen3MoeForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    tokens = [3, 17, 99, 250, 7, 42, 200, 11]
+    with torch.no_grad():
+        hf_all = model(
+            torch.tensor([tokens], dtype=torch.long)
+        ).logits[0].float().numpy()
+
+    fam = get_family("qwen3_moe")
+    cfg = fam.config_from_hf(f"{tmp_path}/config.json")
+    assert cfg.qk_norm
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = fam.load_weights(cfg, tmp_path)
+    cos, sin = make_rope_tables(cfg)
+    block_size = 4
+    cache = init_kv_cache(cfg, 16, block_size)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prefill_len = 4
+    logits, cache = mx.mixtral_forward_prefill(
+        params, cfg, jnp.asarray(tokens[:prefill_len], jnp.int32), cache,
+        blocks, jnp.int32(prefill_len), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_all[prefill_len - 1], atol=5e-4, rtol=5e-4
+    )
+    tables = blocks[None, :]
+    for p in range(prefill_len, len(tokens)):
+        slot = jnp.asarray([blocks[p // block_size] * block_size + p % block_size])
+        logits, cache = mx.mixtral_forward_decode(
+            params, cfg, jnp.asarray([tokens[p]], jnp.int32), cache,
+            tables, jnp.asarray([p + 1], jnp.int32), slot, cos, sin,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], hf_all[p], atol=5e-4, rtol=5e-4,
+            err_msg=f"qwen3-moe decode position {p}",
+        )
