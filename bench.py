@@ -958,7 +958,8 @@ def _probe_devices(timeout_s: float) -> dict:
     code = (
         "import time,sys; t0=time.time(); import jax; "
         "ds=jax.devices(); "
-        "print('PROBE_OK', [d.device_kind for d in ds], round(time.time()-t0,1))"
+        "tag='PROBE_OK' if any(d.platform=='tpu' for d in ds) else 'PROBE_CPU'; "
+        "print(tag, [d.device_kind for d in ds], round(time.time()-t0,1))"
     )
     t0 = time.monotonic()
     try:
@@ -1051,27 +1052,31 @@ def main() -> None:
         print(f"bench: relay probe: {relay}", file=sys.stderr)
         evidence: dict = {"relay": relay}
         bringup["attempts"].append(evidence)
-        run_full = False
-        if relay["state"] in ("held_open", "data", "n/a"):
-            # upstream looks alive — confirm with a cheap device-init probe
-            # before committing the full window
-            probe = _probe_devices(probe_timeouts[min(attempt, len(probe_timeouts) - 1)])
-            evidence["device_probe"] = probe
-            print(f"bench: device probe: {probe}", file=sys.stderr)
-            run_full = probe["ok"]
-        else:
-            # accept-then-close / refused: device init WILL hang in the
-            # claim loop; don't burn a device-init window proving it
-            print(
-                f"bench: relay {relay['state']}; skipping full attempt",
-                file=sys.stderr,
-            )
-        if not run_full and last:
-            # escape hatch: the probes are advisory, not authoritative — a
-            # relay on a nonstandard port or a probe artifact must not
-            # convert a working TPU into CPU fallback.  One unconditional
-            # full attempt; the child's own device-init watchdog bounds
-            # the cost of a truly dead tunnel.
+        # The socket state is evidence, never a gate: a relay that closes a
+        # bare probe connection can still serve the PJRT handshake (observed
+        # round 5: accept_then_close with a healthy chip behind it).  The
+        # device probe is authoritative and its timeout bounds the cost of a
+        # genuinely dead tunnel.
+        probe = _probe_devices(probe_timeouts[min(attempt, len(probe_timeouts) - 1)])
+        evidence["device_probe"] = probe
+        print(f"bench: device probe: {probe}", file=sys.stderr)
+        run_full = probe["ok"]
+        # PROBE_CPU is conclusive only when no axon pool is configured: with
+        # a pool present, a transient plugin-init failure also yields rc=0 +
+        # cpu devices (JAX falls back silently), which must NOT skip the
+        # escape hatch.
+        cpu_only = (
+            probe.get("rc") == 0
+            and "PROBE_CPU" in probe.get("stdout", "")
+            and not os.environ.get("PALLAS_AXON_POOL_IPS")
+        )
+        if not run_full and last and not cpu_only:
+            # escape hatch: a probe that died or hung is advisory, not
+            # authoritative — it must not convert a working TPU into CPU
+            # fallback.  One unconditional full attempt; the child's own
+            # device-init watchdog bounds the cost of a truly dead tunnel.
+            # (A probe that ANSWERED with cpu-only devices is conclusive:
+            # skip straight to the small-geometry CPU fallback.)
             print(
                 "bench: probes failed; final unconditional full attempt",
                 file=sys.stderr,
