@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import os
 import queue as thread_queue
 import threading
 import time
@@ -104,6 +105,11 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
             return skip("recorded in interpret mode")
         if table.get("platform") != "tpu":
             return skip(f"platform {table.get('platform')!r} is not tpu")
+        if table.get("calib_ok") is False:
+            # the table's own known-FLOPs/known-bytes calibration exceeded
+            # device peaks: the timing did not serialize, nothing in it is
+            # trustworthy (absent key = older table without calibration)
+            return skip("calibration rows exceed device peaks")
         if device_kind and table.get("device_kind") not in (None, device_kind):
             logger.info(
                 "kernel-perf table is from %r, this chip is %r; ignoring",
@@ -445,6 +451,23 @@ class JaxLlmEngine:
             lanes = config.max_batch_size
             gen_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
             prompt_counts = jnp.zeros((lanes, cfg.vocab_size), jnp.int32)
+        # CRITICAL transfer detail: the init-time arrays were built on the
+        # host CPU backend (above); handing a CPU-backend jax.Array straight
+        # to device_put leaves a cross-backend buffer that some PJRT
+        # runtimes (measured on the tunneled axon TPU plugin) re-stage on
+        # EVERY program execution that takes it as an argument — ~150ms per
+        # such arg per call, which buried the decode loop under ~10x its
+        # compute time.  mesh.host_bounce converts such leaves to host
+        # ndarrays so device_put yields native, committed device buffers.
+        from dynamo_tpu.parallel.mesh import host_bounce
+
+        target_platform = jax.devices()[0].platform
+        bounce = lambda x: host_bounce(x, target_platform)  # noqa: E731
+        raw_params = jax.tree.map(bounce, raw_params)
+        raw_cache = jax.tree.map(bounce, raw_cache)
+        cos, sin = bounce(cos), bounce(sin)
+        gen_counts = bounce(gen_counts)
+        prompt_counts = bounce(prompt_counts)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -495,6 +518,23 @@ class JaxLlmEngine:
         # produced host-side (no device RNG in the request path).
         self._host_rng = np.random.Generator(np.random.PCG64(config.seed))
         self._lane_keys = np.zeros((lanes, 2), np.uint32)
+
+        # Decode hot-loop phase accounting (DYN_ENGINE_PHASE_TIMING=1):
+        # wall seconds + counts per phase, surfaced via stats().  Exists
+        # because the serving chip can sit behind a high-latency transport
+        # (the axon tunnel adds ~6ms per host<->device sync) where the loop's
+        # cost profile is unrecognizable vs a local chip — upload/dispatch/
+        # readback must be separable from device compute to tune anything.
+        self._phase_timing = os.environ.get("DYN_ENGINE_PHASE_TIMING") == "1"
+        self.phase_stats: dict[str, list[float]] = {}
+        # Sampling-tail upload cache: the per-window device copies of the
+        # (lane_keys, temp, top_k, ...) arrays are reused while their host
+        # values are unchanged — at steady-state decode the batch
+        # composition changes rarely, and behind a high-RTT transport the
+        # ~10 small uploads per window are measurable.  Equality-checked
+        # against fresh host arrays every window (cheap), so there is no
+        # invalidation bookkeeping to miss.
+        self._tail_cache: tuple | None = None
         if self.mesh is not None:
             self._gen_counts = jax.device_put(gen_counts, repl)
             self._prompt_counts = jax.device_put(prompt_counts, repl)
@@ -1653,6 +1693,13 @@ class JaxLlmEngine:
         }
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
+        if self.phase_stats:
+            # snapshot: the device thread inserts keys concurrently
+            out["phase_ms"] = {
+                name: {"total_ms": round(tot * 1e3, 2), "n": n,
+                       "mean_ms": round(tot / n * 1e3, 3)}
+                for name, (tot, n) in list(self.phase_stats.items())
+            }
         return out
 
     # -- device thread -----------------------------------------------------
@@ -2081,6 +2128,8 @@ class JaxLlmEngine:
                 seq, int(token), float(lp), top=(tkv, tki) if want_top else None
             )
             return
+        timing = self._phase_timing
+        tp = time.perf_counter() if timing else 0.0
         # the continued-prefill jit serves prefix hits AND every chunk (an
         # intermediate first chunk needs its sample gate; start_pos=0 masks
         # the prefix away entirely)
@@ -2123,6 +2172,12 @@ class JaxLlmEngine:
                 jnp.int32(end), jnp.int32(0), jnp.asarray(gen_row), jnp.asarray(key),
                 *sampling_tail, self._guided_row(seq), self.cos, self.sin,
             )
+        if timing:
+            # opt-in diagnosis only: the forced scalar sync breaks chunk
+            # pipelining, so production never pays it
+            tp = self._phase("prefill.dispatch", tp)
+            np.asarray(token)
+            self._phase("prefill.readback", tp)
         seq.prefilled_tokens = end
         if not final:
             # intermediate chunk: KV written, no token sampled; publish the
@@ -2212,7 +2267,34 @@ class JaxLlmEngine:
                 return self._run_verify_decode(seqs, drafts)
         return self._run_plain_decode(seqs)
 
+    def _device_sampling_tail(self, active: list[Sequence], lanes: int) -> tuple:
+        """Device copies of (lane_keys, temp, top_k, top_p, greedy, pres,
+        freq, rep, bias_ids, bias_vals), reused across windows while the
+        host values are unchanged (see ``_tail_cache`` in __init__)."""
+        host_tail = (self._lane_keys,) + self._sampling_arrays(active, lanes)
+        cached = self._tail_cache
+        if cached is not None and all(
+            np.array_equal(a, b) for a, b in zip(cached[0], host_tail)
+        ):
+            return cached[1]
+        sampling_tail = tuple(jnp.asarray(x) for x in host_tail)
+        self._tail_cache = (
+            tuple(np.copy(x) for x in host_tail), sampling_tail
+        )
+        return sampling_tail
+
+    def _phase(self, name: str, t0: float) -> float:
+        """Accumulate wall time since ``t0`` into ``phase_stats[name]`` and
+        return a fresh timestamp (phase-timing mode only)."""
+        t1 = time.perf_counter()
+        s = self.phase_stats.setdefault(name, [0.0, 0])
+        s[0] += t1 - t0
+        s[1] += 1
+        return t1
+
     def _run_plain_decode(self, seqs: list[Sequence]) -> None:
+        timing = self._phase_timing
+        t = time.perf_counter() if timing else 0.0
         lanes = self.config.max_batch_size
         steps = self.config.decode_steps
         token_ids = np.zeros((lanes,), np.int32)
@@ -2257,42 +2339,50 @@ class JaxLlmEngine:
         want_top = any(
             seq.request.sampling.top_logprobs > 0 for seq in active
         )
-        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
-            self._sampling_arrays(active, lanes)
-        )
-        sampling_tail = (
-            jnp.asarray(self._lane_keys), jnp.asarray(temp), jnp.asarray(top_k),
-            jnp.asarray(top_p), jnp.asarray(greedy), jnp.asarray(pres),
-            jnp.asarray(freq), jnp.asarray(rep), jnp.asarray(bias_ids),
-            jnp.asarray(bias_vals),
-        )
+        if timing:
+            t = self._phase("decode.schedule", t)
+        sampling_tail = self._device_sampling_tail(active, lanes)
         if steps <= 1:
             gmodes = np.full((lanes,), -1, np.int32)
             for seq in active:
                 if seq.guided is not None:
                     gmodes[seq.lane] = seq.guided.mode_id
+            args = (
+                jnp.asarray(token_ids), jnp.asarray(block_tables),
+                jnp.asarray(context_lens), jnp.asarray(slot_ids),
+                *sampling_tail, self._guided_table, jnp.asarray(gmodes),
+            )
+            if timing:
+                t = self._phase("decode.upload", t)
             tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
-                jnp.asarray(token_ids), jnp.asarray(block_tables),
-                jnp.asarray(context_lens), jnp.asarray(slot_ids), *sampling_tail,
-                self._guided_table, jnp.asarray(gmodes),
-                self.cos, self.sin,
+                *args, self.cos, self.sin,
             )
+            if timing:
+                t = self._phase("decode.dispatch", t)
             tokens_host = np.asarray(tokens)[None, :]  # [1, lanes]
             lps_host = np.asarray(lps)[None, :]
             tkv_host = np.asarray(tkvs)[None] if want_top else None
             tki_host = np.asarray(tkis)[None] if want_top else None
         else:
-            tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
-                self.params, self.cache, self._gen_counts, self._prompt_counts,
+            args = (
                 jnp.asarray(token_ids), jnp.asarray(block_tables),
                 jnp.asarray(context_lens), *sampling_tail,
-                self.cos, self.sin,
             )
+            if timing:
+                t = self._phase("decode.upload", t)
+            tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                *args, self.cos, self.sin,
+            )
+            if timing:
+                t = self._phase("decode.dispatch", t)
             tokens_host = np.asarray(tokens)  # [steps, lanes]
             lps_host = np.asarray(lps)
             tkv_host = np.asarray(tkvs) if want_top else None
             tki_host = np.asarray(tkis) if want_top else None
+        if timing:
+            t = self._phase("decode.readback", t)
 
         for s in range(tokens_host.shape[0]):
             for seq in active:
@@ -2306,6 +2396,8 @@ class JaxLlmEngine:
                         if want_top else None
                     ),
                 )
+        if timing:
+            self._phase("decode.post", t)
 
     def _warm_verify_step(self) -> None:
         """Compile the verify program: one launch with every lane inactive
@@ -2377,17 +2469,12 @@ class JaxLlmEngine:
                 slot_mat[lane, j] = blocks[pos // bs] * bs + pos % bs
 
         want_top = any(s.request.sampling.top_logprobs > 0 for s in active)
-        temp, top_k, top_p, greedy, pres, freq, rep, bias_ids, bias_vals = (
-            self._sampling_arrays(active, lanes)
-        )
+        sampling_tail = self._device_sampling_tail(active, lanes)
         tokens, n_accept, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_verify(
             self.params, self.cache, self._gen_counts, self._prompt_counts,
             jnp.asarray(token_mat), jnp.asarray(block_tables),
             jnp.asarray(context_lens), jnp.asarray(slot_mat),
-            jnp.asarray(spec_ok), jnp.asarray(self._lane_keys),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-            jnp.asarray(greedy), jnp.asarray(pres), jnp.asarray(freq),
-            jnp.asarray(rep), jnp.asarray(bias_ids), jnp.asarray(bias_vals),
+            jnp.asarray(spec_ok), *sampling_tail,
             self.cos, self.sin,
         )
         tokens_h = np.asarray(tokens)

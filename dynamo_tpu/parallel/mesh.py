@@ -69,11 +69,35 @@ def named_sharding(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
 
 
+def host_bounce(x, target_platform: str):
+    """Return ``x``, bounced through a host ndarray when it is a jax.Array
+    on a different backend than ``target_platform``.
+
+    device_put of a cross-backend jax.Array can leave a buffer that
+    re-stages on every program execution taking it as an argument
+    (measured ~150ms/arg/call on tunneled PJRT runtimes); a host ndarray
+    transfers into a native, committed device buffer.  The single shared
+    predicate for every transfer path (engine init, mesh placement).
+    """
+    if (
+        isinstance(x, jax.Array)
+        and next(iter(x.devices())).platform != target_platform
+    ):
+        return np.asarray(x)
+    return x
+
+
 def shard_pytree(tree, specs, mesh: Mesh):
-    """Place a pytree on the mesh according to a matching specs pytree."""
-    return jax.tree.map(
-        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)), tree, specs
-    )
+    """Place a pytree on the mesh according to a matching specs pytree
+    (cross-backend leaves host-bounce first — see ``host_bounce``)."""
+    mesh_platform = mesh.devices.flat[0].platform
+
+    def put(x, spec):
+        return jax.device_put(
+            host_bounce(x, mesh_platform), NamedSharding(mesh, spec)
+        )
+
+    return jax.tree.map(put, tree, specs)
 
 
 def replicated(mesh: Mesh):

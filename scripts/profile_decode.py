@@ -1,0 +1,171 @@
+"""Decode hot-loop phase profile on the current accelerator.
+
+Builds a const-init engine (same construction as bench.py's rungs), drives
+a fixed batch of greedy requests, and prints one JSON line with per-phase
+wall time from the engine's DYN_ENGINE_PHASE_TIMING accounting
+(decode.schedule / upload / dispatch / readback / post) plus ITL and
+throughput.  Exists to answer "where do the decode milliseconds go" —
+which, behind a tunneled PJRT transport with ~6ms/sync RTT, is dominated
+by host<->device round-trips rather than compute (the thing the fused
+decode_steps>1 path and upload caching exist to amortize).
+
+Usage: python scripts/profile_decode.py [--model llama32_1b] [--quant int8]
+           [--isl 256] [--osl 64] [--batch 16] [--decode-steps 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["DYN_ENGINE_PHASE_TIMING"] = "1"
+
+
+async def run(args: argparse.Namespace) -> dict:
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.registry import get_family
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    cfg = getattr(LlamaConfig, args.model)()
+    family = get_family("llama")
+    max_len = args.isl + args.osl + 16
+    block_size = 16
+    num_blocks = args.batch * ((max_len + block_size - 1) // block_size) + 8
+
+    def shaped(k):
+        p = family.init_params(cfg, k)
+        if args.quant and args.quant != "none":
+            from dynamo_tpu.ops.quant import quantize_params
+
+            p = quantize_params(p, family.quant_leaves)
+        return p
+
+    shapes = jax.eval_shape(shaped, jax.random.PRNGKey(0))
+    params = jax.tree.map(
+        lambda s: np.full(
+            s.shape, 1 if np.issubdtype(s.dtype, np.integer) else 0.01,
+            dtype=s.dtype,
+        ),
+        shapes,
+    )
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg,
+            num_blocks=num_blocks,
+            block_size=block_size,
+            max_batch_size=args.batch,
+            max_model_len=max_len,
+            prefill_buckets=(args.isl,),
+            decode_steps=args.decode_steps,
+            top_logprobs_k=0,
+            logit_bias_k=0,
+            quantize=None if args.quant in (None, "none") else args.quant,
+            kv_cache_dtype=args.kv_dtype,
+        ),
+        params=params,
+    )
+    engine.start()
+    print(f"profile: engine up ({args.model})", file=sys.stderr)
+    rng = np.random.default_rng(0)
+
+    from dynamo_tpu.runtime.engine import Context
+
+    def make_request() -> dict:
+        tokens = rng.integers(10, cfg.vocab_size - 10, size=args.isl).tolist()
+        return PreprocessedRequest(
+            token_ids=tokens,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=args.osl, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+
+    itls: list[float] = []
+    started = 0
+    all_started = asyncio.Event()
+
+    async def drive(req: dict) -> int:
+        nonlocal started
+        t0 = time.monotonic()
+        ttft = t_last = None
+        count = 0
+        stream = await engine.generate(Context(req))
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is None or not ann.data.token_ids:
+                continue
+            t_last = time.monotonic()
+            if ttft is None:
+                ttft = t_last - t0
+                started += 1
+                if started == args.batch:
+                    all_started.set()
+            count += len(ann.data.token_ids)
+        if ttft is not None and count > 1:
+            itls.append((t_last - t0 - ttft) / (count - 1))
+        return count
+
+    t0 = time.monotonic()
+    await drive(make_request())  # warmup: compiles
+    print(f"profile: warmup {time.monotonic()-t0:.1f}s", file=sys.stderr)
+    itls.clear()
+
+    # Steady-state isolation: phase stats restart once every lane has
+    # produced a first token, so prefill interleave doesn't pollute the
+    # decode-window attribution (a window's readback otherwise waits on
+    # queued prefill programs and bills them to decode).
+    async def clear_at_steady():
+        await all_started.wait()
+        engine.phase_stats.clear()
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(
+        clear_at_steady(), *[drive(make_request()) for _ in range(args.batch)]
+    )
+    counts = results[1:]
+    wall = time.monotonic() - t0
+    stats = engine.stats()
+    engine.stop()
+    return {
+        "model": args.model,
+        "quant": args.quant,
+        "batch": args.batch,
+        "isl": args.isl,
+        "osl": args.osl,
+        "decode_steps": args.decode_steps,
+        "wall_s": round(wall, 2),
+        "tok_s": round(sum(counts) / wall, 1),
+        "itl_mean_ms": round(1e3 * sum(itls) / max(len(itls), 1), 2),
+        "phase_ms": stats.get("phase_ms", {}),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="llama32_1b")
+    parser.add_argument("--quant", default="none")
+    parser.add_argument("--kv-dtype", default="bf16")
+    parser.add_argument("--isl", type=int, default=256)
+    parser.add_argument("--osl", type=int, default=64)
+    parser.add_argument("--batch", type=int, default=16)
+    parser.add_argument("--decode-steps", type=int, default=1)
+    args = parser.parse_args()
+    print(json.dumps(asyncio.run(run(args))))
+
+
+if __name__ == "__main__":
+    main()

@@ -178,11 +178,23 @@ def _time_us(fn, *args, iters: int, chain=None) -> float:
     tunneled axon platform: back-to-back *identical* dispatches measured
     >10 TB/s effective bandwidth on a v5e (HBM peak ~0.82 TB/s), i.e. repeat
     executions of the same (executable, args) pair are elided or overlapped
-    somewhere below us.  A data dependency between iterations defeats that;
-    the calibration rows (bench_calibration) verify the resulting ceiling."""
-    import jax
+    somewhere below us.  A data dependency between iterations defeats that.
 
-    jax.block_until_ready(fn(*args))  # compile + warm
+    The end-of-loop sync is a HOST READBACK of one element of the final
+    output, not ``block_until_ready`` — measured on the same platform,
+    block_until_ready returns before on-device completion, which let a
+    first version of this timer report 8300 TFLOP/s on a 197 TFLOP/s chip.
+    The readback transitively waits on the whole dependent chain; the
+    calibration rows (bench_calibration) verify the resulting ceiling."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    def sync(out):
+        leaf = out[0] if isinstance(out, tuple) else jax.tree.leaves(out)[0]
+        return float(jnp.ravel(leaf)[0])  # device slice + scalar fetch
+
+    sync(fn(*args))  # compile + warm
     samples = []
     for _ in range(3):
         a = args
@@ -192,7 +204,7 @@ def _time_us(fn, *args, iters: int, chain=None) -> float:
             out = fn(*a)
             if chain is not None:
                 a = chain(a, out)
-        jax.block_until_ready(out)
+        sync(out)
         samples.append((time.perf_counter() - t0) / iters)
     return sorted(samples)[1] * 1e6
 
@@ -375,6 +387,29 @@ def run_bench(out_path: str | None) -> int:
             print(json.dumps(row))
             sys.stdout.flush()
         table["rows"].extend(rows)
+    # Methodology gate: if the known-FLOPs/known-bytes calibration rows
+    # exceed the chip's public peaks, the timing didn't serialize and NO
+    # row in this table is trustworthy.  The engine refuses calib_ok=false
+    # tables (attention_impl=auto falls back to its static heuristic).
+    peaks = {"v6": (918e12, 1640.0), "v5p": (459e12, 2765.0),
+             "v5": (197e12, 820.0), "v4": (275e12, 1228.0)}
+    flops_peak = bw_peak = None
+    for key, (fp, bw) in peaks.items():
+        if key in dev.device_kind.lower():
+            flops_peak, bw_peak = fp, bw
+            break
+    calib_ok = None
+    if not INTERPRET and flops_peak is not None:
+        calib_ok = True
+        for row in table["rows"]:
+            if row.get("bench") == "calib_matmul" and "tflops" in row:
+                calib_ok &= row["tflops"] <= flops_peak / 1e12 * 1.15
+            if row.get("bench") == "calib_stream" and "gbps" in row:
+                calib_ok &= row["gbps"] <= bw_peak * 1.25
+        if not calib_ok:
+            print(json.dumps({"warning": "calibration exceeds device peaks; "
+                              "table marked calib_ok=false"}))
+    table["calib_ok"] = calib_ok
     if out_path:
         with open(out_path, "w") as f:
             json.dump(table, f, indent=2)
