@@ -705,6 +705,64 @@ def test_measured_attention_preference_robust(monkeypatch, tmp_path):
     assert _measured_attention_preference("TPU v5e") is None
     assert _measured_attention_preference("TPU v4") == "pallas"
     assert _measured_attention_preference() == "pallas"  # kind unknown: accept
+    # calibration gate: a table whose own known-FLOPs/known-bytes rows
+    # exceeded device peaks recorded calib_ok=false — nothing in it is
+    # trustworthy (calib_ok absent or true: accepted as before)
+    table([row(2.0)], calib_ok=False)
+    assert _measured_attention_preference() is None
+    table([row(2.0)], calib_ok=True)
+    assert _measured_attention_preference() == "pallas"
+    table([row(2.0)], calib_ok=None)
+    assert _measured_attention_preference() == "pallas"
+
+
+def test_host_bounce_cross_backend():
+    """device_put of a cross-backend jax.Array re-stages per execution on
+    some PJRT runtimes; host_bounce converts exactly those leaves."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.parallel.mesh import host_bounce
+
+    cpu_arr = jax.numpy.zeros((4,), jax.numpy.int32)  # tests run on cpu
+    out = host_bounce(cpu_arr, "tpu")  # foreign target → ndarray
+    assert isinstance(out, np.ndarray)
+    same = host_bounce(cpu_arr, "cpu")  # same backend → untouched
+    assert same is cpu_arr
+    nd = np.zeros((4,), np.int32)  # plain ndarrays always pass through
+    assert host_bounce(nd, "tpu") is nd
+
+
+async def test_sampling_tail_upload_cache():
+    """Steady-state decode windows with unchanged sampling state reuse the
+    same device copies of the sampling tail (the cache equality-checks
+    host values each window); changed state gets fresh copies."""
+
+    def seeded(temp=None):
+        return PreprocessedRequest(
+            token_ids=list(range(3, 9)),
+            sampling=SamplingOptions(
+                use_greedy=temp is None, temperature=temp, seed=7,
+            ),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            eos_token_ids=[1],
+        ).to_wire()
+
+    engine = make_engine()
+    try:
+        await collect(engine, seeded())
+        cache1 = engine._tail_cache
+        assert cache1 is not None
+        # identical sampling state (pinned seed → identical lane key): the
+        # cached device tuple survives a whole second request
+        await collect(engine, seeded())
+        assert engine._tail_cache is not None
+        assert engine._tail_cache[1] is cache1[1]
+        # different sampling config → fresh device copies
+        await collect(engine, seeded(temp=0.7))
+        assert engine._tail_cache[1] is not cache1[1]
+    finally:
+        engine.stop()
 
 
 async def test_pp_tp_mesh_engine_matches_dense_reference():
