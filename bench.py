@@ -439,9 +439,12 @@ async def _measure(engine, cfg, model_name, quant, num_requests, prompt_len, out
                 else round(decode_phase_tok_s, 2)
             ),
             # decode-worker-equivalent score vs the reference's 145 tok/s
-            # (that figure excludes prefill; see decode_phase_tok_s note)
+            # (that figure excludes prefill; see decode_phase_tok_s note).
+            # Only scored on real accelerator runs — a toy-model CPU
+            # fallback ratio would be meaningless and misleading.
             "vs_baseline_decode_phase": (
-                None if decode_phase_tok_s is None
+                None
+                if decode_phase_tok_s is None or fallback_cpu
                 else round(decode_phase_tok_s / BASELINE_TOK_S_PER_GPU, 3)
             ),
             "prefix_hits_total": run_stats.get("prefix_hits_total"),

@@ -344,6 +344,14 @@ def main() -> int:
         num_workers=args.num_workers, engine=args.engine, **extra,
     )
     result = asyncio.run(compare_policies(session_cfg, fleet_cfg))
+    if args.engine == "jax":
+        # stamp where the real engines actually ran — a CPU-fallback
+        # artifact must not read as an on-TPU result
+        import jax
+
+        dev = jax.devices()[0]
+        result["platform"] = dev.platform
+        result["device_kind"] = dev.device_kind
     with open(args.out, "w") as f:
         json.dump(result, f, indent=2)
     print(json.dumps(result))

@@ -140,7 +140,10 @@ async def run(args: argparse.Namespace) -> dict:
     wall = time.monotonic() - t0
     stats = engine.stats()
     engine.stop()
+    dev = jax.devices()[0]
     return {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
         "model": args.model,
         "quant": args.quant,
         "batch": args.batch,
@@ -163,8 +166,14 @@ def main() -> None:
     parser.add_argument("--osl", type=int, default=64)
     parser.add_argument("--batch", type=int, default=16)
     parser.add_argument("--decode-steps", type=int, default=1)
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON result to this path")
     args = parser.parse_args()
-    print(json.dumps(asyncio.run(run(args))))
+    result = asyncio.run(run(args))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

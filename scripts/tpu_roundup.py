@@ -12,10 +12,14 @@ starts:
 3. kernel perf — scripts/tpu_validate.py --bench → KERNEL_PERF.json with
                  platform=tpu, activating attention_impl="auto"'s measured
                  selection (engine/engine.py)
-4. bench       — bench.py headline ladder (llama3_8b int8, ISL 3000 /
+4. decode prof — scripts/profile_decode.py → PROFILE_DECODE.json, the
+                 steady-state hot-loop phase split (schedule/upload/
+                 dispatch/readback/post) that located the cross-backend
+                 re-staging bug
+5. bench       — bench.py headline ladder (llama3_8b int8, ISL 3000 /
                  OSL 150) → BENCH JSON with platform=tpu, real MFU,
                  vs_baseline vs the 145 tok/s/GPU reference figure
-5. fleet       — routed-fleet KV-routing artifact with REAL engines on the
+6. fleet       — routed-fleet KV-routing artifact with REAL engines on the
                  chip (ROUTED_FLEET_JAX.json; the mocker artifact stays as
                  the reference-style sim)
 
@@ -116,6 +120,12 @@ def main() -> int:
         "kernel_perf",
         [sys.executable, "scripts/tpu_validate.py", "--bench",
          "--out", "KERNEL_PERF.json"],
+        min(900, remaining()),
+    )
+    results["decode_profile"] = run_stage(
+        "decode_profile",
+        [sys.executable, "scripts/profile_decode.py", "--model", "llama32_1b",
+         "--decode-steps", "8", "--out", "PROFILE_DECODE.json"],
         min(900, remaining()),
     )
     results["bench"] = run_stage(
