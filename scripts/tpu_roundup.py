@@ -19,7 +19,10 @@ starts:
 5. bench       — bench.py headline ladder (llama3_8b int8, ISL 3000 /
                  OSL 150) → BENCH JSON with platform=tpu, real MFU,
                  vs_baseline vs the 145 tok/s/GPU reference figure
-6. fleet       — routed-fleet KV-routing artifact with REAL engines on the
+6. disagg      — dynamo_tpu.bench.disagg_bench → DISAGG_BENCH.json,
+                 req/s + decode-phase tok/s through the full disagg path
+                 (remote prefill, KV transfer, landing) vs aggregated
+7. fleet       — routed-fleet KV-routing artifact with REAL engines on the
                  chip (ROUTED_FLEET_JAX.json; the mocker artifact stays as
                  the reference-style sim)
 
@@ -131,6 +134,12 @@ def main() -> int:
     results["bench"] = run_stage(
         "bench", [sys.executable, "bench.py"], min(1800, max(60, remaining())),
     )
+    if remaining() > 300:
+        results["disagg_bench"] = run_stage(
+            "disagg_bench",
+            [sys.executable, "-m", "dynamo_tpu.bench.disagg_bench"],
+            min(1200, remaining()),
+        )
     if not args.skip_fleet and remaining() > 300:
         results["fleet_jax"] = run_stage(
             "fleet_jax",

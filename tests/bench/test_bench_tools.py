@@ -332,3 +332,27 @@ async def test_kv_routing_with_real_engines():
             f"kv={kv_result['followup_ttft_p50_ms']}ms "
             f"random={random_result['followup_ttft_p50_ms']}ms"
         )
+
+
+@pytest.mark.integration
+@pytest.mark.slow
+async def test_disagg_bench_tiny():
+    """The disagg throughput bench runs end-to-end at tiny geometry: every
+    measured request prefills remotely, both sections report sane rates,
+    and the result carries platform provenance."""
+    import argparse
+
+    from dynamo_tpu.bench.disagg_bench import run as disagg_run
+
+    args = argparse.Namespace(
+        model="tiny", quant="none", kv_dtype="bf16",
+        isl=24, osl=8, batch=4, requests=5,
+    )
+    result = await disagg_run(args)
+    assert result["disagg"]["remote_prefills"] == 5  # measured only
+    assert result["disagg"]["all_prefills_remote"] is True
+    assert result["aggregated"]["req_s"] > 0
+    assert result["disagg"]["req_s"] > 0
+    assert result["disagg"]["decode_phase_tok_s"] > 0
+    assert result["platform"] in ("cpu", "tpu")
+    assert "disagg_overhead_pct" in result
