@@ -842,3 +842,30 @@ async def test_pp_ep_mesh_engine_matches_single_device():
         assert tokens == expected
     finally:
         engine.stop()
+
+
+async def test_phase_timing_stats(monkeypatch):
+    """DYN_ENGINE_PHASE_TIMING=1 slices the hot loop into phases surfaced
+    via stats(); off by default (no phase_ms key, no hot-loop tax)."""
+    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
+    engine = make_engine()
+    try:
+        prompt = list(range(3, 9))
+        await collect(engine, request(prompt, max_tokens=4, ignore_eos=True))
+        phases = engine.stats().get("phase_ms", {})
+        for name in ("decode.schedule", "decode.upload", "decode.dispatch",
+                     "decode.readback", "decode.post", "prefill.dispatch",
+                     "prefill.readback"):
+            assert name in phases, (name, sorted(phases))
+            assert phases[name]["n"] >= 1
+            assert phases[name]["total_ms"] >= 0
+    finally:
+        engine.stop()
+
+    monkeypatch.delenv("DYN_ENGINE_PHASE_TIMING")
+    engine = make_engine()
+    try:
+        await collect(engine, request(list(range(3, 9)), max_tokens=2))
+        assert "phase_ms" not in engine.stats()
+    finally:
+        engine.stop()
