@@ -1,0 +1,419 @@
+"""Gemma-2 model family (TPU-first, layer-scanned).
+
+What distinguishes Gemma-2 from the llama-geometry families
+(reference serves it through its engines' model zoos; HF architecture
+``Gemma2ForCausalLM``):
+
+- **Alternating local/global attention**: even-indexed layers use a
+  sliding window, odd-indexed layers full attention.  The layer stack
+  still runs as ONE ``lax.scan``: a per-layer int32 window array threads
+  through the scan and the attention ops mask with a traced window
+  (``<= 0`` = full attention, ops/attention.py ``_window_mask``) — no
+  unrolling, one compiled layer body.
+- **Logit soft-capping**: attention logits pass through
+  ``cap * tanh(x / cap)`` (attn_logit_softcapping, 50.0) and final LM
+  logits likewise (final_logit_softcapping, 30.0).
+- **Sandwich norms**: each sub-block is wrapped pre AND post
+  (input_layernorm / post_attention_layernorm around attention,
+  pre_feedforward_layernorm / post_feedforward_layernorm around the MLP),
+  with the post-norm applied to the block output before the residual add.
+- **Query scaling** by ``query_pre_attn_scalar**-0.5`` instead of
+  ``head_dim**-0.5``.
+- Gemma-1 quirks carry over: GeGLU MLP, sqrt(hidden) embedding scale,
+  (1 + w) RMSNorm weights (baked to ``1 + w`` at load).
+
+Serving notes: the paged decode path uses the JAX attention op (the
+Pallas kernel has no per-layer window plumbing yet — ``attention=`` is
+accepted and ignored); speculative decoding and sequence parallelism are
+fenced by the engine's existing ``sliding_window`` guards.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.attention import (
+    _apply_softcap,
+    dense_causal_attention,
+    gather_prefix_kv,
+    paged_decode_attention,
+    prefill_attention_with_prefix,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import mm
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+
+P = jax.sharding.PartitionSpec
+
+
+@dataclass(frozen=True)
+class Gemma2Config:
+    vocab_size: int = 256000
+    hidden_size: int = 2304
+    intermediate_size: int = 9216
+    num_layers: int = 26
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 256
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    rope_scaling: Any = None          # gemma-2 ships none; kept for rope_table
+    sliding_window: int = 4096        # even-indexed layers only
+    query_pre_attn_scalar: float = 256.0
+    attn_logit_softcap: float = 50.0
+    final_logit_softcap: float = 30.0
+    tie_word_embeddings: bool = True  # always, in every released checkpoint
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def embed_scale(self) -> float:
+        return float(self.hidden_size) ** 0.5
+
+    def layer_windows(self) -> jnp.ndarray:
+        """Per-layer attention window, int32 [L]: the sliding window on
+        even layers, 0 (= full attention) on odd layers — HF Gemma-2's
+        ``layer_types`` pattern (sliding_attention first)."""
+        idx = jnp.arange(self.num_layers, dtype=jnp.int32)
+        return jnp.where(idx % 2 == 0, jnp.int32(self.sliding_window), 0)
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "Gemma2Config":
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        heads = config["num_attention_heads"]
+        return cls(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_layers=config["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=config.get("num_key_value_heads", heads),
+            head_dim=config.get("head_dim") or config["hidden_size"] // heads,
+            max_position_embeddings=config.get("max_position_embeddings", 8192),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-6),
+            rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=config.get("rope_scaling"),
+            sliding_window=config.get("sliding_window", 4096),
+            query_pre_attn_scalar=float(
+                config.get("query_pre_attn_scalar")
+                or config["hidden_size"] // heads
+            ),
+            attn_logit_softcap=config.get("attn_logit_softcapping", 50.0),
+            final_logit_softcap=config.get("final_logit_softcapping", 30.0),
+        )
+
+    @classmethod
+    def tiny(cls) -> "Gemma2Config":
+        """Test geometry: small enough for CPU oracles, 4 layers so both
+        attention patterns appear twice."""
+        return cls(
+            vocab_size=480, hidden_size=64, intermediate_size=128,
+            num_layers=4, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position_embeddings=128, sliding_window=8,
+            query_pre_attn_scalar=16.0,
+        )
+
+
+def init_params(cfg: Gemma2Config, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 9)
+    h, i, l_ = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+
+    def norm_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(cfg.dtype)
+
+    return {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), 1.0),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "post_attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "post_mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "wq": norm_init(keys[1], (l_, h, qd), h),
+            "wk": norm_init(keys[2], (l_, h, kvd), h),
+            "wv": norm_init(keys[3], (l_, h, kvd), h),
+            "wo": norm_init(keys[4], (l_, qd, h), qd),
+            "w_gate": norm_init(keys[5], (l_, h, i), h),
+            "w_up": norm_init(keys[6], (l_, h, i), h),
+            "w_down": norm_init(keys[7], (l_, i, h), i),
+        },
+    }
+
+
+def param_specs(cfg: Gemma2Config) -> dict:
+    """Same TP/PP story as the llama family: heads sharded on 'tp',
+    stacked layer axis on 'pp' (models/llama.py param_specs)."""
+    norm = P("pp", None)
+    return {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": norm, "post_attn_norm": norm,
+            "mlp_norm": norm, "post_mlp_norm": norm,
+            "wq": P("pp", None, "tp"), "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"), "wo": P("pp", "tp", None),
+            "w_gate": P("pp", None, "tp"), "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+    }
+
+
+def make_rope_tables(cfg: Gemma2Config):
+    return rope_table(
+        cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta,
+        scaling=cfg.rope_scaling,
+    )
+
+
+def _embed(params, cfg: Gemma2Config, token_ids) -> jnp.ndarray:
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    return x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+
+
+def _geglu(x, w):
+    act = jax.nn.gelu(mm(x, w["w_gate"]), approximate=True)
+    return mm(act * mm(x, w["w_up"]), w["w_down"])
+
+
+def _qkv(attn_in, w, cfg: Gemma2Config):
+    s = attn_in.shape[0]
+    q = mm(attn_in, w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+    k = mm(attn_in, w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    v = mm(attn_in, w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _final_logits(params, cfg: Gemma2Config, x) -> jnp.ndarray:
+    logits = (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+    # HF semantics: null/0 capping = no capping (guard both; 0 would be a
+    # divide-by-zero into NaN logits)
+    if not cfg.final_logit_softcap:
+        return logits
+    return _apply_softcap(logits, cfg.final_logit_softcap)
+
+
+def _attn_kwargs(cfg: Gemma2Config, window) -> dict:
+    return {
+        "sliding_window": window,
+        # HF semantics: null/0 capping = no capping
+        "logit_softcap": cfg.attn_logit_softcap or None,
+        "query_scale": float(cfg.query_pre_attn_scalar) ** -0.5,
+    }
+
+
+def gemma2_forward_prefill(
+    params: dict,
+    cfg: Gemma2Config,
+    token_ids: jnp.ndarray,   # [seq_pad] int32
+    kv_cache: dict,           # {"k","v"}: [L, N, bs, kvh, d]
+    block_ids: jnp.ndarray,   # [max_blocks] int32
+    seq_len: jnp.ndarray,     # scalar int32
+    start_pos: jnp.ndarray,   # scalar int32 (chunked prefill offset)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Single-sequence prefill.  Returns (last-token logits [vocab], cache).
+
+    start_pos > 0 (an intermediate-chunk continuation) is served by
+    gemma2_forward_prefill_with_prefix; this entry handles whole prompts.
+    """
+    s = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        k_layer, v_layer = write_prefill_kv(
+            k_layer, v_layer, k, v, block_ids, seq_len
+        )
+        attn = dense_causal_attention(
+            q[None], k[None], v[None], seq_len[None],
+            **_attn_kwargs(cfg, window),
+        )[0]
+        attn = mm(attn.reshape(s, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x,
+        (params["layers"], cfg.layer_windows(), kv_cache["k"], kv_cache["v"]),
+    )
+    x = rms_norm(x, params["final_norm"], eps)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _final_logits(params, cfg, last[None])[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma2_forward_prefill_with_prefix(
+    params: dict,
+    cfg: Gemma2Config,
+    token_ids: jnp.ndarray,       # [tail_pad] int32
+    kv_cache: dict,
+    full_block_ids: jnp.ndarray,  # [max_blocks] int32 (prefix + tail)
+    tail_block_ids: jnp.ndarray,  # [max_blocks] int32 (from first tail block)
+    tail_len: jnp.ndarray,        # scalar int32
+    start_pos: jnp.ndarray,       # scalar int32 (cached prefix length)
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Continued prefill over a resident prefix (prefix-cache hits and
+    chunked prefill) — same contract as the llama-family twin."""
+    s = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
+        k_layer, v_layer = write_prefill_kv(
+            k_layer, v_layer, k, v, tail_block_ids, tail_len
+        )
+        attn = prefill_attention_with_prefix(
+            q, k, v, k_prefix, v_prefix, start_pos, tail_len,
+            **_attn_kwargs(cfg, window),
+        )
+        attn = mm(attn.reshape(s, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x,
+        (params["layers"], cfg.layer_windows(), kv_cache["k"], kv_cache["v"]),
+    )
+    x = rms_norm(x, params["final_norm"], eps)
+    last = x[jnp.maximum(tail_len - 1, 0)]
+    logits = _final_logits(params, cfg, last[None])[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma2_forward_decode(
+    params: dict,
+    cfg: Gemma2Config,
+    token_ids: jnp.ndarray,     # [batch] int32
+    kv_cache: dict,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32 (length INCLUDING this token)
+    slot_ids: jnp.ndarray,      # [batch] int32 flat slot for this token
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",     # accepted for engine compat; the JAX path
+                                # is used regardless (no per-layer window
+                                # plumbing in the Pallas kernel yet)
+) -> tuple[jnp.ndarray, dict]:
+    """Batched single-token decode.  Returns (logits [batch, vocab], cache)."""
+    del attention
+    b = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)  # [b, h]
+    positions = jnp.maximum(context_lens - 1, 0)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
+        attn = paged_decode_attention(
+            q, k_layer, v_layer, block_tables, context_lens,
+            **_attn_kwargs(cfg, window),
+        )
+        attn = mm(attn.reshape(b, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x,
+        (params["layers"], cfg.layer_windows(), kv_cache["k"], kv_cache["v"]),
+    )
+    x = rms_norm(x, params["final_norm"], eps)
+    logits = _final_logits(params, cfg, x)
+    return logits, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# HF weight loading
+# ---------------------------------------------------------------------------
+
+_HF_LAYER_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "post_attn_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "mlp_norm": "model.layers.{i}.pre_feedforward_layernorm.weight",
+    "post_mlp_norm": "model.layers.{i}.post_feedforward_layernorm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+
+_NORM_LEAVES = ("attn_norm", "post_attn_norm", "mlp_norm", "post_mlp_norm")
+
+
+def load_hf_weights(cfg: Gemma2Config, model_dir: str | Path, *,
+                    tensors: dict | None = None) -> dict:
+    """Gemma checkpoints store RMSNorm weights as w with runtime (1 + w):
+    bake the +1 once (same trick as gemma-1, models/llama.py)."""
+    if tensors is None:
+        from dynamo_tpu.models.hf_io import read_safetensors
+
+        tensors = read_safetensors(model_dir)
+    if "lm_head.weight" in tensors:
+        # every released Gemma-2 ties the unembedding; a finetune shipping
+        # a trained lm_head would be silently mis-projected by the tied
+        # path — refuse loudly instead
+        raise ValueError(
+            "gemma2 checkpoint ships lm_head.weight (untied unembedding); "
+            "this family implements the tied projection only"
+        )
+
+    def get(name: str, transpose: bool = False):
+        t = tensors[name]
+        if transpose:
+            t = t.T
+        return jnp.asarray(t, cfg.dtype)
+
+    plus_one = lambda t: (t.astype(jnp.float32) + 1.0).astype(t.dtype)  # noqa: E731
+    layers: dict[str, list] = {k: [] for k in _HF_LAYER_MAP}
+    for i in range(cfg.num_layers):
+        for ours, theirs in _HF_LAYER_MAP.items():
+            t = get(theirs.format(i=i), transpose=ours.startswith("w"))
+            if ours in _NORM_LEAVES:
+                t = plus_one(t)
+            layers[ours].append(t)
+    return {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": plus_one(get("model.norm.weight")),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }

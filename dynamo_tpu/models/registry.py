@@ -164,14 +164,33 @@ def _phi3_family() -> ModelFamily:
 def _gemma_family() -> ModelFamily:
     # Gemma-1 = llama skeleton + GeGLU, sqrt(hidden) embedding scale, and
     # (1+w) RMSNorm baked at load (models/llama.py gemma_* helpers).
-    # Gemma-2/3 (interleaved local/global attention, logit softcapping)
-    # would need per-layer attention patterns — not yet supported.
     from dynamo_tpu.models import llama
 
     return _llama_like_family(
         "gemma",
         config_from_hf=llama.gemma_config_from_hf,
         load_weights=llama.gemma_load_hf_weights,
+    )
+
+
+def _gemma2_family() -> ModelFamily:
+    # Gemma-2 = alternating local/global attention (per-layer window array
+    # through one lax.scan), attn + final logit soft-capping, sandwich
+    # norms, query_pre_attn_scalar (models/gemma2.py)
+    from dynamo_tpu.models import gemma2
+
+    return ModelFamily(
+        name="gemma2",
+        config_from_hf=gemma2.Gemma2Config.from_hf_config,
+        init_params=gemma2.init_params,
+        param_specs=gemma2.param_specs,
+        forward_prefill=gemma2.gemma2_forward_prefill,
+        forward_decode=gemma2.gemma2_forward_decode,
+        forward_prefill_with_prefix=gemma2.gemma2_forward_prefill_with_prefix,
+        make_rope_tables=gemma2.make_rope_tables,
+        embed=gemma2._embed,
+        load_weights=gemma2.load_hf_weights,
+        quant_leaves=_PROJ_QUANT_LEAVES,
     )
 
 
@@ -248,6 +267,7 @@ _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "qwen2": _qwen2_family,
     "qwen3": _qwen3_family,
     "gemma": _gemma_family,
+    "gemma2": _gemma2_family,
     "phi3": _phi3_family,
     "mixtral": _mixtral_family,
     "qwen3_moe": _qwen3_moe_family,

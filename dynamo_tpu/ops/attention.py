@@ -56,27 +56,53 @@ def write_decode_kv(
     return flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape)
 
 
+def _apply_softcap(logits: jnp.ndarray, cap) -> jnp.ndarray:
+    """Gemma-2-style logit soft-capping: cap * tanh(logits / cap)."""
+    cap = jnp.float32(cap)
+    return cap * jnp.tanh(logits / cap)
+
+
+def _window_mask(causal, pos_diff, window):
+    """AND a sliding-window constraint into ``causal``.
+
+    ``window`` may be a static int (always windowed) or a traced int32
+    scalar where <= 0 means full attention — what lets a per-layer window
+    array thread through a ``lax.scan`` over heterogeneous layers
+    (Gemma-2 alternating local/global, qwen2 max_window_layers splits).
+    """
+    if isinstance(window, (int, float)):
+        return causal & (pos_diff < window)
+    return causal & ((window <= 0) | (pos_diff < window))
+
+
 def dense_causal_attention(
     q: jnp.ndarray,  # [batch, seq, heads, head_dim]
     k: jnp.ndarray,  # [batch, seq, kv_heads, head_dim]
     v: jnp.ndarray,
     seq_len: jnp.ndarray | None = None,  # [batch] valid lengths (padding mask)
     *,
-    sliding_window: int | None = None,   # Mistral-style: attend the last W only
+    sliding_window=None,   # Mistral-style: attend the last W only; may be
+                           # a traced scalar (<=0 = full) — see _window_mask
+    logit_softcap: float | None = None,  # Gemma-2 attn soft-capping
+    query_scale: float | None = None,    # override 1/sqrt(head_dim)
 ) -> jnp.ndarray:
     """Causal self-attention for prefill (GQA-aware, fp32 softmax)."""
     b, s, h, d = q.shape
     kvh = k.shape[2]
     groups = h // kvh
     qg = q.reshape(b, s, kvh, groups, d)
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scale = jnp.float32(query_scale) if query_scale is not None else (
+        1.0 / jnp.sqrt(jnp.float32(d))
+    )
     logits = jnp.einsum("bqkgd,bskd->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32))
     logits = logits * scale
+    if logit_softcap is not None:
+        logits = _apply_softcap(logits, logit_softcap)
     pos = jnp.arange(s)
     causal = pos[None, :] <= pos[:, None]  # [q, s]
     if sliding_window is not None:
         # each query sees only the last `sliding_window` positions
-        causal = causal & (pos[:, None] - pos[None, :] < sliding_window)
+        causal = _window_mask(causal, pos[:, None] - pos[None, :], sliding_window)
     mask = causal[None, None, None, :, :]
     if seq_len is not None:
         valid = pos[None, :] < seq_len[:, None]  # [b, s]
@@ -94,7 +120,10 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,  # [batch, max_blocks] int32
     context_lens: jnp.ndarray,  # [batch] int32 (0 ⇒ inactive lane)
     *,
-    sliding_window: int | None = None,  # attend only the last W positions
+    sliding_window=None,  # attend only the last W positions; may be a
+                          # traced scalar (<=0 = full) — see _window_mask
+    logit_softcap: float | None = None,
+    query_scale: float | None = None,
 ) -> jnp.ndarray:
     """Decode-step attention: gather each sequence's pages and attend.
 
@@ -113,13 +142,20 @@ def paged_decode_attention(
     v = v.reshape(b, length, kvh, d)
 
     qg = q.reshape(b, kvh, groups, d).astype(jnp.float32)
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scale = jnp.float32(query_scale) if query_scale is not None else (
+        1.0 / jnp.sqrt(jnp.float32(d))
+    )
     logits = jnp.einsum("bkgd,blkd->bkgl", qg, k.astype(jnp.float32)) * scale
+    if logit_softcap is not None:
+        logits = _apply_softcap(logits, logit_softcap)
     pos = jnp.arange(length)[None, :]
     valid = pos < context_lens[:, None]  # [b, l]
     if sliding_window is not None:
-        # the query sits at position ctx-1; it sees [ctx-W, ctx)
-        valid = valid & (pos >= context_lens[:, None] - sliding_window)
+        # the query sits at position ctx-1; it sees [ctx-W, ctx), i.e.
+        # keys whose distance (ctx-1 - pos) is < W
+        valid = _window_mask(
+            valid, (context_lens[:, None] - 1) - pos, sliding_window
+        )
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     # fully-masked (inactive) lanes produce uniform weights; output is junk
@@ -215,7 +251,10 @@ def prefill_attention_with_prefix(
     prefix_len: jnp.ndarray,  # scalar: valid prefix tokens
     seq_len: jnp.ndarray,     # scalar: valid new tokens
     *,
-    sliding_window: int | None = None,  # attend only the last W positions
+    sliding_window=None,  # attend only the last W positions; may be a
+                          # traced scalar (<=0 = full) — see _window_mask
+    logit_softcap: float | None = None,
+    query_scale: float | None = None,
 ) -> jnp.ndarray:
     """Chunked/continued prefill: queries attend to reused prefix + themselves."""
     s, h, d = q.shape
@@ -232,8 +271,12 @@ def prefill_attention_with_prefix(
         [v_prefix.astype(jnp.float32), v_new.astype(jnp.float32)], axis=0
     )
     qg = q.reshape(s, kvh, groups, d).astype(jnp.float32)
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scale = jnp.float32(query_scale) if query_scale is not None else (
+        1.0 / jnp.sqrt(jnp.float32(d))
+    )
     logits = jnp.einsum("qkgd,lkd->kgql", qg, k) * scale
+    if logit_softcap is not None:
+        logits = _apply_softcap(logits, logit_softcap)
     q_pos = prefix_len + jnp.arange(s)
     kv_pos = jnp.arange(p + s)
     kv_valid = (kv_pos < prefix_len) | ((kv_pos >= p) & (kv_pos - p < seq_len))
@@ -242,7 +285,9 @@ def prefill_attention_with_prefix(
     kv_abs = kv_pos - jnp.where(kv_pos >= p, p - prefix_len, 0)
     causal = kv_abs[None, :] <= q_pos[:, None]
     if sliding_window is not None:
-        causal = causal & (q_pos[:, None] - kv_abs[None, :] < sliding_window)
+        causal = _window_mask(
+            causal, q_pos[:, None] - kv_abs[None, :], sliding_window
+        )
     mask = causal & kv_valid[None, :]
     logits = jnp.where(mask[None, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
