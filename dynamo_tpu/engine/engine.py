@@ -643,11 +643,6 @@ class JaxLlmEngine:
             # docs/SPEC_VS_FUSED.json.
             if config.mesh is not None and config.mesh.pp > 1:
                 raise ValueError("speculative decoding does not support pp meshes")
-            if getattr(cfg, "sliding_window", None):
-                raise ValueError(
-                    "speculative decoding is incompatible with sliding-window "
-                    "attention: the verify window has no window mask yet"
-                )
             if config.spec_tokens < 1:
                 raise ValueError("spec_tokens must be >= 1")
             if config.spec_ngram < 1:

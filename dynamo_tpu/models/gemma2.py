@@ -24,8 +24,11 @@ What distinguishes Gemma-2 from the llama-geometry families
 
 Serving notes: the paged decode path uses the JAX attention op (the
 Pallas kernel has no per-layer window plumbing yet — ``attention=`` is
-accepted and ignored); speculative decoding and sequence parallelism are
-fenced by the engine's existing ``sliding_window`` guards.
+accepted and ignored); sequence parallelism is fenced by the engine's
+``sliding_window`` sp-mesh guard, and speculative decoding is rejected
+because this family ships no ``forward_verify`` (a future verify forward
+must thread the per-layer window array into its window attention, like
+llama_forward_verify does for the uniform window).
 """
 
 from __future__ import annotations

@@ -595,7 +595,8 @@ def llama_forward_verify(
 
     def attend(q, k_layer, v_layer):
         return window_attention(
-            attention, q, k_layer, v_layer, block_tables, context_lens
+            attention, q, k_layer, v_layer, block_tables, context_lens,
+            sliding_window=cfg.sliding_window,
         )
 
     def layer(x, layer_in):

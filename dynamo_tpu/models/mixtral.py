@@ -41,6 +41,15 @@ from dynamo_tpu.ops.rope import apply_rope
 class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     experts_per_token: int = 2
+
+    def __post_init__(self):
+        # inherited field from LlamaConfig that NO mixtral-family forward
+        # honors (prefill/decode/verify all run full attention) — refuse a
+        # programmatic config rather than silently ignoring the window
+        if self.sliding_window is not None:
+            raise NotImplementedError(
+                "mixtral-family attention has no sliding-window mask"
+            )
     capacity_factor: float = 2.0
     # expert FFN width; 0 = same as intermediate_size (Mixtral proper).
     # Qwen3-MoE configs carry a distinct moe_intermediate_size.

@@ -171,6 +171,9 @@ def paged_window_attention(
     block_tables: jnp.ndarray,  # [batch, max_blocks] int32
     context_lens: jnp.ndarray,  # [batch] int32: context length INCLUDING the
                                 # window's last token (0 ⇒ inactive lane)
+    *,
+    sliding_window=None,  # attend only the last W positions per query; may
+                          # be a traced scalar (<=0 = full) — _window_mask
 ) -> jnp.ndarray:
     """Multi-query decode attention for speculative verification: the w
     window tokens' K/V are already written to the cache (like decode), and
@@ -194,6 +197,8 @@ def paged_window_attention(
     q_pos = context_lens[:, None] - w + jnp.arange(w)[None, :]       # [b, w]
     kv_pos = jnp.arange(length)[None, None, :]                        # [1, 1, l]
     mask = kv_pos <= q_pos[:, :, None]                                # [b, w, l]
+    if sliding_window is not None:
+        mask = _window_mask(mask, q_pos[:, :, None] - kv_pos, sliding_window)
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgwl,blkd->bwkgd", weights, v.astype(jnp.float32))
@@ -207,19 +212,29 @@ def window_attention(
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,
     context_lens: jnp.ndarray,
+    *,
+    sliding_window=None,
 ) -> jnp.ndarray:
     """Dispatch speculative-window attention by implementation name
     ("pallas"/"pallas_interpret" → the Pallas window kernel, else the
     XLA gather path above).  One dispatch shared by every family's verify
-    forward so kernel signature changes happen in one place."""
-    if attention.startswith("pallas"):
+    forward so kernel signature changes happen in one place.
+
+    ``sliding_window`` routes to the XLA path regardless of ``attention``:
+    the Pallas multi-query kernel has no sliding mask yet, and a silently
+    full-attention verify would accept drafts the real model would not.
+    """
+    if attention.startswith("pallas") and sliding_window is None:
         from dynamo_tpu.ops.pallas import paged_window_attention_decode
 
         return paged_window_attention_decode(
             q, k_cache, v_cache, block_tables, context_lens,
             interpret=attention == "pallas_interpret",
         )
-    return paged_window_attention(q, k_cache, v_cache, block_tables, context_lens)
+    return paged_window_attention(
+        q, k_cache, v_cache, block_tables, context_lens,
+        sliding_window=sliding_window,
+    )
 
 
 def position_major_to_batch(t: jnp.ndarray, w: int, b: int, *tail: int) -> jnp.ndarray:

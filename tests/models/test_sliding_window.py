@@ -169,16 +169,9 @@ async def test_engine_sliding_window_chunked_prefill():
         chunked.stop()
 
 
-def test_sliding_window_rejects_spec_and_sp():
+def test_sliding_window_rejects_sp_mesh():
     from dynamo_tpu.parallel.mesh import MeshConfig
 
-    with pytest.raises(ValueError, match="sliding-window"):
-        JaxLlmEngine(
-            EngineConfig(model=CFG, num_blocks=16, block_size=4,
-                         max_batch_size=2, max_model_len=32,
-                         speculative="ngram"),
-            params=PARAMS,
-        )
     with pytest.raises(ValueError, match="sliding-window"):
         JaxLlmEngine(
             EngineConfig(model=CFG, num_blocks=16, block_size=4,
@@ -187,6 +180,27 @@ def test_sliding_window_rejects_spec_and_sp():
                          mesh=MeshConfig(sp=2)),
             params=PARAMS,
         )
+
+
+async def test_speculative_composes_with_sliding_window():
+    """Speculative decoding on a sliding-window config: the verify forward
+    masks each window query to its own last-W positions
+    (ops/attention.paged_window_attention sliding_window), so spec output
+    is token-exact vs plain greedy well past the window boundary."""
+    pattern = [7, 11, 19] * 5  # drafting-friendly, 15 tokens > window 6
+    plain = make_engine()
+    spec = make_engine(speculative="ngram", spec_tokens=4, num_blocks=128,
+                       max_model_len=64)
+    try:
+        for prompt in (pattern, list(range(3, 17))):
+            a, _ = await collect(plain, request(prompt, max_tokens=24))
+            b, _ = await collect(spec, request(prompt, max_tokens=24))
+            assert a == b, f"spec diverged on sliding window: {a} vs {b}"
+        stats = spec.stats()
+        assert stats["spec_drafted_tokens_total"] > 0
+    finally:
+        plain.stop()
+        spec.stop()
 
 
 def test_mistral_hf_config_maps_to_llama_family():
