@@ -1,0 +1,443 @@
+"""Gemma-3 text model family (TPU-first, layer-scanned).
+
+Builds on the Gemma-2 machinery (sandwich norms, GeGLU, sqrt(hidden)
+embed scale, (1+w) RMSNorm, per-layer traced attention windows through
+one ``lax.scan``) with Gemma-3's changes:
+
+- **5:1 local/global pattern**: five sliding-window layers then one
+  full-attention layer (HF ``layer_types``), vs Gemma-2's 1:1.
+- **Dual rope bases**: local layers use ``rope_local_base_freq`` (10k),
+  global layers ``rope_theta`` (1M, optionally ``rope_scaling``-stretched
+  on long-context checkpoints).  The engine threads ONE (cos, sin) pair
+  sliced to ``[:max_len]``, so both tables pack along the feature axis
+  ([max_len, head_dim] = local_half ++ global_half) and each scanned
+  layer selects its half by a per-layer flag.
+- **Per-head q/k RMSNorm** ((1 + w) convention, baked at load) instead of
+  Gemma-2's logit soft-capping (no attn or final capping).
+
+Multimodal Gemma-3 checkpoints (``model_type: gemma3`` with a nested
+``text_config`` + vision tower) parse their text config; the vision tower
+itself is not implemented for this family and image inputs are rejected
+by the engine (no ``forward_prefill_embeds``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.gemma2 import _geglu
+from dynamo_tpu.ops.attention import (
+    dense_causal_attention,
+    gather_prefix_kv,
+    paged_decode_attention,
+    prefill_attention_with_prefix,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.norms import rms_norm
+from dynamo_tpu.ops.quant import mm
+from dynamo_tpu.ops.rope import apply_rope, rope_table
+
+P = jax.sharding.PartitionSpec
+
+
+@dataclass(frozen=True)
+class Gemma3Config:
+    vocab_size: int = 262208
+    hidden_size: int = 2560
+    intermediate_size: int = 10240
+    num_layers: int = 34
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    head_dim: int = 256
+    max_position_embeddings: int = 131072
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1e6           # global layers
+    rope_local_theta: float = 10000.0  # sliding layers
+    rope_scaling: Any = None           # applies to the GLOBAL table only
+    sliding_window: int = 4096
+    query_pre_attn_scalar: float = 256.0
+    # per-layer pattern: True = full attention (HF layer_types); default
+    # built by __post_init__ as every 6th layer global
+    global_layers: tuple = field(default=())
+    tie_word_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if not self.global_layers:
+            object.__setattr__(
+                self, "global_layers",
+                tuple((i + 1) % 6 == 0 for i in range(self.num_layers)),
+            )
+        if len(self.global_layers) != self.num_layers:
+            raise ValueError(
+                f"global_layers has {len(self.global_layers)} entries for "
+                f"{self.num_layers} layers"
+            )
+
+    @property
+    def embed_scale(self) -> float:
+        return float(self.hidden_size) ** 0.5
+
+    def layer_windows(self) -> jnp.ndarray:
+        """Per-layer attention window, int32 [L]: 0 (= full) on global
+        layers, the sliding window elsewhere."""
+        flags = jnp.asarray(self.global_layers, bool)
+        return jnp.where(flags, 0, jnp.int32(self.sliding_window))
+
+    def layer_global_flags(self) -> jnp.ndarray:
+        return jnp.asarray(self.global_layers, bool)
+
+    @classmethod
+    def from_hf_config(cls, config: dict | str | Path) -> "Gemma3Config":
+        if not isinstance(config, dict):
+            config = json.loads(Path(config).read_text())
+        if "text_config" in config:  # multimodal wrapper (model_type gemma3)
+            config = config["text_config"]
+        heads = config.get("num_attention_heads", 8)
+        layer_types = config.get("layer_types")
+        n_layers = config["num_hidden_layers"]
+        global_layers = (
+            tuple(t == "full_attention" for t in layer_types)
+            if layer_types else ()
+        )
+        return cls(
+            vocab_size=config["vocab_size"],
+            hidden_size=config["hidden_size"],
+            intermediate_size=config["intermediate_size"],
+            num_layers=n_layers,
+            num_heads=heads,
+            num_kv_heads=config.get("num_key_value_heads", heads),
+            head_dim=config.get("head_dim") or config["hidden_size"] // heads,
+            max_position_embeddings=config.get(
+                "max_position_embeddings", 131072
+            ),
+            rms_norm_eps=config.get("rms_norm_eps", 1e-6),
+            rope_theta=config.get("rope_theta", 1e6),
+            rope_local_theta=config.get("rope_local_base_freq", 10000.0),
+            rope_scaling=config.get("rope_scaling"),
+            sliding_window=config.get("sliding_window", 4096),
+            query_pre_attn_scalar=float(
+                config.get("query_pre_attn_scalar")
+                or config["hidden_size"] // heads
+            ),
+            global_layers=global_layers,
+        )
+
+    @classmethod
+    def tiny(cls) -> "Gemma3Config":
+        """Test geometry: 7 layers so the 5:1 pattern includes one global
+        layer (index 5) plus two more local ones."""
+        return cls(
+            vocab_size=480, hidden_size=64, intermediate_size=128,
+            num_layers=7, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_position_embeddings=128, sliding_window=8,
+            query_pre_attn_scalar=16.0,
+        )
+
+
+def init_params(cfg: Gemma3Config, rng: jax.Array) -> dict:
+    keys = jax.random.split(rng, 9)
+    h, i, l_ = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+
+    def norm_init(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(cfg.dtype)
+
+    return {
+        "embed": norm_init(keys[0], (cfg.vocab_size, h), 1.0),
+        "final_norm": jnp.ones((h,), cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "post_attn_norm": jnp.ones((l_, h), cfg.dtype),
+            "mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "post_mlp_norm": jnp.ones((l_, h), cfg.dtype),
+            "q_norm": jnp.ones((l_, cfg.head_dim), cfg.dtype),
+            "k_norm": jnp.ones((l_, cfg.head_dim), cfg.dtype),
+            "wq": norm_init(keys[1], (l_, h, qd), h),
+            "wk": norm_init(keys[2], (l_, h, kvd), h),
+            "wv": norm_init(keys[3], (l_, h, kvd), h),
+            "wo": norm_init(keys[4], (l_, qd, h), qd),
+            "w_gate": norm_init(keys[5], (l_, h, i), h),
+            "w_up": norm_init(keys[6], (l_, h, i), h),
+            "w_down": norm_init(keys[7], (l_, i, h), i),
+        },
+    }
+
+
+def param_specs(cfg: Gemma3Config) -> dict:
+    norm = P("pp", None)
+    return {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "layers": {
+            "attn_norm": norm, "post_attn_norm": norm,
+            "mlp_norm": norm, "post_mlp_norm": norm,
+            "q_norm": norm, "k_norm": norm,
+            "wq": P("pp", None, "tp"), "wk": P("pp", None, "tp"),
+            "wv": P("pp", None, "tp"), "wo": P("pp", "tp", None),
+            "w_gate": P("pp", None, "tp"), "w_up": P("pp", None, "tp"),
+            "w_down": P("pp", "tp", None),
+        },
+    }
+
+
+def make_rope_tables(cfg: Gemma3Config):
+    """Both bases packed along the feature axis: [max_pos, head_dim] =
+    local half ++ global half (each [max_pos, head_dim//2]).  The engine
+    slices positions ([:max_len]) without knowing about the packing;
+    layers split and select their half (see _rope_halves)."""
+    cos_l, sin_l = rope_table(
+        cfg.max_position_embeddings, cfg.head_dim, cfg.rope_local_theta
+    )
+    cos_g, sin_g = rope_table(
+        cfg.max_position_embeddings, cfg.head_dim, cfg.rope_theta,
+        scaling=cfg.rope_scaling,
+    )
+    return (
+        jnp.concatenate([cos_l, cos_g], axis=-1),
+        jnp.concatenate([sin_l, sin_g], axis=-1),
+    )
+
+
+def _rope_halves(cos, sin, is_global):
+    """Select a layer's (cos, sin) from the packed dual tables by the
+    traced per-layer flag."""
+    half = cos.shape[-1] // 2
+    c = jnp.where(is_global, cos[..., half:], cos[..., :half])
+    s = jnp.where(is_global, sin[..., half:], sin[..., :half])
+    return c, s
+
+
+def _embed(params, cfg: Gemma3Config, token_ids) -> jnp.ndarray:
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    return x * jnp.asarray(cfg.embed_scale, cfg.dtype)
+
+
+def _qkv(attn_in, w, cfg: Gemma3Config):
+    s = attn_in.shape[0]
+    q = mm(attn_in, w["wq"]).reshape(s, cfg.num_heads, cfg.head_dim)
+    k = mm(attn_in, w["wk"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    v = mm(attn_in, w["wv"]).reshape(s, cfg.num_kv_heads, cfg.head_dim)
+    q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
+    return q, k, v
+
+
+def _final_logits(params, cfg: Gemma3Config, x) -> jnp.ndarray:
+    return (x @ params["embed"].T.astype(x.dtype)).astype(jnp.float32)
+
+
+def _attn_kwargs(cfg: Gemma3Config, window) -> dict:
+    return {
+        "sliding_window": window,
+        "query_scale": float(cfg.query_pre_attn_scalar) ** -0.5,
+    }
+
+
+def _scan_xs(cfg: Gemma3Config, params: dict, kv_cache: dict):
+    return (
+        params["layers"], cfg.layer_windows(), cfg.layer_global_flags(),
+        kv_cache["k"], kv_cache["v"],
+    )
+
+
+def gemma3_forward_prefill(
+    params: dict,
+    cfg: Gemma3Config,
+    token_ids: jnp.ndarray,   # [seq_pad] int32
+    kv_cache: dict,           # {"k","v"}: [L, N, bs, kvh, d]
+    block_ids: jnp.ndarray,   # [max_blocks] int32
+    seq_len: jnp.ndarray,     # scalar int32
+    start_pos: jnp.ndarray,   # scalar int32
+    cos: jnp.ndarray,         # packed dual tables (make_rope_tables)
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    s = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, is_global, k_layer, v_layer = layer_in
+        c, si = _rope_halves(cos, sin, is_global)
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, c, si)
+        k = apply_rope(k, positions, c, si)
+        k_layer, v_layer = write_prefill_kv(
+            k_layer, v_layer, k, v, block_ids, seq_len
+        )
+        attn = dense_causal_attention(
+            q[None], k[None], v[None], seq_len[None],
+            **_attn_kwargs(cfg, window),
+        )[0]
+        attn = mm(attn.reshape(s, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, _scan_xs(cfg, params, kv_cache))
+    x = rms_norm(x, params["final_norm"], eps)
+    last = x[jnp.maximum(seq_len - 1, 0)]
+    logits = _final_logits(params, cfg, last[None])[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma3_forward_prefill_with_prefix(
+    params: dict,
+    cfg: Gemma3Config,
+    token_ids: jnp.ndarray,
+    kv_cache: dict,
+    full_block_ids: jnp.ndarray,
+    tail_block_ids: jnp.ndarray,
+    tail_len: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    s = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, is_global, k_layer, v_layer = layer_in
+        c, si = _rope_halves(cos, sin, is_global)
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, c, si)
+        k = apply_rope(k, positions, c, si)
+        k_prefix, v_prefix = gather_prefix_kv(k_layer, v_layer, full_block_ids)
+        k_layer, v_layer = write_prefill_kv(
+            k_layer, v_layer, k, v, tail_block_ids, tail_len
+        )
+        attn = prefill_attention_with_prefix(
+            q, k, v, k_prefix, v_prefix, start_pos, tail_len,
+            **_attn_kwargs(cfg, window),
+        )
+        attn = mm(attn.reshape(s, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, _scan_xs(cfg, params, kv_cache))
+    x = rms_norm(x, params["final_norm"], eps)
+    last = x[jnp.maximum(tail_len - 1, 0)]
+    logits = _final_logits(params, cfg, last[None])[0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma3_forward_decode(
+    params: dict,
+    cfg: Gemma3Config,
+    token_ids: jnp.ndarray,
+    kv_cache: dict,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    slot_ids: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",  # engine compat; JAX path regardless (no
+                             # per-layer window plumbing in the kernel)
+) -> tuple[jnp.ndarray, dict]:
+    del attention
+    b = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)
+    positions = jnp.maximum(context_lens - 1, 0)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, is_global, k_layer, v_layer = layer_in
+        c, si = _rope_halves(cos, sin, is_global)
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, c, si)
+        k = apply_rope(k, positions, c, si)
+        k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, slot_ids)
+        attn = paged_decode_attention(
+            q, k_layer, v_layer, block_tables, context_lens,
+            **_attn_kwargs(cfg, window),
+        )
+        attn = mm(attn.reshape(b, -1), w["wo"])
+        x = x + rms_norm(attn, w["post_attn_norm"], eps)
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, _scan_xs(cfg, params, kv_cache))
+    x = rms_norm(x, params["final_norm"], eps)
+    logits = _final_logits(params, cfg, x)
+    return logits, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# HF weight loading
+# ---------------------------------------------------------------------------
+
+_HF_LAYER_MAP = {
+    "attn_norm": "model.layers.{i}.input_layernorm.weight",
+    "post_attn_norm": "model.layers.{i}.post_attention_layernorm.weight",
+    "mlp_norm": "model.layers.{i}.pre_feedforward_layernorm.weight",
+    "post_mlp_norm": "model.layers.{i}.post_feedforward_layernorm.weight",
+    "q_norm": "model.layers.{i}.self_attn.q_norm.weight",
+    "k_norm": "model.layers.{i}.self_attn.k_norm.weight",
+    "wq": "model.layers.{i}.self_attn.q_proj.weight",
+    "wk": "model.layers.{i}.self_attn.k_proj.weight",
+    "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "wo": "model.layers.{i}.self_attn.o_proj.weight",
+    "w_gate": "model.layers.{i}.mlp.gate_proj.weight",
+    "w_up": "model.layers.{i}.mlp.up_proj.weight",
+    "w_down": "model.layers.{i}.mlp.down_proj.weight",
+}
+
+_NORM_LEAVES = (
+    "attn_norm", "post_attn_norm", "mlp_norm", "post_mlp_norm",
+    "q_norm", "k_norm",
+)
+
+
+def load_hf_weights(cfg: Gemma3Config, model_dir: str | Path, *,
+                    tensors: dict | None = None) -> dict:
+    """(1 + w) RMSNorm baking incl. the per-head q/k norms; refuses untied
+    unembeddings (same rationale as gemma2)."""
+    if tensors is None:
+        from dynamo_tpu.models.hf_io import read_safetensors
+
+        tensors = read_safetensors(model_dir)
+    if "lm_head.weight" in tensors:
+        raise ValueError(
+            "gemma3 checkpoint ships lm_head.weight (untied unembedding); "
+            "this family implements the tied projection only"
+        )
+
+    def get(name: str, transpose: bool = False):
+        t = tensors[name]
+        if transpose:
+            t = t.T
+        return jnp.asarray(t, cfg.dtype)
+
+    plus_one = lambda t: (t.astype(jnp.float32) + 1.0).astype(t.dtype)  # noqa: E731
+    layers: dict[str, list] = {k: [] for k in _HF_LAYER_MAP}
+    for i in range(cfg.num_layers):
+        for ours, theirs in _HF_LAYER_MAP.items():
+            t = get(theirs.format(i=i), transpose=ours.startswith("w"))
+            if ours in _NORM_LEAVES:
+                t = plus_one(t)
+            layers[ours].append(t)
+    return {
+        "embed": get("model.embed_tokens.weight"),
+        "final_norm": plus_one(get("model.norm.weight")),
+        "layers": {k: jnp.stack(v) for k, v in layers.items()},
+    }

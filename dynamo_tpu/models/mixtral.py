@@ -41,15 +41,6 @@ from dynamo_tpu.ops.rope import apply_rope
 class MixtralConfig(LlamaConfig):
     num_experts: int = 8
     experts_per_token: int = 2
-
-    def __post_init__(self):
-        # inherited field from LlamaConfig that NO mixtral-family forward
-        # honors (prefill/decode/verify all run full attention) — refuse a
-        # programmatic config rather than silently ignoring the window
-        if self.sliding_window is not None:
-            raise NotImplementedError(
-                "mixtral-family attention has no sliding-window mask"
-            )
     capacity_factor: float = 2.0
     # expert FFN width; 0 = same as intermediate_size (Mixtral proper).
     # Qwen3-MoE configs carry a distinct moe_intermediate_size.
@@ -57,6 +48,16 @@ class MixtralConfig(LlamaConfig):
     # renormalize top-k router weights (Mixtral yes; some Qwen3-MoE
     # variants disable it)
     norm_topk_prob: bool = True
+
+    def __post_init__(self):
+        # inherited field from LlamaConfig that NO mixtral-family forward
+        # honors (prefill/decode/verify all run full attention) — refuse
+        # rather than silently ignoring the window; from_hf_config parses
+        # the HF window fields specifically so this fires on checkpoints
+        if self.sliding_window is not None:
+            raise NotImplementedError(
+                "mixtral-family attention has no sliding-window mask"
+            )
 
     @property
     def expert_intermediate_size(self) -> int:
@@ -107,6 +108,11 @@ class MixtralConfig(LlamaConfig):
             qk_norm=config.get(
                 "qk_norm", config.get("model_type") == "qwen3_moe"
             ),
+            # parsed with HF's use_sliding_window/max_window_layers
+            # semantics; a genuinely-windowed MoE checkpoint then hits the
+            # __post_init__ refusal instead of silently running full
+            # attention
+            sliding_window=cls._resolve_sliding_window(config),
         )
 
 

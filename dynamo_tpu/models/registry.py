@@ -194,6 +194,28 @@ def _gemma2_family() -> ModelFamily:
     )
 
 
+def _gemma3_family() -> ModelFamily:
+    # Gemma-3 text = Gemma-2 machinery + 5:1 local/global pattern, dual
+    # rope bases packed along the feature axis, per-head q/k (1+w) norms,
+    # no soft-capping (models/gemma3.py).  Multimodal checkpoints parse
+    # their text_config; image inputs are rejected (no embeds prefill).
+    from dynamo_tpu.models import gemma3
+
+    return ModelFamily(
+        name="gemma3",
+        config_from_hf=gemma3.Gemma3Config.from_hf_config,
+        init_params=gemma3.init_params,
+        param_specs=gemma3.param_specs,
+        forward_prefill=gemma3.gemma3_forward_prefill,
+        forward_decode=gemma3.gemma3_forward_decode,
+        forward_prefill_with_prefix=gemma3.gemma3_forward_prefill_with_prefix,
+        make_rope_tables=gemma3.make_rope_tables,
+        embed=gemma3._embed,
+        load_weights=gemma3.load_hf_weights,
+        quant_leaves=_PROJ_QUANT_LEAVES,
+    )
+
+
 def _mixtral_family() -> ModelFamily:
     from dynamo_tpu.models import mixtral
 
@@ -268,6 +290,8 @@ _FAMILIES: dict[str, Callable[[], ModelFamily]] = {
     "qwen3": _qwen3_family,
     "gemma": _gemma_family,
     "gemma2": _gemma2_family,
+    "gemma3": _gemma3_family,
+    "gemma3_text": _gemma3_family,
     "phi3": _phi3_family,
     "mixtral": _mixtral_family,
     "qwen3_moe": _qwen3_moe_family,

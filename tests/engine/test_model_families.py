@@ -176,3 +176,54 @@ async def test_gemma2_engine_serving_matches_hf(tmp_path):
         assert tokens == hf_out, f"engine {tokens} != HF greedy {hf_out}"
     finally:
         engine.stop()
+
+
+async def test_gemma3_engine_serving_matches_hf(tmp_path):
+    """Gemma-3 through the full engine: greedy tokens equal HF greedy
+    (dual-base rope + 5:1 window pattern through the paged cache)."""
+    import jax.numpy as jnp
+
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    config = transformers.Gemma3TextConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+        sliding_window=6, query_pre_attn_scalar=16.0,
+        hidden_activation="gelu_pytorch_tanh", torch_dtype="float32",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(22)
+    model = transformers.Gemma3ForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    from dynamo_tpu.models.registry import get_family
+
+    fam = get_family("gemma3")
+    cfg = fam.config_from_hf(f"{tmp_path}/config.json")
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = fam.load_weights(cfg, tmp_path)
+
+    prompt = [3, 17, 99, 250, 7, 42, 200, 11]
+    n_new = 6
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.tensor([prompt], dtype=torch.long), max_new_tokens=n_new,
+            do_sample=False,
+        )[0, len(prompt):].tolist()
+
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg, model_family="gemma3", num_blocks=64, block_size=4,
+            max_batch_size=2, prefill_buckets=(8, 16), max_model_len=64,
+        ),
+        params=params,
+    )
+    engine.start()
+    try:
+        tokens, _ = await collect(engine, request(prompt, max_tokens=n_new))
+        assert tokens == hf_out, f"engine {tokens} != HF greedy {hf_out}"
+    finally:
+        engine.stop()

@@ -707,3 +707,100 @@ def test_gemma2_windowed_decode_matches_hf(tmp_path):
             np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
             err_msg=f"gemma2 windowed decode position {p}",
         )
+
+
+@pytest.mark.slow
+def test_gemma3_matches_hf(tmp_path):
+    """Gemma-3 text: 5:1 local/global attention pattern, DUAL rope bases
+    (local 10k / global 1M, packed along the feature axis and selected by
+    a traced per-layer flag), per-head q/k (1+w) RMSNorm, no soft-capping.
+    7 layers puts one global layer (idx 5) among six local ones; the
+    20-token prompt exceeds the 8-token window."""
+    config = transformers.Gemma3TextConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+        sliding_window=8, query_pre_attn_scalar=16.0,
+        hidden_activation="gelu_pytorch_tanh", torch_dtype="float32",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    model = transformers.Gemma3ForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    def ours(model_dir, prompt):
+        from dynamo_tpu.models import gemma3
+        from dynamo_tpu.models.registry import get_family
+
+        fam = get_family("gemma3_text")
+        cfg = fam.config_from_hf(f"{model_dir}/config.json")
+        cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+        assert cfg.global_layers == (False,) * 5 + (True,) + (False,)
+        params = fam.load_weights(cfg, model_dir)
+        cos, sin = fam.rope_tables(cfg)
+        cache = fam.cache_init(cfg, 16, 4)
+        blocks = jnp.arange(8, dtype=jnp.int32)
+        logits, _ = gemma3.gemma3_forward_prefill(
+            params, cfg, jnp.asarray(prompt, jnp.int32), cache, blocks,
+            jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+        )
+        return np.asarray(logits)
+
+    _check(ours, model, tmp_path)
+
+
+@pytest.mark.slow
+def test_gemma3_windowed_decode_matches_hf(tmp_path):
+    """Gemma-3 DECODE across the sliding boundary with the dual-base rope:
+    local layers drop context per-position, the global layer keeps it."""
+    from dynamo_tpu.models import gemma3
+    from dynamo_tpu.models.registry import get_family
+
+    config = transformers.Gemma3TextConfig(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+        sliding_window=6, query_pre_attn_scalar=16.0,
+        hidden_activation="gelu_pytorch_tanh", torch_dtype="float32",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(14)
+    model = transformers.Gemma3ForCausalLM(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    tokens = [3, 17, 99, 250, 7, 42, 200, 11, 85, 301, 12, 13, 44, 45]
+    with torch.no_grad():
+        hf_all = model(
+            torch.tensor([tokens], dtype=torch.long)
+        ).logits[0].float().numpy()
+
+    fam = get_family("gemma3")
+    cfg = fam.config_from_hf(f"{tmp_path}/config.json")
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    params = fam.load_weights(cfg, tmp_path)
+    cos, sin = fam.rope_tables(cfg)
+    block_size = 4
+    cache = fam.cache_init(cfg, 16, block_size)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prefill_len = 4
+    logits, cache = gemma3.gemma3_forward_prefill(
+        params, cfg, jnp.asarray(tokens[:prefill_len], jnp.int32), cache,
+        blocks, jnp.int32(prefill_len), jnp.int32(0), cos, sin,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_all[prefill_len - 1], atol=3e-4, rtol=3e-4
+    )
+    tables = blocks[None, :]
+    for p in range(prefill_len, len(tokens)):
+        slot = jnp.asarray([blocks[p // block_size] * block_size + p % block_size])
+        logits, cache = gemma3.gemma3_forward_decode(
+            params, cfg, jnp.asarray([tokens[p]], jnp.int32), cache,
+            tables, jnp.asarray([p + 1], jnp.int32), slot, cos, sin,
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
+            err_msg=f"gemma3 windowed decode position {p}",
+        )
