@@ -25,10 +25,10 @@ What distinguishes Gemma-2 from the llama-geometry families
 Serving notes: the paged decode path uses the JAX attention op (the
 Pallas kernel has no per-layer window plumbing yet — ``attention=`` is
 accepted and ignored); sequence parallelism is fenced by the engine's
-``sliding_window`` sp-mesh guard, and speculative decoding is rejected
-because this family ships no ``forward_verify`` (a future verify forward
-must thread the per-layer window array into its window attention, like
-llama_forward_verify does for the uniform window).
+``sliding_window`` sp-mesh guard.  Speculative decoding IS supported:
+``gemma2_forward_verify`` threads the per-layer traced windows plus the
+attn softcap and query scale through ``window_attention``, spec-vs-plain
+token-exactness pinned by test.
 """
 
 from __future__ import annotations
@@ -47,6 +47,7 @@ from dynamo_tpu.ops.attention import (
     gather_prefix_kv,
     paged_decode_attention,
     prefill_attention_with_prefix,
+    window_attention,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -360,6 +361,70 @@ def gemma2_forward_decode(
     )
     x = rms_norm(x, params["final_norm"], eps)
     logits = _final_logits(params, cfg, x)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma2_forward_verify(
+    params: dict,
+    cfg: Gemma2Config,
+    token_ids: jnp.ndarray,     # [batch, w] int32 — last accepted + drafts
+    kv_cache: dict,
+    block_tables: jnp.ndarray,  # [batch, max_blocks] int32
+    context_lens: jnp.ndarray,  # [batch] int32 INCLUDING the window's last
+    slot_ids: jnp.ndarray,      # [batch, w] int32 flat slots per position
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",     # accepted for engine compat; windowed
+                                # layers always take the XLA verify path
+) -> tuple[jnp.ndarray, dict]:
+    """Speculative-verification forward: score all w window positions in
+    one pass (logits [batch, w, vocab]) — same contract as
+    llama_forward_verify, with each layer's traced window masking its
+    verify queries (ops/attention.window_attention sliding_window)."""
+    b, w_len = token_ids.shape
+    x = _embed(params, cfg, token_ids.reshape(-1))  # [b*w, h]
+    positions = jnp.maximum(
+        context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
+    )
+    flat_slots = slot_ids.reshape(-1)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(
+            q.reshape(b, w_len, cfg.num_heads, cfg.head_dim), positions,
+            cos, sin,
+        )
+        k = apply_rope(
+            k.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim), positions,
+            cos, sin,
+        )
+        v = v.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim)
+        k_layer, v_layer = write_decode_kv(
+            k_layer, v_layer,
+            k.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim), flat_slots,
+        )
+        attn = window_attention(
+            "jax", q, k_layer, v_layer, block_tables, context_lens,
+            **_attn_kwargs(cfg, window),
+        )
+        x = x + rms_norm(
+            mm(attn.reshape(b * w_len, -1), w["wo"]), w["post_attn_norm"], eps
+        )
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x,
+        (params["layers"], cfg.layer_windows(), kv_cache["k"], kv_cache["v"]),
+    )
+    x = rms_norm(x, params["final_norm"], eps)
+    logits = _final_logits(params, cfg, x).reshape(b, w_len, -1)
     return logits, {"k": new_k, "v": new_v}
 
 

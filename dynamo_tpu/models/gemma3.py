@@ -37,6 +37,7 @@ from dynamo_tpu.ops.attention import (
     gather_prefix_kv,
     paged_decode_attention,
     prefill_attention_with_prefix,
+    window_attention,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -379,6 +380,64 @@ def gemma3_forward_decode(
     x, (new_k, new_v) = jax.lax.scan(layer, x, _scan_xs(cfg, params, kv_cache))
     x = rms_norm(x, params["final_norm"], eps)
     logits = _final_logits(params, cfg, x)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def gemma3_forward_verify(
+    params: dict,
+    cfg: Gemma3Config,
+    token_ids: jnp.ndarray,     # [batch, w] int32
+    kv_cache: dict,
+    block_tables: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    slot_ids: jnp.ndarray,      # [batch, w] int32
+    cos: jnp.ndarray,           # packed dual tables
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",
+) -> tuple[jnp.ndarray, dict]:
+    """Speculative-verification forward (contract of llama_forward_verify):
+    per-layer traced windows and dual-base rope through the verify window."""
+    b, w_len = token_ids.shape
+    x = _embed(params, cfg, token_ids.reshape(-1))
+    positions = jnp.maximum(
+        context_lens[:, None] - w_len + jnp.arange(w_len)[None, :], 0
+    )
+    flat_slots = slot_ids.reshape(-1)
+    eps = cfg.rms_norm_eps
+
+    def layer(x, layer_in):
+        w, window, is_global, k_layer, v_layer = layer_in
+        c, si = _rope_halves(cos, sin, is_global)
+        attn_in = rms_norm(x, w["attn_norm"], eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(
+            q.reshape(b, w_len, cfg.num_heads, cfg.head_dim), positions, c, si
+        )
+        k = apply_rope(
+            k.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim), positions,
+            c, si,
+        )
+        v = v.reshape(b, w_len, cfg.num_kv_heads, cfg.head_dim)
+        k_layer, v_layer = write_decode_kv(
+            k_layer, v_layer,
+            k.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim),
+            v.reshape(b * w_len, cfg.num_kv_heads, cfg.head_dim), flat_slots,
+        )
+        attn = window_attention(
+            "jax", q, k_layer, v_layer, block_tables, context_lens,
+            **_attn_kwargs(cfg, window),
+        )
+        x = x + rms_norm(
+            mm(attn.reshape(b * w_len, -1), w["wo"]), w["post_attn_norm"], eps
+        )
+        mlp = _geglu(rms_norm(x, w["mlp_norm"], eps), w)
+        x = x + rms_norm(mlp, w["post_mlp_norm"], eps)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(layer, x, _scan_xs(cfg, params, kv_cache))
+    x = rms_norm(x, params["final_norm"], eps)
+    logits = _final_logits(params, cfg, x).reshape(b, w_len, -1)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -191,6 +191,7 @@ def _gemma2_family() -> ModelFamily:
         embed=gemma2._embed,
         load_weights=gemma2.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
+        forward_verify=gemma2.gemma2_forward_verify,
     )
 
 
@@ -213,6 +214,7 @@ def _gemma3_family() -> ModelFamily:
         embed=gemma3._embed,
         load_weights=gemma3.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
+        forward_verify=gemma3.gemma3_forward_verify,
     )
 
 

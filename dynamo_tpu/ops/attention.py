@@ -174,6 +174,8 @@ def paged_window_attention(
     *,
     sliding_window=None,  # attend only the last W positions per query; may
                           # be a traced scalar (<=0 = full) — _window_mask
+    logit_softcap: float | None = None,
+    query_scale: float | None = None,
 ) -> jnp.ndarray:
     """Multi-query decode attention for speculative verification: the w
     window tokens' K/V are already written to the cache (like decode), and
@@ -190,8 +192,12 @@ def paged_window_attention(
     length = max_blocks * block_size
 
     qg = q.reshape(b, w, kvh, groups, d).astype(jnp.float32)
-    scale = 1.0 / jnp.sqrt(jnp.float32(d))
+    scale = jnp.float32(query_scale) if query_scale is not None else (
+        1.0 / jnp.sqrt(jnp.float32(d))
+    )
     logits = jnp.einsum("bwkgd,blkd->bkgwl", qg, k.astype(jnp.float32)) * scale
+    if logit_softcap is not None:
+        logits = _apply_softcap(logits, logit_softcap)
     # query i sits at absolute position context_lens - w + i; it sees
     # positions <= its own
     q_pos = context_lens[:, None] - w + jnp.arange(w)[None, :]       # [b, w]
@@ -214,17 +220,25 @@ def window_attention(
     context_lens: jnp.ndarray,
     *,
     sliding_window=None,
+    logit_softcap: float | None = None,
+    query_scale: float | None = None,
 ) -> jnp.ndarray:
     """Dispatch speculative-window attention by implementation name
     ("pallas"/"pallas_interpret" → the Pallas window kernel, else the
     XLA gather path above).  One dispatch shared by every family's verify
     forward so kernel signature changes happen in one place.
 
-    ``sliding_window`` routes to the XLA path regardless of ``attention``:
-    the Pallas multi-query kernel has no sliding mask yet, and a silently
-    full-attention verify would accept drafts the real model would not.
+    ``sliding_window``/``logit_softcap``/``query_scale`` route to the XLA
+    path regardless of ``attention``: the Pallas multi-query kernel has
+    none of that plumbing yet, and a verify that silently dropped a mask
+    or cap would accept drafts the real model would not.
     """
-    if attention.startswith("pallas") and sliding_window is None:
+    if (
+        attention.startswith("pallas")
+        and sliding_window is None
+        and logit_softcap is None
+        and query_scale is None
+    ):
         from dynamo_tpu.ops.pallas import paged_window_attention_decode
 
         return paged_window_attention_decode(
@@ -233,7 +247,8 @@ def window_attention(
         )
     return paged_window_attention(
         q, k_cache, v_cache, block_tables, context_lens,
-        sliding_window=sliding_window,
+        sliding_window=sliding_window, logit_softcap=logit_softcap,
+        query_scale=query_scale,
     )
 
 
