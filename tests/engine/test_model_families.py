@@ -269,3 +269,45 @@ async def test_gemma_speculative_matches_plain_greedy(family_name):
     finally:
         plain.stop()
         spec.stop()
+
+
+@pytest.mark.parametrize("family_name", ["gemma2", "gemma3"])
+async def test_gemma_fused_decode_matches_single_step(family_name):
+    """decode_steps=4 (fused on-device scan) for the gemma families is
+    token-exact vs single-step decode — the per-layer window/rope-select
+    machinery runs inside the outer decode scan."""
+    import jax
+    import jax.numpy as jnp
+
+    fam = get_family(family_name)
+    if family_name == "gemma2":
+        from dynamo_tpu.models.gemma2 import Gemma2Config as Cfg
+    else:
+        from dynamo_tpu.models.gemma3 import Gemma3Config as Cfg
+    cfg = Cfg(**{**Cfg.tiny().__dict__, "dtype": jnp.float32})
+    params = fam.init_params(cfg, jax.random.PRNGKey(4))
+
+    def engine(steps):
+        eng = JaxLlmEngine(
+            EngineConfig(
+                model=cfg, model_family=family_name, num_blocks=64,
+                block_size=4, max_batch_size=2, prefill_buckets=(16,),
+                max_model_len=64, decode_steps=steps,
+            ),
+            params=params,
+        )
+        eng.start()
+        return eng
+
+    prompt = list(range(3, 15))  # 12 tokens > window 8
+    single = engine(1)
+    try:
+        a, _ = await collect(single, request(prompt, max_tokens=14))
+    finally:
+        single.stop()
+    fused = engine(4)
+    try:
+        b, _ = await collect(fused, request(prompt, max_tokens=14))
+    finally:
+        fused.stop()
+    assert a == b, f"{family_name} fused decode diverged: {a} vs {b}"
