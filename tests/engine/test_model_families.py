@@ -18,6 +18,9 @@ def test_registry_families():
     assert get_family("mixtral").name == "mixtral"
     assert get_family("deepseek_v2").name == "deepseek"
     assert get_family("deepseek_v3").name == "deepseek"
+    assert get_family("gemma2").name == "gemma2"
+    assert get_family("gemma3").name == "gemma3"
+    assert get_family("gemma3_text").name == "gemma3"
     with pytest.raises(ValueError, match="unknown model family"):
         get_family("gpt-oss")
     # classic DeepSeek-MoE is conventional attention, not the MLA family
