@@ -16,9 +16,11 @@ one ``lax.scan``) with Gemma-3's changes:
   Gemma-2's logit soft-capping (no attn or final capping).
 
 Multimodal Gemma-3 checkpoints (``model_type: gemma3`` with a nested
-``text_config`` + vision tower) parse their text config; the vision tower
-itself is not implemented for this family and image inputs are rejected
-by the engine (no ``forward_prefill_embeds``).
+``text_config`` + vision tower) parse their text config.  The family
+ships ``forward_prefill_embeds`` (LLaVA-style embedding splicing), so
+the engine's multimodal path can feed it encoder output — the generic
+ViT tower in ``models/vision.py`` works today; Gemma's own SigLIP tower
+weights are not loaded (a checkpoint's vision half is ignored).
 """
 
 from __future__ import annotations
@@ -262,8 +264,29 @@ def gemma3_forward_prefill(
     cos: jnp.ndarray,         # packed dual tables (make_rope_tables)
     sin: jnp.ndarray,
 ) -> tuple[jnp.ndarray, dict]:
-    s = token_ids.shape[0]
-    x = _embed(params, cfg, token_ids)
+    return gemma3_forward_prefill_embeds(
+        params, cfg, _embed(params, cfg, token_ids), kv_cache, block_ids,
+        seq_len, start_pos, cos, sin,
+    )
+
+
+def gemma3_forward_prefill_embeds(
+    params: dict,
+    cfg: Gemma3Config,
+    input_embeds: jnp.ndarray,  # [seq_pad, hidden] — pre-computed (vision
+                                # patches + text embeds via the family's
+                                # embed hook, which applies the sqrt scale)
+    kv_cache: dict,
+    block_ids: jnp.ndarray,
+    seq_len: jnp.ndarray,
+    start_pos: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, dict]:
+    """Prefill from pre-computed input embeddings (LLaVA-style splicing —
+    contract of llama_forward_prefill_embeds)."""
+    s = input_embeds.shape[0]
+    x = input_embeds.astype(cfg.dtype)
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
     eps = cfg.rms_norm_eps
 

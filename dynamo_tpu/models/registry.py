@@ -210,6 +210,7 @@ def _gemma3_family() -> ModelFamily:
         forward_prefill=gemma3.gemma3_forward_prefill,
         forward_decode=gemma3.gemma3_forward_decode,
         forward_prefill_with_prefix=gemma3.gemma3_forward_prefill_with_prefix,
+        forward_prefill_embeds=gemma3.gemma3_forward_prefill_embeds,
         make_rope_tables=gemma3.make_rope_tables,
         embed=gemma3._embed,
         load_weights=gemma3.load_hf_weights,
