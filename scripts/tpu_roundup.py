@@ -31,6 +31,11 @@ Run:  python scripts/tpu_roundup.py [--skip-fleet] [--budget-min 50]
 Every stage writes its artifact even if later stages die; rerunning skips
 nothing (artifacts are cheap to refresh once compiles are cached in
 .jax_cache).
+
+Stage timeouts are generous on purpose: killing a process that holds the
+device mid-compile/mid-execute can WEDGE the tunnel for hours (observed
+round 5 — jax.devices() then hangs for every process).  Prefer waiting
+out a slow stage over killing it.
 """
 
 from __future__ import annotations
@@ -117,22 +122,22 @@ def main() -> int:
     results = {}
     results["kernels"] = run_stage(
         "kernels", [sys.executable, "scripts/tpu_validate.py"],
-        min(600, remaining()),
+        min(900, remaining()),
     )
     results["kernel_perf"] = run_stage(
         "kernel_perf",
         [sys.executable, "scripts/tpu_validate.py", "--bench",
          "--out", "KERNEL_PERF.json"],
-        min(900, remaining()),
+        min(1200, remaining()),
     )
     results["decode_profile"] = run_stage(
         "decode_profile",
         [sys.executable, "scripts/profile_decode.py", "--model", "llama32_1b",
          "--decode-steps", "8", "--out", "PROFILE_DECODE.json"],
-        min(900, remaining()),
+        min(1500, remaining()),
     )
     results["bench"] = run_stage(
-        "bench", [sys.executable, "bench.py"], min(1800, max(60, remaining())),
+        "bench", [sys.executable, "bench.py"], min(2400, max(60, remaining())),
     )
     if remaining() > 300:
         results["disagg_bench"] = run_stage(
