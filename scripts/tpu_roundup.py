@@ -143,14 +143,14 @@ def main() -> int:
         results["disagg_bench"] = run_stage(
             "disagg_bench",
             [sys.executable, "-m", "dynamo_tpu.bench.disagg_bench"],
-            min(1200, remaining()),
+            min(1800, remaining()),
         )
     if not args.skip_fleet and remaining() > 300:
         results["fleet_jax"] = run_stage(
             "fleet_jax",
             [sys.executable, "-m", "dynamo_tpu.bench.routed_fleet",
              "--engine", "jax", "--num-sessions", "16", "--turns", "3"],
-            min(900, remaining()),
+            min(1200, remaining()),
         )
     print("roundup: " + json.dumps(results), flush=True)
     return 0 if all(results.values()) else 1
