@@ -488,6 +488,18 @@ async def test_http_soak_concurrent_chats():
     request must complete with tokens.  Guards the full serving path's
     behavior under burst load (the runtime-level twin lives in
     tests/runtime/test_runtime_e2e.py)."""
+    # This soak runs late in the full suite, after tests/engine/ has
+    # accumulated gigabytes of compiled executables in-process; the
+    # resulting allocator/GC pressure stalls the event loop long enough
+    # for httpx to abandon stream transports mid-flight.  The mocker
+    # worker needs none of that state — drop it before the wave.
+    import gc
+
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
+
     rt = await make_runtime()
     service = watcher = worker = None
     try:
