@@ -498,11 +498,31 @@ def load_hf_weights(cfg: Gemma3Config, model_dir: str | Path, *,
         from dynamo_tpu.models.hf_io import read_safetensors
 
         tensors = read_safetensors(model_dir)
-    if "lm_head.weight" in tensors:
-        raise ValueError(
-            "gemma3 checkpoint ships lm_head.weight (untied unembedding); "
-            "this family implements the tied projection only"
-        )
+    # untied-unembedding guard BEFORE any remap filters tensors away: a
+    # trained lm_head silently mis-projected through the tied embedding
+    # would corrupt every logit with no diagnostic (all spellings: plain
+    # text checkpoint, multimodal legacy, multimodal state_dict naming)
+    for head in ("lm_head.weight", "language_model.lm_head.weight",
+                 "model.language_model.lm_head.weight"):
+        if head in tensors:
+            raise ValueError(
+                f"gemma3 checkpoint ships {head} (untied unembedding); "
+                "this family implements the tied projection only"
+            )
+    if "model.embed_tokens.weight" not in tensors:
+        # multimodal checkpoint (Gemma3ForConditionalGeneration): the text
+        # half lives under a language_model prefix — serialized as
+        # language_model.model.* (save_pretrained legacy mapping) or
+        # model.language_model.* (state_dict naming).  Remap to the text
+        # layout and drop the vision tower (not loaded by this family).
+        for prefix in ("language_model.model.", "model.language_model."):
+            if prefix + "embed_tokens.weight" in tensors:
+                tensors = {
+                    "model." + name[len(prefix):]: t
+                    for name, t in tensors.items()
+                    if name.startswith(prefix)
+                }
+                break
 
     def get(name: str, transpose: bool = False):
         t = tensors[name]

@@ -804,3 +804,59 @@ def test_gemma3_windowed_decode_matches_hf(tmp_path):
             np.asarray(logits)[0], hf_all[p], atol=3e-4, rtol=3e-4,
             err_msg=f"gemma3 windowed decode position {p}",
         )
+
+
+@pytest.mark.slow
+def test_gemma3_multimodal_checkpoint_text_half(tmp_path):
+    """A multimodal Gemma-3 checkpoint (Gemma3ForConditionalGeneration:
+    nested text_config, weights under model.language_model.*) loads its
+    text half through the same family — config unwrap + tensor remap —
+    and matches the HF text model's logits."""
+    text_cfg = dict(
+        vocab_size=320, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=7, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        rope_theta=1_000_000.0, rope_local_base_freq=10000.0,
+        sliding_window=8, query_pre_attn_scalar=16.0,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    config = transformers.Gemma3Config(
+        text_config=text_cfg,
+        vision_config={
+            "hidden_size": 32, "intermediate_size": 64,
+            "num_hidden_layers": 1, "num_attention_heads": 2,
+            "image_size": 28, "patch_size": 14,
+        },
+        torch_dtype="float32",
+    )
+    torch.manual_seed(15)
+    model = transformers.Gemma3ForConditionalGeneration(config).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    from dynamo_tpu.models import gemma3
+    from dynamo_tpu.models.registry import get_family
+
+    fam = get_family("gemma3")
+    cfg = fam.config_from_hf(f"{tmp_path}/config.json")  # unwraps text_config
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": jnp.float32})
+    assert cfg.num_layers == 7 and cfg.sliding_window == 8
+    params = fam.load_weights(cfg, tmp_path)  # remaps language_model.*
+    cos, sin = fam.rope_tables(cfg)
+    cache = fam.cache_init(cfg, 16, 4)
+    blocks = jnp.arange(8, dtype=jnp.int32)
+
+    prompt = [3, 17, 99, 250, 7, 42]
+    logits, _ = gemma3.gemma3_forward_prefill(
+        params, cfg, jnp.asarray(prompt, jnp.int32), cache, blocks,
+        jnp.int32(len(prompt)), jnp.int32(0), cos, sin,
+    )
+    with torch.no_grad():
+        hf = model.language_model(
+            torch.tensor([prompt], dtype=torch.long)
+        ).last_hidden_state
+        hf_logits = (
+            hf @ model.model.language_model.embed_tokens.weight.T
+        )[0, -1].float().numpy()
+    np.testing.assert_allclose(
+        np.asarray(logits), hf_logits, atol=3e-4, rtol=3e-4
+    )
