@@ -46,6 +46,22 @@ class MetricsService:
         self.waiting = Gauge(
             f"{PREFIX}_requests_waiting", "Queued requests", ["worker"], registry=self.registry
         )
+        # engine step telemetry (emitted every scheduler iteration by the
+        # engine's device loop; observability.step_metrics)
+        self.running = Gauge(
+            f"{PREFIX}_requests_running", "Running (decoding) requests",
+            ["worker"], registry=self.registry,
+        )
+        self.batch_occupancy = Gauge(
+            f"{PREFIX}_batch_occupancy_perc",
+            "Decode-lane occupancy of the latest engine step (running/slots)",
+            ["worker"], registry=self.registry,
+        )
+        self.preemptions = Gauge(
+            f"{PREFIX}_preemptions",
+            "Sequences preempted for KV pressure (cumulative)",
+            ["worker"], registry=self.registry,
+        )
         # mirrored remote counters need .set(), so they are gauges —
         # named WITHOUT the counter-reserved _total suffix
         self.prefix_hits = Gauge(
@@ -64,6 +80,7 @@ class MetricsService:
         )
         self._worker_gauges = (
             self.kv_active, self.kv_total, self.cache_usage, self.waiting,
+            self.running, self.batch_occupancy, self.preemptions,
             self.prefix_hits, self.prefix_cached_tokens, self.spec_accepted,
         )
         self._seen_workers: set[str] = set()
@@ -130,6 +147,9 @@ class MetricsService:
             self.kv_total.labels(label).set(m.kv_total_blocks)
             self.cache_usage.labels(label).set(m.gpu_cache_usage_perc)
             self.waiting.labels(label).set(m.num_requests_waiting)
+            self.running.labels(label).set(m.num_requests_running)
+            self.batch_occupancy.labels(label).set(m.batch_occupancy_perc)
+            self.preemptions.labels(label).set(m.num_preemptions_total)
             self.prefix_hits.labels(label).set(m.prefix_hits_total)
             self.prefix_cached_tokens.labels(label).set(m.prefix_cached_tokens_total)
             self.spec_accepted.labels(label).set(m.spec_accepted_tokens_total)

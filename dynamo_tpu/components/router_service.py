@@ -15,6 +15,7 @@ import argparse
 import asyncio
 
 from dynamo_tpu.llm.kv_router.router import KvRouter
+from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.runtime.client import Client
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context, ResponseStream
@@ -34,9 +35,20 @@ class RouterEngine:
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         token_ids = request.data.get("token_ids", [])
-        worker_id, matched = await self.kv_router.schedule(
-            token_ids, self.client.instance_ids
+        span = get_recorder().start(
+            "router.schedule", getattr(request.ctx, "trace", None),
+            component="router_service",
         )
+        try:
+            worker_id, matched = await self.kv_router.schedule(
+                token_ids, self.client.instance_ids
+            )
+        except BaseException as exc:
+            if span is not None:
+                span.end(status="error", error=repr(exc))
+            raise
+        if span is not None:
+            span.end(worker=f"{worker_id:x}", overlap_blocks=matched)
 
         async def gen():
             yield {"worker_id": worker_id, "overlap_blocks": matched}

@@ -45,6 +45,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
+from dynamo_tpu.observability import StepTelemetry, get_recorder
 from dynamo_tpu.ops.sampling import (
     apply_logit_bias,
     apply_penalties,
@@ -527,6 +528,16 @@ class JaxLlmEngine:
         # readback must be separable from device compute to tune anything.
         self._phase_timing = os.environ.get("DYN_ENGINE_PHASE_TIMING") == "1"
         self.phase_stats: dict[str, list[float]] = {}
+        # Step telemetry: batch occupancy / queue depth / KV pool usage per
+        # scheduler iteration, merged into stats() → load-metrics publisher
+        # → dyn_worker_* Prometheus gauges (observability.step_metrics).
+        self.step_telemetry = StepTelemetry(config.max_batch_size)
+        # DYN_XPROF_ANNOTATE=1: wrap hot steps in jax.profiler
+        # TraceAnnotation so host-side spans line up with xprof device
+        # traces (adds a TraceMe per step — keep off unless profiling)
+        self._xprof_annotate = os.environ.get("DYN_XPROF_ANNOTATE") == "1"
+        # DYN_PROFILER_TRACE_DIR: set when start() opened a device trace
+        self._profiler_trace_dir: str | None = None
         # Sampling-tail upload cache: the per-window device copies of the
         # (lane_keys, temp, top_k, ...) arrays are reused while their host
         # values are unchanged — at steady-state decode the batch
@@ -603,6 +614,7 @@ class JaxLlmEngine:
             prefill_chunk_tokens=self.chunk_tokens,
             bucket_cost=self._bucket_len,
         )
+        self.scheduler.on_preempt = self._on_preempt
         self._event_sink = event_sink
         self._iterations = 0
 
@@ -1111,6 +1123,11 @@ class JaxLlmEngine:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # DYN_PROFILER_TRACE_DIR: capture a device trace of the whole serve
+        # window (stopped in stop() by whichever engine started it)
+        from dynamo_tpu.utils import profiling
+
+        self._profiler_trace_dir = profiling.maybe_start_trace_from_env()
         self._stop = False
         self._thread = threading.Thread(target=self._device_loop, name="jax-engine", daemon=True)
         self._thread.start()
@@ -1121,6 +1138,11 @@ class JaxLlmEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self._profiler_trace_dir is not None:
+            from dynamo_tpu.utils import profiling
+
+            profiling.maybe_stop_trace()
+            self._profiler_trace_dir = None
         if self.host_tier is not None:
             self.host_tier.close()  # release + delete the G3 memmap
 
@@ -1142,6 +1164,7 @@ class JaxLlmEngine:
                 f"prompt length {len(pre.token_ids)} exceeds engine max length {self.max_len}"
             )
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
+        seq.trace = getattr(ctx, "trace", None)
         if pre.output_format is not None:
             seq.guided = self._make_guided_cursor(pre.output_format)
         return self._start_sequence(seq, ctx)
@@ -1242,6 +1265,7 @@ class JaxLlmEngine:
                 f"exceeds engine max length {self.max_len}"
             )
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, mm_embeds=embeds)
+        seq.trace = getattr(ctx, "trace", None)
         if pre.output_format is not None:
             # same contract as generate(): a guided multimodal request on a
             # deployment that cannot constrain it must fail loudly (the mm
@@ -1332,6 +1356,7 @@ class JaxLlmEngine:
         loop = asyncio.get_running_loop()
         out_q: asyncio.Queue = asyncio.Queue()
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre, remote_prefilled=True)
+        seq.trace = getattr(ctx, "trace", None)
         if pre.output_format is not None:
             # disagg split: the remote prefill worker sampled first_token —
             # advance a fresh cursor over it.  A guided-enabled prefill
@@ -1685,6 +1710,8 @@ class JaxLlmEngine:
             "spec_accepted_tokens_total": self._spec_accepted,
             "guided_requests_total": self._guided_requests,
             "guided_completions_total": self._guided_completions,
+            "num_preemptions_total": self.scheduler.preemptions_total,
+            **self.step_telemetry.stats(),
         }
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
@@ -1714,39 +1741,55 @@ class JaxLlmEngine:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
+                t_step = time.perf_counter()
                 decision = self.scheduler.schedule()
                 for seq in decision.prefills:
+                    self._maybe_record_queue_span(seq)
+                    t_prefill = time.time()
                     try:
-                        try:
-                            self._run_prefill(seq)
-                        except Exception as exc:  # noqa: BLE001
-                            if not self._attention_fallback(exc):
-                                raise
-                            self._run_prefill(seq)
+                        with self._xprof_span("dyn.prefill"):
+                            try:
+                                self._run_prefill(seq)
+                            except Exception as exc:  # noqa: BLE001
+                                if not self._attention_fallback(exc):
+                                    raise
+                                self._run_prefill(seq)
                     except Exception as exc:  # noqa: BLE001 — fail THIS
                         # sequence (free blocks, resolve its caller) and
                         # keep serving; retrying would hot-spin on
                         # deterministic failures and skipping the rest of
                         # the batch would leave restore plans unexecuted
                         logger.exception("prefill failed for %s", seq.seq_id)
+                        self._record_prefill_span(seq, t_prefill, status="error")
                         self._fail_sequence(seq, exc)
+                    else:
+                        self._record_prefill_span(seq, t_prefill)
                 decodes = [
                     s for s in self.scheduler.running if s.status == SeqStatus.RUNNING
                 ]
                 if decodes:
                     try:
-                        try:
-                            self._run_decode(decodes)
-                        except Exception as exc:  # noqa: BLE001
-                            if not self._attention_fallback(exc):
-                                raise
-                            self._run_decode(decodes)
+                        with self._xprof_span("dyn.decode"):
+                            try:
+                                self._run_decode(decodes)
+                            except Exception as exc:  # noqa: BLE001
+                                if not self._attention_fallback(exc):
+                                    raise
+                                self._run_decode(decodes)
                     except Exception as exc:  # noqa: BLE001
                         logger.exception("decode step failed")
                         for seq in decodes:
                             if seq.status == SeqStatus.RUNNING:
                                 self._fail_sequence(seq, exc)
                 self._iterations += 1
+                self.step_telemetry.observe_step(
+                    iteration=self._iterations,
+                    num_running=self.scheduler.num_running,
+                    num_waiting=self.scheduler.num_waiting,
+                    kv_active_blocks=self.allocator.used_blocks,
+                    kv_total_blocks=self.allocator.num_blocks,
+                    step_duration_s=time.perf_counter() - t_step,
+                )
             except Exception:  # noqa: BLE001 — scheduler-level bug: keep the
                 # thread alive (callers would hang forever), don't hot-spin
                 logger.exception("engine step failed")
@@ -1796,9 +1839,82 @@ class JaxLlmEngine:
             self._jit_verify = self._build_verify()
         return True
 
+    def _xprof_span(self, name: str):
+        """jax.profiler.TraceAnnotation around a hot step when
+        DYN_XPROF_ANNOTATE=1, so host spans line up with xprof device
+        traces; a nullcontext otherwise."""
+        if not self._xprof_annotate:
+            return contextlib.nullcontext()
+        return jax.profiler.TraceAnnotation(name)
+
+    def _record_prefill_span(self, seq: Sequence, start_ts: float,
+                             status: str = "ok") -> None:
+        """One span per prefill window (chunked prefills show every chunk).
+        The window that produced the first token carries the engine-side
+        TTFT (arrival → first sample)."""
+        if seq.trace is None:
+            return
+        # intermediate chunks leave the sequence PREFILLING; the final
+        # window flips it to RUNNING (or FINISHED for prefill_only)
+        final = seq.status is not SeqStatus.PREFILLING
+        attrs = {
+            "prefilled_tokens": seq.prefilled_tokens,
+            "cached_tokens": seq.cached_tokens,
+        }
+        # a preemption-recompute prefill is not a first-token event: TTFT
+        # attaches exactly once per request, on the window that sampled the
+        # first token
+        if final and status == "ok" and not seq.ttft_recorded:
+            seq.ttft_recorded = True
+            attrs["ttft_s"] = max(0.0, time.time() - seq.arrival_ts)
+        get_recorder().record(
+            "engine.prefill", seq.trace, start_ts, time.time(),
+            component="engine", status=status, attrs=attrs,
+        )
+
+    def _on_preempt(self, seq: Sequence) -> None:
+        """Scheduler preemption hook: close the victim's decode span (the
+        wait + recompute after preemption must not be billed as decode
+        time) and re-arm the queue span so the re-admission wait records as
+        a second engine.queue span starting at the preemption instant.
+        ``arrival_ts`` is untouched — TTFT always measures from request
+        arrival, even when the first token lands after a preemption."""
+        self._record_decode_span(seq, status="preempted")
+        if seq.trace is not None:
+            seq.queue_span_recorded = False
+            seq.queue_start_ts = time.time()
+
+    def _maybe_record_queue_span(self, seq: Sequence) -> None:
+        """One engine.queue span per admission: submission (or preemption
+        re-queue) → first time the scheduler put the sequence on device.
+        Called at prefill scheduling AND at decode start — the latter
+        covers remote-prefilled sequences, which the scheduler admits
+        straight to RUNNING without a local prefill pass."""
+        if seq.trace is None or seq.queue_span_recorded:
+            return
+        seq.queue_span_recorded = True
+        get_recorder().record(
+            "engine.queue", seq.trace, seq.queue_start_ts or seq.arrival_ts,
+            time.time(), component="engine",
+            attrs={"prompt_tokens": seq.prompt_len,
+                   "cached_tokens": seq.cached_tokens},
+        )
+
+    def _record_decode_span(self, seq: Sequence, status: str = "ok") -> None:
+        """Close the sequence's decode span (first decode step → finish)."""
+        if seq.trace is None or seq.decode_start_ts == 0.0:
+            return
+        get_recorder().record(
+            "engine.decode", seq.trace, seq.decode_start_ts, time.time(),
+            component="engine", status=status,
+            attrs={"tokens_out": len(seq.output_ids)},
+        )
+        seq.decode_start_ts = 0.0
+
     def _fail_sequence(self, seq: Sequence, exc: BaseException) -> None:
         """Terminate one sequence on an engine-side error: free its
         resources and resolve its caller with the failure."""
+        self._record_decode_span(seq, status="error")
         self.scheduler.finish(seq)
         if seq.on_prefill_done:
             seq.on_prefill_done(exc)
@@ -1815,6 +1931,7 @@ class JaxLlmEngine:
                 self.scheduler.add(seq)
             elif op == "abort":
                 if seq.status != SeqStatus.FINISHED:
+                    self._record_decode_span(seq, status="cancelled")
                     self.scheduler.abort(seq)
                     seq.status = SeqStatus.FINISHED
                     if seq.emit:
@@ -2321,6 +2438,10 @@ class JaxLlmEngine:
             if not seq.sampling_seeded:
                 # remotely-prefilled: entered decode without a local prefill
                 self._seed_lane_state(seq)
+            if seq.decode_start_ts == 0.0:
+                # covers remote-prefilled admission (no prefill pass)
+                self._maybe_record_queue_span(seq)
+                seq.decode_start_ts = time.time()
             lane = seq.lane
             token_ids[lane] = seq.all_token_ids[-1]
             blocks = self.allocator.block_ids(seq.seq_id)
@@ -2447,6 +2568,9 @@ class JaxLlmEngine:
         for seq in active:
             if not seq.sampling_seeded:
                 self._seed_lane_state(seq)
+            if seq.decode_start_ts == 0.0:
+                self._maybe_record_queue_span(seq)
+                seq.decode_start_ts = time.time()
             lane = seq.lane
             all_tokens = seq.all_token_ids
             draft = drafts.get(seq.seq_id) or []
@@ -2528,6 +2652,7 @@ class JaxLlmEngine:
                 top_logprobs=top_rows,
             )
         if finish is not None:
+            self._record_decode_span(seq)
             self.scheduler.finish(seq)
         elif seq.context_len % self.config.block_size == 0 and seq.mm_embeds is None:
             # (multimodal blocks never publish: text-token hashes cannot

@@ -55,6 +55,13 @@ class Scheduler:
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
         self._free_lanes = list(range(max_batch_size - 1, -1, -1))
+        # step telemetry: cumulative preemption count (KV-pressure evidence
+        # exported as dyn_worker_preemptions via the metrics service)
+        self.preemptions_total = 0
+        # optional hook fired on every preemption (the engine closes the
+        # victim's tracing spans here; the scheduler itself stays
+        # observability-agnostic)
+        self.on_preempt = None
 
     # -- queue ops ---------------------------------------------------------
     def add(self, seq: Sequence) -> None:
@@ -213,6 +220,9 @@ class Scheduler:
 
     def preempt(self, seq: Sequence) -> None:
         logger.warning("preempting sequence %s (recompute)", seq.seq_id)
+        self.preemptions_total += 1
+        if self.on_preempt is not None:
+            self.on_preempt(seq)
         self._release(seq)
         seq.status = SeqStatus.PREEMPTED
         # remotely-prefilled KV is gone once blocks are freed: recompute locally

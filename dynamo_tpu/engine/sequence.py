@@ -22,6 +22,9 @@ class Sequence:
     seq_id: str
     request: PreprocessedRequest
     arrival_time: float = field(default_factory=time.monotonic)
+    # epoch twin of arrival_time: span timestamps are wall-clock so traces
+    # from different processes line up on one timeline
+    arrival_ts: float = field(default_factory=time.time)
     status: SeqStatus = SeqStatus.WAITING
     output_ids: list[int] = field(default_factory=list)
     lane: int = -1            # decode batch lane while RUNNING
@@ -48,6 +51,16 @@ class Sequence:
     # end of the prefill window the scheduler planned for this step
     # (0 = whole prompt)
     chunk_target: int = 0
+    # tracing: the request's propagated TraceContext (observability.trace);
+    # engine spans (queue/prefill/decode) parent to it.  None = untraced.
+    trace: object = None
+    queue_span_recorded: bool = False
+    ttft_recorded: bool = False   # first-token latency attached to a span
+    # wall-clock start of the CURRENT queue wait (0.0 = arrival_ts; reset
+    # to the preemption instant on re-queue so the second engine.queue span
+    # measures only the re-admission wait, while TTFT keeps arrival_ts)
+    queue_start_ts: float = 0.0
+    decode_start_ts: float = 0.0  # wall-clock start of this seq's decode span
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only

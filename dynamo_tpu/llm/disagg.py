@@ -26,6 +26,8 @@ from dataclasses import dataclass
 
 from dynamo_tpu.engine.engine import JaxLlmEngine
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.observability import get_recorder
+from dynamo_tpu.observability.trace import read_trace, stamp_trace
 from dynamo_tpu.parallel.kv_transfer import (
     KvTransferClient,
     KvTransferPayload,
@@ -42,6 +44,14 @@ logger = get_logger("llm.disagg")
 
 def disagg_config_key(model: str) -> str:
     return f"{ROOT_PATH}public/components/disagg_router/models/chat/{model}"
+
+
+def _payload_bytes(blocks) -> int:
+    """Total bytes of a KV transfer payload's cache pytree (host or device
+    arrays both expose nbytes)."""
+    import jax
+
+    return int(sum(getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(blocks)))
 
 
 @dataclass
@@ -149,14 +159,14 @@ class DisaggDecodeEngine:
         self.engine = engine
         self.router = router
         self.queue = queue
-        # seq_id -> (future, reserved landing blocks).  Ownership protocol
+        # seq_id -> (future, reserved landing blocks, trace).  Ownership protocol
         # (all transitions are atomic dict pops on the one event loop):
         # whoever pops the entry owns the blocks' fate — the requester
         # releases on timeout, the transfer path injects and then releases
         # iff the requester's wait was already cancelled.  This is what
         # keeps a LATE transfer from scattering stale KV into blocks that
         # were released and re-allocated to a live sequence.
-        self._pending: dict[str, tuple[asyncio.Future, list[int]]] = {}
+        self._pending: dict[str, tuple[asyncio.Future, list[int], object]] = {}
         self.prefill_timeout_s = float(
             os.environ.get("DYN_DISAGG_PREFILL_TIMEOUT_S", "300")
         )
@@ -165,6 +175,10 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_prefill_timeouts = 0
+        # KV-transfer observability (cumulative; per-request latency/bytes
+        # also land on each trace's kv.transfer span)
+        self.kv_transfer_bytes_total = 0
+        self.kv_transfer_seconds_total = 0.0
 
     async def start(self) -> None:
         await self.transfer_server.start()
@@ -182,15 +196,27 @@ class DisaggDecodeEngine:
                 payload.seq_id,
             )
             return
-        fut, block_ids = entry
+        fut, block_ids, trace = entry
+        nbytes = _payload_bytes(payload.blocks)
+        span = get_recorder().start(
+            "kv.transfer", trace, component="decode_worker",
+            attrs={"bytes": nbytes, "blocks": len(payload.block_ids)},
+        )
+        t0 = time.monotonic()
         try:
             await self.engine.inject_blocks(payload.block_ids, payload.blocks)
         except Exception as exc:  # noqa: BLE001
+            if span is not None:
+                span.end(status="error", error=repr(exc))
             if fut.cancelled():
                 self.engine.release_blocks(block_ids)
             elif not fut.done():
                 fut.set_exception(exc)  # requester releases (generate())
             return
+        self.kv_transfer_bytes_total += nbytes
+        self.kv_transfer_seconds_total += time.monotonic() - t0
+        if span is not None:
+            span.end()
         if fut.cancelled():
             # requester's wait timed out between our pop and the inject
             # finishing; the blocks were still reserved (we owned them), so
@@ -221,11 +247,14 @@ class DisaggDecodeEngine:
 
         self.remote_prefills += 1
         seq_id = request.ctx.id or uuid.uuid4().hex
+        trace = getattr(request.ctx, "trace", None)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[seq_id] = (fut, block_ids)
+        self._pending[seq_id] = (fut, block_ids, trace)
         n_kv_blocks = self.engine.allocator.blocks_needed(len(pre.token_ids))
+        # trace context rides the queue item (stamp_trace below) so the
+        # prefill worker's span joins the same request tree
         await self.queue.enqueue(
-            {
+            stamp_trace({
                 "seq_id": seq_id,
                 "request": request.data,
                 "dst_block_ids": block_ids[:n_kv_blocks],
@@ -240,7 +269,7 @@ class DisaggDecodeEngine:
                 # applied with a skew margin.
                 "ttl_s": self.prefill_timeout_s,
                 "deadline_ts": time.time() + self.prefill_timeout_s,
-            }
+            }, trace)
         )
         try:
             first_token, first_lp, first_top = await asyncio.wait_for(
@@ -283,6 +312,8 @@ class DisaggDecodeEngine:
         stats["remote_prefills"] = self.remote_prefills
         stats["local_prefills"] = self.local_prefills
         stats["remote_prefill_timeouts"] = self.remote_prefill_timeouts
+        stats["kv_transfer_bytes_total"] = self.kv_transfer_bytes_total
+        stats["kv_transfer_seconds_total"] = self.kv_transfer_seconds_total
         return stats
 
 
@@ -371,22 +402,34 @@ class PrefillWorker:
             )
             return
         pre = PreprocessedRequest.from_wire(item["request"])
+        trace = read_trace(item)
+        span = get_recorder().start(
+            "prefill_worker.handle", trace, component="prefill_worker",
+            attrs={"prompt_tokens": len(pre.token_ids)},
+        )
         # strategy selection by destination locality (reference:
         # block/transfer/strategy.rs:345): same-process destinations keep
         # blocks on device (ICI-class copy), remote ones stage to host
         local = item["transfer_address"] in LOCAL_SERVERS
-        first_token, first_lp, first_top, blocks, n = await self.engine.prefill_extract(
-            pre, device=local
-        )
-        await self.client.send(
-            item["transfer_address"],
-            KvTransferPayload(
-                seq_id=item["seq_id"],
-                first_token=first_token,
-                first_token_logprob=first_lp,
-                first_token_top_logprobs=first_top,
-                block_ids=item["dst_block_ids"][:n],
-                blocks=blocks,
-            ),
-        )
+        try:
+            first_token, first_lp, first_top, blocks, n = await self.engine.prefill_extract(
+                pre, device=local
+            )
+            await self.client.send(
+                item["transfer_address"],
+                KvTransferPayload(
+                    seq_id=item["seq_id"],
+                    first_token=first_token,
+                    first_token_logprob=first_lp,
+                    first_token_top_logprobs=first_top,
+                    block_ids=item["dst_block_ids"][:n],
+                    blocks=blocks,
+                ),
+            )
+        except BaseException as exc:
+            if span is not None:
+                span.end(status="error", error=repr(exc))
+            raise
+        if span is not None:
+            span.end(bytes=_payload_bytes(blocks), blocks=n)
         self.prefills_done += 1  # actual prefills only, not dropped items

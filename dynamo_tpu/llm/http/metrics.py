@@ -88,6 +88,10 @@ class InflightGuard:
         self.status = "error"
         self._start = time.monotonic()
         self._last_token: float | None = None
+        # per-request lifecycle facts, readable after done() (span summary
+        # + structured request log)
+        self.ttft_s: float | None = None
+        self.token_count = 0
         metrics.inflight.labels(model, endpoint).inc()
 
     def mark_ok(self) -> None:
@@ -96,10 +100,16 @@ class InflightGuard:
     def token_observed(self) -> None:
         now = time.monotonic()
         if self._last_token is None:
-            self.metrics.time_to_first_token.labels(self.model).observe(now - self._start)
+            self.ttft_s = now - self._start
+            self.metrics.time_to_first_token.labels(self.model).observe(self.ttft_s)
         else:
             self.metrics.inter_token_latency.labels(self.model).observe(now - self._last_token)
         self._last_token = now
+        self.token_count += 1
+
+    @property
+    def duration_s(self) -> float:
+        return time.monotonic() - self._start
 
     def done(self) -> None:
         self.metrics.inflight.labels(self.model, self.endpoint).dec()

@@ -16,11 +16,14 @@ from __future__ import annotations
 
 import asyncio
 import json
+import uuid
 from typing import Any
 
 from aiohttp import web
 
 from dynamo_tpu.llm.http.metrics import FrontendMetrics
+from dynamo_tpu.observability import get_recorder
+from dynamo_tpu.observability.trace import sanitize_request_id
 from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.protocols.aggregator import (
     aggregate_chat_stream,
@@ -34,9 +37,11 @@ from dynamo_tpu.llm.protocols.openai import (
     ModelList,
 )
 from dynamo_tpu.runtime.engine import Context
-from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.logging import get_logger, log_fields
 
 logger = get_logger("llm.http")
+
+REQUEST_ID_HEADER = "x-request-id"
 
 
 class ModelManager:
@@ -119,7 +124,10 @@ class HttpService:
         # async () -> list[str]: broadcast a cache flush to every backing
         # worker component (reference: lib/llm/src/http/service/clear_kv_blocks.rs)
         self.clear_kv = clear_kv
-        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app = web.Application(
+            client_max_size=64 * 1024 * 1024,
+            middlewares=[self._request_id_middleware],
+        )
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
         self.app.router.add_post("/v1/embeddings", self.handle_embeddings)
@@ -145,6 +153,58 @@ class HttpService:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    # -- request identity / tracing ---------------------------------------
+    @web.middleware
+    async def _request_id_middleware(self, request: web.Request, handler):
+        """Assign every request an id (honoring an incoming ``x-request-id``)
+        and echo it on the response — including error responses.  Streaming
+        responses prepare inside their handler, so ``_stream_sse`` sets the
+        header itself before ``prepare()``."""
+        rid = sanitize_request_id(request.headers.get(REQUEST_ID_HEADER))
+        request["request_id"] = rid or uuid.uuid4().hex
+        try:
+            response = await handler(request)
+        except web.HTTPException as exc:
+            exc.headers.setdefault(REQUEST_ID_HEADER, request["request_id"])
+            raise
+        if not response.prepared:
+            response.headers.setdefault(REQUEST_ID_HEADER, request["request_id"])
+        return response
+
+    def _trace_root(self, request: web.Request, endpoint: str, model: str):
+        """Root span of the request's trace tree; the request id IS the
+        trace id, so a client-supplied ``x-request-id`` correlates client
+        logs, server logs, and the exported span tree."""
+        return get_recorder().start(
+            "http.request", None, component="frontend",
+            root_trace_id=request["request_id"],
+            attrs={"endpoint": endpoint, "model": model},
+        )
+
+    def _finish_request(self, request: web.Request, root, guard) -> None:
+        """Close the root span with the lifecycle facts the guard gathered
+        and emit one structured per-request log record."""
+        if root is not None:
+            root.end(
+                status=guard.status,
+                ttft_s=guard.ttft_s,
+                tokens_out=guard.token_count,
+            )
+        logger.info(
+            "%s %s -> %s",
+            guard.endpoint, guard.model, guard.status,
+            extra=log_fields(
+                request_id=request["request_id"],
+                model=guard.model,
+                endpoint=guard.endpoint,
+                request_type=guard.request_type,
+                status=guard.status,
+                duration_s=round(guard.duration_s, 6),
+                ttft_s=None if guard.ttft_s is None else round(guard.ttft_s, 6),
+                tokens_out=guard.token_count,
+            ),
+        )
 
     # -- handlers ----------------------------------------------------------
     async def handle_health(self, request: web.Request) -> web.Response:
@@ -206,13 +266,14 @@ class HttpService:
             )
 
         guard = self.metrics.guard(chat_request.model, "chat_completions", "stream" if chat_request.stream else "unary")
+        root = self._trace_root(request, "chat_completions", chat_request.model)
         if not chat_request.stream:
             # non-streaming responses always carry usage (OpenAI semantics)
             chat_request.stream_options = {**(chat_request.stream_options or {}), "include_usage": True}
         ctx = None
         try:
             try:
-                stream, ctx = await _start_generation(engine, chat_request)
+                stream, ctx = await _start_generation(engine, chat_request, root)
             except ValueError as exc:
                 return _error(400, str(exc))
             if chat_request.stream:
@@ -231,6 +292,7 @@ class HttpService:
             return _error(500, repr(exc), "internal_error")
         finally:
             guard.done()
+            self._finish_request(request, root, guard)
 
     async def handle_completions(self, request: web.Request) -> web.StreamResponse:
         try:
@@ -265,12 +327,13 @@ class HttpService:
         guard = self.metrics.guard(
             completion_request.model, "completions", "stream" if completion_request.stream else "unary"
         )
+        root = self._trace_root(request, "completions", completion_request.model)
         if not completion_request.stream:
             completion_request.stream_options = {**(completion_request.stream_options or {}), "include_usage": True}
         ctx = None
         try:
             try:
-                stream, ctx = await _start_generation(engine, completion_request)
+                stream, ctx = await _start_generation(engine, completion_request, root)
             except ValueError as exc:
                 return _error(400, str(exc))
             if completion_request.stream:
@@ -292,6 +355,7 @@ class HttpService:
             return _error(500, repr(exc), "internal_error")
         finally:
             guard.done()
+            self._finish_request(request, root, guard)
 
     async def handle_embeddings(self, request: web.Request) -> web.Response:
         try:
@@ -309,6 +373,7 @@ class HttpService:
                 param="model", code="model_not_found",
             )
         guard = self.metrics.guard(embedding_request.model, "embeddings", "unary")
+        root = self._trace_root(request, "embeddings", embedding_request.model)
         try:
             try:
                 response = await engine.embed(embedding_request)
@@ -321,6 +386,7 @@ class HttpService:
             return _error(500, repr(exc), "internal_error")
         finally:
             guard.done()
+            self._finish_request(request, root, guard)
 
     # -- streaming ---------------------------------------------------------
     async def _stream_sse(self, request, stream, ctx, guard, model: str) -> web.StreamResponse:
@@ -329,6 +395,9 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                # echoed here (not in the middleware): an SSE response is
+                # already prepared by the time the middleware sees it
+                REQUEST_ID_HEADER: request["request_id"],
             }
         )
         await response.prepare(request)
@@ -340,8 +409,12 @@ class HttpService:
                         sse.encode_event(event=ann.event, comments=ann.comment).encode()
                     )
                     continue
-                guard.token_observed()
-                completion_tokens += 1
+                # usage-only final chunks (include_usage) carry no choices:
+                # counting them would inflate ITL samples and the output-
+                # token histogram by one per stream
+                if getattr(ann.data, "choices", None):
+                    guard.token_observed()
+                    completion_tokens += 1
                 # pydantic-core's Rust serializer: ~3x faster than
                 # model_dump() + json.dumps() (measured 4us vs 12us per
                 # chunk), and this runs once per streamed chunk, squarely
@@ -378,34 +451,40 @@ class HttpService:
 
 
 def _data_only(stream, guard):
-    """Strip annotations; count tokens for metrics."""
+    """Strip annotations; count tokens for metrics (usage-only chunks have
+    no choices and pass through uncounted)."""
 
     async def gen():
         async for ann in stream:
             if ann.is_annotation() or ann.data is None:
                 continue
-            guard.token_observed()
+            if getattr(ann.data, "choices", None):
+                guard.token_observed()
             yield ann.data
 
     return gen()
 
 
-async def _start_generation(engine, request_model):
+async def _start_generation(engine, request_model, root=None):
     """One dispatch for both OpenAI endpoints: validates ``n``, fans out
-    when n>1, else a plain single-choice generate.  Returns (stream, ctx);
-    raises ValueError for 400-class problems."""
+    when n>1, else a plain single-choice generate.  ``root`` is the
+    request's root span handle; its context rides the EngineContext into
+    every downstream layer.  Returns (stream, ctx); raises ValueError for
+    400-class problems."""
     n = request_model.n if request_model.n is not None else 1
     if n < 1:
         raise ValueError("n must be >= 1")
     if n > 16:
         raise ValueError("n must be <= 16")
+    trace_ctx = root.ctx if root is not None else None
     if n > 1:
-        return await _generate_fanout(engine, request_model, n)
+        return await _generate_fanout(engine, request_model, n, trace_ctx)
     ctx = Context(request_model)
+    ctx.ctx.trace = trace_ctx
     return await engine.generate(ctx), ctx
 
 
-async def _generate_fanout(engine, request_model, n: int):
+async def _generate_fanout(engine, request_model, n: int, trace_ctx=None):
     """OpenAI ``n>1``: issue n independent single-choice requests (seeded
     requests get seed+i per choice, like vLLM) and merge the streams with
     choice indices rewritten; per-choice usage chunks are summed into one.
@@ -419,8 +498,12 @@ async def _generate_fanout(engine, request_model, n: int):
             sub.seed = sub.seed + i
         subs.append(sub)
     parent = Context(request_model)
+    parent.ctx.trace = trace_ctx
     ctxs = [Context(sub) for sub in subs]
     for c in ctxs:
+        # all sub-requests parent to the one root span: the trace tree shows
+        # n parallel dispatch/worker/engine branches under one http.request
+        c.ctx.trace = trace_ctx
         parent.ctx.link_child(c.ctx)
     streams = []
     try:

@@ -57,6 +57,10 @@ class ForwardPassMetrics:
     prefix_hits_total: int = 0
     prefix_cached_tokens_total: int = 0
     spec_accepted_tokens_total: int = 0
+    # step telemetry (observability.step_metrics): decode-lane occupancy of
+    # the latest step and cumulative preemption count
+    batch_occupancy_perc: float = 0.0
+    num_preemptions_total: int = 0
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -81,6 +85,8 @@ class ForwardPassMetrics:
             prefix_hits_total=stats.get("prefix_hits_total", 0),
             prefix_cached_tokens_total=stats.get("prefix_cached_tokens_total", 0),
             spec_accepted_tokens_total=stats.get("spec_accepted_tokens_total", 0),
+            batch_occupancy_perc=stats.get("batch_occupancy_perc", 0.0),
+            num_preemptions_total=stats.get("num_preemptions_total", 0),
         )
 
 

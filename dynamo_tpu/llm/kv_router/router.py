@@ -19,6 +19,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
     RouterEvent,
 )
 from dynamo_tpu.llm.kv_router.scheduler import KvRouterConfig, KvScheduler
+from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.runtime.client import InstanceNotFound, PushRouter
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.engine import Context, ResponseStream
@@ -129,7 +130,19 @@ class KvPushRouter:
                 raise last_err or RuntimeError(
                     "no instances available for kv-routed dispatch"
                 )
-            worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+            # routing-decision span: which worker, how much prefix it holds
+            span = get_recorder().start(
+                "router.schedule", getattr(request.ctx, "trace", None),
+                component="router", attrs={"candidates": len(worker_ids)},
+            )
+            try:
+                worker_id, matched = await self.kv_router.schedule(token_ids, worker_ids)
+            except BaseException as exc:
+                if span is not None:
+                    span.end(status="error", error=repr(exc))
+                raise
+            if span is not None:
+                span.end(worker=f"{worker_id:x}", overlap_blocks=matched)
             request.data["estimated_prefix_hit_blocks"] = matched
             try:
                 return await self.push_router.generate(request, instance_id=worker_id)

@@ -83,6 +83,12 @@ class MockerEngine:
             "num_requests_running": self.scheduler.num_running,
             "request_total_slots": self.config.max_batch_size,
             "iterations_total": self._iterations,
+            # same step-telemetry names as the real engine so mocker fleets
+            # light up the dyn_worker occupancy/preemption gauges too
+            "batch_occupancy_perc": (
+                self.scheduler.num_running / max(self.config.max_batch_size, 1)
+            ),
+            "num_preemptions_total": self.scheduler.preemptions_total,
         }
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:

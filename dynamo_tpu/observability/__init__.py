@@ -1,0 +1,36 @@
+"""End-to-end request observability.
+
+One request, one ``trace_id``, visible in every layer it touches:
+
+- ``trace``        — the propagated context (``trace_id``/``span_id``/parent)
+  minted at the HTTP frontend (honoring an incoming ``x-request-id``) and
+  carried through the control-plane request envelope and data-plane prologue.
+- ``recorder``     — process-wide span recorder with a bounded buffer,
+  JSONL and Chrome-trace (``chrome://tracing`` / Perfetto) exporters, and
+  per-request lifecycle summaries (queue wait, prefill, TTFT, KV transfer).
+- ``step_metrics`` — engine step telemetry (batch occupancy, running/waiting
+  counts, KV pool usage, preemptions) accumulated on the device thread and
+  surfaced through the existing Prometheus registries.
+
+See docs/observability.md for the metric families, env vars, and formats.
+"""
+
+from dynamo_tpu.observability.recorder import (
+    Span,
+    SpanRecorder,
+    get_recorder,
+    set_recorder,
+)
+from dynamo_tpu.observability.step_metrics import StepTelemetry
+from dynamo_tpu.observability.trace import TraceContext, new_span_id, new_trace_id
+
+__all__ = [
+    "Span",
+    "SpanRecorder",
+    "StepTelemetry",
+    "TraceContext",
+    "get_recorder",
+    "new_span_id",
+    "new_trace_id",
+    "set_recorder",
+]

@@ -1,0 +1,85 @@
+"""Engine step telemetry.
+
+The engine's device loop calls :meth:`StepTelemetry.observe_step` once per
+scheduler iteration (plain Python assignments under the GIL — safe to read
+from the asyncio thread).  The snapshot rides the existing telemetry path:
+``JaxLlmEngine.stats()`` merges it, ``WorkerMetricsPublisher`` ships it as
+``ForwardPassMetrics``, and ``components/metrics_service.py`` exports it as
+``dyn_worker_*`` Prometheus gauges — no new registry, one coherent pipeline.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class StepSnapshot:
+    """State of the most recent engine step."""
+
+    iteration: int = 0
+    num_running: int = 0
+    num_waiting: int = 0
+    batch_occupancy_perc: float = 0.0   # running lanes / max_batch_size
+    kv_usage_perc: float = 0.0          # used blocks / pool blocks
+    kv_active_blocks: int = 0
+    step_duration_s: float = 0.0
+    timestamp_s: float = 0.0
+
+
+class StepTelemetry:
+    """Latest-step snapshot + monotone counters, cheap enough for every step."""
+
+    def __init__(self, max_batch_size: int):
+        self.max_batch_size = max(max_batch_size, 1)
+        self.snapshot = StepSnapshot()
+        self.steps_total = 0
+        self.busy_steps_total = 0        # steps with at least one running lane
+        self.step_time_total_s = 0.0
+
+    def observe_step(
+        self,
+        *,
+        iteration: int,
+        num_running: int,
+        num_waiting: int,
+        kv_active_blocks: int,
+        kv_total_blocks: int,
+        step_duration_s: float,
+    ) -> None:
+        self.snapshot = StepSnapshot(
+            iteration=iteration,
+            num_running=num_running,
+            num_waiting=num_waiting,
+            batch_occupancy_perc=num_running / self.max_batch_size,
+            kv_usage_perc=(
+                kv_active_blocks / kv_total_blocks if kv_total_blocks else 0.0
+            ),
+            kv_active_blocks=kv_active_blocks,
+            step_duration_s=step_duration_s,
+            timestamp_s=time.time(),
+        )
+        self.steps_total += 1
+        if num_running:
+            self.busy_steps_total += 1
+        self.step_time_total_s += step_duration_s
+
+    def stats(self) -> dict:
+        """Merged into ``JaxLlmEngine.stats()`` (names stable: the wire
+        protocol and the Prometheus exporter key off them).  The ``step_*``
+        names are the state AT the latest step — a coherent point-in-time
+        view, unlike the live scheduler/allocator reads the engine's other
+        stats fields take mid-drain."""
+        s = self.snapshot
+        return {
+            "batch_occupancy_perc": s.batch_occupancy_perc,
+            "step_num_running": s.num_running,
+            "step_num_waiting": s.num_waiting,
+            "step_kv_usage_perc": s.kv_usage_perc,
+            "step_kv_active_blocks": s.kv_active_blocks,
+            "engine_steps_total": self.steps_total,
+            "engine_busy_steps_total": self.busy_steps_total,
+            "engine_step_time_total_s": self.step_time_total_s,
+            "last_step_duration_s": s.step_duration_s,
+        }

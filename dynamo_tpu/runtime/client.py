@@ -19,6 +19,8 @@ import uuid
 
 import msgpack
 
+from dynamo_tpu.observability import get_recorder
+from dynamo_tpu.observability.trace import stamp_trace
 from dynamo_tpu.runtime.component import Endpoint, Instance, instances_prefix
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
@@ -257,15 +259,26 @@ class PushRouter:
             # must find nothing and get killed)
             stream_id = uuid.uuid4().hex
             pending = server.register(stream_id, ctx)
+            # per-attempt dispatch span: the worker's spans parent to it, so
+            # a failed-over request shows every rendezvous it paid for
+            dispatch = get_recorder().start(
+                "dispatch", getattr(ctx, "trace", None), component="frontend",
+                attrs={"instance": f"{inst.instance_id:x}", "subject": inst.subject},
+            )
+            control = stamp_trace(
+                {"id": ctx.id, "ci": server.connection_info(stream_id).to_dict()},
+                dispatch.ctx if dispatch is not None else None,
+            )
             envelope = msgpack.packb(
-                {
-                    "c": {"id": ctx.id, "ci": server.connection_info(stream_id).to_dict()},
-                    "p": request.data,
-                },
-                use_bin_type=True,
+                {"c": control, "p": request.data}, use_bin_type=True
             )
             try:
-                await runtime.plane.bus.publish(inst.subject, envelope)
+                # the trace also stamps the control-plane transport frame
+                # (remote planes), so dynctl can attribute publish failures
+                await runtime.plane.bus.publish(
+                    inst.subject, envelope,
+                    trace=dispatch.ctx if dispatch is not None else None,
+                )
                 # rendezvous: wait for the worker to connect back before
                 # returning the stream (the reference awaits the prologue)
                 await asyncio.wait_for(pending.connected.wait(), timeout=attempt_timeout)
@@ -275,8 +288,11 @@ class PushRouter:
                     # (both fire in the same loop pass): the stream is
                     # live — failing over here would run the request twice
                     self._dark.pop(inst.instance_id, None)
+                    self._end_dispatch(dispatch, pending)
                     return ResponseStream(pending, ctx)
                 server.unregister(stream_id)
+                if dispatch is not None:
+                    dispatch.end(status="error", error="rendezvous timeout")
                 tried.add(inst.instance_id)
                 self.quarantine(inst.instance_id)
                 # a bare TimeoutError is undiagnosable from the frontend;
@@ -293,19 +309,35 @@ class PushRouter:
                     raise last_err from None
                 logger.warning("%s; failing over", last_err)
                 continue
-            except BaseException:
+            except BaseException as exc:
                 # includes caller cancellation mid-rendezvous: the pending
                 # registration must not leak (a later connect-back to an
                 # unknown stream gets killed instead of streaming into an
                 # orphaned queue)
                 server.unregister(stream_id)
+                if dispatch is not None:
+                    dispatch.end(status="error", error=repr(exc))
                 raise
             # successful rendezvous clears any quarantine: one transient
             # overload blip must not idle a recovered worker for the TTL
             self._dark.pop(inst.instance_id, None)
+            self._end_dispatch(dispatch, pending)
             return ResponseStream(pending, ctx)
         assert last_err is not None
         raise last_err
+
+    @staticmethod
+    def _end_dispatch(dispatch, pending) -> None:
+        """Close a rendezvous span, cross-linking the worker-side span id
+        the connect-back prologue carried — the explicit edge between the
+        frontend's dispatch attempt and the worker.handle span that served
+        it (robust even if either side's buffer later drops a span)."""
+        if dispatch is None:
+            return
+        if pending.trace is not None:
+            dispatch.end(worker_span=pending.trace.span_id)
+        else:
+            dispatch.end()
 
     async def generate_direct(self, request: Context[dict], instance_id: int) -> ResponseStream[dict]:
         return await self.generate(request, instance_id=instance_id)

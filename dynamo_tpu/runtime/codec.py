@@ -19,6 +19,15 @@ _PREFIX = struct.Struct("!II")
 MAX_HEADER = 1 << 20          # 1 MiB
 MAX_PAYLOAD = 1 << 31         # 2 GiB
 
+# Frame headers carry a TraceContext wire dict under the shared reserved
+# key (observability.trace.TRACE_WIRE_KEY) so data-plane streams stay
+# correlatable with the request that opened them.  One canonical
+# stamp/decode pair serves every transport.
+from dynamo_tpu.observability.trace import (  # noqa: E402 (re-export)
+    read_trace as extract_trace,
+    stamp_trace as attach_trace,
+)
+
 
 @dataclass
 class TwoPartMessage:

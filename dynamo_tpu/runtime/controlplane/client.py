@@ -23,7 +23,12 @@ from dynamo_tpu.runtime.controlplane.interface import (
     WatchEvent,
     WatchEventType,
 )
-from dynamo_tpu.runtime.controlplane.wire import kv_entry_from_wire, pack_frame, read_frame
+from dynamo_tpu.runtime.controlplane.wire import (
+    kv_entry_from_wire,
+    pack_frame,
+    read_frame,
+    with_trace,
+)
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger("runtime.controlplane.client")
@@ -120,7 +125,9 @@ class RpcConnection:
                 Message(subject=data["subject"], payload=data["payload"], reply_to=data["reply_to"])
             )
 
-    async def call(self, method: str, *args, timeout: float | None = 30.0):
+    async def call(
+        self, method: str, *args, timeout: float | None = 30.0, trace=None
+    ):
         if self._closed:
             raise ConnectionError("control plane connection closed")
         req_id = next(self._req_ids)
@@ -128,7 +135,12 @@ class RpcConnection:
         self._pending[req_id] = fut
         async with self._write_lock:
             assert self._writer is not None
-            self._writer.write(pack_frame({"i": req_id, "m": method, "a": list(args)}))
+            # request-scoped RPCs (e.g. the push router's envelope publish)
+            # stamp their TraceContext on the frame so dynctl can attribute
+            # failures to the request trace
+            self._writer.write(
+                pack_frame(with_trace({"i": req_id, "m": method, "a": list(args)}, trace))
+            )
             await self._writer.drain()
         if timeout is None:
             return await fut
@@ -239,8 +251,10 @@ class RemoteBus(MessageBus):
         # set once a server rejects bus.queue_pop_meta (older dynctl)
         self._pop_meta_unsupported = False
 
-    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
-        await self._conn.call("bus.publish", subject, payload, reply_to)
+    async def publish(
+        self, subject: str, payload: bytes, reply_to: str | None = None, trace=None
+    ) -> None:
+        await self._conn.call("bus.publish", subject, payload, reply_to, trace=trace)
 
     async def subscribe(self, subject: str, queue_group: str | None = None) -> Subscription:
         sub = Subscription(subject)

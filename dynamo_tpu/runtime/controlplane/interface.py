@@ -214,7 +214,12 @@ class Bucket:
 
 class MessageBus(ABC):
     @abstractmethod
-    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
+    async def publish(
+        self, subject: str, payload: bytes, reply_to: str | None = None, trace=None
+    ) -> None:
+        """``trace``: optional TraceContext stamped on the transport frame
+        by remote implementations (request-scoped publishes only); purely
+        advisory — delivery semantics never depend on it."""
         ...
 
     @abstractmethod

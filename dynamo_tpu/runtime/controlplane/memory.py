@@ -149,7 +149,11 @@ class MemoryBus(MessageBus):
         )
         self._objects: dict[str, dict[str, bytes]] = defaultdict(dict)
 
-    async def publish(self, subject: str, payload: bytes, reply_to: str | None = None) -> None:
+    async def publish(
+        self, subject: str, payload: bytes, reply_to: str | None = None, trace=None
+    ) -> None:
+        # trace: accepted for interface parity; in-process delivery needs no
+        # frame-level correlation (the request envelope already carries it)
         msg = Message(subject=subject, payload=payload, reply_to=reply_to)
         # group -> matching members; None-group members all get a copy
         grouped: dict[str, list[Subscription]] = defaultdict(list)

@@ -16,6 +16,7 @@ import itertools
 from dynamo_tpu.runtime.controlplane.interface import WATCH_SYNC, Subscription, Watch
 from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
 from dynamo_tpu.runtime.controlplane.wire import (
+    frame_trace,
     kv_entry_to_wire,
     pack_frame,
     read_frame,
@@ -168,6 +169,13 @@ class ControlPlaneServer:
                 result = await dispatch(frame["m"], frame.get("a", []))
                 await send({"i": frame["i"], "ok": True, "r": result})
             except Exception as exc:  # noqa: BLE001
+                # request-scoped RPCs carry a trace frame stamp: name the
+                # request so a failed publish is attributable end-to-end
+                trace = frame_trace(frame)
+                logger.warning(
+                    "rpc %s failed: %r%s", frame.get("m"), exc,
+                    f" (trace {trace.trace_id})" if trace is not None else "",
+                )
                 await send({"i": frame["i"], "ok": False, "e": repr(exc)})
 
         try:

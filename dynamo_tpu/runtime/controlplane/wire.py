@@ -39,6 +39,17 @@ async def read_frame(reader: asyncio.StreamReader) -> dict | None:
     return msgpack.unpackb(payload, raw=False)
 
 
+# RPC frames carry a TraceContext under the shared reserved key: request-
+# scoped RPCs (the push router's envelope publish, via
+# ``RpcConnection.call(..., trace=...)``) stamp it so the dynctl server can
+# attribute failures to the request trace (``frame_trace`` server-side).
+# Canonical stamp/decode pair lives in observability.trace.
+from dynamo_tpu.observability.trace import (  # noqa: E402 (re-export)
+    read_trace as frame_trace,
+    stamp_trace as with_trace,
+)
+
+
 def kv_entry_to_wire(entry) -> dict:
     return {"k": entry.key, "v": entry.value, "rev": entry.revision, "lease": entry.lease_id}
 

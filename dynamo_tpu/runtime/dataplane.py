@@ -23,7 +23,13 @@ from dataclasses import dataclass
 
 import msgpack
 
-from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
+from dynamo_tpu.runtime.codec import (
+    TwoPartMessage,
+    attach_trace,
+    encode_frame,
+    extract_trace,
+    read_two_part,
+)
 from dynamo_tpu.runtime.engine import EngineContext
 from dynamo_tpu.utils.logging import get_logger
 
@@ -92,6 +98,9 @@ class PendingStream:
         self.queue: asyncio.Queue[dict | None] = asyncio.Queue()
         self.connected = asyncio.Event()
         self.error: str | None = None
+        # the worker's trace context from the connect-back prologue (None
+        # until connected / when the worker is untraced)
+        self.trace = None
         self._writer: asyncio.StreamWriter | None = None
 
     async def send_control(self, kind: str) -> None:
@@ -174,6 +183,7 @@ class ResponseStreamServer:
                 await writer.drain()
                 writer.close()
                 return
+            stream.trace = extract_trace(prologue.header)
             stream._writer = writer
             stream.connected.set()
 
@@ -235,9 +245,13 @@ class ResponseStreamSender:
                     raise
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 1.0)
-        self._writer.write(
-            encode_frame(TwoPartMessage(header={"t": "prologue", "stream_id": self.info.stream_id}))
+        # the prologue carries the worker-side trace context so the caller
+        # can correlate this byte stream with the request's span tree
+        header = attach_trace(
+            {"t": "prologue", "stream_id": self.info.stream_id},
+            getattr(self.ctx, "trace", None),
         )
+        self._writer.write(encode_frame(TwoPartMessage(header=header)))
         await self._writer.drain()
         self._control_task = asyncio.ensure_future(self._control_loop())
 

@@ -34,6 +34,11 @@ class EngineContext:
 
     def __init__(self, request_id: str | None = None):
         self.id: str = request_id or uuid.uuid4().hex
+        # distributed tracing context (observability.trace.TraceContext |
+        # None): set by whoever minted this request (HTTP frontend) or
+        # decoded it off the wire (ingress); rides Context.map/transfer for
+        # free since the EngineContext object itself is transferred
+        self.trace = None
         self._stopped = asyncio.Event()
         self._killed = asyncio.Event()
         self._children: list[EngineContext] = []

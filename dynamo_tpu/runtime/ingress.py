@@ -15,6 +15,8 @@ import time
 
 import msgpack
 
+from dynamo_tpu.observability import get_recorder
+from dynamo_tpu.observability.trace import read_trace
 from dynamo_tpu.runtime.component import Instance, instance_key, stats_subject
 from dynamo_tpu.runtime.dataplane import ConnectionInfo, ResponseStreamSender
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineContext
@@ -146,6 +148,16 @@ class EndpointService:
         control = envelope["c"]
         request = envelope["p"]
         ctx = EngineContext(control["id"])
+        # propagated trace context: engine-side spans (queue/prefill/decode)
+        # nest under this worker's handle span so one trace_id reassembles
+        # the whole frontend → router → engine path
+        wire_trace = read_trace(control)
+        span = get_recorder().start(
+            "worker.handle", wire_trace, component="worker",
+            attrs={"subject": self.instance.subject,
+                   "instance": f"{self.instance.instance_id:x}"},
+        )
+        ctx.trace = span.ctx if span is not None else None
         sender = ResponseStreamSender(ConnectionInfo.from_dict(control["ci"]), ctx)
         self._in_flight += 1
         self._arrived_total += 1
@@ -158,23 +170,33 @@ class EndpointService:
         # must not leak _in_flight
         except (ConnectionError, OSError, asyncio.TimeoutError) as exc:
             logger.warning("connect-back failed for %s: %r", control["id"], exc)
+            if span is not None:
+                span.end(status="error", error=f"connect-back failed: {exc!r}")
             self._request_done()
             return
         try:
             stream = await self.engine.generate(Context(request, ctx))
+            items = 0
             async for item in stream:
                 if ctx.is_killed:
                     break
+                items += 1
                 await sender.send(item)
             await sender.complete()
             self._handled_total += 1
+            if span is not None:
+                span.end(items=items, killed=ctx.is_killed)
         except asyncio.CancelledError:
             await sender.error("worker shutting down")
+            if span is not None:
+                span.end(status="error", error="worker shutting down")
             raise
         except Exception as exc:  # noqa: BLE001
             logger.exception("engine error on %s", self.instance.subject)
             self._errors_total += 1
             await sender.error(repr(exc))
+            if span is not None:
+                span.end(status="error", error=repr(exc))
         finally:
             self._request_done()
 

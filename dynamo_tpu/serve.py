@@ -143,6 +143,12 @@ async def serve_worker(
         publishers = [kv_pub, metrics_pub]
         engine.start()
     elif engine_kind == "jax":
+        # device-plane profiling hooks: DYN_PROFILER_PORT serves the jax
+        # profiler for TensorBoard/xprof attach; DYN_PROFILER_TRACE_DIR is
+        # honored by engine.start() (a whole-serve-window device trace)
+        from dynamo_tpu.utils import profiling
+
+        profiling.maybe_start_from_env()
         # publishers are wired before the engine so allocator events flow.
         # Built off the event loop: weight loading takes seconds and a G4
         # remote tier's mount does blocking TCP (RemoteStorage info RPC) —

@@ -56,6 +56,15 @@ class TextFormatter(logging.Formatter):
             datefmt="%H:%M:%S",
         )
 
+    def format(self, record: logging.LogRecord) -> str:
+        line = super().format(record)
+        fields = getattr(record, "fields", None)
+        if fields:
+            # same structured fields the JSONL formatter emits, rendered as
+            # trailing key=value pairs (request_id correlation in text logs)
+            line += " " + " ".join(f"{k}={v}" for k, v in fields.items())
+        return line
+
 
 def _parse_filter(spec: str) -> tuple[int, dict[str, int]]:
     """Parse ``warn,dynamo_tpu.runtime=debug`` into (root_level, {target: level})."""
@@ -94,6 +103,13 @@ def configure_logging(level: str | None = None, *, force: bool = False) -> None:
     root.propagate = False
     for target, lvl in targets.items():
         logging.getLogger(target).setLevel(lvl)
+
+
+def log_fields(**fields) -> dict:
+    """``extra=`` payload attaching structured fields to a log record:
+    ``logger.info("done", extra=log_fields(request_id=rid))`` — JSONL output
+    merges them into the object, text output appends ``k=v`` pairs."""
+    return {"fields": fields}
 
 
 def get_logger(name: str) -> logging.Logger:

@@ -6,7 +6,11 @@ The reference relies on external genai-perf plus ``tracing`` spans
 
 - ``start_server(port)``: serve the profiler so TensorBoard/xprof can attach.
 - ``trace(path)``: context manager capturing a trace of the enclosed steps.
-- env ``DYN_PROFILER_PORT``: auto-start in the engine at import.
+- env ``DYN_PROFILER_PORT``: auto-start the profiler server in serving paths.
+- env ``DYN_PROFILER_TRACE_DIR``: capture a device trace of the whole engine
+  serve window (``maybe_start_trace_from_env`` at engine start,
+  ``maybe_stop_trace`` at engine stop) — open the result in TensorBoard /
+  xprof, where ``DYN_XPROF_ANNOTATE=1`` span names line up with host spans.
 """
 
 from __future__ import annotations
@@ -19,6 +23,7 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger("utils.profiling")
 
 _server_started = False
+_trace_dir: str | None = None
 
 
 def start_server(port: int = 9012) -> None:
@@ -36,6 +41,43 @@ def maybe_start_from_env() -> None:
     port = os.environ.get("DYN_PROFILER_PORT")
     if port:
         start_server(int(port))
+
+
+def maybe_start_trace_from_env() -> str | None:
+    """Start a long-running device trace into ``DYN_PROFILER_TRACE_DIR``
+    (once per process; the engine serve path calls this at start).  Returns
+    the directory when THIS call started the trace, else None — the caller
+    that got the directory owns the matching ``maybe_stop_trace``."""
+    global _trace_dir
+    log_dir = os.environ.get("DYN_PROFILER_TRACE_DIR")
+    if not log_dir or _trace_dir is not None:
+        return None
+    import jax
+
+    try:
+        jax.profiler.start_trace(log_dir)
+    except Exception as exc:  # noqa: BLE001 — profiling must never stop serving
+        logger.warning("profiler trace start failed: %r", exc)
+        return None
+    _trace_dir = log_dir
+    logger.info("profiler trace capturing to %s", log_dir)
+    return log_dir
+
+
+def maybe_stop_trace() -> None:
+    """Stop the env-started trace (no-op when none is active)."""
+    global _trace_dir
+    if _trace_dir is None:
+        return
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written to %s", _trace_dir)
+    except Exception as exc:  # noqa: BLE001
+        logger.warning("profiler trace stop failed: %r", exc)
+    finally:
+        _trace_dir = None
 
 
 @contextlib.contextmanager

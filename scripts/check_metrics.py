@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Smoke-check the Prometheus surfaces of a running deployment.
+
+Scrapes the frontend's ``/metrics`` (``dyn_llm_*`` families) and the metrics
+service's ``/metrics`` (``dyn_worker_*`` families) and asserts every expected
+metric family is present — the fast "is observability wired at all?" gate for
+CI and for operators bringing up a fleet.
+
+Usage::
+
+    python scripts/check_metrics.py \
+        --frontend http://127.0.0.1:8080/metrics \
+        --worker   http://127.0.0.1:9091/metrics
+
+Either URL may be omitted to check only one surface.  Exit code 0 = all
+expected families present; 1 = something missing (printed).
+
+The family lists are importable (``FRONTEND_FAMILIES``/``WORKER_FAMILIES``,
+``missing_families``) so the tier-1 test (tests/llm/test_check_metrics.py)
+runs the same assertions in-process without sockets flakiness.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import urllib.request
+
+# frontend registry (dynamo_tpu/llm/http/metrics.py)
+FRONTEND_FAMILIES = (
+    "dyn_llm_http_service_requests_total",
+    "dyn_llm_http_service_inflight_requests",
+    "dyn_llm_http_service_request_duration_seconds",
+    "dyn_llm_http_service_time_to_first_token_seconds",
+    "dyn_llm_http_service_inter_token_latency_seconds",
+    "dyn_llm_http_service_input_sequence_tokens",
+    "dyn_llm_http_service_output_sequence_tokens",
+)
+
+# metrics service registry (dynamo_tpu/components/metrics_service.py)
+WORKER_FAMILIES = (
+    "dyn_worker_kv_active_blocks",
+    "dyn_worker_kv_total_blocks",
+    "dyn_worker_cache_usage_perc",
+    "dyn_worker_requests_waiting",
+    "dyn_worker_requests_running",
+    "dyn_worker_batch_occupancy_perc",
+    "dyn_worker_preemptions",
+    "dyn_worker_prefix_hits",
+    "dyn_worker_prefix_cached_tokens",
+    "dyn_worker_spec_accepted_tokens",
+    "dyn_worker_kv_hit_blocks_total",
+    "dyn_worker_kv_isl_blocks_total",
+)
+
+_HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
+
+
+def exposed_families(text: str) -> set[str]:
+    """Metric family names declared in a Prometheus text exposition."""
+    return set(_HELP_RE.findall(text))
+
+
+def missing_families(text: str, expected) -> list[str]:
+    have = exposed_families(text)
+    return [name for name in expected if name not in have]
+
+
+def _scrape(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+        return resp.read().decode("utf-8", "replace")
+
+
+def check_url(url: str, expected, timeout: float = 5.0) -> list[str]:
+    return missing_families(_scrape(url, timeout), expected)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--frontend", help="frontend /metrics URL (dyn_llm_*)")
+    parser.add_argument("--worker", help="metrics service /metrics URL (dyn_worker_*)")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    if not args.frontend and not args.worker:
+        parser.error("give --frontend and/or --worker")
+
+    failed = False
+    for url, expected, label in (
+        (args.frontend, FRONTEND_FAMILIES, "frontend"),
+        (args.worker, WORKER_FAMILIES, "worker"),
+    ):
+        if not url:
+            continue
+        try:
+            missing = check_url(url, expected, args.timeout)
+        except OSError as exc:
+            print(f"{label}: scrape of {url} failed: {exc}")
+            failed = True
+            continue
+        if missing:
+            print(f"{label}: {url} missing families: {', '.join(missing)}")
+            failed = True
+        else:
+            print(f"{label}: {url} ok ({len(expected)} families)")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

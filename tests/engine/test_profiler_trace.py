@@ -1,0 +1,60 @@
+"""DYN_PROFILER_TRACE_DIR wires utils.profiling into the engine serve path:
+engine.start() opens a jax profiler trace, engine.stop() writes it — on the
+CPU backend here, so the hook is covered without hardware."""
+
+import jax
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.runtime.engine import Context
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+async def test_profiler_trace_dir_env_captures_serve_window(tmp_path, monkeypatch):
+    trace_dir = tmp_path / "xprof"
+    monkeypatch.setenv("DYN_PROFILER_TRACE_DIR", str(trace_dir))
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=CFG, num_blocks=32, block_size=4, max_batch_size=2,
+            prefill_buckets=(16,), max_model_len=64,
+        ),
+        params=PARAMS,
+    )
+    engine.start()
+    try:
+        assert engine._profiler_trace_dir == str(trace_dir)
+        req = PreprocessedRequest(
+            token_ids=[2, 3, 4, 5],
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            eos_token_ids=[],
+        )
+        stream = await engine.generate(Context(req.to_wire()))
+        async for _ in stream:
+            pass
+    finally:
+        engine.stop()
+    # stop() wrote the capture: xprof traces land under plugins/profile/
+    written = list(trace_dir.rglob("*"))
+    assert any(p.is_file() for p in written), written
+    # the env hook is once-per-process; a second engine must not re-arm it
+    # against the (already consumed) global trace state
+    engine2 = JaxLlmEngine(
+        EngineConfig(
+            model=CFG, num_blocks=32, block_size=4, max_batch_size=2,
+            prefill_buckets=(16,), max_model_len=64,
+        ),
+        params=PARAMS,
+    )
+    engine2.start()
+    try:
+        assert engine2._profiler_trace_dir == str(trace_dir)
+    finally:
+        engine2.stop()

@@ -1,0 +1,81 @@
+"""scripts/check_metrics.py under tier-1: the smoke check's family lists
+must match what the real registries expose — in-process against rendered
+expositions AND over HTTP against live /metrics endpoints."""
+
+import asyncio
+import sys
+from pathlib import Path
+
+import httpx
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent / "scripts"))
+from check_metrics import (  # noqa: E402
+    FRONTEND_FAMILIES,
+    WORKER_FAMILIES,
+    exposed_families,
+    missing_families,
+)
+
+from dynamo_tpu.components.metrics_service import MetricsService
+from dynamo_tpu.llm.http.metrics import FrontendMetrics
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+def test_frontend_registry_exposes_every_expected_family():
+    text = FrontendMetrics().render().decode()
+    assert missing_families(text, FRONTEND_FAMILIES) == []
+    # the check actually discriminates: a fabricated family is reported
+    assert missing_families(text, ("dyn_llm_nonexistent_family",)) == [
+        "dyn_llm_nonexistent_family"
+    ]
+
+
+async def test_live_scrape_of_frontend_and_metrics_service():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://check-metrics")
+    )
+    service = HttpService(host="127.0.0.1", port=0)
+    metrics_svc = MetricsService(
+        rt.namespace("ns").component("backend"), host="127.0.0.1", port=0
+    )
+    try:
+        await service.start()
+        await metrics_svc.start()
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{service.port}/metrics")
+            assert r.status_code == 200
+            assert missing_families(r.text, FRONTEND_FAMILIES) == []
+            r = await client.get(f"http://127.0.0.1:{metrics_svc.port}/metrics")
+            assert r.status_code == 200
+            assert missing_families(r.text, WORKER_FAMILIES) == []
+            # sanity on the parser itself
+            assert "dyn_worker_kv_hit_blocks_total" in exposed_families(r.text)
+    finally:
+        await metrics_svc.stop()
+        await service.stop()
+        await rt.close()
+
+
+async def test_main_exit_codes():
+    """The CLI surface: a live endpoint passes, a dead one fails loudly."""
+    from check_metrics import main
+
+    service = HttpService(host="127.0.0.1", port=0)
+    await service.start()
+    try:
+        url = f"http://127.0.0.1:{service.port}/metrics"
+        # urllib is blocking: keep it off the loop serving the scrape
+        assert await asyncio.to_thread(main, ["--frontend", url]) == 0
+        assert (
+            await asyncio.to_thread(
+                main,
+                ["--frontend", "http://127.0.0.1:9/metrics", "--timeout", "0.5"],
+            )
+            == 1
+        )
+    finally:
+        await service.stop()

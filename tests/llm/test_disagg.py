@@ -145,6 +145,61 @@ async def test_remote_prefill_exactness():
         await rt.close()
 
 
+async def test_disagg_trace_joins_request_tree():
+    """A traced request through the disagg split produces the full span set
+    under ONE trace: the prefill worker's handle span (via the queue item's
+    stamped context), the kv.transfer span with a positive byte count, and
+    the decode engine's queue span (remote-prefilled sequences enter decode
+    without a local prefill pass and must still record their wait)."""
+    from dynamo_tpu.observability import SpanRecorder, TraceContext, set_recorder
+
+    rec = set_recorder(SpanRecorder(max_spans=2048))
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg-tr"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine()
+    disagg = None
+    prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue)
+        prefill_worker.start()
+
+        ctx = Context(request(list(range(3, 13)), max_tokens=4))
+        ctx.ctx.trace = TraceContext.new_root("disagg-trace-1")
+        stream = await disagg.generate(ctx)
+        await collect(stream)
+        assert disagg.remote_prefills == 1
+
+        for _ in range(100):
+            names = {s.name for s in rec.spans_for("disagg-trace-1")}
+            if {"prefill_worker.handle", "kv.transfer", "engine.queue",
+                "engine.decode"} <= names:
+                break
+            await asyncio.sleep(0.02)
+        spans = {s.name: s for s in rec.spans_for("disagg-trace-1")}
+        assert {"prefill_worker.handle", "kv.transfer", "engine.queue",
+                "engine.decode"} <= set(spans), sorted(spans)
+        assert spans["kv.transfer"].attrs["bytes"] > 0
+        assert spans["prefill_worker.handle"].attrs["bytes"] > 0
+        assert disagg.kv_transfer_bytes_total == spans["kv.transfer"].attrs["bytes"]
+        assert disagg.kv_transfer_seconds_total > 0
+        summary = rec.summary("disagg-trace-1")
+        assert summary["kv_transfer_bytes"] > 0
+        assert summary["kv_transfer_s"] >= 0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
 async def test_short_prompt_stays_local():
     MemoryControlPlane.reset_named()
     rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://disagg3"))
@@ -455,7 +510,7 @@ async def test_claimed_transfer_with_cancelled_waiter_releases():
         used_with_reservation = engine.allocator.used_blocks
         fut = asyncio.get_running_loop().create_future()
         fut.cancel()
-        disagg._pending["s1"] = (fut, block_ids)
+        disagg._pending["s1"] = (fut, block_ids, None)
         from dynamo_tpu.parallel.kv_transfer import KvTransferPayload
 
         import jax.numpy as jnp

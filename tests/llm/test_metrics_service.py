@@ -26,6 +26,8 @@ STATS = {
     "prefix_hits_total": 5,
     "prefix_cached_tokens_total": 320,
     "spec_accepted_tokens_total": 17,
+    "batch_occupancy_perc": 3 / 8,
+    "num_preemptions_total": 2,
 }
 
 
@@ -49,11 +51,71 @@ async def test_metrics_service_exports_worker_gauges():
         text = r.text
         assert 'kv_active_blocks{worker="ab"} 7.0' in text
         assert 'requests_waiting{worker="ab"} 2.0' in text
+        assert 'requests_running{worker="ab"} 3.0' in text
+        assert 'batch_occupancy_perc{worker="ab"} 0.375' in text
+        assert 'preemptions{worker="ab"} 2.0' in text
         assert 'prefix_hits{worker="ab"} 5.0' in text
         assert 'prefix_cached_tokens{worker="ab"} 320.0' in text
         assert 'spec_accepted_tokens{worker="ab"} 17.0' in text
         assert "kv_hit_blocks_total 4.0" in text
         assert "kv_isl_blocks_total 10.0" in text
+    finally:
+        await pub.stop()
+        await service.stop()
+        await rt.close()
+
+
+async def test_hit_rate_subscription_survives_malformed_events():
+    """The KV-hit-rate subscription must tolerate garbage on the subject
+    (a buggy router version, a stray publisher): malformed payloads are
+    skipped and later valid events still count."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://metrics2"))
+    comp = rt.namespace("ns").component("backend")
+    service = MetricsService(comp, host="127.0.0.1", port=0)
+    try:
+        await service.start()
+        subject = comp.event_subject(KV_HIT_RATE_SUBJECT)
+        bus = comp.runtime.plane.bus
+        await bus.publish(subject, b"not json at all")
+        await bus.publish(subject, b'{"unexpected": "shape"}')
+        for overlap in (3, 2):
+            await bus.publish(
+                subject,
+                KvHitRateEvent(
+                    worker_id=1, isl_blocks=8, overlap_blocks=overlap
+                ).to_json(),
+            )
+        await asyncio.sleep(0.1)
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{service.port}/metrics")
+        assert "kv_hit_blocks_total 5.0" in r.text
+        assert "kv_isl_blocks_total 16.0" in r.text
+    finally:
+        await service.stop()
+        await rt.close()
+
+
+async def test_worker_gauges_removed_when_worker_disappears():
+    """A worker that stops publishing (lease lost) must fall out of the
+    export after the aggregator TTL — stale gauges looking alive forever
+    would defeat load-aware routing dashboards."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://metrics3"))
+    comp = rt.namespace("ns").component("backend")
+    service = MetricsService(comp, host="127.0.0.1", port=0)
+    pub = WorkerMetricsPublisher(comp, worker_id=0xCD, stats_fn=lambda: STATS)
+    try:
+        await service.start()
+        await pub.publish_once()
+        await asyncio.sleep(0.1)
+        async with httpx.AsyncClient() as client:
+            r = await client.get(f"http://127.0.0.1:{service.port}/metrics")
+            assert 'kv_active_blocks{worker="cd"}' in r.text
+            # simulate TTL expiry without waiting 10s
+            service.aggregator.ttl_s = 0.0
+            r = await client.get(f"http://127.0.0.1:{service.port}/metrics")
+            assert 'worker="cd"' not in r.text
     finally:
         await pub.stop()
         await service.stop()
