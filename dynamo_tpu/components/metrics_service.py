@@ -17,6 +17,7 @@ from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, KvHitRateEvent
+from dynamo_tpu.robustness import counters as robustness_counters
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.utils.config import RuntimeConfig
@@ -90,6 +91,14 @@ class MetricsService:
         self.isl_blocks = Counter(
             f"{PREFIX}_kv_isl_blocks_total", "Total request prefix blocks", registry=self.registry
         )
+        # resilience counters (robustness.counters): mirrored on refresh so
+        # one scrape shows recovery activity next to worker load.  Gauges
+        # because a mirror needs .set() (same rationale as above), but they
+        # keep the canonical *_total names the frontend exposition uses.
+        self.resilience = {
+            name: Gauge(name, help_text, registry=self.registry)
+            for name, help_text in robustness_counters.HELP.items()
+        }
         self._hit_sub = None
         self._hit_task: asyncio.Task | None = None
         self._runner: web.AppRunner | None = None
@@ -130,6 +139,10 @@ class MetricsService:
             self.isl_blocks.inc(max(event.isl_blocks, 0))
 
     def _refresh(self) -> None:
+        for name, value in robustness_counters.snapshot().items():
+            gauge = self.resilience.get(name)
+            if gauge is not None:
+                gauge.set(value)
         snapshot = self.aggregator.snapshot()
         live = {f"{wid:x}" for wid in snapshot.workers}
         # drop gauges for workers that fell out of the snapshot (lease

@@ -46,6 +46,7 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
 from dynamo_tpu.observability import StepTelemetry, get_recorder
+from dynamo_tpu.robustness.faults import ENGINE_STEP, FAULTS
 from dynamo_tpu.ops.sampling import (
     apply_logit_bias,
     apply_penalties,
@@ -1732,6 +1733,9 @@ class JaxLlmEngine:
         )
         while not self._stop:
             try:
+                # chaos seam: an injected step failure exercises the loop's
+                # keep-alive catch below (thread survives, requests continue)
+                FAULTS.check(ENGINE_STEP)
                 # evictions queued by asyncio-thread mutators (disagg
                 # reserve_blocks) offload here, before anything can write
                 # into the evicted blocks

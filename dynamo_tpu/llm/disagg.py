@@ -33,6 +33,8 @@ from dynamo_tpu.parallel.kv_transfer import (
     KvTransferPayload,
     KvTransferServer,
 )
+from dynamo_tpu.robustness.faults import FAULTS, PREFILL_DEQUEUE
+from dynamo_tpu.robustness.retry import Backoff
 from dynamo_tpu.runtime.component import ROOT_PATH
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -81,10 +83,32 @@ class DisaggRouter:
             self._task.cancel()
 
     async def _loop(self) -> None:
-        try:
-            await self._config_loop()
-        except ConnectionError as exc:
-            logger.warning("disagg config watch lost (keeping last config): %s", exc)
+        """Run the config watch; on connection loss, resubscribe with
+        backoff instead of exiting permanently (pre-fix, one dropped
+        control-plane connection froze the disagg thresholds forever —
+        the router kept serving on the last config, but could never see
+        another hot-reload)."""
+        backoff = Backoff(initial=0.1, max_delay=5.0)
+        while True:
+            started = asyncio.get_running_loop().time()
+            try:
+                await self._config_loop()
+                return  # watch cancelled / closed cleanly (stop())
+            except ConnectionError as exc:
+                # a watch that survived a while before dying is a fresh,
+                # independent outage — don't let attempts accumulate over a
+                # long process lifetime until every blip pays the max delay
+                if asyncio.get_running_loop().time() - started > 5.0:
+                    backoff.reset()
+                delay = backoff.next()
+                logger.warning(
+                    "disagg config watch lost (keeping last config; "
+                    "resubscribing in %.1fs): %s", delay, exc,
+                )
+                await asyncio.sleep(delay)  # stop() cancels us here
+                self._watch = self.runtime.plane.kv.watch_prefix(
+                    disagg_config_key(self.model)
+                )
 
     async def _config_loop(self) -> None:
         async for event in self._watch:
@@ -103,6 +127,10 @@ class DisaggRouter:
                 logger.info("disagg config reloaded: %s", self.config)
             except Exception:  # noqa: BLE001
                 logger.exception("bad disagg config update")
+                # a poison value that keeps getting re-emitted (e.g. a
+                # config controller fighting the watch) must not spin this
+                # loop hot
+                await asyncio.sleep(0.1)
 
     def prefill_remote(self, prefill_length: int, queue_size: int) -> bool:
         return (
@@ -350,6 +378,9 @@ class PrefillWorker:
     async def _loop(self) -> None:
         while True:
             try:
+                # chaos seam: a failed dequeue exercises the sleep-and-retry
+                # path below (the pump must survive broker churn)
+                FAULTS.check(PREFILL_DEQUEUE)
                 popped = await self.queue.dequeue_with_age(timeout=1.0)
             except asyncio.CancelledError:
                 raise

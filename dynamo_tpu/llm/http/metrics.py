@@ -18,6 +18,8 @@ from prometheus_client import (
     generate_latest,
 )
 
+from dynamo_tpu.robustness import counters as robustness_counters
+
 PREFIX = "dyn_llm"
 
 
@@ -76,7 +78,9 @@ class FrontendMetrics:
         return InflightGuard(self, model, endpoint, request_type)
 
     def render(self) -> bytes:
-        return generate_latest(self.registry)
+        # one scrape surface: per-model serving metrics plus the process-
+        # wide resilience counters (retries, sheds, control-plane reconnects)
+        return generate_latest(self.registry) + robustness_counters.render()
 
 
 class InflightGuard:

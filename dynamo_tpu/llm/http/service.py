@@ -24,6 +24,11 @@ from aiohttp import web
 from dynamo_tpu.llm.http.metrics import FrontendMetrics
 from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.observability.trace import sanitize_request_id
+from dynamo_tpu.robustness.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    Overloaded,
+)
 from dynamo_tpu.llm.protocols import sse
 from dynamo_tpu.llm.protocols.aggregator import (
     aggregate_chat_stream,
@@ -79,6 +84,7 @@ def _error(
     *,
     param: str | None = None,
     code: str | None = None,
+    headers: dict[str, str] | None = None,
 ) -> web.Response:
     """Structured OpenAI-shaped error body: ``{"error": {message, type,
     param, code}}`` with ``param`` naming the offending field and ``code``
@@ -87,6 +93,7 @@ def _error(
     return web.json_response(
         {"error": {"message": message, "type": err_type, "param": param, "code": code}},
         status=status,
+        headers=headers,
     )
 
 
@@ -115,6 +122,7 @@ class HttpService:
         metrics: FrontendMetrics | None = None,
         request_template=None,
         clear_kv=None,
+        admission: AdmissionConfig | None = None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
@@ -124,9 +132,12 @@ class HttpService:
         # async () -> list[str]: broadcast a cache flush to every backing
         # worker component (reference: lib/llm/src/http/service/clear_kv_blocks.rs)
         self.clear_kv = clear_kv
+        # load shedding on the inference routes (429/503 + Retry-After);
+        # disabled unless configured or DYN_ADMISSION_MAX_INFLIGHT is set
+        self.admission = AdmissionController(admission)
         self.app = web.Application(
             client_max_size=64 * 1024 * 1024,
-            middlewares=[self._request_id_middleware],
+            middlewares=[self._request_id_middleware, self._admission_middleware],
         )
         self.app.router.add_post("/v1/chat/completions", self.handle_chat)
         self.app.router.add_post("/v1/completions", self.handle_completions)
@@ -171,6 +182,27 @@ class HttpService:
         if not response.prepared:
             response.headers.setdefault(REQUEST_ID_HEADER, request["request_id"])
         return response
+
+    @web.middleware
+    async def _admission_middleware(self, request: web.Request, handler):
+        """Admission control on the inference routes only — health, metrics
+        and admin endpoints must stay reachable exactly when the service is
+        overloaded."""
+        if request.method != "POST" or not request.path.startswith("/v1/"):
+            return await handler(request)
+        try:
+            await self.admission.acquire()
+        except Overloaded as exc:
+            return _error(
+                exc.status, str(exc), "overloaded_error", code="overloaded",
+                headers={"Retry-After": f"{max(int(exc.retry_after_s), 1)}"},
+            )
+        try:
+            return await handler(request)
+        finally:
+            # streaming handlers return only after the SSE body is fully
+            # written, so the slot covers the whole stream lifetime
+            await self.admission.release()
 
     def _trace_root(self, request: web.Request, endpoint: str, model: str):
         """Root span of the request's trace tree; the request id IS the

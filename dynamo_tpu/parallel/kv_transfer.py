@@ -27,6 +27,7 @@ from typing import Awaitable, Callable
 
 import numpy as np
 
+from dynamo_tpu.robustness.faults import FAULTS, KV_TRANSFER
 from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
 from dynamo_tpu.utils.logging import get_logger
 
@@ -150,6 +151,9 @@ class KvTransferClient:
         return entry
 
     async def send(self, address: str, payload: KvTransferPayload) -> None:
+        # chaos seam: a failed KV shipment (the decode side's prefill wait
+        # times out and degrades to a local prefill)
+        FAULTS.check(KV_TRANSFER, seq_id=payload.seq_id)
         local = LOCAL_SERVERS.get(address)
         if local is not None:
             await local.deliver_local(payload)

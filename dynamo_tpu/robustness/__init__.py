@@ -1,0 +1,36 @@
+"""Fault injection + self-healing runtime primitives.
+
+Two halves (see docs/robustness.md for the failure-mode matrix):
+
+- :mod:`faults`    — a registry of named fault points checked at the
+  runtime's seams, armed via ``DYN_FAULTS`` with deterministic triggers so
+  chaos scenarios run as ordinary pytest.
+- healing building blocks — :mod:`retry` (capped exponential backoff with
+  jitter), :mod:`admission` (frontend load shedding), and :mod:`counters`
+  (process-global recovery counters exported on every Prometheus surface).
+
+The control-plane reconnect/resync machinery itself lives with the client
+(``runtime/controlplane/client.py``) and the safe-retry dispatch policy
+with the push router (``runtime/client.py``); both are built from, and
+observable through, this package.
+"""
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    Overloaded,
+)
+from dynamo_tpu.robustness.faults import FAULTS, FaultRegistry, get_faults
+from dynamo_tpu.robustness.retry import Backoff
+
+__all__ = [
+    "FAULTS",
+    "AdmissionConfig",
+    "AdmissionController",
+    "Backoff",
+    "FaultRegistry",
+    "Overloaded",
+    "counters",
+    "get_faults",
+]

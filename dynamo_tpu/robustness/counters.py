@@ -1,0 +1,65 @@
+"""Process-global resilience counters.
+
+The self-healing paths live in layers that must not depend on
+prometheus_client (runtime/controlplane, runtime/client run inside workers,
+frontends, and bare tools alike), so recovery events are counted here in a
+plain thread-safe dict.  Surfaces that already speak Prometheus pull from
+it: the HTTP frontend appends :func:`render` to its ``/metrics`` body and
+``components/metrics_service.py`` mirrors the snapshot into gauges.
+
+Known families (always rendered, zero-valued until the first event):
+
+- ``dyn_cp_reconnects_total`` — control-plane connections re-established
+- ``dyn_retries_total``       — requests re-dispatched pre-first-token
+- ``dyn_shed_total``          — requests shed by frontend admission control
+- ``dyn_faults_injected_total`` — faults fired by the injection registry
+"""
+
+from __future__ import annotations
+
+import threading
+
+HELP = {
+    "dyn_cp_reconnects_total": "Control-plane connections re-established after loss",
+    "dyn_retries_total": "Requests safely re-dispatched after a pre-first-token stream failure",
+    "dyn_shed_total": "Requests shed (429/503) by frontend admission control",
+    "dyn_faults_injected_total": "Faults fired by the DYN_FAULTS injection registry",
+}
+
+_lock = threading.Lock()
+_counters: dict[str, int] = {}
+
+
+def incr(name: str, by: int = 1) -> int:
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + by
+        return _counters[name]
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> dict[str, int]:
+    """All known families plus any ad-hoc names that have been bumped."""
+    with _lock:
+        out = {name: 0 for name in HELP}
+        out.update(_counters)
+        return out
+
+
+def reset() -> None:
+    with _lock:
+        _counters.clear()
+
+
+def render() -> bytes:
+    """Prometheus text exposition of every counter (known families always
+    present so scrape checks can assert on them before the first event)."""
+    lines = []
+    for name, value in sorted(snapshot().items()):
+        lines.append(f"# HELP {name} {HELP.get(name, 'Resilience counter')}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+    return ("\n".join(lines) + "\n").encode()

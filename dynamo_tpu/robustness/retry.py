@@ -1,0 +1,53 @@
+"""Exponential backoff with jitter (reconnects, resubscribes, retries).
+
+(Reference posture: etcd/NATS clients reconnect forever with capped
+exponential backoff; jitter keeps a restarted control plane from being
+stampeded by every client retrying in phase.)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+
+class Backoff:
+    """Capped exponential backoff.  ``rng`` may be seeded for deterministic
+    chaos tests; jitter multiplies each delay by ``1 ± jitter``."""
+
+    def __init__(
+        self,
+        initial: float = 0.05,
+        factor: float = 2.0,
+        max_delay: float = 2.0,
+        jitter: float = 0.2,
+        rng: random.Random | None = None,
+    ):
+        self.initial = initial
+        self.factor = factor
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.attempts = 0
+        self._rng = rng or random.Random()
+
+    @classmethod
+    def from_env(cls, prefix: str, **defaults) -> "Backoff":
+        """Read ``{prefix}_BACKOFF_S`` / ``{prefix}_BACKOFF_MAX_S`` env
+        overrides on top of ``defaults``."""
+        initial = os.environ.get(f"{prefix}_BACKOFF_S")
+        max_delay = os.environ.get(f"{prefix}_BACKOFF_MAX_S")
+        if initial is not None:
+            defaults["initial"] = float(initial)
+        if max_delay is not None:
+            defaults["max_delay"] = float(max_delay)
+        return cls(**defaults)
+
+    def next(self) -> float:
+        delay = min(self.initial * (self.factor ** self.attempts), self.max_delay)
+        self.attempts += 1
+        if self.jitter:
+            delay *= 1.0 + self._rng.uniform(-self.jitter, self.jitter)
+        return max(delay, 0.0)
+
+    def reset(self) -> None:
+        self.attempts = 0

@@ -21,7 +21,9 @@ import msgpack
 
 from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.observability.trace import stamp_trace
+from dynamo_tpu.robustness import counters
 from dynamo_tpu.runtime.component import Endpoint, Instance, instances_prefix
+from dynamo_tpu.runtime.dataplane import PendingStream
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
@@ -126,6 +128,32 @@ class InstanceNotFound(RuntimeError):
     lease-reaped between scheduling and dispatch)."""
 
 
+# Remote engine errors arrive as RuntimeError("remote engine error: <repr>")
+# (dataplane error frames) — the repr is all we have to distinguish a dead
+# worker from a request its engine deterministically rejects.
+_TRANSIENT_STREAM_MARKERS = (
+    "connection lost",          # transport died mid-stream (no error frame)
+    "worker shutting down",     # drain raced the dispatch
+    "ConnectionError",          # worker-side transport/injected failures,
+    "ConnectionResetError",     # surfaced through the error frame's repr
+    "BrokenPipeError",
+    "TimeoutError",
+    "OSError",
+)
+
+
+def _is_transient_stream_error(exc: BaseException) -> bool:
+    """True for stream failures where re-dispatching can help (worker died,
+    transport broke).  A deterministic application error (bad prompt,
+    guided-decoding rejection) would fail identically on every peer —
+    retrying it burns duplicate work and, worse, quarantines healthy
+    workers over a poison request."""
+    if isinstance(exc, (ConnectionError, OSError, asyncio.TimeoutError)):
+        return True
+    message = str(exc)
+    return any(marker in message for marker in _TRANSIENT_STREAM_MARKERS)
+
+
 class PushRouter:
     """Routes requests to instances and returns the response stream."""
 
@@ -203,7 +231,84 @@ class PushRouter:
         surface a timeout to the caller while healthy peers sit idle.
         Safe because nothing has streamed before the rendezvous completes.
         Direct routing (explicit ``instance_id``) never fails over.
+
+        After the rendezvous, a stream that fails BEFORE its first item is
+        re-dispatched to another healthy instance (up to ``DYN_RETRY_MAX``
+        times, counted in ``dyn_retries_total`` and visible as a
+        ``dispatch.retry`` span).  First-token is the retry boundary: with
+        zero items delivered the request provably had no observable effect
+        on the client, so re-running it cannot duplicate output; once
+        anything has streamed, the error surfaces as a clean truncation
+        error instead.
         """
+        tried: set[int] = set()
+        pending, inst_id = await self._rendezvous(request, instance_id, tried)
+        retry_max = int(os.environ.get("DYN_RETRY_MAX", "1"))
+        if instance_id is not None or retry_max <= 0:
+            # direct routing keeps affinity decisions with the scheduler
+            # (KV router does its own reschedule-excluding-failed failover)
+            return ResponseStream(pending, request.ctx)
+        return ResponseStream(
+            self._stream_with_retry(request, pending, inst_id, tried, retry_max),
+            request.ctx,
+        )
+
+    async def _stream_with_retry(
+        self, request: Context[dict], pending, inst_id: int, tried: set[int],
+        retry_max: int,
+    ):
+        retries = 0
+        while True:
+            streamed_any = False
+            try:
+                async for item in pending:
+                    streamed_any = True
+                    yield item
+                return
+            except Exception as exc:  # noqa: BLE001 — retry decision below
+                if (
+                    streamed_any
+                    or retries >= retry_max
+                    or request.ctx.is_killed
+                    or not _is_transient_stream_error(exc)
+                ):
+                    raise
+                retries += 1
+                counters.incr("dyn_retries_total")
+                tried.add(inst_id)
+                self.quarantine(inst_id)
+                logger.warning(
+                    "stream from instance %x failed pre-first-token (%s); "
+                    "re-dispatching (retry %d/%d)",
+                    inst_id, exc, retries, retry_max,
+                )
+                span = get_recorder().start(
+                    "dispatch.retry", getattr(request.ctx, "trace", None),
+                    component="frontend",
+                    attrs={
+                        "failed_instance": f"{inst_id:x}",
+                        "attempt": retries,
+                        "error": repr(exc),
+                    },
+                )
+                try:
+                    pending, inst_id = await self._rendezvous(request, None, tried)
+                except BaseException as redispatch_exc:
+                    if span is not None:
+                        span.end(status="error", error=repr(redispatch_exc))
+                    # surface the original stream failure; the re-dispatch
+                    # failure (usually "no instances left") rides as cause
+                    raise exc from redispatch_exc
+                if span is not None:
+                    span.end(instance=f"{inst_id:x}")
+
+    async def _rendezvous(
+        self, request: Context[dict], instance_id: int | None, tried: set[int]
+    ) -> "tuple[PendingStream, int]":
+        """One dispatch: pick an instance, publish the envelope, await the
+        worker's connect-back.  Fails over across instances (``tried`` is
+        shared with the caller's retry policy so a retry never lands on an
+        instance this request already burned)."""
         runtime = self.client.runtime
         server = await runtime.data_server()
         ctx = request.ctx
@@ -226,8 +331,10 @@ class PushRouter:
             os.environ.get("DYN_RENDEZVOUS_BUDGET_S", "0")
         ) or 3.0 * connect_timeout
         t_start = time.monotonic()
-        tried: set[int] = set()
         last_err: Exception | None = None
+        dark_started: dict[int, float] = {}  # instance -> first dark publish
+        dark_count = 0
+        empty_since: float | None = None  # first empty-instance-view pick
         while True:
             remaining = budget - (time.monotonic() - t_start)
             if remaining <= 0 and last_err is not None:
@@ -238,7 +345,22 @@ class PushRouter:
                 break
             # bounded by exclusion, not a count: every live instance gets
             # one shot (3 dark + 2 healthy must reach the healthy ones)
-            inst = self._pick(instance_id, exclude=tried)
+            try:
+                inst = self._pick(instance_id, exclude=tried)
+                empty_since = None
+            except InstanceNotFound:
+                raise  # pinned dispatch: let KV routing reschedule at once
+            except RuntimeError as exc:
+                # EMPTY instance view — can be transient: a control-plane
+                # resync replays synthetic deletes before the workers'
+                # re-registrations land (observed driving a real 3-process
+                # dynctl restart).  Wait it out briefly before giving up.
+                now = time.monotonic()
+                empty_since = empty_since if empty_since is not None else now
+                if now - empty_since >= dark_probe_timeout or remaining <= 0:
+                    raise last_err or exc
+                await asyncio.sleep(0.2)
+                continue
             if inst is None:
                 break
             # expiry-aware: an EXPIRED quarantine entry must not demote a
@@ -275,10 +397,45 @@ class PushRouter:
             try:
                 # the trace also stamps the control-plane transport frame
                 # (remote planes), so dynctl can attribute publish failures
-                await runtime.plane.bus.publish(
+                delivered = await runtime.plane.bus.publish(
                     inst.subject, envelope,
                     trace=dispatch.ctx if dispatch is not None else None,
                 )
+                if delivered == 0:
+                    # nobody received the envelope: the worker is dead (its
+                    # lease will reap shortly and the watch prunes it) or
+                    # mid-resubscribe after a control-plane reconnect.
+                    # Re-publish soon instead of burning the full rendezvous
+                    # timeout waiting for a connect-back that cannot come —
+                    # found by driving a real multi-process dynctl restart.
+                    server.unregister(stream_id)
+                    if dispatch is not None:
+                        dispatch.end(status="error", error="subject dark (no subscriber)")
+                    last_err = TimeoutError(
+                        f"no subscriber on {inst.subject} — worker dead, or "
+                        "mid-resubscribe after a control-plane reconnect"
+                    )
+                    now = time.monotonic()
+                    already_quarantined = self._dark.get(inst.instance_id, 0.0) > now
+                    first_dark = dark_started.setdefault(inst.instance_id, now)
+                    if already_quarantined or now - first_dark >= dark_probe_timeout:
+                        # confirmed-dead subject (it was already suspect, or
+                        # stayed dark past the probe window): same remedy as
+                        # a rendezvous timeout — quarantine and fail over;
+                        # pinned dispatch raises so KV routing reschedules
+                        tried.add(inst.instance_id)
+                        self.quarantine(inst.instance_id)
+                        if instance_id is not None:
+                            raise last_err from None
+                        logger.warning("%s; failing over", last_err)
+                        continue
+                    # freshly dark: likely a resubscribe gap, not a death —
+                    # re-publish within the probe window
+                    dark_count += 1
+                    if dark_count in (1, 2) or dark_count % 8 == 0:
+                        logger.warning("%s; re-publishing", last_err)
+                    await asyncio.sleep(0.25)
+                    continue
                 # rendezvous: wait for the worker to connect back before
                 # returning the stream (the reference awaits the prologue)
                 await asyncio.wait_for(pending.connected.wait(), timeout=attempt_timeout)
@@ -289,7 +446,7 @@ class PushRouter:
                     # live — failing over here would run the request twice
                     self._dark.pop(inst.instance_id, None)
                     self._end_dispatch(dispatch, pending)
-                    return ResponseStream(pending, ctx)
+                    return pending, inst.instance_id
                 server.unregister(stream_id)
                 if dispatch is not None:
                     dispatch.end(status="error", error="rendezvous timeout")
@@ -309,6 +466,20 @@ class PushRouter:
                     raise last_err from None
                 logger.warning("%s; failing over", last_err)
                 continue
+            except ConnectionError as exc:
+                # control-plane blip mid-publish: not the instance's fault.
+                # Don't burn it from this request's candidate set — back off
+                # briefly (the plane client is reconnecting underneath) and
+                # re-dispatch; the rendezvous budget bounds the healing wait
+                server.unregister(stream_id)
+                if dispatch is not None:
+                    dispatch.end(status="error", error=repr(exc))
+                last_err = exc
+                if instance_id is not None:
+                    raise
+                logger.warning("publish to %s failed (%s); retrying dispatch", inst.subject, exc)
+                await asyncio.sleep(0.1)
+                continue
             except BaseException as exc:
                 # includes caller cancellation mid-rendezvous: the pending
                 # registration must not leak (a later connect-back to an
@@ -322,8 +493,14 @@ class PushRouter:
             # overload blip must not idle a recovered worker for the TTL
             self._dark.pop(inst.instance_id, None)
             self._end_dispatch(dispatch, pending)
-            return ResponseStream(pending, ctx)
-        assert last_err is not None
+            return pending, inst.instance_id
+        if last_err is None:
+            # every live instance is already in ``tried`` (the pre-first-
+            # token retry path re-enters with the failed set pre-populated)
+            raise RuntimeError(
+                f"no instances left to dispatch {self.client.endpoint.path} "
+                f"({len(tried)} already failed this request)"
+            )
         raise last_err
 
     @staticmethod

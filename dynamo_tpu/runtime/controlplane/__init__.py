@@ -15,6 +15,8 @@ Backends:
 - ``memory://``    — in-process singletons (static/dev mode and tests).
 - ``host:port``    — msgpack-RPC TCP client to a ``dynctl`` server process
   (the distributed mode; see ``dynamo_tpu.runtime.controlplane.server``).
+  Self-healing by default: lost connections reconnect with backoff and
+  resync leases/watches/subscriptions (docs/robustness.md).
 """
 
 from dynamo_tpu.runtime.controlplane.interface import (

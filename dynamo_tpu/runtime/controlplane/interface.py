@@ -216,8 +216,14 @@ class MessageBus(ABC):
     @abstractmethod
     async def publish(
         self, subject: str, payload: bytes, reply_to: str | None = None, trace=None
-    ) -> None:
-        """``trace``: optional TraceContext stamped on the transport frame
+    ) -> int | None:
+        """Returns the number of subscribers the message reached, or None
+        when the backend cannot tell (e.g. an older dynctl server).  A hard
+        0 lets publishers detect a dark subject — a worker mid-resubscribe
+        after a control-plane reconnect, or dead — and re-publish instead
+        of waiting out a rendezvous timeout on a message nobody received.
+
+        ``trace``: optional TraceContext stamped on the transport frame
         by remote implementations (request-scoped publishes only); purely
         advisory — delivery semantics never depend on it."""
         ...

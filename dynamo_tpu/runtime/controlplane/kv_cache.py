@@ -53,6 +53,11 @@ class KvWatchCache:
     async def _pump(self) -> None:
         assert self._watch is not None
         try:
+            # against a self-healing remote plane this loop survives
+            # connection loss transparently: the watch resyncs (snapshot
+            # PUTs + synthetic DELETEs) and the view converges — `stale`
+            # only trips on a TERMINAL watch death (reconnect disabled,
+            # plane closed, or a memory-backend watch cancelled externally)
             async for event in self._watch:
                 key = event.entry.key
                 if not key.startswith(self.prefix):
@@ -67,9 +72,8 @@ class KvWatchCache:
         except ConnectionError:
             pass  # handled below: the finally marks the view stale
         finally:
-            # watch ended (connection lost / server close / cancel): the
-            # view stops updating — flag it and wake any waiters so callers
-            # never block forever on a dead cache
+            # watch ended for good: the view stops updating — flag it and
+            # wake any waiters so callers never block forever on a dead cache
             if not self._closing:
                 self._stale = True
                 logger.warning(

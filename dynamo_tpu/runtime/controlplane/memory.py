@@ -151,10 +151,11 @@ class MemoryBus(MessageBus):
 
     async def publish(
         self, subject: str, payload: bytes, reply_to: str | None = None, trace=None
-    ) -> None:
+    ) -> int:
         # trace: accepted for interface parity; in-process delivery needs no
         # frame-level correlation (the request envelope already carries it)
         msg = Message(subject=subject, payload=payload, reply_to=reply_to)
+        delivered = 0
         # group -> matching members; None-group members all get a copy
         grouped: dict[str, list[Subscription]] = defaultdict(list)
         for pattern, group, sub in list(self._subs):
@@ -162,12 +163,15 @@ class MemoryBus(MessageBus):
                 continue
             if group is None:
                 sub._deliver(msg)
+                delivered += 1
             else:
                 grouped[f"{pattern}|{group}"].append(sub)
         for key, members in grouped.items():
             idx = self._rr[(key, "")] % len(members)
             self._rr[(key, "")] += 1
             members[idx]._deliver(msg)
+            delivered += 1
+        return delivered
 
     async def subscribe(self, subject: str, queue_group: str | None = None) -> Subscription:
         sub = Subscription(subject)

@@ -33,6 +33,7 @@ class ControlPlaneServer:
         self.state = MemoryControlPlane()
         self._server: asyncio.Server | None = None
         self._stream_ids = itertools.count(1)
+        self._client_writers: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
@@ -49,8 +50,15 @@ class ControlPlaneServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        # drop established client connections too: stop() must look like a
+        # dead server to clients (their reconnect logic depends on seeing
+        # EOF), not like a server that merely stopped accepting
+        for writer in list(self._client_writers):
+            writer.close()
+        self._client_writers.clear()
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._client_writers.add(writer)
         # per-connection resources torn down on disconnect
         watches: dict[int, Watch] = {}
         subs: dict[int, Subscription] = {}
@@ -126,8 +134,9 @@ class ControlPlaneServer:
                     watch.cancel()
                 return True
             if method == "bus.publish":
-                await bus.publish(args[0], args[1], args[2])
-                return True
+                # subscriber count, so remote publishers can detect a dark
+                # subject (worker mid-resubscribe) and re-publish
+                return await bus.publish(args[0], args[1], args[2])
             if method == "bus.subscribe":
                 stream_id = next(self._stream_ids)
                 sub = await bus.subscribe(args[0], args[1])
@@ -187,6 +196,7 @@ class ControlPlaneServer:
                 # connection; every request runs as its own task.
                 asyncio.ensure_future(handle_request(frame))
         finally:
+            self._client_writers.discard(writer)
             for watch in watches.values():
                 watch.cancel()
             for sub in subs.values():

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 
 import msgpack
 
+from dynamo_tpu.robustness.faults import DP_CONNECT, DP_SEND, FAULTS
 from dynamo_tpu.runtime.codec import (
     TwoPartMessage,
     attach_trace,
@@ -228,6 +229,9 @@ class ResponseStreamSender:
         self._control_task: asyncio.Task | None = None
 
     async def connect(self, attempts: int = 5) -> None:
+        # chaos seam: a worker that dies before dialing back (the frontend
+        # sees a rendezvous timeout and fails over)
+        FAULTS.check(DP_CONNECT, stream=self.info.stream_id)
         # bounded retry: under a connect burst the frontend's accept queue
         # can momentarily overflow and the kernel RSTs the dial; without a
         # retry that request is silently lost and the frontend waits out
@@ -272,6 +276,9 @@ class ResponseStreamSender:
                 return
 
     async def send(self, item: dict) -> None:
+        # chaos seam: a mid-stream write failure (worker killed while
+        # streaming; pre-first-token it is retried, after it truncates)
+        FAULTS.check(DP_SEND, stream=self.info.stream_id)
         assert self._writer is not None
         self._writer.write(
             encode_frame(

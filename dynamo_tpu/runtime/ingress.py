@@ -17,6 +17,7 @@ import msgpack
 
 from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.observability.trace import read_trace
+from dynamo_tpu.robustness.faults import FAULTS, WORKER_GENERATE
 from dynamo_tpu.runtime.component import Instance, instance_key, stats_subject
 from dynamo_tpu.runtime.dataplane import ConnectionInfo, ResponseStreamSender
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineContext
@@ -175,6 +176,10 @@ class EndpointService:
             self._request_done()
             return
         try:
+            # chaos seam: a worker failing before its engine produced
+            # anything — the error frame reaches the frontend pre-first-
+            # token, which re-dispatches to a healthy peer
+            FAULTS.check(WORKER_GENERATE, request=control["id"])
             stream = await self.engine.generate(Context(request, ctx))
             items = 0
             async for item in stream:

@@ -187,6 +187,7 @@ async def serve_frontend(
     port: int = 8080,
     router_mode: RouterMode = RouterMode.ROUND_ROBIN,
     request_template: str | Path | None = None,
+    admission=None,
 ) -> tuple[HttpService, ModelWatcher]:
     from dynamo_tpu.llm.request_template import RequestTemplate
 
@@ -195,7 +196,7 @@ async def serve_frontend(
     watcher = ModelWatcher(runtime, manager, router_mode=router_mode)
     service = HttpService(
         manager, host=host, port=port, request_template=template,
-        clear_kv=watcher.clear_kv_blocks,
+        clear_kv=watcher.clear_kv_blocks, admission=admission,
     )
     await watcher.start()
     await service.start()

@@ -27,7 +27,16 @@ import re
 import sys
 import urllib.request
 
-# frontend registry (dynamo_tpu/llm/http/metrics.py)
+# resilience counters (dynamo_tpu/robustness/counters.py): appended to the
+# frontend's /metrics body and mirrored as gauges by the metrics service
+RESILIENCE_FAMILIES = (
+    "dyn_cp_reconnects_total",
+    "dyn_retries_total",
+    "dyn_shed_total",
+    "dyn_faults_injected_total",
+)
+
+# frontend registry (dynamo_tpu/llm/http/metrics.py) + resilience counters
 FRONTEND_FAMILIES = (
     "dyn_llm_http_service_requests_total",
     "dyn_llm_http_service_inflight_requests",
@@ -36,7 +45,7 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-)
+) + RESILIENCE_FAMILIES
 
 # metrics service registry (dynamo_tpu/components/metrics_service.py)
 WORKER_FAMILIES = (
@@ -52,7 +61,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-)
+) + RESILIENCE_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 

@@ -1,0 +1,206 @@
+"""Control-plane death and restart: the client reconnects, re-grants
+leases, re-puts lease-attached keys, and resyncs watches — so discovery,
+registration, and serving survive a dynctl restart that loses ALL server
+state (the hardest variant; a mere connection blip keeps state and is
+strictly easier)."""
+
+import asyncio
+import socket
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime.controlplane.client import RemoteControlPlane
+from dynamo_tpu.runtime.controlplane.interface import WatchEventType
+from dynamo_tpu.runtime.controlplane.server import ControlPlaneServer
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.serve import serve_frontend, serve_worker
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def wait_for(predicate, timeout=10.0, what="condition"):
+    for _ in range(int(timeout / 0.05)):
+        if predicate():
+            return
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f"{what} not reached within {timeout}s")
+
+
+async def test_lease_and_keys_survive_server_restart():
+    """A lease-attached key re-appears on the fresh server after restart
+    (re-grant + re-put), and the new lease keeps being kept alive."""
+    port = free_port()
+    server = ControlPlaneServer(port=port)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", port)
+    await plane.connect()
+    try:
+        lease = await plane.kv.grant_lease(0.5)
+        await plane.kv.put("inst/worker-1", b"alive", lease_id=lease.id)
+
+        await server.stop()
+        await asyncio.sleep(0.3)
+        server = ControlPlaneServer(port=port)  # fresh state machine
+        await server.start()
+
+        await wait_for(lambda: plane.reconnects_total >= 1, what="reconnect")
+        assert counters.get("dyn_cp_reconnects_total") >= 1
+        # the key was re-put under a re-granted lease on the NEW server
+        entry = await plane.kv.get("inst/worker-1")
+        assert entry is not None and entry.value == b"alive"
+        assert not lease.revoked
+        # keep-alive works against the re-granted lease: the key outlives
+        # several TTLs
+        await asyncio.sleep(1.5)
+        entry = await plane.kv.get("inst/worker-1")
+        assert entry is not None, "re-granted lease was not kept alive"
+        assert not lease.revoked
+    finally:
+        await plane.close()
+        await server.stop()
+
+
+async def test_watch_resyncs_with_synthetic_deletes_after_restart():
+    """A consumer's Watch handle survives a restart: keys that vanished
+    with the server's state come through as synthetic DELETEs (carrying
+    their last-known value), and fresh PUTs flow afterwards."""
+    port = free_port()
+    server = ControlPlaneServer(port=port)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", port)
+    await plane.connect()
+    try:
+        # ephemeral key (no lease → not re-put on resync) + a lease-attached
+        # one (re-put on resync, so it must NOT be reported deleted)
+        await plane.kv.put("w/ephemeral", b"gone-after-restart")
+        lease = await plane.kv.grant_lease(5.0)
+        await plane.kv.put("w/durable", b"re-put", lease_id=lease.id)
+
+        watch = plane.kv.watch_prefix("w/")
+        events = []
+
+        async def consume():
+            async for ev in watch:
+                events.append(ev)
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.wait_for(watch.ready(), 5)
+        assert {e.entry.key for e in events} == {"w/ephemeral", "w/durable"}
+
+        await server.stop()
+        await asyncio.sleep(0.2)
+        server = ControlPlaneServer(port=port)
+        await server.start()
+        await wait_for(lambda: plane.reconnects_total >= 1, what="reconnect")
+
+        # the ephemeral key died with the server: consumers see a DELETE
+        # with its last value, not a silent disappearance
+        await wait_for(
+            lambda: any(
+                e.type == WatchEventType.DELETE and e.entry.key == "w/ephemeral"
+                for e in events
+            ),
+            what="synthetic delete",
+        )
+        deleted = [e for e in events if e.type == WatchEventType.DELETE]
+        assert deleted[0].entry.value == b"gone-after-restart"
+        assert not any(
+            e.type == WatchEventType.DELETE and e.entry.key == "w/durable"
+            for e in events
+        ), "lease-attached key must survive the resync"
+
+        # the healed watch keeps delivering live events
+        await plane.kv.put("w/after", b"new")
+        await wait_for(
+            lambda: any(e.entry.key == "w/after" for e in events),
+            what="post-restart put",
+        )
+        watch.cancel()
+        await asyncio.wait_for(task, 5)
+    finally:
+        await plane.close()
+        await server.stop()
+
+
+async def test_serve_stack_survives_controlplane_restart():
+    """End-to-end: worker + frontend keep serving across a dynctl restart —
+    the worker re-registers (instances AND model entries re-put under its
+    re-granted lease), its bus subscription resubscribes, and requests flow
+    again; the model never 404s for long."""
+    port = free_port()
+    server = ControlPlaneServer(port=port)
+    await server.start()
+    runtime = await DistributedRuntime.create(
+        RuntimeConfig(control_plane=f"127.0.0.1:{port}")
+    )
+    worker = service = watcher = None
+    try:
+        worker = await serve_worker(runtime, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        service, watcher = await serve_frontend(runtime, host="127.0.0.1", port=0)
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            body = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "before restart"}],
+            }
+            for _ in range(100):
+                r = await client.get("/v1/models")
+                if r.json().get("data"):
+                    break
+                await asyncio.sleep(0.1)
+            r = await client.post("/v1/chat/completions", json=body, timeout=30)
+            assert r.status_code == 200
+
+            await server.stop()
+            await asyncio.sleep(0.3)
+            server = ControlPlaneServer(port=port)
+            await server.start()
+            await wait_for(
+                lambda: runtime.plane.reconnects_total >= 1, what="reconnect"
+            )
+
+            # worker re-registered on the fresh server (lease re-grant
+            # re-put both its instance key and its model entry)
+            from dynamo_tpu.llm.discovery import MODELS_PREFIX
+
+            entries = await runtime.plane.kv.get_prefix(MODELS_PREFIX)
+            assert entries, "model registration vanished after restart"
+
+            # requests keep flowing end-to-end
+            body["messages"][0]["content"] = "after restart"
+            r = await client.post("/v1/chat/completions", json=body, timeout=30)
+            assert r.status_code == 200
+            assert "after restart" in r.json()["choices"][0]["message"]["content"]
+            assert counters.get("dyn_cp_reconnects_total") >= 1
+    finally:
+        if watcher:
+            await watcher.stop()
+        if service:
+            await service.stop()
+        if worker:
+            await worker.shutdown()
+        await runtime.close()
+        await server.stop()

@@ -1,0 +1,240 @@
+"""Worker death relative to the first token — the retry-safety boundary:
+
+- pre-first-token: the frontend re-dispatches to a healthy instance and the
+  client sees plain success (zero items streamed ⇒ re-running provably
+  cannot duplicate output);
+- post-first-token: the client sees a clean truncation error, never a hang
+  and never a silent fake finish.
+"""
+
+import asyncio
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.serve import serve_frontend, serve_worker
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+async def make_stack(n_workers: int):
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://chaos-retry"))
+    workers = [
+        await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        for _ in range(n_workers)
+    ]
+    service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+    return rt, workers, service, watcher
+
+
+async def teardown(rt, workers, service, watcher):
+    await watcher.stop()
+    await service.stop()
+    for w in workers:
+        await w.shutdown()
+    await rt.close()
+
+
+async def wait_for_model(client, name="tiny", timeout=10.0):
+    for _ in range(int(timeout / 0.1)):
+        r = await client.get("/v1/models")
+        if name in [m["id"] for m in r.json().get("data", [])]:
+            return
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"model {name} never appeared")
+
+
+async def test_worker_fails_pre_first_token_frontend_retries():
+    """The engine handoff dies on one worker; the request lands on the
+    other and the client never learns anything went wrong."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            FAULTS.arm("worker.generate:once")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "retry me"}],
+                },
+                timeout=30,
+            )
+            assert r.status_code == 200
+            assert "retry me" in r.json()["choices"][0]["message"]["content"]
+            assert counters.get("dyn_retries_total") == 1
+            assert FAULTS.fired.get("worker.generate") == 1
+            # the retry is visible on the scrape surface
+            m = await client.get("/metrics")
+            assert "dyn_retries_total 1" in m.text
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_stream_dies_pre_first_token_frontend_retries():
+    """Same boundary, lower seam: the worker's FIRST data-plane write
+    fails (connect-back succeeded, zero items delivered) — still safely
+    retried."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            FAULTS.arm("dp.send:nth=1")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [{"role": "user", "content": "first write dies"}],
+                },
+                timeout=30,
+            )
+            assert r.status_code == 200
+            assert counters.get("dyn_retries_total") == 1
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_stream_dies_post_first_token_clean_truncation():
+    """After tokens have streamed, a worker death must surface as an error
+    — promptly (no hang) and explicitly (no fake finish)."""
+    rt, workers, service, watcher = await make_stack(1)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            # the 4th data-plane write of the stream fails: well past the
+            # first token for an echo response
+            FAULTS.arm("dp.send:nth=4")
+
+            from dynamo_tpu.llm.protocols.sse import SseDecoder
+
+            decoder = SseDecoder()
+            events = []
+            async with client.stream(
+                "POST",
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [
+                        {"role": "user", "content": "one two three four five six"}
+                    ],
+                    "stream": True,
+                },
+                timeout=30,
+            ) as r:
+                assert r.status_code == 200
+                async for chunk in r.aiter_bytes():
+                    for ev in decoder.feed(chunk):
+                        if ev["data"] and ev["data"] != "[DONE]":
+                            events.append(json.loads(ev["data"]))
+            saw_tokens = any(e.get("choices") for e in events)
+            errors = [e for e in events if "error" in e]
+            assert saw_tokens, "stream produced nothing before the fault"
+            assert errors, f"no error event surfaced: {events}"
+            assert errors[-1]["error"]["type"] == "internal_error"
+            # post-first-token is NOT retried
+            assert counters.get("dyn_retries_total") == 0
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_unary_post_first_token_is_500_not_hang():
+    rt, workers, service, watcher = await make_stack(1)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            FAULTS.arm("dp.send:nth=4")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [
+                        {"role": "user", "content": "one two three four five six"}
+                    ],
+                },
+                timeout=30,
+            )
+            assert r.status_code == 500
+            assert "error" in r.json()
+            assert counters.get("dyn_retries_total") == 0
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_deterministic_engine_error_is_not_retried():
+    """A request the engine rejects deterministically (RuntimeError, not a
+    transport failure) must NOT be re-dispatched: it would fail identically
+    on every peer while quarantining healthy workers over a poison
+    request."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            # a non-transport failure at the engine handoff
+            FAULTS.arm("worker.generate:once:exc=RuntimeError")
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "x"}]},
+                timeout=30,
+            )
+            assert r.status_code == 500
+            assert counters.get("dyn_retries_total") == 0
+            # the healthy fleet is untouched: the next request succeeds on
+            # a full-speed (non-quarantined) dispatch
+            router = watcher._pipelines["tiny"]["router"]
+            assert router.dark_instances() == set()
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "y"}]},
+                timeout=30,
+            )
+            assert r.status_code == 200
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_retry_exhaustion_surfaces_original_error():
+    """With every instance failing pre-first-token, the retry budget runs
+    out and the original stream failure surfaces (a 500, not a hang)."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            FAULTS.arm("worker.generate:every=1")  # every dispatch fails
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny", "messages": [{"role": "user", "content": "x"}]},
+                timeout=30,
+            )
+            assert r.status_code == 500
+            assert counters.get("dyn_retries_total") == 1  # budget spent
+    finally:
+        await teardown(rt, workers, service, watcher)

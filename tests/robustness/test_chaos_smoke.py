@@ -1,0 +1,28 @@
+"""scripts/chaos_smoke.py under tier-1: the CI chaos gate runs in-process
+(same pattern as tests/llm/test_check_metrics.py) so the canned
+kill-the-control-plane + kill-a-stream schedule is exercised on every run."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent / "scripts"))
+
+from dynamo_tpu.robustness import counters  # noqa: E402
+from dynamo_tpu.robustness.faults import FAULTS  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+async def test_chaos_smoke_passes():
+    from chaos_smoke import amain
+
+    assert await amain(requests=6, burst=12) == 0

@@ -1,0 +1,92 @@
+"""Fault-injection registry: trigger grammar, determinism, thread safety
+of the no-fault fast path (unarmed checks must be free)."""
+
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FaultRegistry, parse_faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+def test_parse_grammar():
+    specs = parse_faults("cp.recv:once;dp.send:nth=3:exc=RuntimeError; engine.step:prob=0.5:seed=7")
+    assert [s.point for s in specs] == ["cp.recv", "dp.send", "engine.step"]
+    assert specs[0].nth == 1
+    assert specs[1].nth == 3 and specs[1].exc_type is RuntimeError
+    assert specs[2].prob == 0.5
+    # commas work as separators too (env-var ergonomics)
+    assert len(parse_faults("a.b:once,c.d:every=2")) == 2
+    for bad in ("justapoint", "p:unknowntrigger", "p:nth=0", "p:once:times"):
+        with pytest.raises(ValueError):
+            parse_faults(bad)
+
+
+def test_once_fires_exactly_once():
+    reg = FaultRegistry()
+    reg.arm("seam.x:once")
+    with pytest.raises(ConnectionError, match="injected fault at seam.x"):
+        reg.check("seam.x")
+    for _ in range(5):
+        reg.check("seam.x")  # disarmed
+    assert reg.fired["seam.x"] == 1
+    assert not reg.armed  # spent specs are pruned entirely
+    assert counters.get("dyn_faults_injected_total") == 1
+
+
+def test_nth_fires_on_exactly_the_nth_check():
+    reg = FaultRegistry()
+    reg.arm("seam.x:nth=3:exc=RuntimeError")
+    reg.check("seam.x")
+    reg.check("seam.x")
+    with pytest.raises(RuntimeError):
+        reg.check("seam.x")
+    reg.check("seam.x")  # spent
+    assert reg.fired["seam.x"] == 1
+
+
+def test_every_fires_periodically_and_times_caps():
+    reg = FaultRegistry()
+    reg.arm("seam.x:every=2:times=2")
+    fired = 0
+    for _ in range(10):
+        try:
+            reg.check("seam.x")
+        except ConnectionError:
+            fired += 1
+    assert fired == 2  # checks 2 and 4; times=2 caps the rest
+
+
+def test_prob_is_deterministic_for_a_seed():
+    def run() -> list[int]:
+        reg = FaultRegistry()
+        reg.arm("seam.x:prob=0.5:seed=42")
+        hits = []
+        for i in range(20):
+            try:
+                reg.check("seam.x")
+            except ConnectionError:
+                hits.append(i)
+        return hits
+
+    first, second = run(), run()
+    assert first == second and 0 < len(first) < 20
+
+
+def test_unknown_point_is_noop_and_reset_disarms():
+    reg = FaultRegistry()
+    reg.check("never.armed")
+    reg.arm("seam.x:once")
+    reg.reset()
+    reg.check("seam.x")  # disarmed by reset
+    assert reg.fired == {}
+
+
+def test_unarmed_registry_has_no_specs():
+    reg = FaultRegistry()
+    assert not reg.armed

@@ -171,6 +171,29 @@ async def test_bus_pubsub_and_queue_groups(plane_factory):
         await teardown(plane, server)
 
 
+async def test_publish_reports_delivered_subscriber_count(plane_factory):
+    """publish() returns how many subscribers the message reached: a hard
+    0 is the frontend's signal that a worker's subject is dark (dead or
+    mid-resubscribe after a control-plane reconnect) and the envelope must
+    be re-published rather than waited on."""
+    plane, server = await make_plane(plane_factory)
+    try:
+        assert await plane.bus.publish("nobody.home", b"x") == 0
+        sub = await plane.bus.subscribe("somebody.home")
+        await asyncio.sleep(0.02)
+        assert await plane.bus.publish("somebody.home", b"x") == 1
+        # queue groups count as one delivery per group
+        g1 = await plane.bus.subscribe("grp.subj", queue_group="g")
+        g2 = await plane.bus.subscribe("grp.subj", queue_group="g")
+        await asyncio.sleep(0.02)
+        assert await plane.bus.publish("grp.subj", b"x") == 1
+        await sub.unsubscribe()
+        await g1.unsubscribe()
+        await g2.unsubscribe()
+    finally:
+        await teardown(plane, server)
+
+
 async def test_bus_request_reply(plane_factory):
     plane, server = await make_plane(plane_factory)
     try:
@@ -330,10 +353,12 @@ async def test_kv_watch_cache_goes_stale_on_watch_death(plane_factory):
 async def test_watch_ready_fails_fast_on_dead_connection():
     """A watch started over a broken connection must surface the error to
     ``ready()`` waiters and iterators instead of hanging forever (the
-    Client.start startup-hang defect)."""
+    Client.start startup-hang defect).  Fail-fast semantics are pinned with
+    ``reconnect=False``; the default self-heals instead (covered in
+    tests/robustness/)."""
     server = ControlPlaneServer(port=0)
     await server.start()
-    plane = RemoteControlPlane("127.0.0.1", server.port)
+    plane = RemoteControlPlane("127.0.0.1", server.port, reconnect=False)
     await plane.connect()
     try:
         # sever the transport under the client, then start a watch
@@ -351,8 +376,29 @@ async def test_watch_ready_fails_fast_on_dead_connection():
 
 
 async def test_live_watch_fails_when_connection_drops():
-    """An established watch whose connection dies mid-stream raises to the
-    consumer instead of ending silently."""
+    """With reconnect disabled, an established watch whose connection dies
+    mid-stream raises to the consumer instead of ending silently."""
+    server = ControlPlaneServer(port=0)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", server.port, reconnect=False)
+    await plane.connect()
+    try:
+        await plane.kv.put("w/a", b"1")
+        watch = plane.kv.watch_prefix("w/")
+        first = await asyncio.wait_for(watch.__anext__(), timeout=10)
+        assert first.entry.key == "w/a"
+        plane._conn._writer.close()
+        with pytest.raises((ConnectionError, RuntimeError)):
+            await asyncio.wait_for(watch.__anext__(), timeout=10)
+    finally:
+        await plane.close()
+        await server.stop()
+
+
+async def test_live_watch_heals_when_connection_drops():
+    """Default (reconnect on): a dropped connection re-establishes the
+    watch transparently — the SAME Watch handle keeps yielding events that
+    happen after the outage, and the reconnect is counted."""
     server = ControlPlaneServer(port=0)
     await server.start()
     plane = RemoteControlPlane("127.0.0.1", server.port)
@@ -363,8 +409,21 @@ async def test_live_watch_fails_when_connection_drops():
         first = await asyncio.wait_for(watch.__anext__(), timeout=10)
         assert first.entry.key == "w/a"
         plane._conn._writer.close()
-        with pytest.raises((ConnectionError, RuntimeError)):
-            await asyncio.wait_for(watch.__anext__(), timeout=10)
+        # wait for the reconnect before writing, so the put is not racing
+        # the resync snapshot
+        for _ in range(200):
+            if plane.reconnects_total >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert plane.reconnects_total >= 1
+        await plane.kv.put("w/b", b"2")
+        seen = {}
+        while "w/b" not in seen:
+            ev = await asyncio.wait_for(watch.__anext__(), timeout=10)
+            if ev.type == WatchEventType.PUT:
+                seen[ev.entry.key] = ev.entry.value
+        assert seen["w/b"] == b"2"
+        watch.cancel()
     finally:
         await plane.close()
         await server.stop()
