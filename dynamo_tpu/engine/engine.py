@@ -211,6 +211,18 @@ class EngineConfig:
     speculative: str | None = None
     spec_tokens: int = 4
     spec_ngram: int = 2
+    # Overlapped decode pipeline: dispatch the next decode window with
+    # ON-DEVICE token feedback (step N+1's input tokens are step N's output
+    # array, never a host round-trip) and retire the previous window's
+    # results by asynchronous readback while the new one runs — the device
+    # never idles waiting on the host half of the loop (double buffering,
+    # in-flight depth 1).  The pipeline synchronizes wherever host state
+    # genuinely gates the device: batch-composition changes (prefill
+    # admission, finishes), preemption, aborts, speculative verify; guided
+    # and top_logprobs lanes fall back to the synchronous path per window
+    # (their per-token host processing cannot lag the device).  None =
+    # DYN_DECODE_OVERLAP env (default on; "0" disables).
+    decode_overlap: bool | None = None
     # Minimum fraction of running lanes that must have a draft for the
     # w-wide verify program to run; below it, plain decode serves the step.
     # Cost model (decode is weight-bandwidth-bound): one verify launch
@@ -241,6 +253,24 @@ _KV_DTYPE_NAMES = {
     "f16": "float16",
     "float16": "float16",
 }
+
+
+@dataclass
+class _InflightWindow:
+    """One dispatched-but-unretired decode window (the overlap pipeline's
+    in-flight slot).  Everything device-side stays a jax.Array until
+    ``_retire_window`` reads it back; ``feedback`` is the final-step token
+    array that seeds the NEXT window's input without a host round-trip."""
+    tokens: object            # [steps, lanes] (or [lanes] when steps == 1)
+    lps: object
+    feedback: object          # [lanes] last sampled token per lane
+    active: list              # sequences RUNNING at dispatch, lane order
+    lane_ids: list            # their lanes (composition fingerprint)
+    steps: int
+    # sequences whose finish was detected while THIS window was in flight:
+    # emitted already, but their lane/blocks are only released when this
+    # window retires (a lagged device step may still write into them)
+    deferred: list = field(default_factory=list)
 
 
 def resolve_kv_cache_dtype(spec):
@@ -547,6 +577,42 @@ class JaxLlmEngine:
         # against fresh host arrays every window (cheap), so there is no
         # invalidation bookkeeping to miss.
         self._tail_cache: tuple | None = None
+        # Overlapped decode pipeline (see EngineConfig.decode_overlap): the
+        # single in-flight window plus counters for stats()/A-B profiling.
+        env_overlap = os.environ.get("DYN_DECODE_OVERLAP")
+        if config.decode_overlap is not None:
+            self.decode_overlap = bool(config.decode_overlap)
+        elif env_overlap is not None:
+            self.decode_overlap = env_overlap.lower() not in ("0", "false", "off")
+        else:
+            self.decode_overlap = True
+        if self.decode_overlap and config.speculative:
+            # drafts are proposed from HOST token history; with windows in
+            # flight that history lags the device by a window, so drafts
+            # would be mispositioned and verify acceptance would collapse —
+            # while every drafting iteration also paid a pipeline drain.
+            # The verify program already fuses its own multi-token window;
+            # run speculative engines synchronous.
+            logger.info("decode overlap disabled: speculative decoding "
+                        "drafts from host token history")
+            self.decode_overlap = False
+        self._inflight: _InflightWindow | None = None
+        self._overlap_windows = 0   # windows dispatched with token feedback
+        self._sync_windows = 0      # windows served by the synchronous path
+        self._decode_steps_total = 0
+        # Per-lane block-table host rows, rewritten only for lanes whose
+        # block list changed since the last window; the device copy is
+        # reused untouched while every row is clean.  At steady-state
+        # decode a lane's table changes once per block_size tokens, so the
+        # (lanes × max_blocks_per_seq) rebuild+upload the old loop paid
+        # every step (decode.upload in the profile) collapses to nothing.
+        lanes_n = config.max_batch_size
+        self._bt_host = np.zeros((lanes_n, self.max_blocks_per_seq), np.int32)
+        self._bt_lane_key: list = [None] * lanes_n
+        self._bt_dev = None
+        # overlap windows carry no guided lanes (they fall back to sync):
+        # one resident all-unguided mode row, uploaded once
+        self._gmodes_unguided = None
         if self.mesh is not None:
             self._gen_counts = jax.device_put(gen_counts, repl)
             self._prompt_counts = jax.device_put(prompt_counts, repl)
@@ -941,13 +1007,17 @@ class JaxLlmEngine:
         lane_idx = jnp.arange(lanes)
 
         kwargs = {}
+        repl = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
             repl = NamedSharding(self.mesh, PartitionSpec())
-            kwargs["out_shardings"] = (repl, repl, repl, repl, self._cache_sharding, repl)
 
         if steps <= 1:
+            if repl is not None:
+                kwargs["out_shardings"] = (
+                    repl, repl, repl, repl, self._cache_sharding, repl
+                )
             def step(params, cache, gen_counts, prompt_counts, token_ids,
                      block_tables, context_lens, slot_ids, keys, temp, top_k,
                      top_p, greedy, pres, freq, rep, bias_ids, bias_vals,
@@ -1008,11 +1078,20 @@ class JaxLlmEngine:
                 lens = jnp.where(active, lens + 1, lens)
                 return (tokens, cache, gen_counts, lens), (tokens, lps, tk_vals, tk_ids)
 
-            (_, cache, gen_counts, _), (tokens_seq, lp_seq, tkv_seq, tki_seq) = jax.lax.scan(
+            (tokens_last, cache, gen_counts, _), (tokens_seq, lp_seq, tkv_seq, tki_seq) = jax.lax.scan(
                 body, (token_ids, cache, gen_counts, context_lens), None, length=steps
             )
-            return tokens_seq, lp_seq, tkv_seq, tki_seq, cache, gen_counts
+            # the carry tokens ride out as a dedicated output: the overlap
+            # pipeline feeds them straight back as the next window's input
+            # (one extra output handle beats a separate slice launch)
+            return tokens_seq, lp_seq, tkv_seq, tki_seq, tokens_last, cache, gen_counts
 
+        if repl is not None:
+            # one extra leading repl vs the single-step tuple: the
+            # dedicated feedback-tokens output
+            kwargs["out_shardings"] = (
+                repl, repl, repl, repl, repl, self._cache_sharding, repl
+            )
         return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
 
     def _build_verify(self):
@@ -1709,6 +1788,9 @@ class JaxLlmEngine:
             "prefix_cached_tokens_total": self.allocator.prefix_cached_tokens_total,
             "spec_drafted_tokens_total": self._spec_drafted,
             "spec_accepted_tokens_total": self._spec_accepted,
+            "decode_windows_overlapped_total": self._overlap_windows,
+            "decode_windows_sync_total": self._sync_windows,
+            "decode_steps_total": self._decode_steps_total,
             "guided_requests_total": self._guided_requests,
             "guided_completions_total": self._guided_completions,
             "num_preemptions_total": self.scheduler.preemptions_total,
@@ -1779,12 +1861,27 @@ class JaxLlmEngine:
                             except Exception as exc:  # noqa: BLE001
                                 if not self._attention_fallback(exc):
                                     raise
+                                # compile-class failure: the previously
+                                # dispatched window (old program) already
+                                # executed — retire it normally, then retry
+                                # this window against the rebuilt jits
+                                self._sync_pipeline()
                                 self._run_decode(decodes)
                     except Exception as exc:  # noqa: BLE001
                         logger.exception("decode step failed")
+                        # a poisoned in-flight window must not feed the next
+                        # dispatch (and _fail_sequence is about to free the
+                        # failing lanes' blocks)
+                        self._abandon_pipeline(decodes)
                         for seq in decodes:
                             if seq.status == SeqStatus.RUNNING:
                                 self._fail_sequence(seq, exc)
+                elif self._inflight is not None:
+                    # nothing decodable this iteration (every lane finished,
+                    # is prefilling, or was preempted) while a window is
+                    # still in flight: retire it so its tokens emit and
+                    # deferred finishes release their lanes/blocks
+                    self._sync_pipeline()
                 self._iterations += 1
                 self.step_telemetry.observe_step(
                     iteration=self._iterations,
@@ -1798,6 +1895,12 @@ class JaxLlmEngine:
                 # thread alive (callers would hang forever), don't hot-spin
                 logger.exception("engine step failed")
                 time.sleep(0.1)
+        # shutdown with a window in flight: retire it so already-computed
+        # tokens reach their streams instead of vanishing with the thread
+        try:
+            self._sync_pipeline()
+        except Exception:  # noqa: BLE001
+            logger.exception("pipeline drain at shutdown failed")
 
     def _attention_fallback(self, exc: BaseException) -> bool:
         """If the Pallas attention kernel is active and a step failed,
@@ -1934,6 +2037,13 @@ class JaxLlmEngine:
             if op == "add":
                 self.scheduler.add(seq)
             elif op == "abort":
+                if seq.status == SeqStatus.RUNNING:
+                    # abort frees the lane's blocks: drain the decode
+                    # pipeline first so no lagged in-flight step writes
+                    # into storage the allocator is about to reclaim.
+                    # (Only RUNNING lanes can be in a window — cancelling
+                    # a still-queued request must not stall the pipeline.)
+                    self._sync_pipeline()
                 if seq.status != SeqStatus.FINISHED:
                     self._record_decode_span(seq, status="cancelled")
                     self.scheduler.abort(seq)
@@ -1961,6 +2071,10 @@ class JaxLlmEngine:
                     done(None)
             elif op == "clear_kv":
                 done = seq  # payload is the completion callback
+                # admin flush: retire the in-flight window first so deferred
+                # finishes release their blocks before the count is judged
+                # (warmup asserts a clean pool right after this resolves)
+                self._sync_pipeline()
                 cleared = self.allocator.clear_published()
                 if self.host_tier is not None:
                     self.host_tier.clear()
@@ -2380,8 +2494,242 @@ class JaxLlmEngine:
             if n_drafting and n_drafting >= (
                 len(running) * self.config.spec_min_fraction
             ):
+                # verify consumes host-side drafts and its acceptance count
+                # gates emission per lane — inherently synchronous
+                self._sync_pipeline()
                 return self._run_verify_decode(seqs, drafts)
+        if self._overlap_ok(seqs):
+            return self._run_overlap_decode(seqs)
+        self._sync_pipeline()
         return self._run_plain_decode(seqs)
+
+    def _overlap_ok(self, seqs: list[Sequence]) -> bool:
+        """Overlap serves a window only when no active lane needs per-token
+        host state: guided lanes advance a host automaton that must gate the
+        NEXT sample (same reason guidance pins decode_steps=1), and
+        top_logprobs lanes ship K-wide rows whose readback belongs on the
+        synchronous path.  Mixed batches fall back whole — lane masks can't
+        split one jitted window."""
+        if not self.decode_overlap:
+            return False
+        for seq in seqs:
+            if seq.status != SeqStatus.RUNNING:
+                continue
+            if seq.guided is not None or seq.request.sampling.top_logprobs > 0:
+                return False
+        return True
+
+    def _sync_pipeline(self) -> None:
+        """Retire the in-flight window (if any): host state catches up with
+        the device before anything that needs it — preemption, aborts,
+        verify, the synchronous decode path, batch-composition changes."""
+        w = self._inflight
+        if w is None:
+            return
+        self._inflight = None
+        self._retire_window(w)
+
+    def _abandon_pipeline(self, seqs: list[Sequence]) -> None:
+        """Decode-step failure cleanup: drop the in-flight window without
+        retiring it (its arrays may be poisoned) and zero the in-flight
+        token accounting so a recovered loop rebuilds from host state.
+        Deferred finishes attached to the dropped window still release
+        their lanes/blocks — leaking them would starve a recovered engine."""
+        w = self._inflight
+        self._inflight = None
+        if w is not None:
+            # the dropped window's device program may still be EXECUTING
+            # (the failure that got us here can be a later dispatch): wait
+            # for it (errors swallowed — completion, not success, is what
+            # gates release) so freeing the deferred sequences' blocks
+            # cannot race its lagged writes into a new owner's storage
+            try:
+                jax.block_until_ready(w.tokens)
+            except Exception:  # noqa: BLE001 — a failed program still ended
+                pass
+            for seq in w.deferred:
+                self.scheduler.finish(seq)
+            for seq in w.active:
+                seq.inflight_tokens = 0
+        for seq in seqs:
+            seq.inflight_tokens = 0
+
+    def _retire_window(self, w: _InflightWindow) -> None:
+        """Readback + emission for one dispatched window.  Runs AFTER the
+        next window was dispatched (steady state), so the device computes
+        while the host blocks here — this wait is the new `decode.retire`
+        phase, replacing the old synchronous `decode.readback`."""
+        timing = self._phase_timing
+        t = time.perf_counter() if timing else 0.0
+        try:
+            tokens_host = np.asarray(w.tokens)
+            lps_host = np.asarray(w.lps)
+            if tokens_host.ndim == 1:
+                tokens_host = tokens_host[None, :]
+                lps_host = lps_host[None, :]
+            if timing:
+                t = self._phase("decode.retire", t)
+            for seq in w.active:
+                seq.inflight_tokens = max(0, seq.inflight_tokens - w.steps)
+            for s in range(tokens_host.shape[0]):
+                for seq in w.active:
+                    if seq.status != SeqStatus.RUNNING:
+                        continue  # finished at an earlier step in this window
+                    self._process_token(
+                        seq, int(tokens_host[s, seq.lane]),
+                        float(lps_host[s, seq.lane]),
+                    )
+        finally:
+            # sequences that finished while THIS window was in flight: their
+            # lagged garbage steps have now executed (or been masked), so
+            # the lane and blocks go back to the pools — even when the
+            # readback/emission above raised (this window is no longer
+            # reachable from self._inflight, so a skipped release here
+            # would leak the lane and blocks forever)
+            for seq in w.deferred:
+                self.scheduler.finish(seq)
+        if timing:
+            self._phase("decode.post", t)
+
+    def _finish_decoded(self, seq: Sequence) -> None:
+        """Finish a sequence from the decode path.  While an in-flight
+        window still references its lane the release is DEFERRED: freeing
+        the blocks now would let the lagged device step garbage-write into
+        storage the allocator may hand to (or prefix-match for) someone
+        else.  Emission already happened — only lane/block release waits."""
+        w = self._inflight
+        if w is not None and seq.lane in w.lane_ids:
+            seq.status = SeqStatus.FINISHED
+            w.deferred.append(seq)
+        else:
+            self.scheduler.finish(seq)
+
+    def _prep_decode_seq(self, seq: Sequence) -> None:
+        """Shared per-sequence bookkeeping at decode dispatch (every decode
+        path: overlap, plain, verify): lane sampling state for sequences
+        that skipped local prefill, and first-decode span/timestamping."""
+        if not seq.sampling_seeded:
+            # remotely-prefilled: entered decode without a local prefill
+            self._seed_lane_state(seq)
+        if seq.decode_start_ts == 0.0:
+            # covers remote-prefilled admission (no prefill pass)
+            self._maybe_record_queue_span(seq)
+            seq.decode_start_ts = time.time()
+
+    def _run_overlap_decode(self, seqs: list[Sequence]) -> None:
+        timing = self._phase_timing
+        t = time.perf_counter() if timing else 0.0
+        lanes = self.config.max_batch_size
+        steps = self.config.decode_steps
+        bs = self.config.block_size
+        oob = self.config.num_blocks * bs
+        prev = self._inflight
+
+        active = [s for s in seqs if s.status == SeqStatus.RUNNING]
+        if prev is not None:
+            # the feedback array only carries tokens for sequences that were
+            # in the previous window: a NEW sequence (fresh prefill, or a
+            # lane reused after a deferred release) forces a drain + host
+            # rebuild.  A SHRINKING batch keeps the pipeline hot — vacated
+            # lanes get context_len 0 below, which masks them to OOB slots
+            # on device (the lagged lane cannot write into freed blocks).
+            prev_members = set(map(id, prev.active))
+            if any(id(s) not in prev_members for s in active):
+                self._sync_pipeline()
+                prev = None
+                active = [s for s in active if s.status == SeqStatus.RUNNING]
+        if not active:
+            self._sync_pipeline()
+            return
+
+        # pre-extend every block table to cover the window at the DEVICE
+        # context (host context + dispatched-unretired tokens) — the one-step
+        # stop-condition lag means these positions may be written before the
+        # host learns whether the lane already finished.  No preemption here:
+        # a preemption would free blocks a lagged in-flight step still
+        # writes; on OOM the pipeline drains and the preempting synchronous
+        # path serves this iteration instead.
+        slots: dict[str, int] = {}
+        for seq in active:
+            # clamp at max_len: a lane the host is about to LENGTH-finish can
+            # have in-flight windows past the end — those steps are pure
+            # garbage (truncated at retire), and unclamped they would index
+            # past the block table the max_pos cap stops growing
+            dev_ctx = min(seq.context_len + seq.inflight_tokens, self.max_len)
+            slot = self.scheduler.try_slots_at(
+                seq, dev_ctx, steps, max_pos=self.max_len - 1
+            )
+            if slot is None:
+                self._sync_pipeline()
+                return self._run_plain_decode(seqs)
+            slots[seq.seq_id] = slot
+
+        context_lens = np.zeros((lanes,), np.int32)
+        slot_ids = np.full((lanes,), oob, np.int32)
+        token_ids = np.zeros((lanes,), np.int32) if prev is None else None
+        for seq in active:
+            self._prep_decode_seq(seq)
+            lane = seq.lane
+            context_lens[lane] = min(
+                seq.context_len + seq.inflight_tokens, self.max_len
+            )
+            if steps <= 1:
+                slot_ids[lane] = slots[seq.seq_id]
+            if token_ids is not None:
+                token_ids[lane] = seq.all_token_ids[-1]
+        tables = self._decode_tables(active)
+        if timing:
+            t = self._phase("decode.schedule", t)
+        sampling_tail = self._device_sampling_tail(active, lanes)
+        # token feedback: step N+1's input IS step N's on-device output —
+        # the host never sees (or waits for) the tokens it dispatches
+        tok_in = prev.feedback if prev is not None else jnp.asarray(token_ids)
+        lens_dev = jnp.asarray(context_lens)
+        if steps <= 1:
+            if self._gmodes_unguided is None:
+                self._gmodes_unguided = jnp.asarray(
+                    np.full((lanes,), -1, np.int32)
+                )
+            args = (
+                tok_in, tables, lens_dev, jnp.asarray(slot_ids),
+                *sampling_tail, self._guided_table, self._gmodes_unguided,
+            )
+            if timing:
+                t = self._phase("decode.upload", t)
+            tokens, lps, _tkvs, _tkis, self.cache, self._gen_counts = self._jit_decode(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                *args, self.cos, self.sin,
+            )
+            feedback = tokens
+            w_tokens, w_lps = tokens, lps
+        else:
+            args = (tok_in, tables, lens_dev, *sampling_tail)
+            if timing:
+                t = self._phase("decode.upload", t)
+            w_tokens, w_lps, _tkvs, _tkis, feedback, self.cache, self._gen_counts = self._jit_decode(
+                self.params, self.cache, self._gen_counts, self._prompt_counts,
+                *args, self.cos, self.sin,
+            )
+        if timing:
+            t = self._phase("decode.dispatch", t)
+        # start the device→host copies now; by the time this window is
+        # retired (one iteration from now) the transfer may already be done
+        for arr in (w_tokens, w_lps):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        for seq in active:
+            seq.inflight_tokens += steps
+        self._inflight = _InflightWindow(
+            tokens=w_tokens, lps=w_lps, feedback=feedback,
+            active=list(active), lane_ids=[s.lane for s in active],
+            steps=steps,
+        )
+        self._overlap_windows += 1
+        self._decode_steps_total += steps
+        if prev is not None:
+            self._retire_window(prev)
 
     def _device_sampling_tail(self, active: list[Sequence], lanes: int) -> tuple:
         """Device copies of (lane_keys, temp, top_k, top_p, greedy, pres,
@@ -2399,6 +2747,30 @@ class JaxLlmEngine:
         )
         return sampling_tail
 
+    def _decode_tables(self, active: list[Sequence]):
+        """Device block-table array for a decode window.  Host rows are
+        persistent and rewritten ONLY for lanes whose (sequence, block list)
+        changed since the last window; the device copy is reused while every
+        row is clean.  Stale rows for vacated lanes are harmless: inactive
+        lanes have context_len 0, so their slots mask to OOB and attention
+        reads nothing."""
+        dirty = self._bt_dev is None
+        for seq in active:
+            lane = seq.lane
+            blocks = self.allocator.block_ids(seq.seq_id)
+            key = self._bt_lane_key[lane]
+            if key is not None and key[0] == seq.seq_id and key[1] == blocks:
+                continue
+            row = self._bt_host[lane]
+            n = len(blocks)
+            row[:n] = blocks
+            row[n:] = 0
+            self._bt_lane_key[lane] = (seq.seq_id, blocks)
+            dirty = True
+        if dirty:
+            self._bt_dev = jnp.asarray(self._bt_host)
+        return self._bt_dev
+
     def _phase(self, name: str, t0: float) -> float:
         """Accumulate wall time since ``t0`` into ``phase_stats[name]`` and
         return a fresh timestamp (phase-timing mode only)."""
@@ -2414,7 +2786,6 @@ class JaxLlmEngine:
         lanes = self.config.max_batch_size
         steps = self.config.decode_steps
         token_ids = np.zeros((lanes,), np.int32)
-        block_tables = np.zeros((lanes, self.max_blocks_per_seq), np.int32)
         context_lens = np.zeros((lanes,), np.int32)
         oob = self.config.num_blocks * self.config.block_size
         slot_ids = np.full((lanes,), oob, np.int32)
@@ -2439,22 +2810,15 @@ class JaxLlmEngine:
         # (possibly re-allocated) blocks
         active = [s for s in candidates if s.status == SeqStatus.RUNNING]
         for seq in active:
-            if not seq.sampling_seeded:
-                # remotely-prefilled: entered decode without a local prefill
-                self._seed_lane_state(seq)
-            if seq.decode_start_ts == 0.0:
-                # covers remote-prefilled admission (no prefill pass)
-                self._maybe_record_queue_span(seq)
-                seq.decode_start_ts = time.time()
+            self._prep_decode_seq(seq)
             lane = seq.lane
             token_ids[lane] = seq.all_token_ids[-1]
-            blocks = self.allocator.block_ids(seq.seq_id)
-            block_tables[lane, : len(blocks)] = blocks
             context_lens[lane] = seq.context_len
             if steps <= 1:
                 slot_ids[lane] = slots[seq.seq_id]
         if not active:
             return
+        tables = self._decode_tables(active)
 
         want_top = any(
             seq.request.sampling.top_logprobs > 0 for seq in active
@@ -2468,7 +2832,7 @@ class JaxLlmEngine:
                 if seq.guided is not None:
                     gmodes[seq.lane] = seq.guided.mode_id
             args = (
-                jnp.asarray(token_ids), jnp.asarray(block_tables),
+                jnp.asarray(token_ids), tables,
                 jnp.asarray(context_lens), jnp.asarray(slot_ids),
                 *sampling_tail, self._guided_table, jnp.asarray(gmodes),
             )
@@ -2486,12 +2850,12 @@ class JaxLlmEngine:
             tki_host = np.asarray(tkis)[None] if want_top else None
         else:
             args = (
-                jnp.asarray(token_ids), jnp.asarray(block_tables),
+                jnp.asarray(token_ids), tables,
                 jnp.asarray(context_lens), *sampling_tail,
             )
             if timing:
                 t = self._phase("decode.upload", t)
-            tokens, lps, tkvs, tkis, self.cache, self._gen_counts = self._jit_decode(
+            tokens, lps, tkvs, tkis, _feedback, self.cache, self._gen_counts = self._jit_decode(
                 self.params, self.cache, self._gen_counts, self._prompt_counts,
                 *args, self.cos, self.sin,
             )
@@ -2503,6 +2867,8 @@ class JaxLlmEngine:
             tki_host = np.asarray(tkis) if want_top else None
         if timing:
             t = self._phase("decode.readback", t)
+        self._sync_windows += 1
+        self._decode_steps_total += int(tokens_host.shape[0])
 
         for s in range(tokens_host.shape[0]):
             for seq in active:
@@ -2570,11 +2936,7 @@ class JaxLlmEngine:
         context_lens = np.zeros((lanes,), np.int32)
         spec_ok = np.zeros((lanes,), bool)
         for seq in active:
-            if not seq.sampling_seeded:
-                self._seed_lane_state(seq)
-            if seq.decode_start_ts == 0.0:
-                self._maybe_record_queue_span(seq)
-                seq.decode_start_ts = time.time()
+            self._prep_decode_seq(seq)
             lane = seq.lane
             all_tokens = seq.all_token_ids
             draft = drafts.get(seq.seq_id) or []
@@ -2657,7 +3019,7 @@ class JaxLlmEngine:
             )
         if finish is not None:
             self._record_decode_span(seq)
-            self.scheduler.finish(seq)
+            self._finish_decoded(seq)
         elif seq.context_len % self.config.block_size == 0 and seq.mm_embeds is None:
             # (multimodal blocks never publish: text-token hashes cannot
             # describe patch-embedding content)

@@ -212,6 +212,20 @@ class Scheduler:
                 return None  # nothing to preempt; caller must handle
             self.preempt(victim)
 
+    def try_slots_at(
+        self, seq: Sequence, context_len: int, steps: int,
+        max_pos: int | None = None,
+    ) -> int | None:
+        """``ensure_slots`` at an EXPLICIT context length (the overlapped
+        decode pipeline allocates at the device-side context —
+        ``seq.context_len + seq.inflight_tokens`` — because in-flight
+        windows have already advanced past what the host retired), and
+        WITHOUT preemption: while a window is in flight, freeing a victim's
+        blocks would let the lagged device step garbage-write into storage
+        the allocator may re-issue or prefix-match.  On None the engine
+        drains the pipeline and retries through the preempting sync path."""
+        return self.allocator.append_slots(seq.seq_id, context_len, steps, max_pos)
+
     def _youngest_other(self, seq: Sequence) -> Sequence | None:
         candidates = [s for s in self.running if s is not seq]
         if not candidates:
@@ -228,6 +242,10 @@ class Scheduler:
         # remotely-prefilled KV is gone once blocks are freed: recompute locally
         seq.remote_prefilled = False
         seq.prefilled_tokens = 0
+        # preemption only ever happens with the decode pipeline drained
+        # (try_slots_at never preempts); zero the in-flight count anyway so
+        # the recompute path starts from clean accounting
+        seq.inflight_tokens = 0
         # re-queue at the front: preempted sequences restart first (their
         # prompt now includes generated tokens, so recompute is exact)
         self.waiting.appendleft(seq)

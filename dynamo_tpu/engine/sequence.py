@@ -39,6 +39,12 @@ class Sequence:
     mm_embeds: object = None
     # per-lane sampling state (penalty counts, rng key) initialized?
     sampling_seeded: bool = False
+    # overlapped decode: tokens dispatched in not-yet-retired windows.  The
+    # device context (what the in-flight programs see) is
+    # context_len + inflight_tokens; slot pre-allocation and the next
+    # window's context_lens are computed there, not at the host's lagging
+    # context_len.
+    inflight_tokens: int = 0
     # guided decoding: host-side automaton (llm/guided.JsonCursor) whose
     # mode id selects the admissible-token mask row each step (None =
     # unconstrained)

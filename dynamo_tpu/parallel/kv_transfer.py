@@ -159,21 +159,35 @@ class KvTransferClient:
             await local.deliver_local(payload)
             return
         reader, writer, lock = await self._conn(address)
-        names = sorted(payload.blocks)
-        arrays = [np.ascontiguousarray(payload.blocks[n]) for n in names]
-        # bf16 numpy: ml_dtypes dtype name round-trips through np.dtype
-        header = {
-            "seq_id": payload.seq_id,
-            "first_token": payload.first_token,
-            "first_token_logprob": payload.first_token_logprob,
-            "first_token_top_logprobs": payload.first_token_top_logprobs,
-            "block_ids": payload.block_ids,
-            "parts": [
-                {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}
-                for n, a in zip(names, arrays)
-            ],
-        }
-        body = b"".join(a.tobytes() for a in arrays)
+
+        # Host staging (layout copies + byte serialization of multi-MB KV
+        # slices) runs OUTSIDE the per-connection lock and OFF the event
+        # loop: concurrent shipments to one decode worker overlap their
+        # staging with each other and with the socket round-trip below,
+        # instead of serializing the whole copy→write→ack chain.  (numpy
+        # releases the GIL for the bulk copies, so the executor thread
+        # genuinely runs alongside the loop.)
+        def stage() -> tuple[dict, bytes]:
+            names = sorted(payload.blocks)
+            arrays = [np.ascontiguousarray(payload.blocks[n]) for n in names]
+            # bf16 numpy: ml_dtypes dtype name round-trips through np.dtype
+            header = {
+                "seq_id": payload.seq_id,
+                "first_token": payload.first_token,
+                "first_token_logprob": payload.first_token_logprob,
+                "first_token_top_logprobs": payload.first_token_top_logprobs,
+                "block_ids": payload.block_ids,
+                "parts": [
+                    {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}
+                    for n, a in zip(names, arrays)
+                ],
+            }
+            return header, b"".join(a.tobytes() for a in arrays)
+
+        loop = asyncio.get_running_loop()
+        header, body = await loop.run_in_executor(None, stage)
+        # only the write→ack round-trip holds the lock (frame interleaving
+        # on one socket is the one thing that must serialize)
         async with lock:
             writer.write(encode_frame(TwoPartMessage(header=header, payload=body)))
             await writer.drain()

@@ -45,7 +45,12 @@ class WorkerHandle:
 
 def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **overrides):
     """Build a JaxLlmEngine from a local model dir (config.json; weights from
-    safetensors when present, random-init otherwise)."""
+    safetensors when present, random-init otherwise).
+
+    ``overrides`` pass straight into EngineConfig — notably
+    ``decode_overlap`` (the overlapped decode pipeline, default on; the
+    ``DYN_DECODE_OVERLAP`` env reaches every launch path through the
+    engine itself, so operators can A/B a deployment without code)."""
     import json as _json
 
     from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
@@ -83,6 +88,11 @@ def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **override
             logger.warning("no safetensors in %s — random-initializing weights", model_dir)
     engine = JaxLlmEngine(config, params=params)
     engine.wants_warmup = wants_warmup
+    logger.info(
+        "decode pipeline: %s (decode_steps=%d)",
+        "overlapped" if engine.decode_overlap else "synchronous",
+        config.decode_steps,
+    )
     # guided JSON decoding needs the tokenizer-compiled mask table; enable
     # here so EVERY launch path (serve_worker, disagg workers, example
     # graphs) supports response_format json_object.  Best-effort: engines

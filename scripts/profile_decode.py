@@ -3,14 +3,24 @@
 Builds a const-init engine (same construction as bench.py's rungs), drives
 a fixed batch of greedy requests, and prints one JSON line with per-phase
 wall time from the engine's DYN_ENGINE_PHASE_TIMING accounting
-(decode.schedule / upload / dispatch / readback / post) plus ITL and
-throughput.  Exists to answer "where do the decode milliseconds go" —
+(decode.schedule / upload / dispatch / readback / retire / post) plus ITL
+and throughput.  Exists to answer "where do the decode milliseconds go" —
 which, behind a tunneled PJRT transport with ~6ms/sync RTT, is dominated
 by host<->device round-trips rather than compute (the thing the fused
-decode_steps>1 path and upload caching exist to amortize).
+decode_steps>1 path, the overlapped decode pipeline, and upload caching
+exist to amortize).
 
-Usage: python scripts/profile_decode.py [--model llama32_1b] [--quant int8]
-           [--isl 256] [--osl 64] [--batch 16] [--decode-steps 1]
+A/B mode (``--ab``) runs the same workload twice — synchronous decode
+(``decode_overlap=False``) then the overlapped pipeline — and reports
+steps/s plus each mode's per-phase share of decode wall.  Exits nonzero
+when overlap regresses throughput below ``--ab-min-speedup`` (default:
+any regression fails).  In overlap mode the synchronous ``decode.readback``
+phase disappears by construction: the wait moves to ``decode.retire``,
+which runs while the NEXT window computes on device.
+
+Usage: python scripts/profile_decode.py [--model llama32_1b|tiny]
+           [--quant int8] [--isl 256] [--osl 64] [--batch 16]
+           [--decode-steps 1] [--overlap 0|1] [--ab]
 """
 
 from __future__ import annotations
@@ -26,7 +36,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["DYN_ENGINE_PHASE_TIMING"] = "1"
 
 
-async def run(args: argparse.Namespace) -> dict:
+def _decode_phase_shares(phase_ms: dict) -> dict:
+    """Each decode.* phase's share of total decode wall (0..1)."""
+    decode = {k: v["total_ms"] for k, v in phase_ms.items() if k.startswith("decode.")}
+    total = sum(decode.values())
+    if total <= 0:
+        return {}
+    return {k: round(v / total, 4) for k, v in decode.items()}
+
+
+async def run(args: argparse.Namespace, *, overlap: bool | None = None) -> dict:
     import jax
     import numpy as np
 
@@ -76,11 +95,13 @@ async def run(args: argparse.Namespace) -> dict:
             logit_bias_k=0,
             quantize=None if args.quant in (None, "none") else args.quant,
             kv_cache_dtype=args.kv_dtype,
+            decode_overlap=overlap,
         ),
         params=params,
     )
     engine.start()
-    print(f"profile: engine up ({args.model})", file=sys.stderr)
+    mode = "overlap" if engine.decode_overlap else "sync"
+    print(f"profile: engine up ({args.model}, {mode})", file=sys.stderr)
     rng = np.random.default_rng(0)
 
     from dynamo_tpu.runtime.engine import Context
@@ -123,6 +144,12 @@ async def run(args: argparse.Namespace) -> dict:
     await drive(make_request())  # warmup: compiles
     print(f"profile: warmup {time.monotonic()-t0:.1f}s", file=sys.stderr)
     itls.clear()
+    before = engine.stats()
+    steps_before = before.get("decode_steps_total", 0)
+    # delta the window counters too: cumulative totals would include
+    # warmup and not reconcile with the steady-state phase stats
+    over_before = before.get("decode_windows_overlapped_total", 0)
+    sync_before = before.get("decode_windows_sync_total", 0)
 
     # Steady-state isolation: phase stats restart once every lane has
     # produced a first token, so prefill interleave doesn't pollute the
@@ -141,6 +168,8 @@ async def run(args: argparse.Namespace) -> dict:
     stats = engine.stats()
     engine.stop()
     dev = jax.devices()[0]
+    phase_ms = stats.get("phase_ms", {})
+    decode_steps = stats.get("decode_steps_total", 0) - steps_before
     return {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
@@ -150,31 +179,84 @@ async def run(args: argparse.Namespace) -> dict:
         "isl": args.isl,
         "osl": args.osl,
         "decode_steps": args.decode_steps,
+        "overlap": engine.decode_overlap,
+        "windows_overlapped": stats.get("decode_windows_overlapped_total", 0) - over_before,
+        "windows_sync": stats.get("decode_windows_sync_total", 0) - sync_before,
         "wall_s": round(wall, 2),
         "tok_s": round(sum(counts) / wall, 1),
+        "steps_s": round(decode_steps / wall, 2),
         "itl_mean_ms": round(1e3 * sum(itls) / max(len(itls), 1), 2),
-        "phase_ms": stats.get("phase_ms", {}),
+        "decode_phase_share": _decode_phase_shares(phase_ms),
+        "phase_ms": phase_ms,
     }
 
 
-def main() -> None:
+async def amain(args: argparse.Namespace) -> tuple[int, dict]:
+    """Run the requested profile; returns (exit_code, result).  Importable
+    so the tier-1 smoke test can drive the A/B in-process."""
+    if not args.ab:
+        overlap = None if args.overlap is None else bool(args.overlap)
+        return 0, await run(args, overlap=overlap)
+
+    sync = await run(args, overlap=False)
+    over = await run(args, overlap=True)
+    speedup = over["tok_s"] / sync["tok_s"] if sync["tok_s"] else 0.0
+    result = {
+        "ab": True,
+        "model": args.model,
+        "batch": args.batch,
+        "isl": args.isl,
+        "osl": args.osl,
+        "decode_steps": args.decode_steps,
+        "overlap_speedup_tok_s": round(speedup, 3),
+        "overlap_speedup_steps_s": round(
+            over["steps_s"] / sync["steps_s"], 3
+        ) if sync["steps_s"] else 0.0,
+        "readback_share_sync": sync["decode_phase_share"].get("decode.readback", 0.0),
+        "readback_share_overlap": over["decode_phase_share"].get("decode.readback", 0.0),
+        "retire_share_overlap": over["decode_phase_share"].get("decode.retire", 0.0),
+        "sync": sync,
+        "overlap": over,
+    }
+    rc = 0
+    if speedup < args.ab_min_speedup:
+        print(
+            f"profile: overlap REGRESSED throughput ({speedup:.3f}x < "
+            f"{args.ab_min_speedup}x)", file=sys.stderr,
+        )
+        rc = 1
+    return rc, result
+
+
+def main() -> int:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", default="llama32_1b")
+    parser.add_argument("--model", default="llama32_1b",
+                        help="LlamaConfig classmethod name (llama32_1b, tiny, ...)")
     parser.add_argument("--quant", default="none")
     parser.add_argument("--kv-dtype", default="bf16")
     parser.add_argument("--isl", type=int, default=256)
     parser.add_argument("--osl", type=int, default=64)
     parser.add_argument("--batch", type=int, default=16)
     parser.add_argument("--decode-steps", type=int, default=1)
+    parser.add_argument("--overlap", type=int, choices=(0, 1), default=None,
+                        help="force the overlapped pipeline on/off "
+                             "(default: engine default / DYN_DECODE_OVERLAP)")
+    parser.add_argument("--ab", action="store_true",
+                        help="run sync AND overlap, report both + speedup; "
+                             "exit nonzero if overlap regresses throughput")
+    parser.add_argument("--ab-min-speedup", type=float, default=1.0,
+                        help="minimum overlap/sync tok_s ratio for --ab to "
+                             "exit 0 (1.0 = fail on any regression)")
     parser.add_argument("--out", default=None,
                         help="also write the JSON result to this path")
     args = parser.parse_args()
-    result = asyncio.run(run(args))
+    rc, result = asyncio.run(amain(args))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2)
     print(json.dumps(result))
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

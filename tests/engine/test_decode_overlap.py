@@ -1,0 +1,236 @@
+"""Overlapped decode pipeline correctness: with overlap enabled the engine
+must emit BYTE-IDENTICAL token streams to the synchronous path — across
+single-step and fused multi-step windows, stops landing mid-window, a
+preemption while a window is in flight, and seeded sampling — while
+actually dispatching windows with on-device token feedback (asserted via
+stats).  Lanes that need per-token host state (top_logprobs, guided) must
+auto-fall back to the synchronous path."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+from tests.engine.test_jax_engine import (
+    collect,
+    greedy_reference,
+    make_engine,
+    request,
+    sampled_request,
+)
+
+
+async def run_matrix(prompts, reqs, **engine_kw):
+    """Drive the same requests through a sync and an overlap engine; return
+    both result lists plus the overlap engine's stats."""
+    out = []
+    stats = None
+    for overlap in (False, True):
+        engine = make_engine(decode_overlap=overlap, **engine_kw)
+        try:
+            results = await asyncio.gather(
+                *[collect(engine, r) for r in reqs]
+            )
+            if overlap:
+                stats = engine.stats()
+        finally:
+            engine.stop()
+        out.append(results)
+    return out[0], out[1], stats
+
+
+async def test_overlap_parity_single_step():
+    prompts = [list(range(3 + i, 11 + i)) for i in range(3)]
+    reqs = [request(p, max_tokens=6, ignore_eos=True) for p in prompts]
+    sync, over, stats = await run_matrix(prompts, reqs)
+    assert over == sync
+    for p, (tokens, _) in zip(prompts, over):
+        assert tokens == greedy_reference(p, 6)
+    # the pipeline actually ran: windows were dispatched with token feedback
+    assert stats["decode_windows_overlapped_total"] > 0
+
+
+async def test_overlap_parity_multistep_midwindow_stop():
+    """decode_steps=4 with max_tokens that land mid-window (3, 9, 6): the
+    lagged in-flight window's garbage steps must be truncated exactly."""
+    prompts = [list(range(3, 10)), list(range(5, 14)), list(range(2, 8))]
+    reqs = [
+        request(p, max_tokens=n, ignore_eos=True)
+        for p, n in zip(prompts, (3, 9, 6))
+    ]
+    sync, over, stats = await run_matrix(prompts, reqs, decode_steps=4)
+    assert over == sync
+    for (tokens, finish), n in zip(over, (3, 9, 6)):
+        assert len(tokens) == n
+        assert finish == FinishReason.LENGTH
+    assert stats["decode_windows_overlapped_total"] > 0
+
+
+async def test_overlap_stop_token_midwindow():
+    """An EOS-class stop detected one window late must truncate emission at
+    the host-detected finish (no trailing garbage tokens)."""
+    prompt = list(range(3, 12))
+    engine = make_engine(decode_overlap=False, decode_steps=2)
+    try:
+        base, _ = await collect(engine, request(prompt, max_tokens=8, ignore_eos=True))
+    finally:
+        engine.stop()
+    stop_tok = base[4]  # force a STOP mid-stream (and mid-window for steps=2)
+    reqs = [
+        PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=8, stop_token_ids=[stop_tok]),
+            eos_token_ids=[],
+        ).to_wire()
+    ]
+    sync, over, _ = await run_matrix([prompt], reqs, decode_steps=2)
+    assert over == sync
+    tokens, finish = over[0]
+    assert finish == FinishReason.STOP
+    assert tokens[-1] == stop_tok
+    assert stop_tok not in tokens[:-1]
+
+
+async def test_overlap_parity_under_preemption():
+    """Tight block pool: the pipeline must drain before any preemption (a
+    lagged window may not write into freed blocks) and the recompute path
+    must keep greedy output exact."""
+    prompts = [list(range(3, 10)), list(range(5, 12)), list(range(2, 9))]
+    reqs = [request(p, max_tokens=8, ignore_eos=True) for p in prompts]
+    engine = make_engine(
+        decode_overlap=True, max_batch_size=4, num_blocks=10, max_model_len=40
+    )
+    preempts = []
+    orig = engine.scheduler.preempt
+    engine.scheduler.preempt = lambda seq: (preempts.append(seq.seq_id), orig(seq))[1]
+    try:
+        results = await asyncio.gather(*[collect(engine, r) for r in reqs])
+    finally:
+        engine.stop()
+    assert preempts, "test geometry failed to force preemption"
+    for (tokens, _), p in zip(results, prompts):
+        assert tokens == greedy_reference(p, 8)
+
+
+async def test_overlap_parity_multistep_under_preemption():
+    prompts = [list(range(3, 10)), list(range(5, 12)), list(range(2, 9))]
+    reqs = [request(p, max_tokens=8, ignore_eos=True) for p in prompts]
+    sync, over, _ = await run_matrix(
+        prompts, reqs, decode_steps=4, max_batch_size=4, num_blocks=10,
+        max_model_len=40,
+    )
+    assert over == sync
+    for (tokens, _), p in zip(over, prompts):
+        assert tokens == greedy_reference(p, 8)
+
+
+async def test_overlap_length_finish_at_engine_max_len():
+    """A lane the host LENGTH-finishes at max_len can have in-flight
+    windows dispatched past the end: their slot pre-allocation must clamp
+    (not index past the block table) and their tokens must be discarded."""
+    prompts = [list(range(3, 10)), list(range(4, 11))]
+    reqs = [request(p, max_tokens=64, ignore_eos=True) for p in prompts]
+    sync, over, _ = await run_matrix(
+        prompts, reqs, decode_steps=4, max_model_len=24, num_blocks=16,
+        max_batch_size=2,
+    )
+    assert over == sync
+    for tokens, finish in over:
+        assert finish == FinishReason.LENGTH
+        assert len(tokens) == 24 - 7  # context capped at engine max_len
+
+
+async def test_overlap_seeded_sampling_parity():
+    """The device-side key fold (key, context_len) advances identically in
+    both modes, so even SAMPLED streams are reproducible across them."""
+    prompt = list(range(3, 10))
+    reqs = [sampled_request(prompt, max_tokens=10, temperature=8.0, seed=1234)]
+    sync, over, stats = await run_matrix([prompt], reqs)
+    assert over == sync
+    assert stats["decode_windows_overlapped_total"] > 0
+
+
+async def test_top_logprobs_falls_back_to_sync():
+    """A top_logprobs lane needs K-wide per-step readback: the whole batch
+    serves synchronously (zero overlapped windows) and the alternatives
+    are intact."""
+    prompt = list(range(3, 10))
+    engine = make_engine(decode_overlap=True)
+    try:
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True, top_logprobs=3),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+        from dynamo_tpu.llm.protocols.common import Annotated, LLMEngineOutput
+        from dynamo_tpu.runtime.engine import Context
+
+        stream = await engine.generate(Context(req))
+        tokens, top_rows = [], []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is None:
+                continue
+            tokens.extend(ann.data.token_ids)
+            if ann.data.top_logprobs:
+                top_rows.extend(ann.data.top_logprobs)
+        stats = engine.stats()
+    finally:
+        engine.stop()
+    assert tokens == greedy_reference(prompt, 4)
+    assert len(top_rows) == len(tokens)
+    assert all(len(row) == 3 for row in top_rows)
+    assert stats["decode_windows_overlapped_total"] == 0
+    assert stats["decode_windows_sync_total"] > 0
+
+
+async def test_overlap_knob_and_env(monkeypatch):
+    """DYN_DECODE_OVERLAP=0 disables the pipeline; an explicit config value
+    outranks the env; default is on."""
+    engine = make_engine()
+    assert engine.decode_overlap is True
+    engine.stop()
+    monkeypatch.setenv("DYN_DECODE_OVERLAP", "0")
+    engine = make_engine()
+    assert engine.decode_overlap is False
+    engine.stop()
+    engine = make_engine(decode_overlap=True)
+    assert engine.decode_overlap is True
+    engine.stop()
+    monkeypatch.delenv("DYN_DECODE_OVERLAP")
+    # speculative engines draft from host token history, which lags the
+    # device while the pipeline is hot: overlap auto-disables
+    engine = make_engine(speculative="ngram")
+    assert engine.decode_overlap is False
+    engine.stop()
+
+
+async def test_overlap_releases_blocks_and_lanes():
+    """Deferred finishes (detected while a window is in flight) must still
+    return every block and lane once the pipeline drains."""
+    engine = make_engine(decode_overlap=True)
+    try:
+        reqs = [request(list(range(3 + i, 10 + i)), max_tokens=5) for i in range(3)]
+        await asyncio.gather(*[collect(engine, r) for r in reqs])
+        for _ in range(100):
+            if (
+                engine.scheduler.num_running == 0
+                and engine.allocator.used_blocks == 0
+            ):
+                break
+            await asyncio.sleep(0.02)
+        assert engine.scheduler.num_running == 0
+        # every block returned to the pool (used_blocks excludes the
+        # reclaimable prefix-cached ones)
+        assert engine.allocator.used_blocks == 0
+        assert sorted(engine.scheduler._free_lanes) == list(range(4))
+    finally:
+        engine.stop()

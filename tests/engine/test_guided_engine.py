@@ -116,6 +116,23 @@ async def test_guided_completion_stops_early(guided_parts, tokenizer):
         engine.stop()
 
 
+async def test_guided_falls_back_to_sync_decode(guided_parts, tokenizer):
+    """A guided lane advances a host automaton that must gate the NEXT
+    sample: the overlapped pipeline auto-falls back to the synchronous
+    path for the whole window (zero overlapped windows dispatched)."""
+    masks, strings = guided_parts
+    engine = make_engine(decode_overlap=True)
+    engine.set_guided(masks, strings, tokenizer.eos_token_ids)
+    try:
+        tokens, _ = await collect(engine, guided_request())
+        assert tokens
+        stats = engine.stats()
+        assert stats["decode_windows_overlapped_total"] == 0
+        assert stats["decode_windows_sync_total"] > 0
+    finally:
+        engine.stop()
+
+
 async def test_guided_rejected_without_mask_table():
     engine = make_engine()
     try:
