@@ -79,12 +79,79 @@ class MetricsService:
             "Draft tokens accepted by speculative verification (cumulative)",
             ["worker"], registry=self.registry,
         )
+        # utilization accounting (observability/perf.py): rolling rates and
+        # cumulative token/wasted-work totals per worker.  Mirrored remote
+        # values, so gauges throughout (same rationale as the counters
+        # below); rates carry their unit in the name.
+        self.mfu = Gauge(
+            f"{PREFIX}_mfu_perc",
+            "Model FLOPs utilization over the rolling window (0-1)",
+            ["worker"], registry=self.registry,
+        )
+        self.bandwidth_util = Gauge(
+            f"{PREFIX}_bandwidth_util_perc",
+            "Model HBM bandwidth utilization over the rolling window (0-1)",
+            ["worker"], registry=self.registry,
+        )
+        self.goodput = Gauge(
+            f"{PREFIX}_goodput_tokens_per_second",
+            "Tokens per second actually delivered to callers (rolling window)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefill_rate = Gauge(
+            f"{PREFIX}_prefill_tokens_per_second",
+            "Prompt tokens per second computed (rolling window)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefill_tokens = Gauge(
+            f"{PREFIX}_prefill_tokens",
+            "Prompt tokens computed (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.decode_tokens = Gauge(
+            f"{PREFIX}_decode_tokens",
+            "Decode positions computed (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.tokens_emitted = Gauge(
+            f"{PREFIX}_tokens_emitted",
+            "Tokens emitted to caller streams (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.preempted_tokens = Gauge(
+            f"{PREFIX}_preempted_tokens",
+            "Context tokens recomputed due to KV-pressure preemption (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.spec_rejected = Gauge(
+            f"{PREFIX}_spec_rejected_tokens",
+            "Draft tokens rejected by speculative verification (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.wasted_tokens = Gauge(
+            f"{PREFIX}_wasted_tokens",
+            "Tokens computed that bought nothing a client received (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        # engine phase timing (DYN_ENGINE_PHASE_TIMING=1): cumulative wall
+        # seconds per decode/prefill phase — makes the overlap/sync pipeline
+        # difference (decode.retire vs decode.readback) visible in /metrics
+        self.phase_seconds = Gauge(
+            f"{PREFIX}_engine_phase_seconds",
+            "Cumulative engine wall seconds per hot-loop phase "
+            "(DYN_ENGINE_PHASE_TIMING=1)",
+            ["worker", "phase"], registry=self.registry,
+        )
         self._worker_gauges = (
             self.kv_active, self.kv_total, self.cache_usage, self.waiting,
             self.running, self.batch_occupancy, self.preemptions,
             self.prefix_hits, self.prefix_cached_tokens, self.spec_accepted,
+            self.mfu, self.bandwidth_util, self.goodput, self.prefill_rate,
+            self.prefill_tokens, self.decode_tokens, self.tokens_emitted,
+            self.preempted_tokens, self.spec_rejected, self.wasted_tokens,
         )
         self._seen_workers: set[str] = set()
+        self._seen_phases: set[tuple[str, str]] = set()
         self.hit_blocks = Counter(
             f"{PREFIX}_kv_hit_blocks_total", "Matched prefix blocks routed", registry=self.registry
         )
@@ -153,6 +220,13 @@ class MetricsService:
                     g.remove(label)
                 except KeyError:
                     pass
+        for label, phase in list(self._seen_phases):
+            if label not in live:
+                try:
+                    self.phase_seconds.remove(label, phase)
+                except KeyError:
+                    pass
+                self._seen_phases.discard((label, phase))
         self._seen_workers = live
         for wid, m in snapshot.workers.items():
             label = f"{wid:x}"
@@ -166,6 +240,30 @@ class MetricsService:
             self.prefix_hits.labels(label).set(m.prefix_hits_total)
             self.prefix_cached_tokens.labels(label).set(m.prefix_cached_tokens_total)
             self.spec_accepted.labels(label).set(m.spec_accepted_tokens_total)
+            self.mfu.labels(label).set(m.mfu_perc)
+            self.bandwidth_util.labels(label).set(m.bandwidth_util_perc)
+            self.goodput.labels(label).set(m.goodput_tokens_per_second)
+            self.prefill_rate.labels(label).set(m.prefill_tokens_per_second)
+            self.prefill_tokens.labels(label).set(m.prefill_tokens_total)
+            self.decode_tokens.labels(label).set(m.decode_tokens_total)
+            self.tokens_emitted.labels(label).set(m.tokens_emitted_total)
+            self.preempted_tokens.labels(label).set(m.preempted_tokens_total)
+            self.spec_rejected.labels(label).set(m.spec_rejected_tokens_total)
+            self.wasted_tokens.labels(label).set(m.wasted_tokens_total)
+            phases_now = set(m.phase_seconds or {})
+            for phase, seconds in (m.phase_seconds or {}).items():
+                self.phase_seconds.labels(label, phase).set(seconds)
+                self._seen_phases.add((label, phase))
+            # a worker that restarted with a different mode (e.g. overlap
+            # toggled) stops reporting some phases: drop their stale series
+            # instead of freezing pre-restart cumulative values forever
+            for seen_label, phase in list(self._seen_phases):
+                if seen_label == label and phase not in phases_now:
+                    try:
+                        self.phase_seconds.remove(label, phase)
+                    except KeyError:
+                        pass
+                    self._seen_phases.discard((label, phase))
 
     async def _metrics(self, request: web.Request) -> web.Response:
         self._refresh()

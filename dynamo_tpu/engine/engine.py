@@ -46,6 +46,7 @@ from dynamo_tpu.llm.protocols.common import (
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
 from dynamo_tpu.observability import StepTelemetry, get_recorder
+from dynamo_tpu.observability.perf import UtilizationTracker, model_cost
 from dynamo_tpu.robustness.faults import ENGINE_STEP, FAULTS
 from dynamo_tpu.ops.sampling import (
     apply_logit_bias,
@@ -563,6 +564,19 @@ class JaxLlmEngine:
         # scheduler iteration, merged into stats() → load-metrics publisher
         # → dyn_worker_* Prometheus gauges (observability.step_metrics).
         self.step_telemetry = StepTelemetry(config.max_batch_size)
+        # Utilization accounting (observability/perf.py): the device loop
+        # feeds per-step token/context/weight-stream facts; stats() exports
+        # rolling MFU / bandwidth-utilization / goodput plus token totals.
+        self.utilization = UtilizationTracker(
+            model_cost(
+                cfg, quantize=config.quantize, kv_cache_dtype=config.kv_cache_dtype
+            )
+        )
+        self._tokens_emitted = 0        # tokens that reached a caller's stream
+        self._step_prefill_tokens = 0   # per-iteration scratch, reset each step
+        self._step_decode_tokens = 0
+        self._step_attn_ctx = 0         # sum of attended context positions
+        self._step_weight_streams = 0.0 # full weight passes dispatched
         # DYN_XPROF_ANNOTATE=1: wrap hot steps in jax.profiler
         # TraceAnnotation so host-side spans line up with xprof device
         # traces (adds a TraceMe per step — keep off unless profiling)
@@ -1795,7 +1809,22 @@ class JaxLlmEngine:
             "guided_completions_total": self._guided_completions,
             "num_preemptions_total": self.scheduler.preemptions_total,
             **self.step_telemetry.stats(),
+            # utilization accounting (observability/perf.py): rolling MFU /
+            # bandwidth-utilization / goodput + cumulative token totals
+            **self.utilization.stats(),
         }
+        # emitted count from the engine's own synchronous counter: the
+        # tracker's copy updates at end-of-iteration, and a caller that just
+        # consumed its stream may read stats() inside that sub-ms gap
+        out["tokens_emitted_total"] = self._tokens_emitted
+        # wasted-work evidence: tokens whose compute bought nothing a client
+        # received (preemption recompute, rejected speculative drafts)
+        spec_rejected = max(0, self._spec_drafted - self._spec_accepted)
+        out["preempted_tokens_total"] = self.scheduler.preempted_tokens_total
+        out["spec_rejected_tokens_total"] = spec_rejected
+        out["wasted_tokens_total"] = (
+            self.scheduler.preempted_tokens_total + spec_rejected
+        )
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
         if self.phase_stats:
@@ -1828,6 +1857,11 @@ class JaxLlmEngine:
                     self._wake.clear()
                     continue
                 t_step = time.perf_counter()
+                emitted_before = self._tokens_emitted
+                self._step_prefill_tokens = 0
+                self._step_decode_tokens = 0
+                self._step_attn_ctx = 0
+                self._step_weight_streams = 0.0
                 decision = self.scheduler.schedule()
                 for seq in decision.prefills:
                     self._maybe_record_queue_span(seq)
@@ -1883,13 +1917,24 @@ class JaxLlmEngine:
                     # deferred finishes release their lanes/blocks
                     self._sync_pipeline()
                 self._iterations += 1
+                step_duration_s = time.perf_counter() - t_step
                 self.step_telemetry.observe_step(
                     iteration=self._iterations,
                     num_running=self.scheduler.num_running,
                     num_waiting=self.scheduler.num_waiting,
                     kv_active_blocks=self.allocator.used_blocks,
                     kv_total_blocks=self.allocator.num_blocks,
-                    step_duration_s=time.perf_counter() - t_step,
+                    step_duration_s=step_duration_s,
+                    prefill_tokens=self._step_prefill_tokens,
+                    decode_tokens=self._step_decode_tokens,
+                )
+                self.utilization.observe_step(
+                    duration_s=step_duration_s,
+                    prefill_tokens=self._step_prefill_tokens,
+                    decode_tokens=self._step_decode_tokens,
+                    attn_ctx_tokens=self._step_attn_ctx,
+                    weight_streams=self._step_weight_streams,
+                    emitted_tokens=self._tokens_emitted - emitted_before,
                 )
             except Exception:  # noqa: BLE001 — scheduler-level bug: keep the
                 # thread alive (callers would hang forever), don't hot-spin
@@ -2353,6 +2398,9 @@ class JaxLlmEngine:
                 self._guided_row(seq), self.cos, self.sin,
             )
             seq.prefilled_tokens = total
+            self._step_prefill_tokens += total
+            self._step_attn_ctx += total * (total + 1) // 2
+            self._step_weight_streams += 1
             want_top = seq.request.sampling.top_logprobs > 0
             self._process_token(
                 seq, int(token), float(lp), top=(tkv, tki) if want_top else None
@@ -2409,6 +2457,11 @@ class JaxLlmEngine:
             np.asarray(token)
             self._phase("prefill.readback", tp)
         seq.prefilled_tokens = end
+        # utilization accounting: this window computed [start, end) — each
+        # position p attends p+1 context positions (causal)
+        self._step_prefill_tokens += end - start
+        self._step_attn_ctx += (end * (end + 1) - start * (start + 1)) // 2
+        self._step_weight_streams += 1
         if not final:
             # intermediate chunk: KV written, no token sampled; publish the
             # completed blocks so routers (and future prompts) can hit them
@@ -2728,6 +2781,9 @@ class JaxLlmEngine:
         )
         self._overlap_windows += 1
         self._decode_steps_total += steps
+        self._step_decode_tokens += len(active) * steps
+        self._step_attn_ctx += int(context_lens.sum()) * steps
+        self._step_weight_streams += steps
         if prev is not None:
             self._retire_window(prev)
 
@@ -2868,7 +2924,11 @@ class JaxLlmEngine:
         if timing:
             t = self._phase("decode.readback", t)
         self._sync_windows += 1
-        self._decode_steps_total += int(tokens_host.shape[0])
+        n_steps = int(tokens_host.shape[0])
+        self._decode_steps_total += n_steps
+        self._step_decode_tokens += len(active) * n_steps
+        self._step_attn_ctx += int(context_lens.sum()) * n_steps
+        self._step_weight_streams += n_steps
 
         for s in range(tokens_host.shape[0]):
             for seq in active:
@@ -2971,6 +3031,11 @@ class JaxLlmEngine:
         # retry re-enters this method for the same step); attempted = the
         # whole window (pads can accept too), so accepted/drafted <= 1
         self._spec_drafted += int(spec_ok.sum()) * (w - 1)
+        # one verify launch streams the weights once and computes w
+        # positions per active lane, EACH attending the lane's full context
+        self._step_decode_tokens += len(active) * w
+        self._step_attn_ctx += int(context_lens.sum()) * w
+        self._step_weight_streams += 1
         for seq in active:
             lane = seq.lane
             n = int(n_h[lane])
@@ -2990,6 +3055,7 @@ class JaxLlmEngine:
         top=None,
     ) -> None:
         seq.output_ids.append(token)
+        self._tokens_emitted += 1
         if seq.guided is not None:
             was_complete = seq.guided.complete
             seq.guided.advance(token)

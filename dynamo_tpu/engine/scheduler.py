@@ -58,6 +58,9 @@ class Scheduler:
         # step telemetry: cumulative preemption count (KV-pressure evidence
         # exported as dyn_worker_preemptions via the metrics service)
         self.preemptions_total = 0
+        # wasted-work accounting: every preempted sequence recomputes its
+        # whole context, so those tokens were computed for nothing
+        self.preempted_tokens_total = 0
         # optional hook fired on every preemption (the engine closes the
         # victim's tracing spans here; the scheduler itself stays
         # observability-agnostic)
@@ -235,6 +238,7 @@ class Scheduler:
     def preempt(self, seq: Sequence) -> None:
         logger.warning("preempting sequence %s (recompute)", seq.seq_id)
         self.preemptions_total += 1
+        self.preempted_tokens_total += max(seq.context_len, 0)
         if self.on_preempt is not None:
             self.on_preempt(seq)
         self._release(seq)

@@ -133,8 +133,14 @@ class HttpService:
         # worker component (reference: lib/llm/src/http/service/clear_kv_blocks.rs)
         self.clear_kv = clear_kv
         # load shedding on the inference routes (429/503 + Retry-After);
-        # disabled unless configured or DYN_ADMISSION_MAX_INFLIGHT is set
+        # disabled unless configured or DYN_ADMISSION_MAX_INFLIGHT is set.
+        # The SLO tracker's burn rate feeds it (DYN_SLO_SHED_BURN): when the
+        # error budget is burning fast, shed instead of queueing deeper.
         self.admission = AdmissionController(admission)
+        self.admission.burn_rate_fn = self.metrics.slo.worst_burn_rate
+        self.admission.shed_burn_threshold = (
+            self.metrics.slo.config.shed_burn_threshold
+        )
         self.app = web.Application(
             client_max_size=64 * 1024 * 1024,
             middlewares=[self._request_id_middleware, self._admission_middleware],
@@ -146,6 +152,7 @@ class HttpService:
         self.app.router.add_get("/health", self.handle_health)
         self.app.router.add_get("/live", self.handle_health)
         self.app.router.add_get("/metrics", self.handle_metrics)
+        self.app.router.add_get("/slo", self.handle_slo)
         self.app.router.add_post("/clear_kv_blocks", self.handle_clear_kv_blocks)
         self._runner: web.AppRunner | None = None
 
@@ -245,6 +252,12 @@ class HttpService:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(), content_type="text/plain")
 
+    async def handle_slo(self, request: web.Request) -> web.Response:
+        """SLO burn rates + histogram-bucket exemplars as JSON — the
+        machine-readable twin of the ``dyn_slo_*`` exposition (consumed by
+        scripts/dyn_top.py and autoscalers)."""
+        return web.json_response(self.metrics.slo_status())
+
     async def handle_clear_kv_blocks(self, request: web.Request) -> web.Response:
         """Admin: flush every worker's published KV-cache state (reference:
         lib/llm/src/http/service/clear_kv_blocks.rs — frontend route that
@@ -297,7 +310,11 @@ class HttpService:
                 param="model", code="model_not_found",
             )
 
-        guard = self.metrics.guard(chat_request.model, "chat_completions", "stream" if chat_request.stream else "unary")
+        guard = self.metrics.guard(
+            chat_request.model, "chat_completions",
+            "stream" if chat_request.stream else "unary",
+            trace_id=request["request_id"],
+        )
         root = self._trace_root(request, "chat_completions", chat_request.model)
         if not chat_request.stream:
             # non-streaming responses always carry usage (OpenAI semantics)
@@ -307,6 +324,7 @@ class HttpService:
             try:
                 stream, ctx = await _start_generation(engine, chat_request, root)
             except ValueError as exc:
+                guard.mark_client_error()
                 return _error(400, str(exc))
             if chat_request.stream:
                 return await self._stream_sse(request, stream, ctx, guard, chat_request.model)
@@ -316,6 +334,7 @@ class HttpService:
             self._observe_usage(chat_request.model, response.usage)
             return web.json_response(response.model_dump(exclude_none=True))
         except asyncio.CancelledError:
+            guard.mark_cancelled()
             if ctx is not None:
                 ctx.ctx.kill()
             raise
@@ -357,7 +376,9 @@ class HttpService:
             )
 
         guard = self.metrics.guard(
-            completion_request.model, "completions", "stream" if completion_request.stream else "unary"
+            completion_request.model, "completions",
+            "stream" if completion_request.stream else "unary",
+            trace_id=request["request_id"],
         )
         root = self._trace_root(request, "completions", completion_request.model)
         if not completion_request.stream:
@@ -367,6 +388,7 @@ class HttpService:
             try:
                 stream, ctx = await _start_generation(engine, completion_request, root)
             except ValueError as exc:
+                guard.mark_client_error()
                 return _error(400, str(exc))
             if completion_request.stream:
                 return await self._stream_sse(request, stream, ctx, guard, completion_request.model)
@@ -379,6 +401,7 @@ class HttpService:
             self._observe_usage(completion_request.model, response.usage)
             return web.json_response(response.model_dump(exclude_none=True))
         except asyncio.CancelledError:
+            guard.mark_cancelled()
             if ctx is not None:
                 ctx.ctx.kill()
             raise
@@ -404,12 +427,16 @@ class HttpService:
                 404, f"model '{embedding_request.model}' not found",
                 param="model", code="model_not_found",
             )
-        guard = self.metrics.guard(embedding_request.model, "embeddings", "unary")
+        guard = self.metrics.guard(
+            embedding_request.model, "embeddings", "unary",
+            trace_id=request["request_id"],
+        )
         root = self._trace_root(request, "embeddings", embedding_request.model)
         try:
             try:
                 response = await engine.embed(embedding_request)
             except ValueError as exc:
+                guard.mark_client_error()
                 return _error(400, str(exc))
             guard.mark_ok()
             return web.json_response(response.model_dump(exclude_none=True))
@@ -456,7 +483,8 @@ class HttpService:
             await response.write(sse.encode_done().encode())
             guard.mark_ok()
         except (ConnectionResetError, asyncio.CancelledError):
-            # client went away: propagate kill upstream
+            # client went away: propagate kill upstream; not a server error
+            guard.mark_cancelled()
             ctx.ctx.kill()
         except Exception as exc:  # noqa: BLE001 — engine failure mid-stream:
             # the SSE response already started, so surface an error event

@@ -61,6 +61,20 @@ class ForwardPassMetrics:
     # the latest step and cumulative preemption count
     batch_occupancy_perc: float = 0.0
     num_preemptions_total: int = 0
+    # utilization accounting (observability.perf): rolling rates + token
+    # totals + wasted-work counters, and the opt-in engine phase timings
+    # (DYN_ENGINE_PHASE_TIMING=1) as {phase: cumulative seconds}
+    mfu_perc: float = 0.0
+    bandwidth_util_perc: float = 0.0
+    goodput_tokens_per_second: float = 0.0
+    prefill_tokens_per_second: float = 0.0
+    prefill_tokens_total: int = 0
+    decode_tokens_total: int = 0
+    tokens_emitted_total: int = 0
+    preempted_tokens_total: int = 0
+    spec_rejected_tokens_total: int = 0
+    wasted_tokens_total: int = 0
+    phase_seconds: dict = field(default_factory=dict)
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -87,6 +101,21 @@ class ForwardPassMetrics:
             spec_accepted_tokens_total=stats.get("spec_accepted_tokens_total", 0),
             batch_occupancy_perc=stats.get("batch_occupancy_perc", 0.0),
             num_preemptions_total=stats.get("num_preemptions_total", 0),
+            mfu_perc=stats.get("mfu_perc", 0.0),
+            bandwidth_util_perc=stats.get("bandwidth_util_perc", 0.0),
+            goodput_tokens_per_second=stats.get("goodput_tokens_per_second", 0.0),
+            prefill_tokens_per_second=stats.get("prefill_tokens_per_second", 0.0),
+            prefill_tokens_total=stats.get("prefill_tokens_total", 0),
+            decode_tokens_total=stats.get("decode_tokens_total", 0),
+            tokens_emitted_total=stats.get("tokens_emitted_total", 0),
+            preempted_tokens_total=stats.get("preempted_tokens_total", 0),
+            spec_rejected_tokens_total=stats.get("spec_rejected_tokens_total", 0),
+            wasted_tokens_total=stats.get("wasted_tokens_total", 0),
+            phase_seconds={
+                str(name): float(row.get("total_ms", 0.0)) / 1e3
+                for name, row in (stats.get("phase_ms") or {}).items()
+                if isinstance(row, dict)
+            },
         )
 
 

@@ -11,25 +11,39 @@ One request, one ``trace_id``, visible in every layer it touches:
 - ``step_metrics`` — engine step telemetry (batch occupancy, running/waiting
   counts, KV pool usage, preemptions) accumulated on the device thread and
   surfaced through the existing Prometheus registries.
+- ``perf``        — utilization accounting: analytical FLOPs/bytes cost
+  model per model geometry + rolling MFU / bandwidth-utilization / goodput
+  (``UtilizationTracker``), exported as ``dyn_worker_*`` gauges.
+- ``slo``         — burn-rate SLO tracking over the frontend's TTFT/ITL/
+  error stream (``SloTracker``), exported as ``dyn_slo_*`` metrics and the
+  frontend's ``/slo`` endpoint.
 
 See docs/observability.md for the metric families, env vars, and formats.
 """
 
+from dynamo_tpu.observability.perf import ModelCost, UtilizationTracker, model_cost
 from dynamo_tpu.observability.recorder import (
     Span,
     SpanRecorder,
     get_recorder,
     set_recorder,
 )
+from dynamo_tpu.observability.slo import SloConfig, SloObjective, SloTracker
 from dynamo_tpu.observability.step_metrics import StepTelemetry
 from dynamo_tpu.observability.trace import TraceContext, new_span_id, new_trace_id
 
 __all__ = [
+    "ModelCost",
+    "SloConfig",
+    "SloObjective",
+    "SloTracker",
     "Span",
     "SpanRecorder",
     "StepTelemetry",
     "TraceContext",
+    "UtilizationTracker",
     "get_recorder",
+    "model_cost",
     "new_span_id",
     "new_trace_id",
     "set_recorder",

@@ -10,7 +10,10 @@ Exports:
 
 - ``export_jsonl`` — one JSON object per span (grep/jq-friendly).  Setting
   ``DYN_TRACE_JSONL=/path/file.jsonl`` streams every finished span there
-  live.
+  live.  ``DYN_TRACE_MAX_BYTES`` bounds it: when the file would exceed the
+  limit it rotates to ``file.jsonl.1`` (replacing any previous rotation)
+  and a fresh file starts — at most ~2x the limit on disk, newest spans
+  always in the live file.  0/unset = unbounded (previous behavior).
 - ``export_chrome_trace`` — Chrome trace-event format ("X" complete events,
   microsecond timestamps) loadable in ``chrome://tracing`` or Perfetto;
   components render as processes, requests as threads.
@@ -101,12 +104,30 @@ class SpanHandle:
         )
 
 class SpanRecorder:
-    def __init__(self, max_spans: int | None = None, jsonl_path: str | None = None):
+    def __init__(
+        self,
+        max_spans: int | None = None,
+        jsonl_path: str | None = None,
+        max_jsonl_bytes: int | None = None,
+    ):
         if max_spans is None:
             max_spans = int(os.environ.get("DYN_TRACE_BUFFER", _DEFAULT_BUFFER))
         self._spans: deque[Span] = deque(maxlen=max(max_spans, 1))
         self._lock = threading.Lock()
         self._jsonl_path = jsonl_path or os.environ.get("DYN_TRACE_JSONL") or None
+        if max_jsonl_bytes is None:
+            try:
+                max_jsonl_bytes = int(os.environ.get("DYN_TRACE_MAX_BYTES", "0"))
+            except ValueError:
+                max_jsonl_bytes = 0
+        self._max_jsonl_bytes = max(max_jsonl_bytes, 0)
+        self._file_lock = threading.Lock()
+        self._jsonl_bytes = 0
+        if self._jsonl_path and self._max_jsonl_bytes:
+            try:
+                self._jsonl_bytes = os.path.getsize(self._jsonl_path)
+            except OSError:
+                self._jsonl_bytes = 0
 
     # -- recording ---------------------------------------------------------
     def start(
@@ -166,11 +187,23 @@ class SpanRecorder:
         with self._lock:
             self._spans.append(span)
         if self._jsonl_path:
-            try:
-                with open(self._jsonl_path, "a") as f:
-                    f.write(json.dumps(span.to_dict(), default=str) + "\n")
-            except OSError:
-                pass  # live export is best-effort; the buffer still has it
+            line = json.dumps(span.to_dict(), default=str) + "\n"
+            with self._file_lock:
+                try:
+                    if (
+                        self._max_jsonl_bytes
+                        and self._jsonl_bytes
+                        and self._jsonl_bytes + len(line) > self._max_jsonl_bytes
+                    ):
+                        # size-based rotation: keep one previous generation,
+                        # newest spans always land in the live file
+                        os.replace(self._jsonl_path, self._jsonl_path + ".1")
+                        self._jsonl_bytes = 0
+                    with open(self._jsonl_path, "a") as f:
+                        f.write(line)
+                    self._jsonl_bytes += len(line)
+                except OSError:
+                    pass  # live export is best-effort; the buffer still has it
 
     # -- querying ----------------------------------------------------------
     def snapshot(self) -> list[Span]:

@@ -26,6 +26,8 @@ class StepSnapshot:
     kv_active_blocks: int = 0
     step_duration_s: float = 0.0
     timestamp_s: float = 0.0
+    prefill_tokens: int = 0             # prompt tokens computed this step
+    decode_tokens: int = 0              # decode positions computed this step
 
 
 class StepTelemetry:
@@ -47,6 +49,8 @@ class StepTelemetry:
         kv_active_blocks: int,
         kv_total_blocks: int,
         step_duration_s: float,
+        prefill_tokens: int = 0,
+        decode_tokens: int = 0,
     ) -> None:
         self.snapshot = StepSnapshot(
             iteration=iteration,
@@ -59,6 +63,8 @@ class StepTelemetry:
             kv_active_blocks=kv_active_blocks,
             step_duration_s=step_duration_s,
             timestamp_s=time.time(),
+            prefill_tokens=prefill_tokens,
+            decode_tokens=decode_tokens,
         )
         self.steps_total += 1
         if num_running:

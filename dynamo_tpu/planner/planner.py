@@ -32,6 +32,53 @@ class WorkloadSample:
     avg_osl: float             # generated tokens/request
     ttft_s: float = 0.0
     itl_s: float = 0.0
+    # Observed fleet utilization (observability/perf.py via the metrics
+    # service): when present, the planner sizes replicas from REAL measured
+    # per-replica throughput instead of interpolating the offline profile —
+    # the profile stays as bootstrap and fallback.
+    observed_prefill_tok_s: float = 0.0   # fleet prompt tokens/s actually served
+    observed_decode_tok_s: float = 0.0    # fleet emitted tokens/s (goodput)
+    num_prefill_replicas: int = 0
+    num_decode_replicas: int = 0
+    # mean decode-lane occupancy across the fleet: observed throughput only
+    # counts as CAPACITY when measured near saturation (an idle replica's
+    # low goodput is headroom, not a ceiling)
+    avg_occupancy: float = 0.0
+
+
+def sample_from_endpoints(
+    endpoints,
+    *,
+    request_rate: float,
+    avg_isl: float,
+    avg_osl: float,
+    ttft_s: float = 0.0,
+    itl_s: float = 0.0,
+) -> WorkloadSample:
+    """Build a WorkloadSample from a live fleet snapshot
+    (llm/kv_router/metrics_aggregator.ProcessedEndpoints): per-worker
+    goodput sums into the observed capacity terms.  Single-pool (non-disagg)
+    deployments report the same worker set for both roles; the planner only
+    consumes the role it scales."""
+    workers = list(getattr(endpoints, "workers", {}).values())
+    goodput = sum(getattr(m, "goodput_tokens_per_second", 0.0) for m in workers)
+    prefill = sum(getattr(m, "prefill_tokens_per_second", 0.0) for m in workers)
+    occupancy = (
+        sum(getattr(m, "batch_occupancy_perc", 0.0) for m in workers) / len(workers)
+        if workers else 0.0
+    )
+    return WorkloadSample(
+        avg_occupancy=occupancy,
+        request_rate=request_rate,
+        avg_isl=avg_isl,
+        avg_osl=avg_osl,
+        ttft_s=ttft_s,
+        itl_s=itl_s,
+        observed_prefill_tok_s=prefill,
+        observed_decode_tok_s=goodput,
+        num_prefill_replicas=len(workers),
+        num_decode_replicas=len(workers),
+    )
 
 
 @dataclass
@@ -49,6 +96,9 @@ class PlannerConfig:
     ttft_target_s: float = 0.0
     itl_target_s: float = 0.0
     scale_down_headroom: float = 1.3   # keep 30% slack before scaling down
+    # min fleet decode-lane occupancy for an observed-throughput sample to
+    # update the capacity estimate (see WorkloadSample.avg_occupancy)
+    saturation_occupancy: float = 0.8
 
 
 @dataclass
@@ -75,6 +125,11 @@ class Planner:
         # planner_core.py correction factors)
         self._ttft_correction = 1.0
         self._itl_correction = 1.0
+        # observed per-replica throughput (EWMA over samples that carried
+        # utilization): replaces the profile interpolation as the capacity
+        # denominator once real measurements exist
+        self._prefill_cap_obs = 0.0
+        self._decode_cap_obs = 0.0
         self.last_decision: PlannerDecision | None = None
         self._task: asyncio.Task | None = None
         self.metrics_source = None  # set for loop mode
@@ -92,6 +147,24 @@ class Planner:
             expected = self.profile.itl_s(sample.avg_isl, sample.avg_osl)
             if expected > 0:
                 self._itl_correction = sample.itl_s / expected
+        # real utilization (when the sample carries it): EWMA of measured
+        # per-replica throughput.  Only samples with actual flow update it —
+        # an idle interval says nothing about capacity.
+        alpha = 0.5
+        if sample.avg_occupancy < self.config.saturation_occupancy:
+            return
+        if sample.num_prefill_replicas > 0 and sample.observed_prefill_tok_s > 0:
+            per_replica = sample.observed_prefill_tok_s / sample.num_prefill_replicas
+            self._prefill_cap_obs = (
+                per_replica if self._prefill_cap_obs == 0
+                else alpha * per_replica + (1 - alpha) * self._prefill_cap_obs
+            )
+        if sample.num_decode_replicas > 0 and sample.observed_decode_tok_s > 0:
+            per_replica = sample.observed_decode_tok_s / sample.num_decode_replicas
+            self._decode_cap_obs = (
+                per_replica if self._decode_cap_obs == 0
+                else alpha * per_replica + (1 - alpha) * self._decode_cap_obs
+            )
 
     def plan(self) -> PlannerDecision:
         cfg = self.config
@@ -102,8 +175,14 @@ class Planner:
         prefill_demand = rate * isl          # prompt tokens/s
         decode_demand = rate * osl           # generated tokens/s
 
-        prefill_capacity = self.profile.prefill_tok_s(isl, osl) / max(self._ttft_correction, 1e-6)
-        decode_capacity = self.profile.decode_tok_s(isl, osl) / max(self._itl_correction, 1e-6)
+        # capacity: measured per-replica throughput at saturation beats the
+        # offline profile; the profile bootstraps and serves cold fleets
+        prefill_capacity = self._prefill_cap_obs or (
+            self.profile.prefill_tok_s(isl, osl) / max(self._ttft_correction, 1e-6)
+        )
+        decode_capacity = self._decode_cap_obs or (
+            self.profile.decode_tok_s(isl, osl) / max(self._itl_correction, 1e-6)
+        )
 
         num_prefill = math.ceil(prefill_demand / max(prefill_capacity, 1e-6) * cfg.scale_down_headroom) if prefill_demand else cfg.min_prefill
         num_decode = math.ceil(decode_demand / max(decode_capacity, 1e-6) * cfg.scale_down_headroom) if decode_demand else cfg.min_decode

@@ -12,6 +12,12 @@ cannot):
 
 Both sheds carry ``Retry-After`` and bump ``dyn_shed_total``.  Disabled
 (the default, ``max_inflight == 0``) every call is a no-op.
+
+SLO hook: when the HTTP frontend wires a ``burn_rate_fn`` (the SLO
+tracker's worst burn rate, observability/slo.py) and
+``shed_burn_threshold`` > 0 (``DYN_SLO_SHED_BURN``), a saturated gate stops
+queueing while the error budget is burning past the threshold — queueing
+deeper during a burn converts future 200s into future SLO violations.
 """
 
 from __future__ import annotations
@@ -66,6 +72,20 @@ class AdmissionController:
         self._inflight = 0
         self._queued = 0
         self.shed_total = 0
+        # SLO consult (set by the frontend): () -> current worst burn rate.
+        # 0 threshold = hook disabled.
+        self.burn_rate_fn = None
+        self.shed_burn_threshold = 0.0
+
+    def _burning(self) -> float | None:
+        """Current burn rate when it exceeds the shed threshold, else None."""
+        if self.shed_burn_threshold <= 0 or self.burn_rate_fn is None:
+            return None
+        try:
+            burn = float(self.burn_rate_fn())
+        except Exception:  # noqa: BLE001 — telemetry must never fail admission
+            return None
+        return burn if burn >= self.shed_burn_threshold else None
 
     @property
     def enabled(self) -> bool:
@@ -103,6 +123,9 @@ class AdmissionController:
             if self._inflight < cfg.max_inflight:
                 self._inflight += 1
                 return
+            burn = self._burning()
+            if burn is not None:
+                raise self._shed(429, f"slo burn rate {burn:.2f}")
             if self._queued >= cfg.max_queue_depth:
                 raise self._shed(429, "queue full")
             self._queued += 1

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Smoke-check the Prometheus surfaces of a running deployment.
 
-Scrapes the frontend's ``/metrics`` (``dyn_llm_*`` families) and the metrics
-service's ``/metrics`` (``dyn_worker_*`` families) and asserts every expected
-metric family is present — the fast "is observability wired at all?" gate for
-CI and for operators bringing up a fleet.
+Scrapes the frontend's ``/metrics`` (``dyn_llm_*`` + ``dyn_slo_*`` families)
+and the metrics service's ``/metrics`` (``dyn_worker_*`` families) and asserts
+every expected metric family is present AND none is declared twice — the fast
+"is observability wired at all?" gate for CI and for operators bringing up a
+fleet.
 
 Usage::
 
@@ -36,6 +37,15 @@ RESILIENCE_FAMILIES = (
     "dyn_faults_injected_total",
 )
 
+# SLO burn-rate families (dynamo_tpu/observability/slo.py), appended to the
+# frontend exposition next to the resilience counters
+SLO_FAMILIES = (
+    "dyn_slo_burn_rate_ratio",
+    "dyn_slo_good_total",
+    "dyn_slo_bad_total",
+    "dyn_slo_threshold_seconds",
+)
+
 # frontend registry (dynamo_tpu/llm/http/metrics.py) + resilience counters
 FRONTEND_FAMILIES = (
     "dyn_llm_http_service_requests_total",
@@ -45,7 +55,23 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-) + RESILIENCE_FAMILIES
+) + RESILIENCE_FAMILIES + SLO_FAMILIES
+
+# utilization accounting (dynamo_tpu/observability/perf.py → engine stats →
+# ForwardPassMetrics → metrics service)
+UTILIZATION_FAMILIES = (
+    "dyn_worker_mfu_perc",
+    "dyn_worker_bandwidth_util_perc",
+    "dyn_worker_goodput_tokens_per_second",
+    "dyn_worker_prefill_tokens_per_second",
+    "dyn_worker_prefill_tokens",
+    "dyn_worker_decode_tokens",
+    "dyn_worker_tokens_emitted",
+    "dyn_worker_preempted_tokens",
+    "dyn_worker_spec_rejected_tokens",
+    "dyn_worker_wasted_tokens",
+    "dyn_worker_engine_phase_seconds",
+)
 
 # metrics service registry (dynamo_tpu/components/metrics_service.py)
 WORKER_FAMILIES = (
@@ -61,9 +87,10 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + RESILIENCE_FAMILIES
+) + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
+_TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)
 
 
 def exposed_families(text: str) -> set[str]:
@@ -76,13 +103,25 @@ def missing_families(text: str, expected) -> list[str]:
     return [name for name in expected if name not in have]
 
 
+def duplicate_families(text: str) -> list[str]:
+    """Families declared (``# TYPE``) more than once — the signature of two
+    code paths registering the same metric, which Prometheus servers reject
+    and dashboards silently double-count."""
+    counts: dict[str, int] = {}
+    for name in _TYPE_RE.findall(text):
+        counts[name] = counts.get(name, 0) + 1
+    return sorted(name for name, n in counts.items() if n > 1)
+
+
 def _scrape(url: str, timeout: float) -> str:
     with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
         return resp.read().decode("utf-8", "replace")
 
 
-def check_url(url: str, expected, timeout: float = 5.0) -> list[str]:
-    return missing_families(_scrape(url, timeout), expected)
+def check_url(url: str, expected, timeout: float = 5.0) -> tuple[list[str], list[str]]:
+    """(missing families, duplicated families) for a live endpoint."""
+    text = _scrape(url, timeout)
+    return missing_families(text, expected), duplicate_families(text)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -102,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
         if not url:
             continue
         try:
-            missing = check_url(url, expected, args.timeout)
+            missing, duplicated = check_url(url, expected, args.timeout)
         except OSError as exc:
             print(f"{label}: scrape of {url} failed: {exc}")
             failed = True
@@ -110,7 +149,10 @@ def main(argv: list[str] | None = None) -> int:
         if missing:
             print(f"{label}: {url} missing families: {', '.join(missing)}")
             failed = True
-        else:
+        if duplicated:
+            print(f"{label}: {url} duplicate families: {', '.join(duplicated)}")
+            failed = True
+        if not missing and not duplicated:
             print(f"{label}: {url} ok ({len(expected)} families)")
     return 1 if failed else 0
 

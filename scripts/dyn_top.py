@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""dyn_top: a live ``top`` for a dynamo-tpu fleet.
+
+Polls the metrics service (per-worker ``dyn_worker_*`` gauges) and the HTTP
+frontend (``/metrics`` + ``/slo``) and renders one screen: per-worker MFU /
+bandwidth utilization / goodput / KV usage / queue depth, fleet aggregates,
+frontend in-flight + SLO burn rates.
+
+Usage::
+
+    python scripts/dyn_top.py \
+        --frontend http://127.0.0.1:8080 \
+        --worker   http://127.0.0.1:9091 \
+        [--interval 2] [--once] [--json]
+
+Either base URL may be omitted to watch one surface.  ``--once`` renders a
+single frame and exits; ``--json`` emits the snapshot as JSON instead of a
+table (``--once --json`` is the machine mode used by tier-1 tests and
+benches).  stdlib only — usable on any node that can reach the endpoints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_METRIC_LINE_HEAD = ("#",)
+
+# dyn_worker_* gauge → snapshot key (per-worker table columns)
+WORKER_FIELDS = {
+    "dyn_worker_mfu_perc": "mfu_perc",
+    "dyn_worker_bandwidth_util_perc": "bandwidth_util_perc",
+    "dyn_worker_goodput_tokens_per_second": "goodput_tokens_per_second",
+    "dyn_worker_cache_usage_perc": "kv_usage_perc",
+    "dyn_worker_kv_active_blocks": "kv_active_blocks",
+    "dyn_worker_requests_running": "running",
+    "dyn_worker_requests_waiting": "waiting",
+    "dyn_worker_batch_occupancy_perc": "batch_occupancy_perc",
+    "dyn_worker_preemptions": "preemptions",
+    "dyn_worker_prefill_tokens": "prefill_tokens",
+    "dyn_worker_decode_tokens": "decode_tokens",
+    "dyn_worker_tokens_emitted": "tokens_emitted",
+    "dyn_worker_wasted_tokens": "wasted_tokens",
+}
+
+
+def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
+    """Minimal text-exposition parser: (family, labels, value) samples."""
+    out: list[tuple[str, dict, float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith(_METRIC_LINE_HEAD):
+            continue
+        try:
+            metric, value_str = line.rsplit(" ", 1)
+            value = float(value_str)
+        except ValueError:
+            continue
+        labels: dict[str, str] = {}
+        name = metric
+        if "{" in metric and metric.endswith("}"):
+            name, _, label_body = metric.partition("{")
+            for pair in label_body[:-1].split(","):
+                if "=" not in pair:
+                    continue
+                k, _, v = pair.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        out.append((name, labels, value))
+    return out
+
+
+def _fetch(url: str, timeout: float) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310
+        return resp.read().decode("utf-8", "replace")
+
+
+def collect_snapshot(
+    frontend: str | None = None,
+    worker: str | None = None,
+    timeout: float = 5.0,
+) -> dict:
+    """One fleet snapshot (the ``--json`` payload).  Unreachable surfaces
+    degrade to an ``error`` field rather than failing the whole frame —
+    a top must keep rendering while half the fleet restarts."""
+    snap: dict = {"ts": time.time(), "workers": {}, "fleet": {}, "frontend": {}}
+
+    if worker:
+        try:
+            samples = parse_prometheus(_fetch(worker.rstrip("/") + "/metrics", timeout))
+        except (OSError, urllib.error.URLError) as exc:
+            snap["workers_error"] = str(exc)
+            samples = []
+        workers: dict[str, dict] = {}
+        for name, labels, value in samples:
+            key = WORKER_FIELDS.get(name)
+            if key is None or "worker" not in labels:
+                continue
+            workers.setdefault(labels["worker"], {})[key] = value
+        snap["workers"] = workers
+        if workers:
+            rows = list(workers.values())
+            snap["fleet"] = {
+                "workers": len(rows),
+                "goodput_tokens_per_second": sum(
+                    r.get("goodput_tokens_per_second", 0.0) for r in rows
+                ),
+                "mfu_perc_avg": sum(r.get("mfu_perc", 0.0) for r in rows) / len(rows),
+                "kv_usage_perc_avg": sum(
+                    r.get("kv_usage_perc", 0.0) for r in rows
+                ) / len(rows),
+                "waiting": sum(r.get("waiting", 0.0) for r in rows),
+                "running": sum(r.get("running", 0.0) for r in rows),
+            }
+
+    if frontend:
+        base = frontend.rstrip("/")
+        front: dict = {}
+        try:
+            samples = parse_prometheus(_fetch(base + "/metrics", timeout))
+            front["inflight"] = sum(
+                v for n, _l, v in samples
+                if n == "dyn_llm_http_service_inflight_requests"
+            )
+            front["requests_total"] = sum(
+                v for n, _l, v in samples
+                if n == "dyn_llm_http_service_requests_total"
+            )
+            front["shed_total"] = sum(
+                v for n, _l, v in samples if n == "dyn_shed_total"
+            )
+        except (OSError, urllib.error.URLError) as exc:
+            front["error"] = str(exc)
+        try:
+            front["slo"] = json.loads(_fetch(base + "/slo", timeout))
+        except (OSError, urllib.error.URLError, ValueError) as exc:
+            # /metrics answering but /slo down is a degraded frontend, not a
+            # dead one — keep the keys distinct so --once can tell them apart
+            front["slo_error"] = str(exc)
+        snap["frontend"] = front
+
+    return snap
+
+
+# -- rendering ---------------------------------------------------------------
+def _pct(value: float | None) -> str:
+    return "-" if value is None else f"{100.0 * value:5.1f}%"
+
+
+def _num(value: float | None, width: int = 8) -> str:
+    if value is None:
+        return "-".rjust(width)
+    if value >= 1e6:
+        return f"{value / 1e6:.1f}M".rjust(width)
+    if value >= 1e4:
+        return f"{value / 1e3:.1f}k".rjust(width)
+    return f"{value:.6g}".rjust(width)
+
+
+def render_table(snap: dict) -> str:
+    lines: list[str] = []
+    ts = time.strftime("%H:%M:%S", time.localtime(snap.get("ts", time.time())))
+    lines.append(f"dynamo-tpu fleet — {ts}")
+    workers = snap.get("workers") or {}
+    if snap.get("workers_error"):
+        lines.append(f"  workers: unreachable ({snap['workers_error']})")
+    if workers:
+        lines.append(
+            f"  {'WORKER':<10} {'MFU':>7} {'BW':>7} {'GOODPUT/s':>10} "
+            f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} {'WASTED':>8}"
+        )
+        for wid in sorted(workers):
+            r = workers[wid]
+            lines.append(
+                f"  {wid:<10} {_pct(r.get('mfu_perc')):>7} "
+                f"{_pct(r.get('bandwidth_util_perc')):>7} "
+                f"{_num(r.get('goodput_tokens_per_second'), 10)} "
+                f"{_pct(r.get('kv_usage_perc')):>7} "
+                f"{_pct(r.get('batch_occupancy_perc')):>7} "
+                f"{_num(r.get('running'), 5)} {_num(r.get('waiting'), 5)} "
+                f"{_num(r.get('preemptions'), 8)} {_num(r.get('wasted_tokens'), 8)}"
+            )
+        fleet = snap.get("fleet") or {}
+        if fleet:
+            lines.append(
+                f"  {'FLEET':<10} {_pct(fleet.get('mfu_perc_avg')):>7} {'':>7} "
+                f"{_num(fleet.get('goodput_tokens_per_second'), 10)} "
+                f"{_pct(fleet.get('kv_usage_perc_avg')):>7} {'':>7} "
+                f"{_num(fleet.get('running'), 5)} {_num(fleet.get('waiting'), 5)}"
+            )
+    front = snap.get("frontend") or {}
+    if front:
+        lines.append("")
+        if front.get("error"):
+            lines.append(f"  frontend: unreachable ({front['error']})")
+        else:
+            lines.append(
+                f"  frontend: inflight={front.get('inflight', 0):g} "
+                f"requests={front.get('requests_total', 0):g} "
+                f"shed={front.get('shed_total', 0):g}"
+            )
+        if front.get("slo_error"):
+            lines.append(f"  slo: unreachable ({front['slo_error']})")
+        slo = front.get("slo") or {}
+        objectives = slo.get("objectives") or {}
+        if objectives:
+            windows = [str(int(w)) for w in slo.get("windows_s", [])]
+            header = "  SLO burn   " + " ".join(f"{w + 's':>10}" for w in windows)
+            lines.append(header)
+            for name, obj in objectives.items():
+                cells = []
+                for w in windows:
+                    rate = (obj.get("windows", {}).get(w) or {}).get("burn_rate", 0.0)
+                    cells.append(f"{rate:>10.2f}")
+                target = obj.get("target")
+                lines.append(
+                    f"  {name:<10} " + " ".join(cells) + f"   (target {target:g})"
+                )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--frontend", help="frontend base URL (http://host:port)")
+    parser.add_argument("--worker", help="metrics service base URL")
+    parser.add_argument("--interval", type=float, default=2.0)
+    parser.add_argument("--once", action="store_true", help="one frame, then exit")
+    parser.add_argument("--json", action="store_true", help="emit JSON snapshots")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    args = parser.parse_args(argv)
+    if not args.frontend and not args.worker:
+        parser.error("give --frontend and/or --worker")
+
+    while True:
+        snap = collect_snapshot(args.frontend, args.worker, args.timeout)
+        if args.json:
+            print(json.dumps(snap))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear screen, home cursor
+            print(render_table(snap))
+        if args.once:
+            # exit nonzero only when EVERY requested surface was
+            # unreachable: a bench gating on --once must not mistake a
+            # reachable-but-idle fleet (no workers registered yet, or /slo
+            # alone down) for a dead one
+            worker_up = args.worker and "workers_error" not in snap
+            frontend_up = args.frontend and not snap["frontend"].get("error")
+            return 0 if (worker_up or frontend_up) else 1
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,115 @@
+"""scripts/dyn_top.py against an in-process fleet: a frontend + metrics
+service + one publishing mock worker must yield a complete ``--once --json``
+snapshot (the machine mode benches and operators script against)."""
+
+import asyncio
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent.parent / "scripts"))
+from dyn_top import collect_snapshot, main, parse_prometheus, render_table  # noqa: E402
+
+from dynamo_tpu.components.metrics_service import MetricsService
+from dynamo_tpu.llm.http.service import HttpService
+from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+
+STATS = {
+    "kv_active_blocks": 7,
+    "kv_total_blocks": 64,
+    "gpu_cache_usage_perc": 7 / 64,
+    "num_requests_waiting": 2,
+    "num_requests_running": 3,
+    "batch_occupancy_perc": 3 / 8,
+    "mfu_perc": 0.42,
+    "bandwidth_util_perc": 0.63,
+    "goodput_tokens_per_second": 123.5,
+    "prefill_tokens_per_second": 20.0,
+    "prefill_tokens_total": 4096,
+    "decode_tokens_total": 1024,
+    "tokens_emitted_total": 1000,
+    "preempted_tokens_total": 128,
+    "spec_rejected_tokens_total": 8,
+    "wasted_tokens_total": 136,
+}
+
+
+def test_parse_prometheus_lines():
+    text = (
+        "# HELP dyn_worker_mfu_perc x\n"
+        "# TYPE dyn_worker_mfu_perc gauge\n"
+        'dyn_worker_mfu_perc{worker="ab"} 0.5\n'
+        "dyn_shed_total 3\n"
+        "garbage line without value\n"
+    )
+    samples = parse_prometheus(text)
+    assert ("dyn_worker_mfu_perc", {"worker": "ab"}, 0.5) in samples
+    assert ("dyn_shed_total", {}, 3.0) in samples
+
+
+async def test_dyn_top_once_json_against_in_process_fleet(capsys):
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://dyn-top")
+    )
+    frontend = HttpService(host="127.0.0.1", port=0)
+    comp = rt.namespace("ns").component("backend")
+    metrics_svc = MetricsService(comp, host="127.0.0.1", port=0)
+    pub = WorkerMetricsPublisher(comp, worker_id=0xAB, stats_fn=lambda: STATS)
+    try:
+        await frontend.start()
+        await metrics_svc.start()
+        await pub.publish_once()
+        # a served request so the frontend section has real numbers
+        g = frontend.metrics.guard("m", "chat_completions", "stream", trace_id="t1")
+        g.token_observed()
+        g.mark_ok()
+        g.done()
+        await asyncio.sleep(0.1)
+
+        frontend_url = f"http://127.0.0.1:{frontend.port}"
+        worker_url = f"http://127.0.0.1:{metrics_svc.port}"
+        # urllib is blocking: keep it off the loop serving the scrape
+        rc = await asyncio.to_thread(
+            main, ["--frontend", frontend_url, "--worker", worker_url,
+                   "--once", "--json"]
+        )
+        assert rc == 0
+        snap = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        worker = snap["workers"]["ab"]
+        assert worker["mfu_perc"] == 0.42
+        assert worker["bandwidth_util_perc"] == 0.63
+        assert worker["goodput_tokens_per_second"] == 123.5
+        assert worker["waiting"] == 2.0 and worker["running"] == 3.0
+        assert snap["fleet"]["workers"] == 1
+        assert snap["fleet"]["goodput_tokens_per_second"] == 123.5
+        assert snap["frontend"]["requests_total"] == 1.0
+        assert set(snap["frontend"]["slo"]["objectives"]) == {
+            "ttft", "itl", "error_rate"
+        }
+        # the human table renders the same snapshot without raising
+        table = render_table(snap)
+        assert "WORKER" in table and "ab" in table and "SLO burn" in table
+    finally:
+        await pub.stop()
+        await metrics_svc.stop()
+        await frontend.stop()
+        await rt.close()
+
+
+async def test_dyn_top_degrades_when_surfaces_are_down():
+    snap = await asyncio.to_thread(
+        collect_snapshot, "http://127.0.0.1:9", "http://127.0.0.1:9", 0.3
+    )
+    assert snap["workers"] == {}
+    assert "workers_error" in snap
+    assert "error" in snap["frontend"]
+    # --once against a dead fleet must exit nonzero
+    rc = await asyncio.to_thread(
+        main, ["--frontend", "http://127.0.0.1:9", "--once", "--json",
+               "--timeout", "0.3"]
+    )
+    assert rc == 1

@@ -143,3 +143,46 @@ def test_step_telemetry_snapshot_and_counters():
     assert t.stats()["batch_occupancy_perc"] == 1.0
     assert t.stats()["step_kv_usage_perc"] == 1.0
     assert t.snapshot.kv_usage_perc == 1.0
+
+
+def test_jsonl_rotation_bounds_disk(tmp_path):
+    """DYN_TRACE_MAX_BYTES: the live JSONL export rotates to ``.1`` instead
+    of growing without bound; newest spans are always in the live file."""
+    path = tmp_path / "spans.jsonl"
+    rec = SpanRecorder(max_spans=512, jsonl_path=str(path), max_jsonl_bytes=2048)
+    for i in range(100):
+        rec.record(
+            f"span-{i:03d}", TraceContext.new_root("t"), 1.0, 2.0, component="c"
+        )
+    rotated = tmp_path / "spans.jsonl.1"
+    assert rotated.exists()
+    assert path.stat().st_size <= 2048
+    assert rotated.stat().st_size <= 2048
+    # the newest span landed in the live file
+    live_names = [json.loads(line)["name"] for line in path.read_text().splitlines()]
+    assert live_names[-1] == "span-099"
+    # only one rotated generation is kept (~2x the limit on disk, total)
+    assert not (tmp_path / "spans.jsonl.2").exists()
+
+
+def test_jsonl_rotation_resumes_from_existing_file(tmp_path):
+    """A restarted process accounts the bytes already in the file, so the
+    limit holds across process lifetimes."""
+    path = tmp_path / "spans.jsonl"
+    path.write_text("x" * 1900 + "\n")
+    rec = SpanRecorder(max_spans=8, jsonl_path=str(path), max_jsonl_bytes=2048)
+    rec.record("after-restart", TraceContext.new_root("t"), 1.0, 2.0, component="c")
+    # the big pre-existing file rotated away; the new span is live
+    assert (tmp_path / "spans.jsonl.1").exists()
+    assert "after-restart" in path.read_text()
+
+
+def test_step_telemetry_token_counts():
+    t = StepTelemetry(max_batch_size=8)
+    t.observe_step(
+        iteration=1, num_running=1, num_waiting=0, kv_active_blocks=1,
+        kv_total_blocks=64, step_duration_s=0.01,
+        prefill_tokens=32, decode_tokens=4,
+    )
+    assert t.snapshot.prefill_tokens == 32
+    assert t.snapshot.decode_tokens == 4

@@ -232,3 +232,69 @@ def test_make_predictor_aliases():
 
     assert isinstance(make_predictor("arima"), ArPredictor)
     assert isinstance(make_predictor("prophet", period=4), SeasonalPredictor)
+
+
+# ------------------------------------------------- observed utilization
+
+
+async def test_observed_utilization_replaces_profile_capacity():
+    """A saturated fleet's measured per-replica goodput must become the
+    capacity denominator — the offline profile only bootstraps."""
+    connector = RecordingConnector()
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", max_prefill=16, max_decode=16, max_total_chips=64,
+        scale_down_headroom=1.0,
+    ))
+    # measured at saturation: 2 decode replicas actually serve 200 tok/s
+    # each (vs 1000 profiled) and 2000 prompt tok/s each (vs 10000)
+    sample = WorkloadSample(
+        request_rate=10, avg_isl=512, avg_osl=64,
+        observed_prefill_tok_s=4000, observed_decode_tok_s=400,
+        num_prefill_replicas=2, num_decode_replicas=2, avg_occupancy=1.0,
+    )
+    d = await planner.step(sample)
+    # demand: 5120 prompt tok/s / 2000 → 3 prefill; 640 tok/s / 200 → 4 decode
+    assert (d.num_prefill, d.num_decode) == (3, 4)
+
+
+async def test_idle_fleet_throughput_is_not_capacity():
+    """Below the saturation-occupancy gate an observed-throughput sample
+    must NOT shrink the capacity estimate: low goodput on an idle fleet is
+    headroom, not a ceiling."""
+    connector = RecordingConnector()
+    planner = Planner(profile(), connector, PlannerConfig(
+        predictor="constant", max_prefill=16, max_decode=16, max_total_chips=64,
+        scale_down_headroom=1.0,
+    ))
+    sample = WorkloadSample(
+        request_rate=10, avg_isl=512, avg_osl=64,
+        observed_prefill_tok_s=100, observed_decode_tok_s=10,
+        num_prefill_replicas=2, num_decode_replicas=2, avg_occupancy=0.1,
+    )
+    d = await planner.step(sample)
+    # profile capacity still rules: same answer as the plain-load test
+    assert (d.num_prefill, d.num_decode) == (1, 1)
+
+
+def test_sample_from_endpoints_sums_worker_utilization():
+    from dynamo_tpu.llm.kv_router.metrics_aggregator import ProcessedEndpoints
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.planner.planner import sample_from_endpoints
+
+    endpoints = ProcessedEndpoints(workers={
+        1: ForwardPassMetrics(
+            worker_id=1, goodput_tokens_per_second=100.0,
+            prefill_tokens_per_second=1000.0, batch_occupancy_perc=0.9,
+        ),
+        2: ForwardPassMetrics(
+            worker_id=2, goodput_tokens_per_second=50.0,
+            prefill_tokens_per_second=500.0, batch_occupancy_perc=0.7,
+        ),
+    })
+    s = sample_from_endpoints(
+        endpoints, request_rate=5.0, avg_isl=512, avg_osl=64
+    )
+    assert s.observed_decode_tok_s == 150.0
+    assert s.observed_prefill_tok_s == 1500.0
+    assert s.num_decode_replicas == 2
+    assert s.avg_occupancy == pytest.approx(0.8)
