@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 from dynamo_tpu.bench.data_generator import Session, SessionConfig, generate_sessions
 from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
@@ -74,6 +74,16 @@ class FleetConfig:
     # jax mode: engine context window; size it to the workload's longest
     # history (main() computes this from the session config)
     max_model_len: int = 512
+    # parked-session mode (run_parked): host offload tier size in blocks —
+    # 0 mounts no tier (the plain routing bench); the prefetch gate for the
+    # engines (None = DYN_PREFETCH env); and an emulated per-block page-in
+    # latency applied to EVERY tier read (demand and prefetch alike, so the
+    # comparison is fair) — on this CPU container host-tier reads are
+    # page-cache-fast, while production disk/DCN tiers pay real IO, and the
+    # bench's point is WHERE that latency lands, not how big it is
+    host_offload_blocks: int = 0
+    prefetch: bool | None = None
+    page_delay_ms: float = 0.0
 
     def __post_init__(self) -> None:
         if self.engine == "jax" and self.speedup != 1.0:
@@ -111,7 +121,7 @@ def _make_fleet_engine(cfg: FleetConfig, params_cache: dict):
         buckets = tuple(
             b for b in (128, 256, 512, 1024, 2048) if b < cfg.max_model_len
         ) + (cfg.max_model_len,)
-        return JaxLlmEngine(
+        engine = JaxLlmEngine(
             EngineConfig(
                 model=mcfg,
                 num_blocks=cfg.num_blocks,
@@ -119,9 +129,14 @@ def _make_fleet_engine(cfg: FleetConfig, params_cache: dict):
                 max_batch_size=cfg.max_batch_size,
                 prefill_buckets=buckets,
                 max_model_len=cfg.max_model_len,
+                host_offload_blocks=cfg.host_offload_blocks,
+                prefetch=cfg.prefetch,
             ),
             params=params_cache["params"],
         )
+        if cfg.page_delay_ms and engine.host_tier is not None:
+            _emulate_tier_latency(engine.host_tier, cfg.page_delay_ms)
+        return engine
     raise ValueError(f"unknown fleet engine {cfg.engine!r} (want mocker|jax)")
 
 
@@ -161,6 +176,22 @@ async def _teardown_fleet(handles) -> None:
 def _pctile(xs: list[float], q: float) -> float | None:
     xs = sorted(xs)
     return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+
+def _emulate_tier_latency(host_tier, page_delay_ms: float) -> None:
+    """Give the offload tier a per-block read latency (sleep on the device
+    thread, exactly where real disk/DCN IO would block).  Applies to every
+    restore — demand paging pays it inside admission, prefetch pays it
+    between steps before the request arrives — so only the PLACEMENT of
+    the latency differs between the bench's modes."""
+    orig = host_tier.read_pinned_many
+    delay_s = page_delay_ms / 1000.0
+
+    def slow_read(seq_hashes, _orig=orig, _d=delay_s):
+        time.sleep(_d * len(seq_hashes))
+        return _orig(seq_hashes)
+
+    host_tier.read_pinned_many = slow_read
 
 
 async def run_fleet(
@@ -296,34 +327,314 @@ async def compare_policies(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Parked-session mode: predictive prefetch vs demand paging vs warm cache
+# ---------------------------------------------------------------------------
+
+PARKED_MODES = ("demand", "prefetch", "warm")
+
+
+def parked_blocks_per_session(session_cfg: SessionConfig, block_size: int) -> int:
+    """KV blocks one two-turn session holds after its returning turn —
+    sizes the host tier and validates that the workload overflows HBM."""
+    tokens = session_cfg.system_tokens + 2 * (
+        session_cfg.user_tokens_per_turn + session_cfg.osl
+    )
+    return tokens // block_size + 2
+
+
+async def run_parked(
+    mode: str,
+    sessions: list[Session],
+    fleet_cfg: FleetConfig,
+    *,
+    hint_lead_s: float = 0.4,
+    wave: int = 4,
+) -> dict:
+    """Park ``sessions`` (turn 1 runs, then the session goes idle and its KV
+    pages out under HBM pressure), then bring every session back for turn 2
+    and measure the RETURNING turn's TTFT.
+
+    - ``demand``:   DYN_PREFETCH=0 semantics — the page-in runs inside
+      admission, on the returning request's critical path.
+    - ``prefetch``: an arrival hint fires ``hint_lead_s`` before the
+      request (the frontend's admission-time hint), the router's forwarder
+      targets the worker holding the prefix, and its pager pre-restores the
+      blocks — the same page-in, off the critical path.
+    - ``warm``:     reference ceiling — HBM sized to hold every session, so
+      the returning turn is a pure device prefix hit (caller passes a big
+      ``num_blocks``).
+
+    Requires ``engine='jax'`` (the mocker has no KV content to offload)."""
+    assert mode in PARKED_MODES, mode
+    if fleet_cfg.engine != "jax":
+        raise ValueError("parked-session mode needs engine='jax' (real KV)")
+    from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+    from dynamo_tpu.prefetch.hints import PREFETCH_HINT_SUBJECT, PrefetchHint
+    from dynamo_tpu.prefetch.worker import PrefetchListener
+
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane=f"memory://park-{mode}")
+    )
+    kv_router = None
+    handles = []
+    listeners: list[PrefetchListener] = []
+    try:
+        comp, ep, handles = await _serve_fleet(rt, fleet_cfg)
+        push = await PushRouter.from_endpoint(ep, mode=RouterMode.RANDOM)
+        # KV-affine dispatch in every mode: the returning turn must land on
+        # the worker holding the parked prefix for ANY policy to page it in
+        kv_router = KvRouter(
+            comp, block_size=fleet_cfg.block_size,
+            enable_prefetch=(mode == "prefetch"),
+        )
+        await kv_router.start()
+        dispatcher = KvPushRouter(push, kv_router)
+        await push.client.wait_for_instances(fleet_cfg.num_workers, timeout=10)
+        for engine, service, *_ in handles:
+            if mode == "prefetch":
+                assert engine.prefetch_pager is not None, (
+                    "prefetch mode needs engines with prefetch enabled"
+                )
+                listener = PrefetchListener(
+                    comp, engine, service.instance.instance_id
+                )
+                listener.start()
+                listeners.append(listener)
+            else:
+                assert engine.prefetch_pager is None, (
+                    f"{mode} mode must run fully demand-driven"
+                )
+            await engine.warmup()
+
+        async def one_turn(history: list[int], osl: int) -> float:
+            """Send one request; returns TTFT and extends history with the
+            ACTUAL streamed tokens (chat clients echo history)."""
+            wire = PreprocessedRequest(
+                token_ids=list(history),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                eos_token_ids=[],
+            ).to_wire()
+            t0 = time.monotonic()
+            stream = await dispatcher.generate(Context(wire))
+            ttft = None
+            async for item in stream:
+                ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+                if ann.data is None:
+                    continue
+                if ann.data.token_ids:
+                    if ttft is None:
+                        ttft = time.monotonic() - t0
+                    history.extend(ann.data.token_ids)
+            assert ttft is not None
+            return ttft
+
+        # -- park: turn 1 for every session, bounded concurrency so later
+        # sessions steadily evict earlier ones' blocks to the offload tier
+        histories: dict[int, list[int]] = {}
+        park_sem = asyncio.Semaphore(wave)
+
+        async def park_one(sess: Session) -> None:
+            history = list(sess.system_tokens) + list(sess.turns[0].user_tokens)
+            async with park_sem:
+                await one_turn(history, sess.turns[0].osl)
+            histories[sess.session_id] = history
+
+        await asyncio.gather(*[park_one(s) for s in sessions])
+        # let in-flight evictions offload before the fleet goes idle
+        await asyncio.sleep(0.2)
+
+        # -- return: turn 2 in waves, oldest (most-evicted) sessions first
+        hint_subject = comp.event_subject(PREFETCH_HINT_SUBJECT)
+        return_ttfts: list[float] = []
+
+        async def return_one(sess: Session) -> None:
+            history = histories[sess.session_id]
+            history.extend(sess.turns[1].user_tokens)
+            return_ttfts.append(await one_turn(history, sess.turns[1].osl))
+
+        ordered = sorted(sessions, key=lambda s: s.session_id)
+        for start in range(0, len(ordered), wave):
+            group = ordered[start : start + wave]
+            if mode == "prefetch":
+                # the admission-time arrival hint, hint_lead_s of paging
+                # window ahead of dispatch (frontend → forwarder → worker)
+                for sess in group:
+                    await rt.plane.bus.publish(
+                        hint_subject,
+                        PrefetchHint(
+                            block_hashes=compute_block_hashes(
+                                histories[sess.session_id],
+                                fleet_cfg.block_size,
+                            )
+                        ).to_json(),
+                    )
+                await asyncio.sleep(hint_lead_s)
+            await asyncio.gather(*[return_one(s) for s in group])
+
+        stat_sum = lambda key: sum(  # noqa: E731
+            h[0].stats().get(key, 0) for h in handles
+        )
+        ms = lambda x: None if x is None else round(x * 1000, 2)  # noqa: E731
+        return {
+            "mode": mode,
+            "num_workers": fleet_cfg.num_workers,
+            "num_sessions": len(sessions),
+            "hbm_blocks_per_worker": fleet_cfg.num_blocks,
+            "host_blocks_per_worker": fleet_cfg.host_offload_blocks,
+            "emulated_page_delay_ms_per_block": fleet_cfg.page_delay_ms,
+            "returning_ttft_p50_ms": ms(_pctile(return_ttfts, 0.5)),
+            "returning_ttft_p99_ms": ms(_pctile(return_ttfts, 0.99)),
+            "returning_ttft_mean_ms": ms(
+                sum(return_ttfts) / len(return_ttfts)
+            ),
+            "prefix_hits_total": stat_sum("prefix_hits_total"),
+            "host_restores_total": stat_sum("host_restores_total"),
+            "preemptions_total": stat_sum("num_preemptions_total"),
+            "prefetch_hits_total": stat_sum("prefetch_hits_total"),
+            "prefetch_misses_total": stat_sum("prefetch_misses_total"),
+            "prefetch_blocks_restored_total": stat_sum(
+                "prefetch_blocks_restored_total"
+            ),
+            "prefetch_hidden_seconds_total": round(
+                stat_sum("prefetch_hidden_seconds_total"), 4
+            ),
+        }
+    finally:
+        for listener in listeners:
+            await listener.stop()
+        if kv_router is not None:
+            await kv_router.stop()
+        await _teardown_fleet(handles)
+        await rt.close()
+
+
+async def compare_parked(
+    session_cfg: SessionConfig,
+    fleet_cfg: FleetConfig,
+    *,
+    hint_lead_s: float = 0.4,
+    wave: int = 4,
+) -> dict:
+    """The PREFETCH_BENCH artifact: same parked sessions replayed under
+    demand paging, predictive prefetch, and a warm-cache ceiling."""
+    sessions = generate_sessions(session_cfg)
+    parked_blocks = len(sessions) * parked_blocks_per_session(
+        session_cfg, fleet_cfg.block_size
+    )
+    if parked_blocks <= fleet_cfg.num_blocks * fleet_cfg.num_workers:
+        raise ValueError(
+            f"workload must overflow HBM: {parked_blocks} session blocks vs "
+            f"{fleet_cfg.num_blocks * fleet_cfg.num_workers} fleet HBM blocks"
+        )
+    results = {}
+    for mode in PARKED_MODES:
+        cfg = replace(
+            fleet_cfg,
+            prefetch=(mode == "prefetch"),
+            # warm ceiling: HBM holds the whole workload, nothing pages
+            **(
+                dict(
+                    num_blocks=parked_blocks + 32 * fleet_cfg.max_batch_size,
+                    host_offload_blocks=0,
+                    page_delay_ms=0.0,
+                )
+                if mode == "warm"
+                else {}
+            ),
+        )
+        results[mode] = await run_parked(
+            mode, sessions, cfg, hint_lead_s=hint_lead_s, wave=wave
+        )
+    ratio = lambda a, b, k: (  # noqa: E731
+        None if not results[b][k] else round(results[a][k] / results[b][k], 2)
+    )
+    out = {
+        "workload": {
+            "num_sessions": session_cfg.num_sessions,
+            "system_tokens": session_cfg.system_tokens,
+            "user_tokens_per_turn": session_cfg.user_tokens_per_turn,
+            "osl": session_cfg.osl,
+            "parked_blocks": parked_blocks,
+            "fleet_hbm_blocks": fleet_cfg.num_blocks * fleet_cfg.num_workers,
+            "hint_lead_s": hint_lead_s,
+        },
+        **results,
+        # the headline: how much returning-turn latency prefetch removes
+        # vs demand paging, and how close it gets to the warm ceiling
+        "demand_over_prefetch_ttft_p50": ratio(
+            "demand", "prefetch", "returning_ttft_p50_ms"
+        ),
+        "demand_over_prefetch_ttft_mean": ratio(
+            "demand", "prefetch", "returning_ttft_mean_ms"
+        ),
+        "prefetch_over_warm_ttft_p50": ratio(
+            "prefetch", "warm", "returning_ttft_p50_ms"
+        ),
+    }
+    logger.info(
+        "parked-session returning-turn TTFT: demand/prefetch p50 %sx, "
+        "prefetch/warm p50 %sx",
+        out["demand_over_prefetch_ttft_p50"],
+        out["prefetch_over_warm_ttft_p50"],
+    )
+    return out
+
+
 def main() -> int:
     import argparse
     import json
-    from dataclasses import replace
 
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--out", default=None)
     parser.add_argument("--num-workers", type=int, default=4)
-    parser.add_argument("--num-sessions", type=int, default=32)
+    parser.add_argument(
+        "--sessions", "--num-sessions", dest="num_sessions", type=int,
+        default=32,
+    )
     parser.add_argument("--turns", type=int, default=4)
     parser.add_argument(
         "--engine", default="mocker", choices=["mocker", "jax"],
         help="mocker = cost-model sim (reference-style); jax = real engines"
     )
+    parser.add_argument(
+        "--park", action="store_true",
+        help="parked-session prefetch bench: sessions >> HBM capacity, "
+        "returning-turn TTFT under demand paging vs predictive prefetch vs "
+        "a warm-cache ceiling (forces --engine jax, 2 turns)",
+    )
+    parser.add_argument(
+        "--hbm-blocks", type=int, default=96,
+        help="park mode: per-worker HBM blocks (the capacity sessions "
+        "must overflow)",
+    )
+    parser.add_argument(
+        "--page-delay-ms", type=float, default=2.0,
+        help="park mode: emulated per-block tier read latency (0 = raw "
+        "host-DRAM speed)",
+    )
+    parser.add_argument("--hint-lead", type=float, default=0.4)
     args = parser.parse_args()
+    if args.park:
+        args.engine = "jax"
     if args.out is None:
         args.out = (
-            "ROUTED_FLEET.json" if args.engine == "mocker"
+            "PREFETCH_BENCH.json" if args.park
+            else "ROUTED_FLEET.json" if args.engine == "mocker"
             else "ROUTED_FLEET_JAX.json"
         )
     session_cfg = replace(
         SessionConfig(), num_sessions=args.num_sessions,
-        turns_per_session=args.turns,
+        turns_per_session=2 if args.park else args.turns,
         # real engines prefill the real history: keep the workload inside
         # the tiny geometry's bucket ladder (mocker scales are unaffected)
         **(
-            dict(system_tokens=256, user_tokens_per_turn=48, osl=16,
+            dict(system_tokens=160, user_tokens_per_turn=32, osl=8,
                  vocab_size=480)
+            if args.park
+            else dict(system_tokens=256, user_tokens_per_turn=48, osl=16,
+                      vocab_size=480)
             if args.engine == "jax" else {}
         ),
     )
@@ -331,9 +642,10 @@ def main() -> int:
     # window sized to the longest session history so any --turns fits
     extra = {}
     if args.engine == "jax":
+        turns = 2 if args.park else args.turns
         longest = (
             session_cfg.system_tokens
-            + args.turns * (session_cfg.user_tokens_per_turn + session_cfg.osl)
+            + turns * (session_cfg.user_tokens_per_turn + session_cfg.osl)
             + 32
         )
         extra = {
@@ -343,7 +655,22 @@ def main() -> int:
     fleet_cfg = FleetConfig(
         num_workers=args.num_workers, engine=args.engine, **extra,
     )
-    result = asyncio.run(compare_policies(session_cfg, fleet_cfg))
+    if args.park:
+        blocks_per_session = parked_blocks_per_session(
+            session_cfg, fleet_cfg.block_size
+        )
+        fleet_cfg = replace(
+            fleet_cfg,
+            num_blocks=args.hbm_blocks,
+            # the host tier parks the whole fleet's overflow
+            host_offload_blocks=args.num_sessions * blocks_per_session + 64,
+            page_delay_ms=args.page_delay_ms,
+        )
+        result = asyncio.run(
+            compare_parked(session_cfg, fleet_cfg, hint_lead_s=args.hint_lead)
+        )
+    else:
+        result = asyncio.run(compare_policies(session_cfg, fleet_cfg))
     if args.engine == "jax":
         # stamp where the real engines actually ran — a CPU-fallback
         # artifact must not read as an on-TPU result

@@ -142,6 +142,51 @@ class MetricsService:
             "(DYN_ENGINE_PHASE_TIMING=1)",
             ["worker", "phase"], registry=self.registry,
         )
+        # predictive prefetch (prefetch/pager.py via engine stats):
+        # canonical dyn_prefetch_* family names from the subsystem contract
+        # — mirrored remote counters, so gauges (same rationale as the
+        # resilience counters below)
+        self.prefetch_hits = Gauge(
+            "dyn_prefetch_hits_total",
+            "Prefetched KV blocks consumed by a sequence before eviction "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefetch_misses = Gauge(
+            "dyn_prefetch_misses_total",
+            "Prefetched KV blocks evicted before any sequence matched them "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefetch_stale = Gauge(
+            "dyn_prefetch_stale_total",
+            "Prefetch hints cancelled because they expired before paging ran "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.prefetch_hidden = Gauge(
+            "dyn_prefetch_hidden_seconds",
+            "Page-in wall seconds moved off request critical paths by "
+            "prefetch (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        # offload-tier occupancy (engine offload_tiers snapshot): capacity
+        # and usage per mounted tier (g2 host / g3 disk / g4 remote)
+        self.offload_blocks = Gauge(
+            "dyn_worker_offload_blocks",
+            "Offload-tier capacity in KV blocks",
+            ["worker", "tier"], registry=self.registry,
+        )
+        self.offload_blocks_used = Gauge(
+            "dyn_worker_offload_blocks_used",
+            "Offload-tier blocks holding content",
+            ["worker", "tier"], registry=self.registry,
+        )
+        self.offload_blocks_pinned = Gauge(
+            "dyn_worker_offload_blocks_pinned",
+            "Hot shared prefixes pinned tier-resident",
+            ["worker", "tier"], registry=self.registry,
+        )
         self._worker_gauges = (
             self.kv_active, self.kv_total, self.cache_usage, self.waiting,
             self.running, self.batch_occupancy, self.preemptions,
@@ -149,9 +194,12 @@ class MetricsService:
             self.mfu, self.bandwidth_util, self.goodput, self.prefill_rate,
             self.prefill_tokens, self.decode_tokens, self.tokens_emitted,
             self.preempted_tokens, self.spec_rejected, self.wasted_tokens,
+            self.prefetch_hits, self.prefetch_misses, self.prefetch_stale,
+            self.prefetch_hidden,
         )
         self._seen_workers: set[str] = set()
         self._seen_phases: set[tuple[str, str]] = set()
+        self._seen_tiers: set[tuple[str, str]] = set()
         self.hit_blocks = Counter(
             f"{PREFIX}_kv_hit_blocks_total", "Matched prefix blocks routed", registry=self.registry
         )
@@ -227,6 +275,17 @@ class MetricsService:
                 except KeyError:
                     pass
                 self._seen_phases.discard((label, phase))
+        for label, tier in list(self._seen_tiers):
+            if label not in live:
+                for g in (
+                    self.offload_blocks, self.offload_blocks_used,
+                    self.offload_blocks_pinned,
+                ):
+                    try:
+                        g.remove(label, tier)
+                    except KeyError:
+                        pass
+                self._seen_tiers.discard((label, tier))
         self._seen_workers = live
         for wid, m in snapshot.workers.items():
             label = f"{wid:x}"
@@ -250,6 +309,17 @@ class MetricsService:
             self.preempted_tokens.labels(label).set(m.preempted_tokens_total)
             self.spec_rejected.labels(label).set(m.spec_rejected_tokens_total)
             self.wasted_tokens.labels(label).set(m.wasted_tokens_total)
+            self.prefetch_hits.labels(label).set(m.prefetch_hits_total)
+            self.prefetch_misses.labels(label).set(m.prefetch_misses_total)
+            self.prefetch_stale.labels(label).set(m.prefetch_stale_total)
+            self.prefetch_hidden.labels(label).set(m.prefetch_hidden_seconds_total)
+            for tier, row in (m.offload_tiers or {}).items():
+                self.offload_blocks.labels(label, tier).set(row.get("blocks", 0))
+                self.offload_blocks_used.labels(label, tier).set(row.get("used", 0))
+                self.offload_blocks_pinned.labels(label, tier).set(
+                    row.get("pinned", 0)
+                )
+                self._seen_tiers.add((label, tier))
             phases_now = set(m.phase_seconds or {})
             for phase, seconds in (m.phase_seconds or {}).items():
                 self.phase_seconds.labels(label, phase).set(seconds)

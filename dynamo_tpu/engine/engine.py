@@ -33,7 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dynamo_tpu.engine.kv_manager import BlockAllocator, KvEvent
+from dynamo_tpu.engine.kv_manager import (
+    BlockAllocator,
+    KvEvent,
+    compute_block_hashes,
+)
 from dynamo_tpu.engine.scheduler import Scheduler
 from dynamo_tpu.engine.sequence import Sequence, SeqStatus
 from dynamo_tpu.llm.protocols.common import (
@@ -177,6 +181,13 @@ class EngineConfig:
     # host_offload_blocks > 0).  Reference: the remote tier of the block
     # manager, lib/llm/src/block_manager.rs:68-81.
     remote_store_addr: str | None = None
+    # Predictive prefetch over the offload tiers (prefetch/): hinted
+    # prefixes page disk→host→HBM between engine steps, bounded by an HBM
+    # headroom reservation so prefetch can never preempt running work, and
+    # hot prefixes pin host-resident.  None = DYN_PREFETCH env (default
+    # on); only effective when an offload tier is mounted.  DYN_PREFETCH=0
+    # restores fully demand-driven paging.
+    prefetch: bool | None = None
     # Compile-time K for per-token top-k alternatives (OpenAI
     # top_logprobs caps at 20).  K>0 adds one lax.top_k over [lanes, vocab]
     # to every step (the host transfer of the rows is skipped unless a
@@ -690,6 +701,38 @@ class JaxLlmEngine:
             enable_prefix_caching=self.prefix_caching,
             offload_sink=offload_sink, host_tier=self.host_tier,
         )
+        # predictive prefetch: pager + HBM headroom reservation (only with
+        # an offload tier mounted — with nothing below HBM there is nothing
+        # to page in ahead of time)
+        self.prefetch_pager = None
+        self._prefetch_headroom_blocks = 0
+        if self.host_tier is not None:
+            from dynamo_tpu.prefetch.hints import prefetch_enabled
+            from dynamo_tpu.prefetch.pager import PrefetchPager
+
+            enabled = (
+                config.prefetch if config.prefetch is not None
+                else prefetch_enabled()
+            )
+            if enabled:
+                from dynamo_tpu.observability import TraceContext
+
+                self.prefetch_pager = PrefetchPager(
+                    ttl_s=float(os.environ.get("DYN_PREFETCH_TTL", "30")),
+                    blocks_per_step=int(os.environ.get("DYN_PREFETCH_BLOCKS", "64")),
+                )
+                self._prefetch_trace = TraceContext.new_root()
+                self.allocator.prefetch_tracker = self.prefetch_pager
+                headroom_frac = float(
+                    os.environ.get("DYN_PREFETCH_HEADROOM", "0.05")
+                )
+                self._prefetch_headroom_blocks = max(
+                    self.allocator.watermark_blocks,
+                    int(config.num_blocks * headroom_frac),
+                )
+            # nothing drains pin candidates without the pager, and
+            # DYN_PREFETCH=0 must be bookkeeping-free demand paging
+            self.host_tier.pin_enabled = self.prefetch_pager is not None
         self.scheduler = Scheduler(
             self.allocator, max_batch_size=config.max_batch_size,
             prefill_chunk_tokens=self.chunk_tokens,
@@ -1782,6 +1825,22 @@ class JaxLlmEngine:
         self._wake.set()
         await fut
 
+    # -- predictive prefetch ------------------------------------------------
+    def prefetch_hint(
+        self, block_hashes: list[int], *, source: str = "arrival"
+    ) -> bool:
+        """Announce a prefix expected to be requested soon (thread-safe;
+        called by the worker's PrefetchListener from the asyncio thread).
+        The device loop pages the hinted blocks disk→host→HBM between
+        steps.  Returns False when prefetch is disabled or there is
+        nothing new to queue."""
+        if self.prefetch_pager is None:
+            return False
+        queued = self.prefetch_pager.submit(block_hashes, source=source)
+        if queued:
+            self._wake.set()
+        return queued
+
     # -- stats / events ----------------------------------------------------
     def _sink_event(self, event: KvEvent) -> None:
         if self._event_sink is not None:
@@ -1827,6 +1886,9 @@ class JaxLlmEngine:
         )
         if self.host_tier is not None:
             out.update(self.host_tier.stats())
+            out["offload_tiers"] = self.host_tier.tiers_snapshot()
+        if self.prefetch_pager is not None:
+            out.update(self.prefetch_pager.stats())
         if self.phase_stats:
             # snapshot: the device thread inserts keys concurrently
             out["phase_ms"] = {
@@ -1852,6 +1914,16 @@ class JaxLlmEngine:
                 # into the evicted blocks
                 self.allocator.flush_offloads()
                 self._drain_submissions()
+                if self.prefetch_pager is not None and self.prefetch_pager.has_work():
+                    # page hinted blocks up-tier between steps: a bounded
+                    # slice when serving (never stalls the batch), full
+                    # throttle when idle.  Progress while idle loops again
+                    # immediately — an idle engine's job is to page.
+                    progress = self._run_prefetch(
+                        idle=not self.scheduler.has_work()
+                    )
+                    if progress and not self.scheduler.has_work():
+                        continue
                 if not self.scheduler.has_work():
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -2080,7 +2152,34 @@ class JaxLlmEngine:
             except thread_queue.Empty:
                 return
             if op == "add":
+                # read BEFORE add: after add the new sequence itself makes
+                # the scheduler busy
+                backlog = self.scheduler.has_work()
                 self.scheduler.add(seq)
+                if (
+                    self.prefetch_pager is not None
+                    and self.prefix_caching
+                    and seq.mm_embeds is None
+                    and not seq.remote_prefilled
+                    and (
+                        backlog
+                        or not self.allocator.can_allocate(
+                            len(seq.request.token_ids)
+                        )
+                    )
+                ):
+                    # queue-hint: while this sequence waits for admission
+                    # (budget/lane/blocks), its offloaded prefix pages in
+                    # behind the current batch — the page-in that demand
+                    # paging would have paid inside allocate_sequence.  An
+                    # idle engine with room admits the sequence this same
+                    # iteration, so hashing the prompt here (device
+                    # thread) would be pure duplicate work — skip it.
+                    hashes = compute_block_hashes(
+                        seq.request.token_ids, self.config.block_size
+                    )
+                    if hashes:
+                        self.prefetch_pager.submit(hashes, source="queued")
             elif op == "abort":
                 if seq.status == SeqStatus.RUNNING:
                     # abort frees the lane's blocks: drain the decode
@@ -2235,6 +2334,104 @@ class JaxLlmEngine:
             return
         if not self.allocator.is_registered(seq_hash):
             self.allocator.emit_removed([seq_hash])
+
+    # -- predictive prefetch execution (device thread) ---------------------
+    def _run_prefetch(self, idle: bool) -> bool:
+        """Drain the pager within this iteration's block budget.  Returns
+        True when any block actually moved (the idle loop uses it to keep
+        paging without sleeping; headroom-deferred work must NOT spin)."""
+        pager = self.prefetch_pager
+        budget = pager.blocks_per_step * (pager.idle_boost if idle else 1)
+        progress = False
+        moved = 0
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        while budget > 0:
+            job = pager.next_job()
+            if job is None:
+                break
+            touched, leftover = self._execute_prefetch(job.hashes, budget)
+            budget -= max(touched, 1)  # an all-resident job still costs a walk
+            moved += touched
+            progress = progress or touched > 0
+            if leftover:
+                # HBM headroom exhausted or the block budget cut the chain:
+                # retry the rest next round instead of dropping it, and
+                # stop this round (further jobs would fare the same).  The
+                # original enqueue time rides along so a hint that keeps
+                # deferring past its TTL still goes stale.
+                pager.requeue(leftover, enqueued=job.enqueued)
+                break
+        # hot-prefix pinning rides the prefetch loop (never the demand
+        # path): promote + pin prefixes that keep paging back in
+        pinned = self.host_tier.pin_hot()
+        if self._phase_timing:
+            self._phase("prefetch.page", t0)
+        if moved:
+            # prefetch work is not tied to any request: spans hang off the
+            # engine-lifetime prefetch root trace (one trace id per engine)
+            get_recorder().record(
+                "engine.prefetch", self._prefetch_trace, wall0, time.time(),
+                component="engine",
+                attrs={"blocks": moved, "idle": idle, "pinned": pinned},
+            )
+        return progress or pinned > 0
+
+    def _execute_prefetch(
+        self, hashes: list[int], budget: int
+    ) -> tuple[int, list[int]]:
+        """Page one hinted prefix toward HBM: walk the hash chain, promote
+        disk/remote-resident blocks into the host tier (OffloadManager
+        onboard path), then pre-restore host-resident blocks into device
+        landing blocks drawn from the TRULY-free list under the headroom
+        reservation.  Returns (blocks touched, leftover hashes the caller
+        must requeue: headroom-deferred plus any chain tail the block
+        budget cut off — a long prefix finishes over later iterations
+        instead of losing its tail)."""
+        pager = self.prefetch_pager
+        touched = 0
+        restore: list[int] = []
+        promote: list[int] = []
+        overflow: list[int] = []
+        for i, h in enumerate(hashes):
+            if len(restore) >= budget:
+                overflow = [
+                    x for x in hashes[i:]
+                    if not self.allocator.is_registered(x)
+                ]
+                break
+            if self.allocator.is_registered(h):
+                continue  # already in HBM
+            tier = self.host_tier.locate(h)
+            if tier is None:
+                break  # chain broken: content gone — deeper blocks useless
+            if tier > 0:
+                promote.append(h)
+            restore.append(h)
+        if promote:
+            moved = self.host_tier.promote_to_host(promote)
+            pager.record_onboarded(moved)
+            touched += moved
+        if not restore:
+            return touched, overflow
+        plan, deferred = self.allocator.prefetch_reserve(
+            restore, self._prefetch_headroom_blocks
+        )
+        if plan:
+            t0 = time.perf_counter()
+            try:
+                self._restore_blocks(plan)
+            except Exception:  # noqa: BLE001 — prefetch is best-effort; a
+                # failed speculative restore must not poison serving
+                logger.exception("prefetch restore failed")
+                self.allocator.abort_prefetch(plan)
+                return touched, []
+            cost = (time.perf_counter() - t0) / len(plan)
+            self.allocator.finish_prefetch(plan)
+            for h, _bid in plan:
+                pager.record_restored(h, cost)
+            touched += len(plan)
+        return touched, deferred + overflow
 
     def _restore_blocks(self, plan: list[tuple[int, int]]) -> None:
         """Scatter pinned host blocks into their device landing blocks (one

@@ -75,6 +75,10 @@ class BlockAllocator:
         offload_sink: Callable[[int, int], None] | None = None,
         host_tier=None,
     ):
+        # predictive prefetch (prefetch/pager.py): the pager is told when a
+        # prefetched block is consumed by a real sequence (hit) or leaves
+        # HBM unconsumed (miss).  None = no prefetch accounting.
+        self.prefetch_tracker = None
         self.num_blocks = num_blocks
         self.block_size = block_size
         # disagg's reserve/release run on the asyncio thread while the
@@ -140,6 +144,10 @@ class BlockAllocator:
             if h is not None and self._hash_to_block.get(h) == bid:
                 del self._hash_to_block[h]
                 self._pending_offload.append((bid, h))
+                if self.prefetch_tracker is not None:
+                    # a prefetched block leaving HBM before any sequence
+                    # matched it = wasted page-in (no-op if untracked)
+                    self.prefetch_tracker.on_block_evicted(h)
             return bid
         return None
 
@@ -278,6 +286,11 @@ class BlockAllocator:
             if cached_tokens:
                 self.prefix_hits_total += 1
                 self.prefix_cached_tokens_total += cached_tokens
+            if self.prefetch_tracker is not None:
+                # prefetched blocks consumed by a real sequence: their
+                # page-in cost was hidden off this request's critical path
+                for h, _bid in device_hits:
+                    self.prefetch_tracker.on_block_hit(h)
             return block_ids[:], cached_tokens
 
     def append_slot(self, seq_id: str, context_len: int) -> int | None:
@@ -369,6 +382,75 @@ class BlockAllocator:
                     self._hash_to_block[h] = bid
                     self._block_hash[bid] = h
 
+    # -- predictive prefetch ----------------------------------------------
+    def prefetch_reserve(
+        self, seq_hashes: list[int], headroom_blocks: int
+    ) -> tuple[list[tuple[int, int]], list[int]]:
+        """Claim landing blocks for a speculative host→HBM prefetch.
+
+        Returns ``(plan, deferred)``: ``plan`` is (hash, landing block)
+        pairs with the host copies pinned (execute with the same restore
+        machinery as demand paging), ``deferred`` the hashes that could
+        not be served *because of the headroom reservation* — the caller
+        requeues those.  Hashes already device-resident or absent from
+        every offload tier are silently dropped (nothing to page).
+
+        A prefetched block ends CACHED (refcount 0, evictable), so paging
+        it in never shrinks allocatable capacity (free + cached) — the
+        landing block comes from the free list or by evicting the LRU
+        *cached* block (which offloads, exactly like demand eviction), and
+        becomes another cached block.  Running sequences are untouchable
+        (refcount ≥ 1), so prefetch can never cause a preemption.  The
+        ``headroom_blocks`` floor additionally keeps prefetch from
+        churning evictions when capacity is nearly exhausted: below it,
+        hashes come back as deferred for a later retry."""
+        plan: list[tuple[int, int]] = []
+        deferred: list[int] = []
+        with self._lock:
+            for h in seq_hashes:
+                if h in self._hash_to_block:
+                    continue
+                if self.free_blocks <= headroom_blocks:
+                    deferred.append(h)
+                    continue
+                if self.host_tier is None or not self.host_tier.pin(h):
+                    continue  # left every tier since the hint was made
+                bid = self._take_block()
+                if bid is None:
+                    self.host_tier.unpin(h)
+                    deferred.append(h)
+                    continue
+                self._ref[bid] = 1
+                plan.append((h, bid))
+            # evictions this reservation caused must offload before the
+            # restore injects into the reclaimed blocks (device thread)
+            self.flush_offloads()
+        return plan, deferred
+
+    def finish_prefetch(self, plan: list[tuple[int, int]]) -> None:
+        """The engine restored + registered the plan (register_restored):
+        release the landing blocks into the cached LRU, where the next
+        matching prompt claims them as ordinary device prefix hits."""
+        with self._lock:
+            for _h, bid in plan:
+                self._decref(bid)
+
+    def abort_prefetch(self, plan: list[tuple[int, int]]) -> None:
+        """A prefetch restore failed mid-flight: unregister any landing
+        block that made it into the registry (its content is suspect) and
+        free the blocks.  Host pins are NOT released here: the restore's
+        ``read_pinned_many`` already released the pin of every hash it
+        consumed, and a second release would steal a ref the tier still
+        needs (e.g. a hot-prefix pin).  A failure before the read consumed
+        a hash leaks that one transient pin — strictly better than
+        corrupting refcounts on the far more common post-read failures."""
+        with self._lock:
+            for h, bid in plan:
+                if self._hash_to_block.get(h) == bid:
+                    del self._hash_to_block[h]
+                self._block_hash.pop(bid, None)
+                self._decref(bid)
+
     def put_back_restore_plan(self, seq_id: str, plan: list[tuple[int, int]]) -> None:
         """Re-arm a taken restore plan after a failed restore so a retry
         re-executes it and sequence teardown cleans up the landing blocks."""
@@ -420,6 +502,9 @@ class BlockAllocator:
         future blocks complete."""
         with self._lock:
             forgotten = set(self._hash_to_block)
+            if self.prefetch_tracker is not None:
+                for h in forgotten:
+                    self.prefetch_tracker.on_block_evicted(h)
             for seq in self._sequences.values():
                 forgotten.update(seq.published_hashes)
                 seq.published_hashes = []

@@ -51,6 +51,11 @@ class OffloadManager:
         self._stopping = False
         self._workers: list[asyncio.Task] = []
         self._inflight = 0
+        # hashes an onboard() is currently copying up-tier: a concurrent
+        # onboard for the same hash (demand restore racing a prefetch hint)
+        # awaits the first copy instead of double-allocating (event per
+        # batch; single-event-loop use by construction)
+        self._onboard_inflight: dict[int, asyncio.Event] = {}
         self.completed = 0
         self.failed = 0
         self.skipped = 0
@@ -92,40 +97,111 @@ class OffloadManager:
         )
         self._wake.set()
 
-    async def onboard(self, seq_hashes: list[int], dst_tier: str, src_tier: str) -> list[int] | None:
-        """Synchronously bring blocks up-tier (prefix hit on a lower tier).
-        Returns destination block ids, or None if allocation failed."""
+    async def onboard(
+        self,
+        seq_hashes: list[int],
+        dst_tier: str,
+        src_tier: str,
+        *,
+        on_fully_evicted=None,
+    ) -> list[int] | None:
+        """Bring blocks up-tier (prefix hit on a lower tier, or a prefetch
+        hint promoting disk/remote content toward the device).  Returns the
+        destination block ids of the hashes THIS call copied (may be empty
+        when every hash was already up-tier), or None if the source lost a
+        hash or the destination could not allocate — nothing is claimed on
+        failure.
+
+        Safe under concurrent demand + prefetch requests for the same
+        hashes: hashes already registered in ``dst_tier`` are skipped
+        (dedupe — callers re-match by hash afterwards), and hashes another
+        onboard is mid-copy are awaited rather than double-allocated, so
+        the same content can never occupy two destination blocks and no
+        allocation leaks.  Destination-LRU evictions the allocation causes
+        cascade one tier further down read-before-overwrite (same contract
+        as ``insert_sync``); ``on_fully_evicted`` fires for hashes the
+        cascade pushed out of the bottom tier."""
         src = self.pools[src_tier]
         dst = self.pools[dst_tier]
-        src_ids = []
-        for h in seq_hashes:
-            bid = src.match_hash(h)
-            if bid is None:
-                return None
-            src_ids.append(bid)
-        dst_ids = []
-        for h in seq_hashes:
-            bid = dst.allocate()
-            if bid is None:
-                for b in dst_ids:
-                    dst.release(b)
-                for h2, b in zip(seq_hashes, src_ids):
-                    src.release(b)
-                return None
-            dst_ids.append(bid)
-        # batched copy through host
-        for start in range(0, len(src_ids), TRANSFER_BATCH):
-            chunk_src = src_ids[start : start + TRANSFER_BATCH]
-            chunk_dst = dst_ids[start : start + TRANSFER_BATCH]
-            data = await asyncio.to_thread(src.read, chunk_src)
-            await asyncio.to_thread(dst.write, chunk_dst, data)
-        for h, bid, n in zip(seq_hashes, dst_ids, itertools.count()):
-            dst.complete(bid, dst.blocks[bid].token_count)
-            dst.register(bid, h)
-        for bid in src_ids:
-            src.release(bid)
-        self.completed += len(seq_hashes)
-        return dst_ids
+        # wait out copies another onboard already has in flight for these
+        # hashes (re-check after each wait: the set mutates while we sleep)
+        while True:
+            waiting = [
+                ev for h in seq_hashes
+                if (ev := self._onboard_inflight.get(h)) is not None
+            ]
+            if not waiting:
+                break
+            for ev in waiting:
+                await ev.wait()
+        todo = [h for h in seq_hashes if not dst.has_hash(h)]
+        self.skipped += len(seq_hashes) - len(todo)
+        if not todo:
+            return []
+        done_ev = asyncio.Event()
+        for h in todo:
+            self._onboard_inflight[h] = done_ev
+        try:
+            src_ids = []
+            for h in todo:
+                bid = src.match_hash(h)
+                if bid is None:
+                    for b in src_ids:
+                        src.release(b)
+                    return None
+                src_ids.append(bid)
+            # next tier down receives anything the dst allocation evicts
+            nxt = None
+            if dst_tier in self.tier_order:
+                idx = self.tier_order.index(dst_tier)
+                if idx + 1 < len(self.tier_order):
+                    nxt = self.tier_order[idx + 1]
+            dst_ids = []
+            for h in todo:
+                captured: list[int] = []
+                prev_sink = dst.evict_sink
+                dst.evict_sink = captured.append
+                try:
+                    bid = dst.allocate()
+                finally:
+                    dst.evict_sink = prev_sink
+                if bid is None:
+                    for b in dst_ids:
+                        dst.release(b)
+                    for b in src_ids:
+                        src.release(b)
+                    return None
+                for ev in captured:
+                    # the evicted block's bytes still live at ``bid`` until
+                    # the write below lands — cascade them down-tier now
+                    placed = nxt is not None and self.insert_sync(
+                        nxt, dst.read([bid]), ev, on_fully_evicted=on_fully_evicted
+                    )
+                    if not placed and on_fully_evicted is not None:
+                        on_fully_evicted(ev)
+                dst_ids.append(bid)
+            # batched copy through host
+            for start in range(0, len(src_ids), TRANSFER_BATCH):
+                chunk_src = src_ids[start : start + TRANSFER_BATCH]
+                chunk_dst = dst_ids[start : start + TRANSFER_BATCH]
+                data = await asyncio.to_thread(src.read, chunk_src)
+                await asyncio.to_thread(dst.write, chunk_dst, data)
+            for h, src_bid, dst_bid in zip(todo, src_ids, dst_ids):
+                dst.complete(dst_bid, src.blocks[src_bid].token_count)
+                dst.register(dst_bid, h)
+                # park inactive (discoverable + evictable): callers revive by
+                # hash — the old code left the ref, leaking the block as
+                # active forever once its caller released only one ref
+                dst.release(dst_bid)
+            for bid in src_ids:
+                src.release(bid)
+            self.completed += len(todo)
+            return dst_ids
+        finally:
+            for h in todo:
+                if self._onboard_inflight.get(h) is done_ev:
+                    del self._onboard_inflight[h]
+            done_ev.set()
 
     def insert_sync(
         self,

@@ -97,10 +97,15 @@ class ModelWatcher:
         manager: ModelManager,
         *,
         router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        prefetch_hinter=None,
     ):
         self.runtime = runtime
         self.manager = manager
         self.router_mode = router_mode
+        # prefetch/frontend.py FrontendHinter: each model pipeline registers
+        # its tokenizer + chat template here so arrival hints hash exactly
+        # the token stream the preprocessor will produce
+        self.prefetch_hinter = prefetch_hinter
         self._watch = None
         self._task: asyncio.Task | None = None
         # model name -> set of entry keys backing it
@@ -182,6 +187,8 @@ class ModelWatcher:
         state = self._pipelines.pop(entry.name, None)
         if state is not None and state.get("kv") is not None:
             await state["kv"].stop()
+        if self.prefetch_hinter is not None:
+            self.prefetch_hinter.remove_model(entry.name)
         self.manager.remove_model(entry.name)
         logger.info("model %s removed (no instances left)", entry.name)
 
@@ -220,7 +227,47 @@ class ModelWatcher:
             self.manager.add_completion_model(
                 entry.name, CompletionPreprocessor(mdc, tokenizer).wrap(backend.wrap(engine))
             )
+        if self.prefetch_hinter is not None:
+            self._register_hinter(entry, mdc, tokenizer, endpoint)
         self._pipelines[entry.name] = {"router": push_router, "kv": kv_router}
         logger.info(
             "model %s wired to %s (mode=%s)", entry.name, entry.endpoint_path(), self.router_mode.value
+        )
+
+    def _register_hinter(self, entry: ModelEntry, mdc, tokenizer, endpoint) -> None:
+        """Wire this model into the frontend's prefetch hinter: tokenize a
+        validated request the same way the preprocessor will (chat template
+        for chat, raw prompt for completions) and publish the hash chain on
+        the component's hint subject."""
+        from dynamo_tpu.llm.preprocessor import PromptFormatter
+        from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+        from dynamo_tpu.prefetch.hints import PREFETCH_HINT_SUBJECT
+
+        import os
+
+        formatter = PromptFormatter(mdc.chat_template)
+        bus = self.runtime.plane.bus
+        subject = endpoint.component.event_subject(PREFETCH_HINT_SUBJECT)
+        # hint tokenization runs synchronously on the frontend event loop
+        # (it must leave before dispatch starts): cap the rendered text so
+        # a long-context prompt costs bounded work.  The hint then covers
+        # the prompt's leading blocks — the part offload tiers hold the
+        # longest — and truncation can at worst invalidate the final
+        # partial block's hash
+        max_chars = int(os.environ.get("DYN_PREFETCH_HINT_CHARS", "16384"))
+
+        def tokenize(request_model) -> list[int] | None:
+            if isinstance(request_model, ChatCompletionRequest):
+                text = formatter.render(request_model)
+            elif isinstance(getattr(request_model, "prompt", None), str):
+                text = request_model.prompt
+            else:
+                return None
+            return tokenizer.encode(text[:max_chars])
+
+        async def publish(payload: bytes) -> None:
+            await bus.publish(subject, payload)
+
+        self.prefetch_hinter.register_model(
+            entry.name, tokenize, mdc.kv_block_size, publish
         )

@@ -123,12 +123,18 @@ class HttpService:
         request_template=None,
         clear_kv=None,
         admission: AdmissionConfig | None = None,
+        prefetch_hinter=None,
     ):
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
         self.request_template = request_template
+        # predictive prefetch (prefetch/frontend.py FrontendHinter): a hint
+        # is emitted the moment a validated request enters the admission
+        # path — before preprocessing/queueing/dispatch — so the target
+        # worker pages the prefix up-tier during that window.  None = off.
+        self.prefetch_hinter = prefetch_hinter
         # async () -> list[str]: broadcast a cache flush to every backing
         # worker component (reference: lib/llm/src/http/service/clear_kv_blocks.rs)
         self.clear_kv = clear_kv
@@ -309,6 +315,8 @@ class HttpService:
                 404, f"model '{chat_request.model}' not found",
                 param="model", code="model_not_found",
             )
+        if self.prefetch_hinter is not None:
+            self.prefetch_hinter.on_request(chat_request.model, chat_request)
 
         guard = self.metrics.guard(
             chat_request.model, "chat_completions",
@@ -373,6 +381,10 @@ class HttpService:
             return _error(
                 404, f"model '{completion_request.model}' not found",
                 param="model", code="model_not_found",
+            )
+        if self.prefetch_hinter is not None:
+            self.prefetch_hinter.on_request(
+                completion_request.model, completion_request
             )
 
         guard = self.metrics.guard(

@@ -75,6 +75,16 @@ class ForwardPassMetrics:
     spec_rejected_tokens_total: int = 0
     wasted_tokens_total: int = 0
     phase_seconds: dict = field(default_factory=dict)
+    # predictive prefetch (prefetch/pager.py) + offload-tier occupancy
+    # ({tier: {"blocks": total, "used": n, "pinned": n?}} — empty when no
+    # offload tier is mounted)
+    prefetch_hits_total: int = 0
+    prefetch_misses_total: int = 0
+    prefetch_stale_total: int = 0
+    prefetch_hidden_seconds_total: float = 0.0
+    prefetch_blocks_restored_total: int = 0
+    prefetch_blocks_onboarded_total: int = 0
+    offload_tiers: dict = field(default_factory=dict)
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -114,6 +124,23 @@ class ForwardPassMetrics:
             phase_seconds={
                 str(name): float(row.get("total_ms", 0.0)) / 1e3
                 for name, row in (stats.get("phase_ms") or {}).items()
+                if isinstance(row, dict)
+            },
+            prefetch_hits_total=stats.get("prefetch_hits_total", 0),
+            prefetch_misses_total=stats.get("prefetch_misses_total", 0),
+            prefetch_stale_total=stats.get("prefetch_stale_total", 0),
+            prefetch_hidden_seconds_total=stats.get(
+                "prefetch_hidden_seconds_total", 0.0
+            ),
+            prefetch_blocks_restored_total=stats.get(
+                "prefetch_blocks_restored_total", 0
+            ),
+            prefetch_blocks_onboarded_total=stats.get(
+                "prefetch_blocks_onboarded_total", 0
+            ),
+            offload_tiers={
+                str(tier): row
+                for tier, row in (stats.get("offload_tiers") or {}).items()
                 if isinstance(row, dict)
             },
         )

@@ -37,6 +37,7 @@ class KvRouter:
         *,
         block_size: int = 16,
         config: KvRouterConfig | None = None,
+        enable_prefetch: bool | None = None,
     ):
         self.component = component
         self.block_size = block_size
@@ -44,6 +45,18 @@ class KvRouter:
         self.scheduler = KvScheduler(config)
         self._subs = []
         self._tasks: list[asyncio.Task] = []
+        # predictive prefetch (prefetch/forwarder.py): hints forwarded to
+        # the worker whose radix index holds the offloaded prefix, plus
+        # session next-turn prediction.  None = DYN_PREFETCH env gate.
+        from dynamo_tpu.prefetch.hints import prefetch_enabled
+
+        if enable_prefetch is None:
+            enable_prefetch = prefetch_enabled()
+        self.prefetch_forwarder = None
+        if enable_prefetch:
+            from dynamo_tpu.prefetch.forwarder import PrefetchForwarder
+
+            self.prefetch_forwarder = PrefetchForwarder(component, self.indexer)
 
     async def start(self) -> None:
         bus = self.component.runtime.plane.bus
@@ -55,8 +68,12 @@ class KvRouter:
             asyncio.ensure_future(self._kv_loop(kv_sub)),
             asyncio.ensure_future(self._load_loop(load_sub)),
         ]
+        if self.prefetch_forwarder is not None:
+            await self.prefetch_forwarder.start()
 
     async def stop(self) -> None:
+        if self.prefetch_forwarder is not None:
+            await self.prefetch_forwarder.stop()
         for sub in self._subs:
             await sub.unsubscribe()
         for task in self._tasks:

@@ -178,6 +178,14 @@ async def serve_worker(
         clear_listener = ClearKvListener(ep.component, engine)
         clear_listener.start()
         publishers = [kv_pub, metrics_pub, clear_listener]
+        if getattr(engine, "prefetch_pager", None) is not None:
+            from dynamo_tpu.prefetch.worker import PrefetchListener
+
+            prefetch_listener = PrefetchListener(
+                ep.component, engine, service.instance.instance_id
+            )
+            prefetch_listener.start()
+            publishers.append(prefetch_listener)
         engine.start()
         if do_warmup:
             # compile every serving program before the model registers:
@@ -203,10 +211,23 @@ async def serve_frontend(
 
     template = RequestTemplate.load(request_template) if request_template else None
     manager = ModelManager()
-    watcher = ModelWatcher(runtime, manager, router_mode=router_mode)
+    # arrival-hint source for predictive prefetch: only meaningful when a
+    # KV router is in the path (it owns the radix index that targets the
+    # hint), gated by DYN_PREFETCH like the rest of the subsystem
+    hinter = None
+    if router_mode == RouterMode.KV:
+        from dynamo_tpu.prefetch.frontend import FrontendHinter
+        from dynamo_tpu.prefetch.hints import prefetch_enabled
+
+        if prefetch_enabled():
+            hinter = FrontendHinter()
+    watcher = ModelWatcher(
+        runtime, manager, router_mode=router_mode, prefetch_hinter=hinter
+    )
     service = HttpService(
         manager, host=host, port=port, request_template=template,
         clear_kv=watcher.clear_kv_blocks, admission=admission,
+        prefetch_hinter=hinter,
     )
     await watcher.start()
     await service.start()

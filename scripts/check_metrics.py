@@ -73,6 +73,18 @@ UTILIZATION_FAMILIES = (
     "dyn_worker_engine_phase_seconds",
 )
 
+# predictive prefetch (dynamo_tpu/prefetch/ via engine stats) + offload-tier
+# occupancy, mirrored by the metrics service
+PREFETCH_FAMILIES = (
+    "dyn_prefetch_hits_total",
+    "dyn_prefetch_misses_total",
+    "dyn_prefetch_stale_total",
+    "dyn_prefetch_hidden_seconds",
+    "dyn_worker_offload_blocks",
+    "dyn_worker_offload_blocks_used",
+    "dyn_worker_offload_blocks_pinned",
+)
+
 # metrics service registry (dynamo_tpu/components/metrics_service.py)
 WORKER_FAMILIES = (
     "dyn_worker_kv_active_blocks",
@@ -87,7 +99,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES
+) + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

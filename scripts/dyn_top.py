@@ -45,6 +45,18 @@ WORKER_FIELDS = {
     "dyn_worker_decode_tokens": "decode_tokens",
     "dyn_worker_tokens_emitted": "tokens_emitted",
     "dyn_worker_wasted_tokens": "wasted_tokens",
+    "dyn_prefetch_hits_total": "prefetch_hits",
+    "dyn_prefetch_misses_total": "prefetch_misses",
+    "dyn_prefetch_stale_total": "prefetch_stale",
+    "dyn_prefetch_hidden_seconds": "prefetch_hidden_seconds",
+}
+
+# offload-tier occupancy gauges carry a second label (tier) and nest under
+# workers[wid]["offload_tiers"][tier]
+TIER_FIELDS = {
+    "dyn_worker_offload_blocks": "blocks",
+    "dyn_worker_offload_blocks_used": "used",
+    "dyn_worker_offload_blocks_pinned": "pinned",
 }
 
 
@@ -96,10 +108,23 @@ def collect_snapshot(
             samples = []
         workers: dict[str, dict] = {}
         for name, labels, value in samples:
+            if "worker" not in labels:
+                continue
+            tier_key = TIER_FIELDS.get(name)
+            if tier_key is not None and "tier" in labels:
+                row = workers.setdefault(labels["worker"], {})
+                row.setdefault("offload_tiers", {}).setdefault(
+                    labels["tier"], {}
+                )[tier_key] = value
+                continue
             key = WORKER_FIELDS.get(name)
-            if key is None or "worker" not in labels:
+            if key is None:
                 continue
             workers.setdefault(labels["worker"], {})[key] = value
+        for row in workers.values():
+            judged = row.get("prefetch_hits", 0.0) + row.get("prefetch_misses", 0.0)
+            if judged:
+                row["prefetch_hit_ratio"] = row.get("prefetch_hits", 0.0) / judged
         snap["workers"] = workers
         if workers:
             rows = list(workers.values())
@@ -170,7 +195,8 @@ def render_table(snap: dict) -> str:
     if workers:
         lines.append(
             f"  {'WORKER':<10} {'MFU':>7} {'BW':>7} {'GOODPUT/s':>10} "
-            f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} {'WASTED':>8}"
+            f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} "
+            f"{'WASTED':>8} {'PF-HIT':>7}"
         )
         for wid in sorted(workers):
             r = workers[wid]
@@ -181,8 +207,23 @@ def render_table(snap: dict) -> str:
                 f"{_pct(r.get('kv_usage_perc')):>7} "
                 f"{_pct(r.get('batch_occupancy_perc')):>7} "
                 f"{_num(r.get('running'), 5)} {_num(r.get('waiting'), 5)} "
-                f"{_num(r.get('preemptions'), 8)} {_num(r.get('wasted_tokens'), 8)}"
+                f"{_num(r.get('preemptions'), 8)} {_num(r.get('wasted_tokens'), 8)} "
+                f"{_pct(r.get('prefetch_hit_ratio')):>7}"
             )
+            tiers = r.get("offload_tiers") or {}
+            if tiers:
+                cells = []
+                for tier in sorted(tiers):
+                    t = tiers[tier]
+                    cell = f"{tier} {t.get('used', 0):g}/{t.get('blocks', 0):g}"
+                    if t.get("pinned"):
+                        cell += f" (pin {t['pinned']:g})"
+                    cells.append(cell)
+                hidden = r.get("prefetch_hidden_seconds")
+                tail = (
+                    f"   hidden {hidden:.2f}s" if hidden else ""
+                )
+                lines.append("  " + " " * 10 + " tiers: " + "  ".join(cells) + tail)
         fleet = snap.get("fleet") or {}
         if fleet:
             lines.append(

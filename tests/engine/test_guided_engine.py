@@ -349,7 +349,13 @@ async def test_guided_composes_with_disagg_split(guided_parts, tokenizer):
         stream = await decode.generate_prefilled(
             Context(pre.to_wire()), target, first
         )
-        tokens = [first]
+        # the stream's FIRST item already carries first_token (the decode
+        # worker surfaces the remotely-sampled token itself) — prepending
+        # ``first`` here double-counted it, which made the replay below
+        # walk a stream the engine never emitted (admissible for some
+        # greedy first tokens, inadmissible for '"'/'{' — the long-standing
+        # "'\"' admissibility" flake)
+        tokens = []
         async for item in stream:
             ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
             if ann.data is None:

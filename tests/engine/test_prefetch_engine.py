@@ -1,0 +1,289 @@
+"""Engine-level predictive prefetch: hinted blocks pre-restore host→HBM
+between steps, hits are credited, running work is never preempted, and
+DYN_PREFETCH=0 restores fully demand-driven paging."""
+
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.engine.kv_manager import compute_block_hashes
+from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+
+from tests.engine.test_host_offload import make_disk_tier
+from tests.engine.test_jax_engine import (
+    collect,
+    greedy_reference,
+    make_engine,
+    request,
+)
+
+BS = 4  # make_engine block_size
+
+
+async def _wait_stat(engine, key, minimum, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if engine.stats().get(key, 0) >= minimum:
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"{key} never reached {minimum}: {engine.stats()}")
+
+
+async def test_hint_pre_restores_evicted_blocks_and_credits_hits():
+    engine = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                         host_offload_blocks=16, prefill_buckets=(16,),
+                         prefetch=True)
+    try:
+        assert engine.prefetch_pager is not None
+        prompt_a = list(range(3, 15))   # 3 full blocks
+        ref_a = greedy_reference(prompt_a, 2)
+        out_a, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a == ref_a
+        # pressure evicts (some of) A's blocks to the host tier
+        await collect(engine, request(list(range(40, 56)), max_tokens=2, ignore_eos=True))
+        await _wait_stat(engine, "host_offloads_total", 1)
+        restores_before = engine.stats()["host_restores_total"]
+
+        # the hint pages A's offloaded blocks back BEFORE the request
+        assert engine.prefetch_hint(compute_block_hashes(prompt_a, BS))
+        await _wait_stat(engine, "prefetch_blocks_restored_total", 1)
+        restored = engine.stats()["prefetch_blocks_restored_total"]
+
+        out_a2, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a2 == ref_a
+        stats = engine.stats()
+        # the request consumed the prefetched blocks: hits credited with
+        # their page-in cost
+        assert stats["prefetch_hits_total"] >= 1, stats
+        assert stats["prefetch_hidden_seconds_total"] > 0.0
+        assert restored >= 1 and restores_before == 0
+    finally:
+        engine.stop()
+
+
+async def test_duplicate_hint_is_free():
+    engine = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                         host_offload_blocks=16, prefill_buckets=(16,),
+                         prefetch=True)
+    try:
+        prompt = list(range(3, 15))
+        await collect(engine, request(prompt, max_tokens=2, ignore_eos=True))
+        hashes = compute_block_hashes(prompt, BS)
+        # everything device-resident: the hint queues, the walk is a no-op
+        engine.prefetch_hint(hashes)
+        await asyncio.sleep(0.2)
+        stats = engine.stats()
+        assert stats["prefetch_blocks_restored_total"] == 0
+        assert stats["num_preemptions_total"] == 0
+    finally:
+        engine.stop()
+
+
+async def test_prefetch_never_preempts_running_sequence():
+    """Paging hinted blocks while a sequence decodes must never preempt it:
+    prefetch draws only free/cached capacity (plus a headroom floor)."""
+    engine = make_engine(num_blocks=8, max_batch_size=2, max_model_len=32,
+                         host_offload_blocks=32, prefill_buckets=(16,),
+                         prefetch=True)
+    try:
+        # park two prompts' blocks in the host tier
+        parked = [list(range(3, 15)), list(range(40, 52))]
+        for p in parked:
+            await collect(engine, request(p, max_tokens=2, ignore_eos=True))
+        await collect(engine, request(list(range(60, 76)), max_tokens=2, ignore_eos=True))
+        await _wait_stat(engine, "host_offloads_total", 1)
+
+        # long decode + a storm of hints for everything parked
+        runner = list(range(80, 88))
+        ref = greedy_reference(runner, 12)
+        task = asyncio.ensure_future(
+            collect(engine, request(runner, max_tokens=12, ignore_eos=True))
+        )
+        for p in parked:
+            engine.prefetch_hint(compute_block_hashes(p, BS))
+        out, _ = await task
+        assert out == ref
+        stats = engine.stats()
+        assert stats["num_preemptions_total"] == 0, stats
+    finally:
+        engine.stop()
+
+
+async def test_queued_sequence_self_hints_while_waiting():
+    """A sequence waiting for admission pages its own offloaded prefix in
+    behind the running batch (source='queued'), so admission finds device
+    hits instead of paying the page-in."""
+    # decode_steps=1: B must genuinely be mid-decode when A arrives — the
+    # fused multi-step decode would finish B before A's submission drains,
+    # and an idle engine with room correctly skips the self-hint (demand
+    # restore serves an immediately-admitted sequence just as well).  The
+    # pool (10 blocks) leaves headroom beyond B's 6 so the pager can page
+    # A's blocks WHILE B decodes.
+    engine = make_engine(num_blocks=10, max_batch_size=1, max_model_len=40,
+                         host_offload_blocks=32, prefill_buckets=(16, 32),
+                         prefetch=True, decode_steps=1)
+    try:
+        prompt_a = list(range(3, 15))
+        ref_a = greedy_reference(prompt_a, 2)
+        await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        # churn (8 blocks) evicts part of A; B's admission below evicts the
+        # rest — A ends fully host-resident
+        await collect(engine, request(list(range(40, 68)), max_tokens=2, ignore_eos=True))
+        await _wait_stat(engine, "host_offloads_total", 1)
+
+        # max_batch_size=1: B runs while A waits in the scheduler queue —
+        # A's queue-hint pages its prefix during B's decode steps.  A is
+        # submitted right behind B (no sleep: B already sits in the
+        # scheduler when A's add drains, so the backlog gate fires
+        # deterministically instead of racing B's short decode)
+        long_b = asyncio.ensure_future(
+            collect(engine, request(list(range(70, 78)), max_tokens=24, ignore_eos=True))
+        )
+        await asyncio.sleep(0.01)
+        out_a, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        await long_b
+        assert out_a == ref_a
+        stats = engine.stats()
+        assert stats["prefetch_hints_total"] >= 1
+        assert stats["prefetch_hits_total"] >= 1, stats
+    finally:
+        engine.stop()
+
+
+async def test_gate_off_restores_demand_paging(monkeypatch):
+    """DYN_PREFETCH=0 (or config prefetch=False): no pager, no prefetch
+    stats keys, hint API inert — and the demand path produces identical
+    output."""
+    monkeypatch.setenv("DYN_PREFETCH", "0")
+    engine = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                         host_offload_blocks=16, prefill_buckets=(16,))
+    try:
+        assert engine.prefetch_pager is None
+        assert engine.prefetch_hint([1, 2, 3]) is False
+        prompt_a = list(range(3, 15))
+        ref_a = greedy_reference(prompt_a, 2)
+        out_a, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        await collect(engine, request(list(range(40, 56)), max_tokens=2, ignore_eos=True))
+        out_a2, _ = await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        assert out_a == ref_a and out_a2 == ref_a
+        stats = engine.stats()
+        assert "prefetch_hits_total" not in stats
+        # demand restore still works exactly as before
+        assert stats["host_restores_total"] >= 1
+        # and restores accumulate NO pin bookkeeping (nothing would ever
+        # drain it without the pager — gate off means bookkeeping-free)
+        assert engine.host_tier._hot_pending == []
+        assert engine.host_tier._hit_counts == {}
+    finally:
+        engine.stop()
+
+
+def test_no_offload_tier_means_no_pager():
+    engine = make_engine(num_blocks=8, prefetch=True)
+    try:
+        assert engine.host_tier is None
+        assert engine.prefetch_pager is None
+        assert engine.prefetch_hint([1]) is False
+    finally:
+        engine.stop()
+
+
+async def test_stats_expose_prefetch_and_tier_occupancy():
+    engine = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                         host_offload_blocks=16, prefill_buckets=(16,),
+                         prefetch=True)
+    try:
+        await collect(engine, request(list(range(3, 15)), max_tokens=2, ignore_eos=True))
+        stats = engine.stats()
+        for key in (
+            "prefetch_hints_total", "prefetch_hits_total",
+            "prefetch_misses_total", "prefetch_stale_total",
+            "prefetch_hidden_seconds_total", "prefetch_queue_depth",
+        ):
+            assert key in stats, key
+        tiers = stats["offload_tiers"]
+        assert tiers["g2"]["blocks"] == 16
+        assert "used" in tiers["g2"] and "pinned" in tiers["g2"]
+        # and the wire protocol carries both to the metrics service
+        m = ForwardPassMetrics.from_stats(1, stats)
+        roundtrip = ForwardPassMetrics.from_json(m.to_json())
+        assert roundtrip.offload_tiers["g2"]["blocks"] == 16
+        assert roundtrip.prefetch_hits_total == stats["prefetch_hits_total"]
+    finally:
+        engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# hot-prefix pinning (tier level)
+# ---------------------------------------------------------------------------
+
+
+def _tier_leaves(i=0):
+    from tests.engine.test_host_offload import _leaves
+
+    return _leaves(i)
+
+
+def test_hot_prefix_pins_host_resident(tmp_path, monkeypatch):
+    """A hash restored ``pin_hits`` times gets pinned into the host tier:
+    subsequent churn can no longer cascade it to disk."""
+    tier = make_disk_tier(tmp_path, host_n=2, disk_n=8)
+    tier.pin_hits = 2
+    tier.pin_max = 1
+    tier.put(1, _tier_leaves(1))
+    for _ in range(2):  # two restores cross the pin threshold
+        assert tier.pin(1)
+        tier.read_pinned(1)
+    assert tier.pin_hot() == 1
+    assert tier.stats()["host_blocks_pinned"] == 1
+    # churn that would normally evict hash 1 from the 2-block host pool
+    for i in range(2, 6):
+        tier.put(i, _tier_leaves(i))
+    assert tier.pool.has_hash(1), "pinned hot prefix must stay host-resident"
+    assert not tier.disk.has_hash(1)
+    # pin budget enforced: nothing else can pin
+    tier._hot_pending.append(2)
+    assert tier.pin_hot() == 0
+    # admin flush drops pins too
+    tier.clear()
+    assert tier.stats()["host_blocks_pinned"] == 0
+    assert not tier.pool.has_hash(1)
+
+
+def test_unpin_all_releases_blocks(tmp_path):
+    tier = make_disk_tier(tmp_path, host_n=2, disk_n=4)
+    tier.pin_hits = 1
+    tier.put(1, _tier_leaves(1))
+    assert tier.pin(1)
+    tier.read_pinned(1)
+    assert tier.pin_hot() == 1
+    tier.unpin_all()
+    assert tier.stats()["host_blocks_pinned"] == 0
+    # unpinned: ordinary LRU eviction applies again
+    tier.put(2, _tier_leaves(2))
+    tier.put(3, _tier_leaves(3))
+    assert tier.disk.has_hash(1)
+
+
+async def test_long_prefix_finishes_across_budget_rounds():
+    """A hinted chain longer than one iteration's block budget must not
+    lose its tail: the un-walked remainder requeues (with the original
+    TTL) and finishes over subsequent rounds."""
+    engine = make_engine(num_blocks=6, max_batch_size=2, max_model_len=24,
+                         host_offload_blocks=16, prefill_buckets=(16,),
+                         prefetch=True)
+    try:
+        prompt_a = list(range(3, 15))   # 3 full blocks
+        await collect(engine, request(prompt_a, max_tokens=2, ignore_eos=True))
+        await collect(engine, request(list(range(40, 56)), max_tokens=2, ignore_eos=True))
+        await _wait_stat(engine, "host_offloads_total", 1)
+        offloaded = engine.stats()["host_blocks_used"]
+
+        # budget of ONE block per round, no idle boost: every offloaded
+        # block still restores, one round at a time
+        engine.prefetch_pager.blocks_per_step = 1
+        engine.prefetch_pager.idle_boost = 1
+        assert engine.prefetch_hint(compute_block_hashes(prompt_a, BS))
+        await _wait_stat(engine, "prefetch_blocks_restored_total", offloaded)
+        assert engine.stats()["num_preemptions_total"] == 0
+    finally:
+        engine.stop()

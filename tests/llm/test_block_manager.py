@@ -262,3 +262,121 @@ async def test_cascade_populates_all_tiers(tmp_path):
         if mgr is not None:
             await mgr.stop()
         await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# onboard under concurrent demand + prefetch (the prefetch subsystem promotes
+# disk→host on hints while demand restores race it for the same hashes)
+# ---------------------------------------------------------------------------
+
+
+def _park_on_disk(mgr, hashes, rng):
+    """Insert content for ``hashes`` directly into the disk tier; returns
+    {hash: payload} for integrity checks."""
+    data = {}
+    for h in hashes:
+        payload = rng.standard_normal((1, *SHAPE)).astype(np.float32)
+        assert mgr.offload.insert_sync(Tier.G3_DISK, payload, h)
+        data[h] = payload
+    return data
+
+
+def _host_disk_mgr(tmp_path, host_blocks=8, disk_blocks=8):
+    return KvBlockManager(KvbmConfig(
+        num_layers=2, block_size=4, kv_heads=2, head_dim=8,
+        device_blocks=0, host_blocks=host_blocks, disk_blocks=disk_blocks,
+        disk_path=str(tmp_path / "kv.bin"),
+    ))
+
+
+async def test_onboard_concurrent_same_hashes_no_double_copy(tmp_path):
+    """Two concurrent onboards (a demand restore racing a prefetch hint)
+    for the SAME hashes: one copies, the other waits it out and skips —
+    each hash occupies exactly one host block and nothing leaks active."""
+    mgr = _host_disk_mgr(tmp_path)
+    hashes = [11, 12, 13]
+    data = _park_on_disk(mgr, hashes, np.random.default_rng(0))
+    host = mgr.pools[Tier.G2_HOST]
+
+    a, b = await asyncio.gather(
+        mgr.offload.onboard(hashes, Tier.G2_HOST, Tier.G3_DISK),
+        mgr.offload.onboard(hashes, Tier.G2_HOST, Tier.G3_DISK),
+    )
+    assert a is not None and b is not None
+    # exactly one call did the copying; the other found everything up-tier
+    assert sorted((len(a), len(b))) == [0, 3]
+    assert mgr.offload.skipped == 3
+    for h in hashes:
+        assert host.has_hash(h)
+        # parked inactive: no leaked refs, revivable by hash
+        assert host.ref_count(h) == 0
+        # source pins released
+        assert mgr.pools[Tier.G3_DISK].ref_count(h) == 0
+    # exactly 3 host blocks hold content — no duplicate destination blocks
+    assert host.num_blocks - host.free_count == 3
+    # integrity through the promotion
+    for h in hashes:
+        bid = host.match_hash(h)
+        np.testing.assert_allclose(host.read([bid]), data[h])
+        host.release(bid)
+
+
+async def test_onboard_overlapping_sets_copy_each_hash_once(tmp_path):
+    mgr = _host_disk_mgr(tmp_path)
+    _park_on_disk(mgr, [1, 2, 3], np.random.default_rng(1))
+    host = mgr.pools[Tier.G2_HOST]
+
+    await asyncio.gather(
+        mgr.offload.onboard([1, 2], Tier.G2_HOST, Tier.G3_DISK),
+        mgr.offload.onboard([2, 3], Tier.G2_HOST, Tier.G3_DISK),
+    )
+    assert host.num_blocks - host.free_count == 3
+    for h in (1, 2, 3):
+        assert host.has_hash(h)
+        assert host.ref_count(h) == 0
+
+
+async def test_onboard_missing_source_claims_nothing(tmp_path):
+    mgr = _host_disk_mgr(tmp_path)
+    _park_on_disk(mgr, [1], np.random.default_rng(2))
+    host = mgr.pools[Tier.G2_HOST]
+    free_before = host.free_count
+
+    out = await mgr.offload.onboard([1, 999], Tier.G2_HOST, Tier.G3_DISK)
+    assert out is None
+    assert host.free_count == free_before
+    assert mgr.pools[Tier.G3_DISK].ref_count(1) == 0
+    # and the inflight guard is cleared: a later onboard succeeds
+    out = await mgr.offload.onboard([1], Tier.G2_HOST, Tier.G3_DISK)
+    assert out is not None and len(out) == 1
+    assert host.has_hash(1)
+
+
+async def test_onboard_eviction_cascades_down_not_lost(tmp_path):
+    """Onboarding into a full host tier evicts its LRU content — which must
+    cascade to disk (read-before-overwrite), never vanish."""
+    mgr = _host_disk_mgr(tmp_path, host_blocks=2, disk_blocks=8)
+    rng = np.random.default_rng(3)
+    # fill host with A, B (inactive); park C on disk
+    a_payload = rng.standard_normal((1, *SHAPE)).astype(np.float32)
+    assert mgr.offload.insert_sync(Tier.G2_HOST, a_payload, 100)
+    assert mgr.offload.insert_sync(
+        Tier.G2_HOST,
+        rng.standard_normal((1, *SHAPE)).astype(np.float32), 101,
+    )
+    _park_on_disk(mgr, [102], rng)
+
+    gone: list[int] = []
+    out = await mgr.offload.onboard(
+        [102], Tier.G2_HOST, Tier.G3_DISK, on_fully_evicted=gone.append
+    )
+    assert out is not None and len(out) == 1
+    host = mgr.pools[Tier.G2_HOST]
+    disk = mgr.pools[Tier.G3_DISK]
+    assert host.has_hash(102)
+    # LRU victim (100) cascaded down: still restorable, observer silent
+    assert gone == []
+    assert disk.has_hash(100)
+    bid = disk.match_hash(100)
+    np.testing.assert_allclose(disk.read([bid]), a_payload)
+    disk.release(bid)

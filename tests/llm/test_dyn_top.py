@@ -34,6 +34,11 @@ STATS = {
     "preempted_tokens_total": 128,
     "spec_rejected_tokens_total": 8,
     "wasted_tokens_total": 136,
+    "prefetch_hits_total": 9,
+    "prefetch_misses_total": 3,
+    "prefetch_stale_total": 1,
+    "prefetch_hidden_seconds_total": 1.25,
+    "offload_tiers": {"g2": {"blocks": 32, "used": 10, "pinned": 2}},
 }
 
 
@@ -84,6 +89,13 @@ async def test_dyn_top_once_json_against_in_process_fleet(capsys):
         assert worker["bandwidth_util_perc"] == 0.63
         assert worker["goodput_tokens_per_second"] == 123.5
         assert worker["waiting"] == 2.0 and worker["running"] == 3.0
+        # prefetch + offload-tier occupancy surfaced per worker
+        assert worker["prefetch_hits"] == 9.0
+        assert worker["prefetch_hit_ratio"] == 0.75
+        assert worker["prefetch_hidden_seconds"] == 1.25
+        assert worker["offload_tiers"]["g2"] == {
+            "blocks": 32.0, "used": 10.0, "pinned": 2.0
+        }
         assert snap["fleet"]["workers"] == 1
         assert snap["fleet"]["goodput_tokens_per_second"] == 123.5
         assert snap["frontend"]["requests_total"] == 1.0
@@ -93,6 +105,7 @@ async def test_dyn_top_once_json_against_in_process_fleet(capsys):
         # the human table renders the same snapshot without raising
         table = render_table(snap)
         assert "WORKER" in table and "ab" in table and "SLO burn" in table
+        assert "PF-HIT" in table and "tiers: g2 10/32 (pin 2)" in table
     finally:
         await pub.stop()
         await metrics_svc.stop()

@@ -1,0 +1,163 @@
+"""Hint distribution plane: forwarder targeting (radix overlap → worker),
+worker listener filtering, and session-predicted hints — over the real bus."""
+
+import asyncio
+
+from dynamo_tpu.engine.kv_manager import KvEvent
+from dynamo_tpu.llm.kv_router import KvRouter, compute_block_hashes
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.prefetch.hints import (
+    PREFETCH_HINT_SUBJECT,
+    SOURCE_PREDICTED,
+    PrefetchHint,
+)
+from dynamo_tpu.prefetch.worker import PrefetchListener
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+BS = 4
+
+
+class FakeEngine:
+    def __init__(self):
+        self.hints: list[tuple[list[int], str]] = []
+
+    def prefetch_hint(self, block_hashes, *, source="arrival"):
+        self.hints.append((list(block_hashes), source))
+        return True
+
+
+async def _wait(cond, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not cond():
+        assert asyncio.get_event_loop().time() < deadline, "condition timed out"
+        await asyncio.sleep(0.02)
+
+
+async def test_hint_routes_to_worker_with_deepest_overlap():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://pf-fwd")
+    )
+    router = None
+    try:
+        component = rt.namespace("ns").component("backend")
+        router = KvRouter(component, block_size=BS, enable_prefetch=True)
+        await router.start()
+        assert router.prefetch_forwarder is not None
+
+        # two workers' listeners + radix entries: 101 holds 3 blocks of the
+        # prefix, 202 holds 1 — the hint must reach 101 ONLY
+        engines = {101: FakeEngine(), 202: FakeEngine()}
+        listeners = [
+            PrefetchListener(component, engines[w], w) for w in engines
+        ]
+        for listener in listeners:
+            listener.start()
+        seq = list(range(1, 13))
+        hashes = compute_block_hashes(seq, BS)
+        pub1 = KvEventPublisher(component, worker_id=101)
+        pub2 = KvEventPublisher(component, worker_id=202)
+        pub1.start(), pub2.start()
+        pub1.sink(KvEvent(kind="stored", block_hashes=hashes))
+        pub2.sink(KvEvent(kind="stored", block_hashes=hashes[:1]))
+        await _wait(lambda: router.indexer.find_matches(hashes).scores.get(101) == 3)
+
+        await rt.plane.bus.publish(
+            component.event_subject(PREFETCH_HINT_SUBJECT),
+            PrefetchHint(block_hashes=hashes).to_json(),
+        )
+        await _wait(lambda: engines[101].hints)
+        assert engines[101].hints[0][0] == hashes
+        assert not engines[202].hints
+        assert router.prefetch_forwarder.forwarded_total == 1
+
+        # a hint with no overlap anywhere is dropped (nothing to page in)
+        await rt.plane.bus.publish(
+            component.event_subject(PREFETCH_HINT_SUBJECT),
+            PrefetchHint(
+                block_hashes=compute_block_hashes([99] * 8, BS)
+            ).to_json(),
+        )
+        await _wait(lambda: router.prefetch_forwarder.unroutable_total == 1)
+        assert len(engines[101].hints) == 1
+
+        await pub1.stop()
+        await pub2.stop()
+        for listener in listeners:
+            await listener.stop()
+    finally:
+        if router is not None:
+            await router.stop()
+        await rt.close()
+
+
+async def test_predicted_next_turn_hint_fires_through_targeting():
+    """Two observed turns build a gap model; the predict loop then emits a
+    SOURCE_PREDICTED hint targeted at the worker holding the session."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://pf-pred")
+    )
+    router = None
+    try:
+        component = rt.namespace("ns").component("backend")
+        router = KvRouter(component, block_size=BS, enable_prefetch=True)
+        await router.start()
+        fwd = router.prefetch_forwarder
+        # aggressive model: predict almost immediately after the 2nd turn
+        fwd.predictor.lead_s = 5.0
+        fwd.predict_period_s = 0.05
+
+        engine = FakeEngine()
+        listener = PrefetchListener(component, engine, 101)
+        listener.start()
+        pub = KvEventPublisher(component, worker_id=101)
+        pub.start()
+
+        turn1 = list(range(1, 9))
+        turn2 = turn1 + list(range(20, 28))
+        h2 = compute_block_hashes(turn2, BS)
+        pub.sink(KvEvent(kind="stored", block_hashes=h2))
+        await _wait(lambda: router.indexer.find_matches(h2).scores.get(101))
+
+        subject = component.event_subject(PREFETCH_HINT_SUBJECT)
+        await rt.plane.bus.publish(
+            subject, PrefetchHint(block_hashes=compute_block_hashes(turn1, BS)).to_json()
+        )
+        await asyncio.sleep(0.1)
+        await rt.plane.bus.publish(
+            subject, PrefetchHint(block_hashes=h2).to_json()
+        )
+        # the predicted hint (lead 5s >> observed gap) fires on the next
+        # predict tick, targeted at worker 101 like any arrival hint
+        await _wait(
+            lambda: any(src == SOURCE_PREDICTED for _h, src in engine.hints)
+        )
+        assert fwd.predicted_total >= 1
+        predicted = [h for h, src in engine.hints if src == SOURCE_PREDICTED]
+        assert predicted[0] == h2
+
+        await pub.stop()
+        await listener.stop()
+    finally:
+        if router is not None:
+            await router.stop()
+        await rt.close()
+
+
+async def test_router_prefetch_disabled_by_gate(monkeypatch):
+    monkeypatch.setenv("DYN_PREFETCH", "0")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://pf-off")
+    )
+    try:
+        component = rt.namespace("ns").component("backend")
+        router = KvRouter(component, block_size=BS)
+        assert router.prefetch_forwarder is None
+        await router.start()
+        await router.stop()
+    finally:
+        await rt.close()

@@ -1,0 +1,74 @@
+"""FrontendHinter: arrival hints at the HTTP admission point — strictly
+fire-and-forget, never able to fail a request."""
+
+import asyncio
+
+from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
+from dynamo_tpu.prefetch.frontend import FrontendHinter
+from dynamo_tpu.prefetch.hints import SOURCE_ARRIVAL, PrefetchHint
+
+BS = 4
+
+
+async def test_on_request_publishes_hash_chain():
+    hinter = FrontendHinter()
+    published: list[bytes] = []
+
+    async def publish(payload: bytes) -> None:
+        published.append(payload)
+
+    tokens = list(range(1, 13))
+    hinter.register_model("m", lambda req: tokens, BS, publish)
+    hinter.on_request("m", object())
+    await asyncio.sleep(0.1)  # let the background tokenize+publish run
+    assert hinter.hints_emitted == 1
+    hint = PrefetchHint.from_json(published[0])
+    assert hint.block_hashes == compute_block_hashes(tokens, BS)
+    assert hint.source == SOURCE_ARRIVAL
+
+
+async def test_unknown_model_and_short_prompt_are_skipped():
+    hinter = FrontendHinter()
+    published: list[bytes] = []
+
+    async def publish(payload: bytes) -> None:
+        published.append(payload)
+
+    hinter.on_request("absent", object())  # not registered: no-op
+    hinter.register_model("m", lambda req: [1, 2], BS, publish)
+    hinter.on_request("m", object())  # < one full block: nothing to hint
+    await asyncio.sleep(0.1)
+    assert published == []
+    assert hinter.hints_skipped == 1
+
+
+async def test_tokenize_failure_never_surfaces():
+    hinter = FrontendHinter()
+
+    def explode(req):
+        raise RuntimeError("tokenizer broke")
+
+    hinter.register_model("m", explode, BS, None)
+    hinter.on_request("m", object())  # must not raise
+    await asyncio.sleep(0.1)
+    assert hinter.hints_skipped == 1
+
+
+async def test_publish_failure_never_surfaces():
+    hinter = FrontendHinter()
+
+    async def bad_publish(payload: bytes) -> None:
+        raise ConnectionError("bus down")
+
+    hinter.register_model("m", lambda req: list(range(8)), BS, bad_publish)
+    hinter.on_request("m", object())
+    await asyncio.sleep(0.1)  # the background publish fails silently
+    assert hinter.hints_emitted == 1
+
+
+def test_remove_model():
+    hinter = FrontendHinter()
+    hinter.register_model("m", lambda req: [1], BS, None)
+    hinter.remove_model("m")
+    hinter.on_request("m", object())
+    assert hinter.hints_emitted == 0 and hinter.hints_skipped == 0

@@ -1,0 +1,63 @@
+"""Hint wire protocol + the DYN_PREFETCH gate."""
+
+import pytest
+
+from dynamo_tpu.prefetch.hints import (
+    SOURCE_PREDICTED,
+    PrefetchHint,
+    TargetedPrefetchHint,
+    prefetch_enabled,
+)
+
+
+def test_hint_roundtrip():
+    hint = PrefetchHint(block_hashes=[1, 2, 3], source=SOURCE_PREDICTED)
+    back = PrefetchHint.from_json(hint.to_json())
+    assert back.block_hashes == [1, 2, 3]
+    assert back.source == SOURCE_PREDICTED
+    assert back.ts == hint.ts
+
+
+def test_hint_decode_ignores_unknown_fields():
+    # a newer peer may add fields; an older listener must not crash
+    data = b'{"block_hashes": [5], "source": "arrival", "ts": 1.0, "extra": 9}'
+    hint = PrefetchHint.from_json(data)
+    assert hint.block_hashes == [5]
+
+
+def test_targeted_hint_roundtrip():
+    t = TargetedPrefetchHint(worker_id=0xABC, hint=PrefetchHint(block_hashes=[7]))
+    back = TargetedPrefetchHint.from_json(t.to_json())
+    assert back.worker_id == 0xABC
+    assert back.hint.block_hashes == [7]
+
+
+def test_targeted_hint_decode_ignores_unknown_nested_fields():
+    # both decoders must share the forward-compat contract: a newer router
+    # adding a hint field cannot kill an old worker's listener
+    data = (
+        b'{"worker_id": 5, "hint": {"block_hashes": [1], "source": '
+        b'"arrival", "ts": 1.0, "lead_s": 2.0}}'
+    )
+    t = TargetedPrefetchHint.from_json(data)
+    assert t.worker_id == 5
+    assert t.hint.block_hashes == [1]
+
+
+@pytest.mark.parametrize(
+    ("value", "expected"),
+    [
+        (None, True),
+        ("1", True),
+        ("0", False),
+        ("false", False),
+        ("off", False),
+        ("on", True),
+    ],
+)
+def test_prefetch_enabled_gate(monkeypatch, value, expected):
+    if value is None:
+        monkeypatch.delenv("DYN_PREFETCH", raising=False)
+    else:
+        monkeypatch.setenv("DYN_PREFETCH", value)
+    assert prefetch_enabled() is expected

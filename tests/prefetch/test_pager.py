@@ -1,0 +1,154 @@
+"""PrefetchPager: priority queue, staleness, dedupe, hit/miss accounting."""
+
+from dynamo_tpu.prefetch.hints import SOURCE_ARRIVAL, SOURCE_PREDICTED, SOURCE_QUEUED
+from dynamo_tpu.prefetch.pager import MAX_TRACKED_BLOCKS, PrefetchPager
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_pager(**kw):
+    clock = FakeClock()
+    kw.setdefault("ttl_s", 10.0)
+    return PrefetchPager(clock=clock, **kw), clock
+
+
+def test_priority_order_queued_before_arrival_before_predicted():
+    pager, _ = make_pager()
+    assert pager.submit([30], source=SOURCE_PREDICTED)
+    assert pager.submit([10], source=SOURCE_QUEUED)
+    assert pager.submit([20], source=SOURCE_ARRIVAL)
+    assert pager.next_job().hashes == [10]
+    assert pager.next_job().hashes == [20]
+    assert pager.next_job().hashes == [30]
+    assert pager.next_job() is None
+
+
+def test_fifo_within_priority():
+    pager, _ = make_pager()
+    pager.submit([1], source=SOURCE_ARRIVAL)
+    pager.submit([2], source=SOURCE_ARRIVAL)
+    assert pager.next_job().hashes == [1]
+    assert pager.next_job().hashes == [2]
+
+
+def test_dedupe_queued_hashes():
+    """N requests hinting the same hot prefix collapse to one job; a hint
+    adding at least one NEW hash queues just the new tail — queue contents
+    and the queued-hash set must agree exactly, so popping one job can
+    never unmark hashes a sibling job still carries."""
+    pager, _ = make_pager()
+    assert pager.submit([1, 2, 3])
+    assert not pager.submit([1, 2, 3])
+    assert not pager.submit([2, 3])
+    assert pager.submit([2, 3, 4])  # only 4 is new
+    assert pager.hints_total == 2
+    assert pager.next_job().hashes == [1, 2, 3]
+    # popping job 1 must not have unmarked hash 4 (still queued in job 2)
+    assert not pager.submit([4])
+    assert pager.next_job().hashes == [4]
+    # after execution the hashes may be hinted again
+    assert pager.submit([1, 2, 3])
+
+
+def test_stale_jobs_cancelled():
+    pager, clock = make_pager(ttl_s=5.0)
+    pager.submit([1], source=SOURCE_ARRIVAL)
+    clock.now += 6.0
+    pager.submit([2], source=SOURCE_ARRIVAL)
+    # job 1 expired: skipped, counted stale; job 2 still fresh
+    assert pager.next_job().hashes == [2]
+    assert pager.next_job() is None
+    assert pager.stale_total == 1
+
+
+def test_requeue_deferred_ahead_of_arrivals():
+    pager, _ = make_pager()
+    pager.submit([1], source=SOURCE_ARRIVAL)
+    pager.requeue([9])  # headroom-deferred: retries before fresh arrivals
+    assert pager.deferred_total == 1
+    assert pager.next_job().hashes == [9]
+    assert pager.next_job().hashes == [1]
+
+
+def test_requeued_job_still_goes_stale():
+    pager, clock = make_pager(ttl_s=5.0)
+    pager.requeue([9])
+    clock.now += 6.0
+    assert pager.next_job() is None
+    assert pager.stale_total == 1
+
+
+def test_hit_credits_hidden_seconds_once():
+    pager, _ = make_pager()
+    pager.record_restored(7, 0.25)
+    assert pager.is_tracked(7)
+    pager.on_block_hit(7)
+    assert pager.hits_total == 1
+    assert abs(pager.hidden_seconds_total - 0.25) < 1e-9
+    # a second hit on the same block is a plain cache hit, not a prefetch hit
+    pager.on_block_hit(7)
+    assert pager.hits_total == 1
+    assert not pager.is_tracked(7)
+
+
+def test_eviction_before_hit_is_a_miss():
+    pager, _ = make_pager()
+    pager.record_restored(7, 0.25)
+    pager.on_block_evicted(7)
+    assert pager.misses_total == 1
+    assert pager.hidden_seconds_total == 0.0
+    # hit after eviction: no longer tracked, no double accounting
+    pager.on_block_hit(7)
+    assert pager.hits_total == 0
+
+
+def test_untracked_blocks_ignored():
+    pager, _ = make_pager()
+    pager.on_block_hit(42)
+    pager.on_block_evicted(42)
+    assert pager.hits_total == 0 and pager.misses_total == 0
+
+
+def test_cost_memory_bounded_forgotten_count_as_misses():
+    pager, _ = make_pager()
+    for h in range(MAX_TRACKED_BLOCKS + 10):
+        pager.record_restored(h, 0.01)
+    assert pager.misses_total == 10
+    assert not pager.is_tracked(0)
+    assert pager.is_tracked(MAX_TRACKED_BLOCKS + 9)
+
+
+def test_stats_snapshot_keys():
+    pager, _ = make_pager()
+    pager.submit([1])
+    stats = pager.stats()
+    for key in (
+        "prefetch_hints_total", "prefetch_hits_total", "prefetch_misses_total",
+        "prefetch_stale_total", "prefetch_hidden_seconds_total",
+        "prefetch_blocks_restored_total", "prefetch_blocks_onboarded_total",
+        "prefetch_deferred_total", "prefetch_queue_depth",
+    ):
+        assert key in stats, key
+    assert stats["prefetch_queue_depth"] == 1
+
+
+def test_deferred_job_keeps_original_enqueue_time():
+    """A job that keeps deferring on HBM headroom must still expire after
+    its ORIGINAL ttl — requeue carries the popped job's enqueue time, so a
+    dead hint cannot be re-walked forever while HBM stays saturated."""
+    pager, clock = make_pager(ttl_s=5.0)
+    pager.submit([1, 2])
+    for _ in range(3):  # defer/retry churn well inside the ttl
+        clock.now += 1.0
+        job = pager.next_job()
+        assert job is not None
+        pager.requeue(job.hashes, enqueued=job.enqueued)
+    clock.now += 3.0  # 6s since the ORIGINAL submit
+    assert pager.next_job() is None
+    assert pager.stale_total == 1

@@ -1,0 +1,114 @@
+"""SessionPredictor: hash-chain session tracking + inter-turn gap model."""
+
+from dynamo_tpu.prefetch.session import SessionPredictor
+
+
+def make_predictor(**kw):
+    state = {"now": 1000.0}
+    kw.setdefault("lead_s", 1.0)
+    pred = SessionPredictor(clock=lambda: state["now"], **kw)
+    return pred, state
+
+
+def test_new_session_then_continuation():
+    pred, state = make_predictor()
+    # turn 1: chain [1, 2]
+    assert pred.observe([1, 2]) is False
+    assert len(pred) == 1
+    # turn 2 embeds turn 1's tip (2) inside its chain → same session
+    state["now"] += 5.0
+    assert pred.observe([1, 2, 3, 4]) is True
+    assert len(pred) == 1  # re-keyed to the new tip, not duplicated
+    assert pred.turns_observed == 2
+    assert pred.sessions_tracked == 1
+
+
+def test_unrelated_chain_is_a_new_session():
+    pred, _ = make_predictor()
+    pred.observe([1, 2])
+    assert pred.observe([7, 8]) is False
+    assert len(pred) == 2
+
+
+def test_gap_ewma_converges():
+    pred, state = make_predictor(alpha=0.5)
+    pred.observe([1])
+    state["now"] += 4.0
+    pred.observe([1, 2])        # first gap: 4.0
+    sess = next(iter(pred._sessions.values()))
+    assert abs(sess.gap_ewma - 4.0) < 1e-9
+    state["now"] += 8.0
+    pred.observe([1, 2, 3])     # EWMA: 0.5*8 + 0.5*4 = 6
+    sess = next(iter(pred._sessions.values()))
+    assert abs(sess.gap_ewma - 6.0) < 1e-9
+
+
+def test_due_fires_once_per_turn_with_lead():
+    pred, state = make_predictor(lead_s=1.0)
+    pred.observe([1])
+    state["now"] += 4.0
+    pred.observe([1, 2])        # gap model: 4s → next turn expected at +4
+    # too early: expected-lead = now+3
+    state["now"] += 2.9
+    assert pred.due() == []
+    state["now"] += 0.2         # now past expected - lead
+    out = pred.due()
+    assert len(out) == 1
+    assert out[0].block_hashes == [1, 2]
+    # fires exactly once until the next observed turn re-arms it
+    state["now"] += 10.0
+    assert pred.due() == []
+    pred.observe([1, 2, 3])     # re-arms; EWMA now 0.5*14.1 + 0.5*4 ≈ 9.05
+    state["now"] += 9.0
+    assert len(pred.due()) == 1
+
+
+def test_single_turn_session_never_predicts():
+    pred, state = make_predictor()
+    pred.observe([1, 2])
+    state["now"] += 100.0
+    assert pred.due() == []  # no gap model until a second turn
+
+
+def test_lru_bound():
+    pred, _ = make_predictor(max_sessions=3)
+    for i in range(5):
+        pred.observe([100 + i])
+    assert len(pred) == 3
+    # oldest two evicted
+    assert 100 not in pred._sessions and 101 not in pred._sessions
+
+
+def test_shared_prefix_sessions_stay_distinct():
+    """Sessions sharing a system prompt but diverging after it are
+    separate sessions: matching keys on recorded TIPS, not any shared
+    block."""
+    pred, _ = make_predictor()
+    pred.observe([1, 2])   # session A
+    pred.observe([1, 3])   # session B shares block 1 but has its own tip
+    assert len(pred) == 2
+    assert pred.observe([1, 2, 9]) is True   # continues A
+    assert pred.observe([1, 3, 8]) is True   # continues B
+    assert len(pred) == 2
+
+
+def test_deepest_tip_wins_when_chain_contains_two_tips():
+    """When an arriving chain embeds two known tips (a turn-1 replay
+    re-created a session at a shallow tip), the walk from the END matches
+    the deepest one — the longest recorded history claims the turn."""
+    pred, state = make_predictor()
+    pred.observe([1, 2])
+    state["now"] += 2.0
+    pred.observe([1, 2, 3, 4])          # A re-keys to tip 4
+    pred.observe([1, 2])                # replay → NEW session at tip 2
+    assert set(pred._sessions) == {4, 2}
+    state["now"] += 2.0
+    assert pred.observe([1, 2, 3, 4, 5]) is True
+    # the tip-4 session advanced to 5; the shallow tip-2 session untouched
+    assert set(pred._sessions) == {5, 2}
+
+
+def test_empty_chain_ignored():
+    pred, _ = make_predictor()
+    assert pred.observe([]) is False
+    assert len(pred) == 0
