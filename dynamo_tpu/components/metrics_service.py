@@ -63,6 +63,21 @@ class MetricsService:
             "Sequences preempted for KV pressure (cumulative)",
             ["worker"], registry=self.registry,
         )
+        # ragged unified-batch step (engine unified_batch knob): one-dispatch
+        # mixed windows served, and the admission-forced pipeline drains the
+        # unified step removes (flat while unified serves the traffic)
+        self.unified_windows = Gauge(
+            f"{PREFIX}_unified_windows",
+            "Mixed prefill+decode windows served by the ragged unified-batch "
+            "dispatch (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.admission_drains = Gauge(
+            f"{PREFIX}_admission_drains",
+            "Decode-pipeline drains forced by new-sequence admission "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
         # mirrored remote counters need .set(), so they are gauges —
         # named WITHOUT the counter-reserved _total suffix
         self.prefix_hits = Gauge(
@@ -190,6 +205,7 @@ class MetricsService:
         self._worker_gauges = (
             self.kv_active, self.kv_total, self.cache_usage, self.waiting,
             self.running, self.batch_occupancy, self.preemptions,
+            self.unified_windows, self.admission_drains,
             self.prefix_hits, self.prefix_cached_tokens, self.spec_accepted,
             self.mfu, self.bandwidth_util, self.goodput, self.prefill_rate,
             self.prefill_tokens, self.decode_tokens, self.tokens_emitted,
@@ -296,6 +312,8 @@ class MetricsService:
             self.running.labels(label).set(m.num_requests_running)
             self.batch_occupancy.labels(label).set(m.batch_occupancy_perc)
             self.preemptions.labels(label).set(m.num_preemptions_total)
+            self.unified_windows.labels(label).set(m.decode_windows_unified_total)
+            self.admission_drains.labels(label).set(m.admission_drains_total)
             self.prefix_hits.labels(label).set(m.prefix_hits_total)
             self.prefix_cached_tokens.labels(label).set(m.prefix_cached_tokens_total)
             self.spec_accepted.labels(label).set(m.spec_accepted_tokens_total)

@@ -235,6 +235,19 @@ class EngineConfig:
     # (their per-token host processing cannot lag the device).  None =
     # DYN_DECODE_OVERLAP env (default on; "0" disables).
     decode_overlap: bool | None = None
+    # Ragged unified-batch step: one jitted launch consumes a MIXED token
+    # batch — chunked-prefill spans and decode tokens from different
+    # sequences, flattened onto one ragged token axis through the ragged
+    # paged-attention kernel (ops/pallas/ragged_attention.py, arxiv
+    # 2604.15464).  Prefill admission stops being a separate dispatch, so
+    # the overlap pipeline no longer drains when a new sequence joins: its
+    # first chunk simply rides the next window.  None = DYN_UNIFIED_BATCH
+    # env (default off).  The split prefill/decode path remains compiled
+    # and serves as fallback — speculative/guided/multimodal/disagg-prefill
+    # lanes keep their current routes, and engines whose geometry the
+    # unified step cannot serve (fused decode_steps>1, multi-chip meshes,
+    # narrowed KV dtypes, families without a unified forward) auto-disable.
+    unified_batch: bool | None = None
     # Minimum fraction of running lanes that must have a draft for the
     # w-wide verify program to run; below it, plain decode serves the step.
     # Cost model (decode is weight-bandwidth-bound): one verify launch
@@ -625,6 +638,55 @@ class JaxLlmEngine:
         self._overlap_windows = 0   # windows dispatched with token feedback
         self._sync_windows = 0      # windows served by the synchronous path
         self._decode_steps_total = 0
+        # Ragged unified-batch step (EngineConfig.unified_batch): mixed
+        # prefill+decode in one launch.  Auto-disables loudly when the
+        # engine's geometry cannot serve it — the split path is always the
+        # fallback, never a silent behavior change.
+        env_unified = os.environ.get("DYN_UNIFIED_BATCH")
+        if config.unified_batch is not None:
+            unified = bool(config.unified_batch)
+        elif env_unified is not None:
+            unified = env_unified.lower() not in ("0", "false", "off")
+        else:
+            unified = False
+        if unified:
+            reason = None
+            if self.family.forward_unified is None:
+                reason = f"family {config.model_family!r} has no unified forward"
+            elif config.speculative:
+                reason = "speculative lanes keep their verify route"
+            elif config.decode_steps > 1:
+                reason = "fused multi-step decode windows cannot carry chunks"
+            elif self.mesh is not None:
+                reason = "multi-chip meshes keep the split step"
+            else:
+                resolved = resolve_kv_cache_dtype(config.kv_cache_dtype)
+                if resolved is not None and jnp.dtype(resolved) != jnp.dtype(
+                    cfg.dtype
+                ):
+                    # split prefill attends full-precision activations while
+                    # the unified step reads its own freshly-written cache:
+                    # a narrowed cache dtype would break the byte-identical
+                    # output parity contract between the two paths
+                    reason = (
+                        f"kv_cache_dtype {config.kv_cache_dtype!r} narrows "
+                        "the cache below the activation dtype"
+                    )
+            if reason is not None:
+                logger.info("unified batch disabled: %s", reason)
+                unified = False
+        self.unified_batch = unified
+        self._unified_windows = 0     # mixed windows served by one dispatch
+        self._admission_drains = 0    # pipeline drains forced by admission
+        # ragged token-block granularity: every span pads to whole blocks
+        # of this many tokens (the kernel grid routes one lane per block);
+        # gcd keeps every compile bucket — powers of two plus block-rounded
+        # chunk windows — block-packable
+        import math as _math
+
+        self._unified_tb = _math.gcd(config.block_size, 8) or 1
+        self._fb_zero = None          # resident all-zero feedback tokens
+        self._seed_none = None        # resident no-op seed scatter args
         # Per-lane block-table host rows, rewritten only for lanes whose
         # block list changed since the last window; the device copy is
         # reused untouched while every row is clean.  At steady-state
@@ -661,6 +723,19 @@ class JaxLlmEngine:
             # pads up to the next full-prompt bucket)
             if self.chunk_tokens < self.max_len:
                 self.buckets = sorted(set(self.buckets) | {self.chunk_tokens})
+                if self.unified_batch:
+                    # the steady-state MIXED window is a full chunk plus one
+                    # decode token per lane: give it its own bucket too, or
+                    # every unified window pads up to the next prompt bucket
+                    pack = (
+                        self._unified_tb
+                        if self.attention_impl.startswith("pallas") else 1
+                    )
+                    mixed = -(-(
+                        self.chunk_tokens + self.config.max_batch_size * pack
+                    ) // 8) * 8
+                    if mixed < self.max_len:
+                        self.buckets = sorted(set(self.buckets) | {mixed})
         self.host_tier = None
         self._host_evictions: list[int] | None = None
         offload_sink = None
@@ -737,6 +812,7 @@ class JaxLlmEngine:
             self.allocator, max_batch_size=config.max_batch_size,
             prefill_chunk_tokens=self.chunk_tokens,
             bucket_cost=self._bucket_len,
+            unified_batch=self.unified_batch,
         )
         self.scheduler.on_preempt = self._on_preempt
         self._event_sink = event_sink
@@ -759,6 +835,11 @@ class JaxLlmEngine:
             else None
         )
         self._jit_decode = self._build_decode()
+        # unified window seed capacity: only NEWLY-ADMITTED prefills need
+        # their penalty-count rows (re)seeded, and admission is bounded by
+        # the scheduler's per-step cap
+        self._unified_seed_slots = max(1, self.scheduler.max_prefills_per_step)
+        self._jit_unified = self._build_unified() if self.unified_batch else None
         self.spec_enabled = bool(config.speculative)
         if self.spec_enabled:
             if config.speculative != "ngram":
@@ -1150,6 +1231,65 @@ class JaxLlmEngine:
                 repl, repl, repl, repl, repl, self._cache_sharding, repl
             )
         return jax.jit(multi, donate_argnums=(1, 2), **kwargs)
+
+    def _build_unified(self):
+        """Ragged unified-batch step: ONE launch computes chunked-prefill
+        spans and decode tokens from different sequences (flat token axis +
+        per-lane span metadata, forward_unified → ragged paged attention),
+        then samples one token per lane.  Key-fold, penalty, bias and
+        guided-free logits math mirror the split programs bit-for-bit so
+        the two paths keep byte-identical outputs:
+
+        - ``context_lens[lane]`` doubles as the attention context AND the
+          per-lane key fold value (split prefill folds with the total
+          length, split decode with the context including the new token —
+          both equal the lane's span end);
+        - newly-admitted prefills (re)seed their penalty-count rows in-jit
+          via the ``seed_*`` scatter, exactly what the split prefill
+          programs compute from the prompt;
+        - ``sample_gate`` drops intermediate-chunk samples from the
+          generated counts, like the continued-prefill program's gate.
+
+        Single-device only (the engine auto-disables unified on meshes)."""
+        cfg = self.config.model
+        topk_k = self.config.top_logprobs_k
+        lanes = self.config.max_batch_size
+        tb = self._unified_tb
+        lane_idx = jnp.arange(lanes)
+
+        def step(params, cache, gen_counts, prompt_counts, token_ids,
+                 feedback, use_fb, block_tables, context_lens, token_pos,
+                 token_slot, token_lane, tb_lane, lane_qstart, lane_qlen,
+                 lane_start, sample_rows, sample_gate, seed_lanes,
+                 seed_prompt, seed_gen, keys, temp, top_k, top_p, greedy,
+                 pres, freq, rep, bias_ids, bias_vals, cos, sin):
+            lane_c = jnp.clip(token_lane, 0, lanes - 1)
+            # on-device token feedback: a decode token whose lane has an
+            # unretired window reads the previous window's output array —
+            # the host never waits for (or sees) the token it dispatches
+            tok = jnp.where(use_fb, feedback[lane_c], token_ids)
+            logits, cache = self.family.forward_unified(
+                params, cfg, tok, cache, block_tables, context_lens,
+                token_pos, token_slot, token_lane, tb_lane, lane_qstart,
+                lane_qlen, lane_start, sample_rows, cos, sin,
+                attention=self.attention_impl, tb_tokens=tb,
+            )  # [lanes, vocab]
+            prompt_counts = prompt_counts.at[seed_lanes].set(
+                seed_prompt, mode="drop"
+            )
+            gen_counts = gen_counts.at[seed_lanes].set(seed_gen, mode="drop")
+            plogits = apply_penalties(
+                logits, gen_counts, prompt_counts, pres, freq, rep
+            )
+            plogits = apply_logit_bias(plogits, bias_ids, bias_vals)
+            step_keys = jax.vmap(jax.random.fold_in)(keys, context_lens)
+            tokens = sample_tokens(plogits, step_keys, temp, top_k, top_p, greedy)
+            lps = token_logprobs(plogits, tokens)
+            tk_vals, tk_ids = topk_logprobs(plogits, topk_k)
+            gen_counts = gen_counts.at[lane_idx, tokens].add(sample_gate)
+            return tokens, lps, tk_vals, tk_ids, cache, gen_counts, prompt_counts
+
+        return jax.jit(step, donate_argnums=(1, 2, 3))
 
     def _build_verify(self):
         """Speculative verification step: one forward over the [lanes, w]
@@ -1863,6 +2003,8 @@ class JaxLlmEngine:
             "spec_accepted_tokens_total": self._spec_accepted,
             "decode_windows_overlapped_total": self._overlap_windows,
             "decode_windows_sync_total": self._sync_windows,
+            "decode_windows_unified_total": self._unified_windows,
+            "admission_drains_total": self._admission_drains,
             "decode_steps_total": self._decode_steps_total,
             "guided_requests_total": self._guided_requests,
             "guided_completions_total": self._guided_completions,
@@ -1935,59 +2077,8 @@ class JaxLlmEngine:
                 self._step_attn_ctx = 0
                 self._step_weight_streams = 0.0
                 decision = self.scheduler.schedule()
-                for seq in decision.prefills:
-                    self._maybe_record_queue_span(seq)
-                    t_prefill = time.time()
-                    try:
-                        with self._xprof_span("dyn.prefill"):
-                            try:
-                                self._run_prefill(seq)
-                            except Exception as exc:  # noqa: BLE001
-                                if not self._attention_fallback(exc):
-                                    raise
-                                self._run_prefill(seq)
-                    except Exception as exc:  # noqa: BLE001 — fail THIS
-                        # sequence (free blocks, resolve its caller) and
-                        # keep serving; retrying would hot-spin on
-                        # deterministic failures and skipping the rest of
-                        # the batch would leave restore plans unexecuted
-                        logger.exception("prefill failed for %s", seq.seq_id)
-                        self._record_prefill_span(seq, t_prefill, status="error")
-                        self._fail_sequence(seq, exc)
-                    else:
-                        self._record_prefill_span(seq, t_prefill)
-                decodes = [
-                    s for s in self.scheduler.running if s.status == SeqStatus.RUNNING
-                ]
-                if decodes:
-                    try:
-                        with self._xprof_span("dyn.decode"):
-                            try:
-                                self._run_decode(decodes)
-                            except Exception as exc:  # noqa: BLE001
-                                if not self._attention_fallback(exc):
-                                    raise
-                                # compile-class failure: the previously
-                                # dispatched window (old program) already
-                                # executed — retire it normally, then retry
-                                # this window against the rebuilt jits
-                                self._sync_pipeline()
-                                self._run_decode(decodes)
-                    except Exception as exc:  # noqa: BLE001
-                        logger.exception("decode step failed")
-                        # a poisoned in-flight window must not feed the next
-                        # dispatch (and _fail_sequence is about to free the
-                        # failing lanes' blocks)
-                        self._abandon_pipeline(decodes)
-                        for seq in decodes:
-                            if seq.status == SeqStatus.RUNNING:
-                                self._fail_sequence(seq, exc)
-                elif self._inflight is not None:
-                    # nothing decodable this iteration (every lane finished,
-                    # is prefilling, or was preempted) while a window is
-                    # still in flight: retire it so its tokens emit and
-                    # deferred finishes release their lanes/blocks
-                    self._sync_pipeline()
+                if not (self.unified_batch and self._maybe_run_unified(decision)):
+                    self._run_split_step(decision)
                 self._iterations += 1
                 step_duration_s = time.perf_counter() - t_step
                 self.step_telemetry.observe_step(
@@ -2018,6 +2109,458 @@ class JaxLlmEngine:
             self._sync_pipeline()
         except Exception:  # noqa: BLE001
             logger.exception("pipeline drain at shutdown failed")
+
+    def _run_split_step(self, decision) -> None:
+        """The split prefill/decode step: one dispatch per prefill window
+        plus one batched decode dispatch — the engine's historical path,
+        kept whole as the unified step's fallback."""
+        for seq in decision.prefills:
+            if seq.status == SeqStatus.FINISHED:
+                continue  # failed/aborted before this step got to it
+            self._maybe_record_queue_span(seq)
+            t_prefill = time.time()
+            try:
+                with self._xprof_span("dyn.prefill"):
+                    try:
+                        self._run_prefill(seq)
+                    except Exception as exc:  # noqa: BLE001
+                        if not self._attention_fallback(exc):
+                            raise
+                        self._run_prefill(seq)
+            except Exception as exc:  # noqa: BLE001 — fail THIS
+                # sequence (free blocks, resolve its caller) and
+                # keep serving; retrying would hot-spin on
+                # deterministic failures and skipping the rest of
+                # the batch would leave restore plans unexecuted
+                logger.exception("prefill failed for %s", seq.seq_id)
+                self._record_prefill_span(seq, t_prefill, status="error")
+                self._fail_sequence(seq, exc)
+            else:
+                self._record_prefill_span(seq, t_prefill)
+        decodes = [
+            s for s in self.scheduler.running if s.status == SeqStatus.RUNNING
+        ]
+        if decodes:
+            try:
+                with self._xprof_span("dyn.decode"):
+                    try:
+                        self._run_decode(decodes)
+                    except Exception as exc:  # noqa: BLE001
+                        if not self._attention_fallback(exc):
+                            raise
+                        # compile-class failure: the previously
+                        # dispatched window (old program) already
+                        # executed — retire it normally, then retry
+                        # this window against the rebuilt jits
+                        self._sync_pipeline()
+                        self._run_decode(decodes)
+            except Exception as exc:  # noqa: BLE001
+                logger.exception("decode step failed")
+                # a poisoned in-flight window must not feed the next
+                # dispatch (and _fail_sequence is about to free the
+                # failing lanes' blocks)
+                self._abandon_pipeline(decodes)
+                for seq in decodes:
+                    if seq.status == SeqStatus.RUNNING:
+                        self._fail_sequence(seq, exc)
+        elif self._inflight is not None:
+            # nothing decodable this iteration (every lane finished,
+            # is prefilling, or was preempted) while a window is
+            # still in flight: retire it so its tokens emit and
+            # deferred finishes release their lanes/blocks
+            self._sync_pipeline()
+
+    # -- ragged unified-batch step ----------------------------------------
+    def _maybe_run_unified(self, decision) -> bool:
+        """Serve this iteration as ONE ragged dispatch mixing prefill
+        chunks and decode tokens.  Returns False when the step needs the
+        split path (which then runs unchanged): guided lanes, multimodal or
+        disagg-prefill sequences, token batches past the largest compile
+        bucket, or OOM requiring the preempting synchronous machinery."""
+        prefills = list(decision.prefills)
+        decodes = [
+            s for s in self.scheduler.running
+            if s.status == SeqStatus.RUNNING and s not in prefills
+        ]
+        if not prefills and not decodes:
+            return False  # idle / window-retire-only: split loop handles
+        for seq in prefills:
+            if seq.prefill_only or seq.mm_embeds is not None:
+                return False  # disagg extract / multimodal keep their routes
+            if seq.guided is not None:
+                return False
+        for seq in decodes:
+            if seq.guided is not None:
+                return False
+
+        spans: list[tuple[Sequence, int, int]] = []
+        for seq in prefills:
+            n = len(seq.all_token_ids)
+            start = max(seq.prefilled_tokens, seq.cached_tokens)
+            end = min(seq.chunk_target, n) if (
+                self.chunk_tokens is not None and seq.chunk_target
+            ) else n
+            if end <= start:
+                return False  # degenerate window: split path owns it
+            spans.append((seq, start, end))
+        if not spans:
+            # decode-only iterations keep the exact-lane decode program: the
+            # unified window's bucketed token axis would pad pure decode
+            # upward for nothing.  Unified earns its keep exactly when a
+            # prefill span shares the window — the iterations where the
+            # split path pays a second dispatch and (under overlap) an
+            # admission drain.  Windows from either program chain through
+            # the same feedback array, so alternating costs nothing.
+            return False
+        # packing granularity: the Pallas kernel routes KV pages per token
+        # block, so spans pack to whole blocks there; the XLA fallback
+        # routes per token and packs densely
+        pack = (
+            self._unified_tb
+            if self.attention_impl.startswith("pallas") else 1
+        )
+        total = len(decodes) * pack + sum(
+            -(-(end - start) // pack) * pack for _, start, end in spans
+        )
+        if total > self.buckets[-1]:
+            return False
+        bucket = self._bucket_len(total)
+        if pack > 1 and bucket % pack:
+            return False  # unpackable compile bucket (odd max_len tail)
+        unseeded = sum(
+            1 for seq, start, _ in spans if start == seq.cached_tokens
+        )
+        if unseeded > self._unified_seed_slots:
+            return False
+
+        # per-window overlap gate, same rule as _overlap_ok: top_logprobs
+        # lanes ship K-wide rows that belong on the synchronous path
+        overlap = self.decode_overlap and not any(
+            s.request.sampling.top_logprobs > 0 for s in prefills + decodes
+        )
+        try:
+            with self._xprof_span("dyn.unified"):
+                try:
+                    return self._run_unified(
+                        spans, decodes, bucket, overlap, pack
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    if not self._attention_fallback(exc):
+                        raise
+                    # compile-class kernel failure: the jits were rebuilt on
+                    # the XLA path; the in-flight window (old program)
+                    # already executed — retire it, then retry this window
+                    # (densely packed now — the fallback routes per token).
+                    # The retire can finish sequences (a stop detected one
+                    # window late) and the first attempt can have failed a
+                    # restore: re-filter so the retry never dispatches a
+                    # freed lane's stale metadata.
+                    self._sync_pipeline()
+                    decodes = [
+                        s for s in decodes if s.status == SeqStatus.RUNNING
+                    ]
+                    spans = [
+                        (s, a, b) for s, a, b in spans
+                        if s.status in (SeqStatus.PREFILLING, SeqStatus.RUNNING)
+                    ]
+                    if not spans:
+                        return False  # split path serves what remains
+                    return self._run_unified(spans, decodes, bucket, overlap, 1)
+        except Exception as exc:  # noqa: BLE001
+            logger.exception("unified step failed")
+            self._abandon_pipeline(prefills + decodes)
+            for seq in prefills + decodes:
+                if seq.status in (SeqStatus.PREFILLING, SeqStatus.RUNNING):
+                    self._fail_sequence(seq, exc)
+            return True  # the step was consumed (by failing its batch)
+
+    def _run_unified(
+        self,
+        spans: list[tuple[Sequence, int, int]],
+        decodes: list[Sequence],
+        bucket: int,
+        overlap: bool,
+        pack: int,
+    ) -> bool:
+        """Build the ragged batch, dispatch once, then either read back
+        synchronously or put the window in flight (overlap).  A newly
+        admitted sequence needs NO pipeline drain here: its prefill tokens
+        come from the host while resident decode lanes keep reading the
+        previous window's on-device feedback."""
+        timing = self._phase_timing
+        t = time.perf_counter() if timing else 0.0
+        lanes = self.config.max_batch_size
+        tb = self._unified_tb
+        bs = self.config.block_size
+        oob = self.config.num_blocks * bs
+        vocab = self.config.model.vocab_size
+        prev = self._inflight
+
+        # preempted-then-readmitted prefix restores run exactly like
+        # _run_prefill's, but a failed restore fails ONLY its sequence (the
+        # split path's per-sequence error contract — one bad host-tier read
+        # must not take down every request in the window).  The plan goes
+        # back first so free_sequence can unregister the garbage landing
+        # blocks and release the host pins.
+        failed: list[Sequence] = []
+        for seq, _, _ in spans:
+            restore = self.allocator.take_restore_plan(seq.seq_id)
+            if restore:
+                try:
+                    self._restore_blocks(restore)
+                except Exception as exc:  # noqa: BLE001
+                    logger.exception("prefix restore failed for %s", seq.seq_id)
+                    self.allocator.put_back_restore_plan(seq.seq_id, restore)
+                    self._fail_sequence(seq, exc)
+                    failed.append(seq)
+        if failed:
+            spans = [(s, a, b) for s, a, b in spans if s not in failed]
+            if not spans:
+                return False  # decode-only now: the split path serves it
+
+        # decode slot growth: overlap allocates at the DEVICE context and
+        # never preempts (a lagged window may still write into a victim's
+        # blocks) — on OOM the pipeline drains and the preempting split
+        # path serves this iteration; sync mode drains first and preempts
+        # like the plain decode path.
+        slots: dict[str, int] = {}
+        if overlap:
+            for seq in decodes:
+                dev_ctx = min(
+                    seq.context_len + seq.inflight_tokens, self.max_len
+                )
+                slot = self.scheduler.try_slots_at(
+                    seq, dev_ctx, 1, max_pos=self.max_len - 1
+                )
+                if slot is None:
+                    self._sync_pipeline()
+                    return False
+                slots[seq.seq_id] = slot
+        else:
+            self._sync_pipeline()
+            for seq in list(decodes):
+                if seq.status != SeqStatus.RUNNING:
+                    continue  # preempted as a victim earlier in this loop
+                slot = self.scheduler.ensure_slots(
+                    seq, 1, max_pos=self.max_len - 1
+                )
+                if slot is None:
+                    self.scheduler.preempt(seq)
+                    continue
+                slots[seq.seq_id] = slot
+            decodes = [s for s in decodes if s.status == SeqStatus.RUNNING]
+            # ensure_slots may have victimized a PREFILLING span owner
+            spans = [
+                (s, a, b) for s, a, b in spans
+                if s.status in (SeqStatus.PREFILLING, SeqStatus.RUNNING)
+            ]
+            if not decodes and not spans:
+                return True  # everything preempted: step consumed
+
+        num_tb = max(1, bucket // tb)
+        token_ids = np.zeros((bucket,), np.int32)
+        token_pos = np.full((bucket,), -1, np.int32)
+        token_slot = np.full((bucket,), oob, np.int32)
+        token_lane = np.full((bucket,), lanes, np.int32)
+        use_fb = np.zeros((bucket,), bool)
+        tb_lane = np.zeros((num_tb,), np.int32)
+        lane_qstart = np.zeros((lanes,), np.int32)
+        lane_qlen = np.zeros((lanes,), np.int32)
+        lane_start = np.zeros((lanes,), np.int32)
+        context_lens = np.zeros((lanes,), np.int32)
+        sample_rows = np.zeros((lanes,), np.int32)
+        sample_gate = np.zeros((lanes,), np.int32)
+        nseed = self._unified_seed_slots
+        # the [nseed, vocab] seed rows only exist on windows that actually
+        # admit (the rare case); steady-state windows reuse one resident
+        # no-op scatter instead of re-uploading ~vocab-sized zeros
+        need_seed = any(
+            start == seq.cached_tokens for seq, start, _ in spans
+        )
+        seed_lanes = seed_prompt = seed_gen = None
+        if need_seed:
+            seed_lanes = np.full((nseed,), lanes, np.int32)
+            seed_prompt = np.zeros((nseed, vocab), np.int32)
+            seed_gen = np.zeros((nseed, vocab), np.int32)
+
+        emit_seqs: list[Sequence] = []
+        cursor = 0
+        for seq in decodes:
+            self._prep_decode_seq(seq)
+            lane = seq.lane
+            dev_ctx = min(
+                seq.context_len + (seq.inflight_tokens if overlap else 0),
+                self.max_len,
+            )
+            pos = dev_ctx - 1
+            token_ids[cursor] = seq.all_token_ids[-1]
+            # the host's last token lags the device while a window holding
+            # this lane is in flight: read the feedback array instead
+            use_fb[cursor] = overlap and seq.inflight_tokens > 0
+            token_pos[cursor] = pos
+            token_slot[cursor] = slots[seq.seq_id]
+            token_lane[cursor] = lane
+            if pack > 1:
+                tb_lane[cursor // tb] = lane
+            lane_qstart[lane] = cursor
+            lane_qlen[lane] = 1
+            lane_start[lane] = pos
+            context_lens[lane] = dev_ctx
+            sample_rows[lane] = cursor
+            sample_gate[lane] = 1
+            emit_seqs.append(seq)
+            cursor += pack
+        si = 0
+        for seq, start, end in spans:
+            self._maybe_record_queue_span(seq)
+            lane = seq.lane
+            tokens = seq.all_token_ids
+            n = len(tokens)
+            span = end - start
+            blocks = np.asarray(
+                self.allocator.block_ids(seq.seq_id), np.int32
+            )
+            token_ids[cursor : cursor + span] = tokens[start:end]
+            ppos = np.arange(start, end, dtype=np.int32)
+            token_pos[cursor : cursor + span] = ppos
+            token_slot[cursor : cursor + span] = (
+                blocks[ppos // bs] * bs + ppos % bs
+            )
+            token_lane[cursor : cursor + span] = lane
+            npack = -(-span // pack)
+            if pack > 1:
+                tb_lane[cursor // tb : cursor // tb + npack] = lane
+            lane_qstart[lane] = cursor
+            lane_qlen[lane] = span
+            lane_start[lane] = start
+            context_lens[lane] = end
+            sample_rows[lane] = cursor + span - 1
+            final = end >= n
+            sample_gate[lane] = 1 if final else 0
+            if start == seq.cached_tokens:
+                # first window of this admission: (re)seed lane sampling
+                # state exactly like the split prefill programs do
+                seed_lanes[si] = lane
+                seed_prompt[si] = self._count_row(seq.request.token_ids)
+                seed_gen[si] = self._count_row(seq.output_ids)
+                si += 1
+                self._seed_lane_key(seq)
+                seq.sampling_seeded = True
+            if final:
+                emit_seqs.append(seq)
+            cursor += npack * pack
+
+        tables = self._decode_tables(decodes + [s for s, _, _ in spans])
+        sampling_tail = self._device_sampling_tail(emit_seqs, lanes)
+        if overlap and prev is not None:
+            feedback_in = prev.feedback
+        else:
+            if self._fb_zero is None:
+                self._fb_zero = jnp.zeros((lanes,), jnp.int32)
+            feedback_in = self._fb_zero
+        if need_seed:
+            seed_args = (
+                jnp.asarray(seed_lanes), jnp.asarray(seed_prompt),
+                jnp.asarray(seed_gen),
+            )
+        else:
+            if self._seed_none is None:
+                self._seed_none = (
+                    jnp.full((nseed,), lanes, jnp.int32),  # OOB → drop
+                    jnp.zeros((nseed, vocab), jnp.int32),
+                    jnp.zeros((nseed, vocab), jnp.int32),
+                )
+            seed_args = self._seed_none
+        if timing:
+            t = self._phase("decode.schedule", t)
+        args = (
+            jnp.asarray(token_ids), feedback_in, jnp.asarray(use_fb),
+            tables, jnp.asarray(context_lens), jnp.asarray(token_pos),
+            jnp.asarray(token_slot), jnp.asarray(token_lane),
+            jnp.asarray(tb_lane), jnp.asarray(lane_qstart),
+            jnp.asarray(lane_qlen), jnp.asarray(lane_start),
+            jnp.asarray(sample_rows), jnp.asarray(sample_gate),
+            *seed_args,
+        )
+        if timing:
+            t = self._phase("decode.upload", t)
+        tokens, lps, tkvs, tkis, self.cache, self._gen_counts, self._prompt_counts = self._jit_unified(
+            self.params, self.cache, self._gen_counts, self._prompt_counts,
+            *args, *sampling_tail, self.cos, self.sin,
+        )
+        if timing:
+            t = self._phase("decode.dispatch", t)
+
+        # host bookkeeping (device-ordered: any later program — including
+        # another engine's extract over published blocks — sees the writes)
+        t_prefill = time.time()
+        for seq, start, end in spans:
+            seq.prefilled_tokens = end
+            self._step_prefill_tokens += end - start
+            self._step_attn_ctx += (end * (end + 1) - start * (start + 1)) // 2
+            all_tokens = seq.all_token_ids
+            if end >= len(all_tokens):
+                if seq.status == SeqStatus.PREFILLING:
+                    seq.status = SeqStatus.RUNNING
+                self.allocator.publish_stored(seq.seq_id, all_tokens)
+            else:
+                self.allocator.publish_stored(seq.seq_id, all_tokens[:end])
+            self._record_prefill_span(seq, t_prefill)
+        self._step_decode_tokens += len(decodes)
+        self._step_attn_ctx += int(
+            sum(context_lens[s.lane] for s in decodes)
+        )
+        self._step_weight_streams += 1
+        self._unified_windows += 1
+        if decodes:
+            self._decode_steps_total += 1
+
+        if not overlap:
+            tokens_h = np.asarray(tokens)
+            lps_h = np.asarray(lps)
+            want_top = any(
+                s.request.sampling.top_logprobs > 0 for s in emit_seqs
+            )
+            tkv_h = np.asarray(tkvs) if want_top else None
+            tki_h = np.asarray(tkis) if want_top else None
+            if timing:
+                t = self._phase("decode.readback", t)
+            self._sync_windows += 1
+            for seq in emit_seqs:
+                if seq.status != SeqStatus.RUNNING:
+                    continue
+                lane = seq.lane
+                want = seq.request.sampling.top_logprobs > 0
+                self._process_token(
+                    seq, int(tokens_h[lane]), float(lps_h[lane]),
+                    top=(tkv_h[lane], tki_h[lane]) if want else None,
+                )
+            if timing:
+                self._phase("decode.post", t)
+            return True
+
+        # overlap: the window retires one iteration from now, while the
+        # NEXT window (possibly carrying a fresh admission) computes
+        for arr in (tokens, lps):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass
+        for seq in emit_seqs:
+            seq.inflight_tokens += 1
+        if emit_seqs:
+            self._inflight = _InflightWindow(
+                tokens=tokens, lps=lps, feedback=tokens,
+                active=emit_seqs, lane_ids=[s.lane for s in emit_seqs],
+                steps=1,
+            )
+        else:
+            # a chunk-only window samples nothing worth retiring: nothing
+            # goes in flight (KV writes are device-ordered regardless)
+            self._inflight = None
+        if prev is not None:
+            self._retire_window(prev)
+        return True
 
     def _attention_fallback(self, exc: BaseException) -> bool:
         """If the Pallas attention kernel is active and a step failed,
@@ -2061,6 +2604,8 @@ class JaxLlmEngine:
         self._jit_decode = self._build_decode()
         if self._jit_verify is not None:
             self._jit_verify = self._build_verify()
+        if self._jit_unified is not None:
+            self._jit_unified = self._build_unified()
         return True
 
     def _xprof_span(self, name: str):
@@ -2885,6 +3430,10 @@ class JaxLlmEngine:
             # on device (the lagged lane cannot write into freed blocks).
             prev_members = set(map(id, prev.active))
             if any(id(s) not in prev_members for s in active):
+                # THE admission sync point the unified step removes: a lane
+                # the feedback array doesn't cover (fresh prefill, reused
+                # lane) forces a drain + host rebuild here
+                self._admission_drains += 1
                 self._sync_pipeline()
                 prev = None
                 active = [s for s in active if s.status == SeqStatus.RUNNING]

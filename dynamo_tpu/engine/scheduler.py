@@ -41,6 +41,7 @@ class Scheduler:
         max_prefills_per_step: int = 1,
         prefill_chunk_tokens: int | None = None,
         bucket_cost=None,
+        unified_batch: bool = False,
     ):
         self.allocator = allocator
         self.max_batch_size = max_batch_size
@@ -48,6 +49,12 @@ class Scheduler:
         # chunked prefill: prompts longer than this prefill in chunks
         # interleaved with decode steps (None = whole-prompt prefill)
         self.prefill_chunk_tokens = prefill_chunk_tokens
+        # unified-batch mode: decode tokens and chunked-prefill tokens ride
+        # ONE ragged window, so the per-step token budget must charge the
+        # decode lanes already in it before planning chunks (split mode
+        # keeps the historical prefill-only budget — decode runs as its own
+        # dispatch there, and its cost is not fungible with chunk tokens)
+        self.unified_batch = unified_batch
         # budget accounting charges the PADDED compute of a window (the
         # engine's compile-bucket length), not raw tokens — otherwise a
         # split budget multiplies real per-step prefill work
@@ -105,6 +112,14 @@ class Scheduler:
         # in flight, so decode ITL stays bounded (vLLM-style budget)
         bs = self.allocator.block_size
         budget = self.prefill_chunk_tokens  # None = unlimited
+        if budget is not None and self.unified_batch:
+            # one decode token per running lane shares this step's window:
+            # draw them from the same budget so a decode-saturated window
+            # shrinks (or skips) its chunk share instead of overrunning
+            n_decode = sum(
+                1 for s in self.running if s.status == SeqStatus.RUNNING
+            )
+            budget = max(0, budget - n_decode)
         prefills: list[Sequence] = []
         continuing = sorted(
             (s for s in self.running if s.status == SeqStatus.PREFILLING),
@@ -130,7 +145,7 @@ class Scheduler:
             and self._free_lanes
             # enough budget for the smallest possible padded window — this
             # is what makes the post-allocation plan assert hold
-            and (budget is None or budget >= self.bucket_cost(bs))
+            and (budget is None or budget >= self._chunk_cost(bs))
         ):
             candidate = self.waiting[0]
             if candidate.remote_prefilled:
@@ -177,11 +192,20 @@ class Scheduler:
         decodes = [s for s in self.running if s not in prefills]
         return ScheduleDecision(prefills=prefills, decodes=decodes, preempted=preempted)
 
+    def _chunk_cost(self, take: int) -> int:
+        """Budget cost of a ``take``-token chunk window.  Split mode charges
+        the PADDED compute (each chunk runs as its own bucketed dispatch);
+        unified mode charges raw tokens — decode lanes and every chunk share
+        ONE window whose single bucket the engine picks, so padding the
+        per-chunk cost there would double-count (and a post-decode-charge
+        budget could never afford a full bucket, starving admission)."""
+        return take if self.unified_batch else self.bucket_cost(take)
+
     def _plan_chunk(self, seq: Sequence, start: int, budget: int | None) -> int | None:
         """Set ``seq.chunk_target`` for this step's prefill window starting
         at ``start``; intermediate chunk ends stay block-aligned and the
-        window's PADDED compute (bucket_cost) must fit ``budget``.  Returns
-        the budget cost charged, or None when nothing affordable fits."""
+        window's compute (_chunk_cost) must fit ``budget``.  Returns the
+        budget cost charged, or None when nothing affordable fits."""
         remaining = seq.context_len - start
         if budget is None:
             seq.chunk_target = seq.context_len
@@ -190,13 +214,13 @@ class Scheduler:
         take = min(remaining, budget)
         if take < remaining:  # intermediate end must be block-aligned
             take = (take // bs) * bs
-        # shrink until the padded window fits the budget
-        while take > 0 and self.bucket_cost(take) > budget:
+        # shrink until the window's charged compute fits the budget
+        while take > 0 and self._chunk_cost(take) > budget:
             take = ((take - 1) // bs) * bs
         if take <= 0:
             return None
         seq.chunk_target = start + take
-        return self.bucket_cost(take)
+        return self._chunk_cost(take)
 
     def ensure_slot(self, seq: Sequence) -> int | None:
         """Get the cache slot for this sequence's next token, preempting the

@@ -61,6 +61,11 @@ class ForwardPassMetrics:
     # the latest step and cumulative preemption count
     batch_occupancy_perc: float = 0.0
     num_preemptions_total: int = 0
+    # ragged unified-batch step: mixed prefill+decode windows served by one
+    # dispatch, and pipeline drains forced by new-sequence admission (the
+    # sync point the unified step exists to remove — flat while unified)
+    decode_windows_unified_total: int = 0
+    admission_drains_total: int = 0
     # utilization accounting (observability.perf): rolling rates + token
     # totals + wasted-work counters, and the opt-in engine phase timings
     # (DYN_ENGINE_PHASE_TIMING=1) as {phase: cumulative seconds}
@@ -111,6 +116,10 @@ class ForwardPassMetrics:
             spec_accepted_tokens_total=stats.get("spec_accepted_tokens_total", 0),
             batch_occupancy_perc=stats.get("batch_occupancy_perc", 0.0),
             num_preemptions_total=stats.get("num_preemptions_total", 0),
+            decode_windows_unified_total=stats.get(
+                "decode_windows_unified_total", 0
+            ),
+            admission_drains_total=stats.get("admission_drains_total", 0),
             mfu_perc=stats.get("mfu_perc", 0.0),
             bandwidth_util_perc=stats.get("bandwidth_util_perc", 0.0),
             goodput_tokens_per_second=stats.get("goodput_tokens_per_second", 0.0),

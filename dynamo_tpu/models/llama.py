@@ -32,6 +32,7 @@ from dynamo_tpu.ops.attention import (
     paged_decode_attention,
     paged_window_attention,  # noqa: F401 — re-exported for tests
     prefill_attention_with_prefix,
+    ragged_paged_attention,
     window_attention,
     write_decode_kv,
     write_prefill_kv,
@@ -562,6 +563,82 @@ def llama_forward_decode(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def llama_forward_unified(
+    params: dict,
+    cfg: LlamaConfig,
+    token_ids: jnp.ndarray,     # [T] int32 — flat ragged token batch
+    kv_cache: dict,
+    block_tables: jnp.ndarray,  # [lanes, max_blocks] int32
+    context_lens: jnp.ndarray,  # [lanes] int32 incl. each lane's span end
+    token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
+    token_slot: jnp.ndarray,    # [T] int32 flat cache slot (OOB = pad)
+    token_lane: jnp.ndarray,    # [T] int32 owning lane (OOB = pad)
+    tb_lane: jnp.ndarray,       # [T // tb_tokens] int32 lane per token block
+    lane_qstart: jnp.ndarray,   # [lanes] int32 flat index of span start
+    lane_qlen: jnp.ndarray,     # [lanes] int32 span length (0 = hole)
+    lane_start: jnp.ndarray,    # [lanes] int32 absolute span start position
+    sample_rows: jnp.ndarray,   # [lanes] int32 flat index of span's LAST token
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    *,
+    attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
+    tb_tokens: int = 8,
+) -> tuple[jnp.ndarray, dict]:
+    """Ragged unified-batch forward: one launch computes chunked-prefill
+    spans AND decode tokens from different sequences, each token at its own
+    absolute position (Ragged Paged Attention, arxiv 2604.15464).  Every
+    token's K/V scatters into its cache slot like decode, attention reads
+    the paged cache per lane (resident prefixes included — this path also
+    subsumes the continued-prefill-with-prefix program), and the logits are
+    gathered at each lane's LAST span row: [lanes, vocab], one sample row
+    per sequence regardless of how many tokens it contributed.  One weight
+    stream from HBM serves the whole mixed batch — the dispatch-count win
+    that removes the engine's prefill/decode phase split."""
+    t = token_ids.shape[0]
+    x = _embed(params, cfg, token_ids)  # [t, h]
+    positions = jnp.maximum(token_pos, 0)
+
+    def attend(q, k_layer, v_layer):
+        if attention.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import (
+                ragged_paged_attention as ragged_kernel,
+            )
+
+            return ragged_kernel(
+                q, k_layer, v_layer, block_tables, context_lens, tb_lane,
+                lane_qstart, lane_qlen, lane_start, tb_tokens=tb_tokens,
+                interpret=attention == "pallas_interpret",
+                sliding_window=cfg.sliding_window,
+            )
+        return ragged_paged_attention(
+            q, k_layer, v_layer, block_tables, context_lens, token_lane,
+            token_pos, sliding_window=cfg.sliding_window,
+        )
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        attn_in = rms_norm(x, w["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(attn_in, w, cfg)
+        q = apply_rope(q, positions, cos, sin)
+        k = apply_rope(k, positions, cos, sin)
+        # every token writes before anyone reads: span tokens see their own
+        # in-window predecessors through the cache (pads scatter-drop)
+        k_layer, v_layer = write_decode_kv(k_layer, v_layer, k, v, token_slot)
+        attn = attend(q, k_layer, v_layer)
+        x = x + mm(attn.reshape(t, -1), w["wo"])
+        mlp_in = rms_norm(x, w["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(mlp_in, w["w_gate"], w["w_up"], w["w_down"], cfg.mlp_activation)
+        return x, (k_layer, v_layer)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    rows = x[sample_rows]  # [lanes, h] — junk for hole lanes, caller-gated
+    logits = _logits(params, cfg, rows)
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 

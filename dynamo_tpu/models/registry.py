@@ -53,6 +53,10 @@ class ModelFamily:
     # multi-position verification forward (speculative decoding); None =
     # the engine rejects speculative config for this family
     forward_verify: Callable | None = None
+    # ragged unified-batch forward (one launch mixing chunked-prefill spans
+    # and decode tokens, ops/pallas/ragged_attention.py); None = the engine
+    # keeps the split prefill/decode step for this family
+    forward_unified: Callable | None = None
     # param-tree leaf names eligible for weight-only int8 (ops/quant.py);
     # empty = the family's forwards don't route matmuls through quant.mm
     quant_leaves: tuple[str, ...] = ()
@@ -129,6 +133,7 @@ def _llama_like_family(
         decode_accepts_tp_mesh=True,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=llama.llama_forward_verify,
+        forward_unified=llama.llama_forward_unified,
     )
 
 

@@ -211,6 +211,89 @@ def paged_window_attention(
     return out.reshape(b, w, h, d).astype(q.dtype)
 
 
+def ragged_paged_attention(
+    q: jnp.ndarray,             # [T, heads, head_dim] flat ragged token batch
+    k_cache: jnp.ndarray,       # [num_blocks, block_size, kv_heads, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [lanes, max_blocks] int32
+    context_lens: jnp.ndarray,  # [lanes] int32 (unused by the mask: kept for
+                                # signature parity with the Pallas kernel)
+    token_lane: jnp.ndarray,    # [T] int32 owning lane per token (OOB = pad)
+    token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
+    *,
+    sliding_window=None,  # attend only the last W positions per token; may
+                          # be a traced scalar (<=0 = full) — _window_mask
+    logit_softcap: float | None = None,
+    query_scale: float | None = None,
+    max_gather_tokens: int = 64,
+) -> jnp.ndarray:
+    """Ragged unified-batch attention over the paged cache — pure-JAX twin
+    of the Pallas kernel (ops/pallas/ragged_attention.py).
+
+    One flat token axis carries chunked-prefill spans and decode tokens from
+    different sequences; each token attends its OWN lane's pages at cache
+    positions <= its absolute position (causal; every token's K/V — and its
+    span predecessors' — must already be written, exactly like the decode
+    and verify paths).  Pad tokens (lane OOB / position -1) mask fully and
+    produce junk rows the caller discards.
+
+    The per-token page view materializes O(tokens × max_blocks·block_size)
+    floats; batches past ``max_gather_tokens`` process in sequential token
+    chunks (lax.map) so the working set stays bounded by the chunk — the
+    split decode fallback's scale — instead of growing with the window.
+    """
+    t, h, d = q.shape
+    _, block_size, kvh, _ = k_cache.shape
+    lanes, max_blocks = block_tables.shape
+    groups = h // kvh
+    length = max_blocks * block_size
+
+    k = k_cache[block_tables].reshape(lanes, length, kvh, d)
+    v = v_cache[block_tables].reshape(lanes, length, kvh, d)
+    scale = jnp.float32(query_scale) if query_scale is not None else (
+        1.0 / jnp.sqrt(jnp.float32(d))
+    )
+
+    def attend(qc, lane_c, pos_c):
+        n = qc.shape[0]
+        kt = k[lane_c]  # [n, length, kvh, d] — per-token page view
+        vt = v[lane_c]
+        qg = qc.reshape(n, kvh, groups, d).astype(jnp.float32)
+        logits = jnp.einsum(
+            "tkgd,tlkd->tkgl", qg, kt.astype(jnp.float32)
+        ) * scale
+        if logit_softcap is not None:
+            logits = _apply_softcap(logits, logit_softcap)
+        kv_pos = jnp.arange(length)[None, :]
+        # causal per token: pos <= own position (pads at -1 mask everything)
+        mask = kv_pos <= pos_c[:, None]
+        if sliding_window is not None:
+            mask = _window_mask(mask, pos_c[:, None] - kv_pos, sliding_window)
+        logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+        weights = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("tkgl,tlkd->tkgd", weights, vt.astype(jnp.float32))
+        return out.reshape(n, h, d)
+
+    lane = jnp.clip(token_lane, 0, lanes - 1)
+    if t <= max_gather_tokens:
+        return attend(q, lane, token_pos).astype(q.dtype)
+    ch = max_gather_tokens
+    n_chunks = -(-t // ch)
+    pad = n_chunks * ch - t
+    qp = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+    lane_p = jnp.pad(lane, (0, pad))                       # lane 0, masked
+    pos_p = jnp.pad(token_pos, (0, pad), constant_values=-1)
+    out = jax.lax.map(
+        lambda a: attend(*a),
+        (
+            qp.reshape(n_chunks, ch, h, d),
+            lane_p.reshape(n_chunks, ch),
+            pos_p.reshape(n_chunks, ch),
+        ),
+    )
+    return out.reshape(n_chunks * ch, h, d)[:t].astype(q.dtype)
+
+
 def window_attention(
     attention: str,
     q: jnp.ndarray,

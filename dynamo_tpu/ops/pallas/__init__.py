@@ -14,11 +14,13 @@ from dynamo_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_window_attention_decode,
 )
+from dynamo_tpu.ops.pallas.ragged_attention import ragged_paged_attention
 from dynamo_tpu.ops.pallas.block_copy import gather_blocks, scatter_blocks
 
 __all__ = [
     "paged_attention_decode",
     "paged_window_attention_decode",
+    "ragged_paged_attention",
     "gather_blocks",
     "scatter_blocks",
 ]

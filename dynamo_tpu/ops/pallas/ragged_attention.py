@@ -1,0 +1,218 @@
+"""Ragged unified-batch paged attention — Pallas TPU kernel.
+
+One launch consumes a RAGGED token batch: chunked-prefill spans and single
+decode tokens from different sequences, flattened onto one token axis with
+each token at its own absolute position (Ragged Paged Attention,
+arxiv 2604.15464).  This is the kernel that lets the engine run mixed
+prefill+decode as ONE dispatch — no separate prefill program, no
+overlap-pipeline drain at sequence admission.
+
+Layout (follows the page-mapping idiom of ``paged_attention.py``):
+
+- the flat token axis is cut into fixed-size TOKEN BLOCKS of ``tb_tokens``
+  rows; the host packs each sequence's query span into whole token blocks
+  (a span never shares a block with another sequence), so every grid step
+  serves exactly one lane — ``tb_lane[t]`` names it;
+- grid = (token blocks × KV pages): for token block ``t`` and page ``p``
+  the BlockSpec index_map reads the scalar-prefetched block table row of
+  ``tb_lane[t]``, so the page "gather" is pure DMA addressing;
+- per-lane row metadata rides in scalar prefetch: ``lane_qstart`` (flat
+  index of the span's first token), ``lane_qlen`` (span length, 0 = lane
+  hole), ``lane_start`` (absolute position of the span's first token) and
+  ``context_lens`` (absolute context INCLUDING the span's last token);
+- heads fold into the row axis like the window kernel (row = token*H + h)
+  and GQA matching uses iota masks on the [TB*H, bs*KVH] score matrix;
+- softmax accumulates online flash-style in VMEM scratch across a token
+  block's pages; causality is per-row: token at absolute position q sees
+  cache positions <= q, which also masks every other lane's pages because
+  pages stream per-lane via the block table.
+
+Padding rows (decode blocks carry 1 live row, span tails round up, the
+token axis pads to a compile bucket with ``tb_lane = 0``) mask out through
+``lane_qstart``/``lane_qlen`` — their output rows are garbage the caller
+never reads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _ragged_kernel(
+    block_tables_ref,   # [lanes, maxb] int32
+    context_lens_ref,   # [lanes] int32 — INCLUDING each lane's span end
+    tb_lane_ref,        # [num_tb] int32 — lane served by each token block
+    lane_qstart_ref,    # [lanes] int32 — flat index of the span's first token
+    lane_qlen_ref,      # [lanes] int32 — span length (0 = hole)
+    lane_start_ref,     # [lanes] int32 — absolute position of the first token
+    q_ref,              # [1, TB*H, D]   (token-major fold: row = tok*H + h)
+    k_page_ref,         # [1, bs*KVH, D]
+    v_page_ref,
+    out_ref,            # [1, TB*H, D]
+    m_ref,              # [TB*H, 128] f32
+    l_ref,
+    acc_ref,            # [TB*H, D] f32
+    *,
+    block_size: int,
+    num_kv_heads: int,
+    groups: int,
+    head_dim: int,
+    max_blocks: int,
+    tb_tokens: int,
+    sliding_window: int | None,
+):
+    """Online-softmax page loop for one ragged token block."""
+    t = pl.program_id(0)
+    page = pl.program_id(1)
+    lane = tb_lane_ref[t]
+    ctx = context_lens_ref[lane]
+    qs = lane_qstart_ref[lane]
+    ql = lane_qlen_ref[lane]
+    sp = lane_start_ref[lane]
+    rows = block_size * num_kv_heads
+    h_all = num_kv_heads * groups
+    tbh = tb_tokens * h_all
+
+    @pl.when(page == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_start = page * block_size
+
+    active = page_start < ctx
+    if sliding_window is not None:
+        # pages entirely below the OLDEST query's window contribute nothing
+        # (lowest visible absolute position = lane_start - (W_s - 1))
+        active &= page_start + block_size > sp - (sliding_window - 1)
+
+    @pl.when(active)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # [TB*H, D]
+        k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
+        v = v_page_ref[0].astype(jnp.float32)
+        scale = 1.0 / (head_dim ** 0.5)
+        s = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                        # [TB*H, bs*KVH]
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
+        pos = page_start + col // num_kv_heads
+        kv_of_col = col % num_kv_heads
+        row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
+        kv_of_row = (row % h_all) // groups
+        # row r serves flat token t*TB + r//H; its offset inside the span
+        # places it at absolute position lane_start + offset
+        q_rel = t * tb_tokens + row // h_all - qs        # [TB*H, 1]
+        q_pos = sp + q_rel
+        row_ok = (q_rel >= 0) & (q_rel < ql)
+        mask = (kv_of_col == kv_of_row) & row_ok & (pos <= q_pos)
+        if sliding_window is not None:
+            mask = mask & (pos > q_pos - sliding_window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(page == max_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tb_tokens", "interpret", "sliding_window")
+)
+def ragged_paged_attention(
+    q: jnp.ndarray,             # [T, H, D] flat ragged token batch
+    k_cache: jnp.ndarray,       # [N, bs, KVH, D]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # [lanes, maxb] int32
+    context_lens: jnp.ndarray,  # [lanes] int32 incl. each span's last token
+    tb_lane: jnp.ndarray,       # [T // tb_tokens] int32
+    lane_qstart: jnp.ndarray,   # [lanes] int32
+    lane_qlen: jnp.ndarray,     # [lanes] int32 (0 = lane hole)
+    lane_start: jnp.ndarray,    # [lanes] int32
+    *,
+    tb_tokens: int = 8,
+    interpret: bool = False,
+    sliding_window: int | None = None,
+) -> jnp.ndarray:
+    """Pallas ragged paged attention: causally-masked paged attention over
+    one mixed prefill+decode token batch in a single launch (pure-JAX twin:
+    ops/attention.py ragged_paged_attention)."""
+    t_pad, h, d = q.shape
+    n, bs, kvh, _ = k_cache.shape
+    maxb = block_tables.shape[1]
+    groups = h // kvh
+    rows = bs * kvh
+    if t_pad % tb_tokens:
+        raise ValueError(
+            f"flat token axis ({t_pad}) must pack whole token blocks of "
+            f"{tb_tokens}"
+        )
+    num_tb = t_pad // tb_tokens
+    tbh = tb_tokens * h
+
+    def kv_map(t, p, bt, cl, tl, qs, ql, ls):
+        return (bt[tl[t], p], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(num_tb, maxb),
+        in_specs=[
+            pl.BlockSpec((1, tbh, d), lambda t, p, *_: (t, 0, 0)),
+            pl.BlockSpec((1, rows, d), kv_map),
+            pl.BlockSpec((1, rows, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, tbh, d), lambda t, p, *_: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tbh, 128), jnp.float32),
+            pltpu.VMEM((tbh, 128), jnp.float32),
+            pltpu.VMEM((tbh, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        block_size=bs,
+        num_kv_heads=kvh,
+        groups=groups,
+        head_dim=d,
+        max_blocks=maxb,
+        tb_tokens=tb_tokens,
+        sliding_window=sliding_window,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_tb, tbh, d), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables, context_lens, tb_lane, lane_qstart, lane_qlen,
+        lane_start,
+        q.reshape(num_tb, tbh, d),
+        k_cache.reshape(n, rows, d),
+        v_cache.reshape(n, rows, d),
+    )
+    return out.reshape(t_pad, h, d)

@@ -85,6 +85,13 @@ PREFETCH_FAMILIES = (
     "dyn_worker_offload_blocks_pinned",
 )
 
+# ragged unified-batch step (engine unified_batch knob → engine stats →
+# ForwardPassMetrics → metrics service)
+UNIFIED_FAMILIES = (
+    "dyn_worker_unified_windows",
+    "dyn_worker_admission_drains",
+)
+
 # metrics service registry (dynamo_tpu/components/metrics_service.py)
 WORKER_FAMILIES = (
     "dyn_worker_kv_active_blocks",
@@ -99,7 +106,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

@@ -41,6 +41,8 @@ WORKER_FIELDS = {
     "dyn_worker_requests_waiting": "waiting",
     "dyn_worker_batch_occupancy_perc": "batch_occupancy_perc",
     "dyn_worker_preemptions": "preemptions",
+    "dyn_worker_unified_windows": "unified_windows",
+    "dyn_worker_admission_drains": "admission_drains",
     "dyn_worker_prefill_tokens": "prefill_tokens",
     "dyn_worker_decode_tokens": "decode_tokens",
     "dyn_worker_tokens_emitted": "tokens_emitted",
@@ -196,7 +198,7 @@ def render_table(snap: dict) -> str:
         lines.append(
             f"  {'WORKER':<10} {'MFU':>7} {'BW':>7} {'GOODPUT/s':>10} "
             f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} "
-            f"{'WASTED':>8} {'PF-HIT':>7}"
+            f"{'WASTED':>8} {'PF-HIT':>7} {'UNI':>6} {'DRAIN':>6}"
         )
         for wid in sorted(workers):
             r = workers[wid]
@@ -208,7 +210,9 @@ def render_table(snap: dict) -> str:
                 f"{_pct(r.get('batch_occupancy_perc')):>7} "
                 f"{_num(r.get('running'), 5)} {_num(r.get('waiting'), 5)} "
                 f"{_num(r.get('preemptions'), 8)} {_num(r.get('wasted_tokens'), 8)} "
-                f"{_pct(r.get('prefetch_hit_ratio')):>7}"
+                f"{_pct(r.get('prefetch_hit_ratio')):>7} "
+                f"{_num(r.get('unified_windows'), 6)} "
+                f"{_num(r.get('admission_drains'), 6)}"
             )
             tiers = r.get("offload_tiers") or {}
             if tiers:

@@ -18,9 +18,19 @@ any regression fails).  In overlap mode the synchronous ``decode.readback``
 phase disappears by construction: the wait moves to ``decode.retire``,
 which runs while the NEXT window computes on device.
 
+Mixed A/B mode (``--mixed``) drives a CONTINUOUS ARRIVAL stream — requests
+land every ``--arrival-ms`` while earlier ones decode, with chunked prefill
+on — twice: the split prefill/decode step, then the ragged unified-batch
+step (``unified_batch=True``).  Reports steps/s (scheduler iterations over
+wall), the admission-drain count (pipeline drains forced by new-sequence
+admission — the sync point the unified step removes; must stay 0 in
+unified mode), unified-window count, and per-phase shares.  Exits nonzero
+when unified regresses steps/s below ``--mixed-min-speedup``.
+
 Usage: python scripts/profile_decode.py [--model llama32_1b|tiny]
            [--quant int8] [--isl 256] [--osl 64] [--batch 16]
            [--decode-steps 1] [--overlap 0|1] [--ab]
+           [--mixed] [--requests 12] [--arrival-ms 50] [--chunk 32]
 """
 
 from __future__ import annotations
@@ -191,9 +201,185 @@ async def run(args: argparse.Namespace, *, overlap: bool | None = None) -> dict:
     }
 
 
+async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
+    """One continuous-arrival mixed prefill+decode run (chunked prefill on,
+    overlap per ``--overlap``/engine default) on the split or the unified
+    step.  ``steps_s`` counts DECODE steps (see the inline note below);
+    raw scheduler iterations ride along as ``iterations``."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.llm.protocols.common import (
+        Annotated,
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.models.registry import get_family
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.runtime.engine import Context
+
+    cfg = getattr(LlamaConfig, args.model)()
+    family = get_family("llama")
+    max_len = args.isl + args.osl + 16
+    block_size = 16
+    num_blocks = args.batch * ((max_len + block_size - 1) // block_size) + 8
+    shapes = jax.eval_shape(
+        lambda k: family.init_params(cfg, k), jax.random.PRNGKey(0)
+    )
+    params = jax.tree.map(
+        lambda s: np.full(
+            s.shape, 1 if np.issubdtype(s.dtype, np.integer) else 0.01,
+            dtype=s.dtype,
+        ),
+        shapes,
+    )
+    overlap = None if args.overlap is None else bool(args.overlap)
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=cfg,
+            num_blocks=num_blocks,
+            block_size=block_size,
+            max_batch_size=args.batch,
+            max_model_len=max_len,
+            prefill_buckets=(args.isl,),
+            prefill_chunk_tokens=args.chunk,
+            top_logprobs_k=0,
+            logit_bias_k=0,
+            # model-dtype cache in BOTH modes: the unified step auto-disables
+            # on narrowed cache dtypes (parity contract), and an A/B must
+            # not compare different cache byte counts anyway
+            kv_cache_dtype=None,
+            decode_overlap=overlap,
+            unified_batch=unified,
+        ),
+        params=params,
+    )
+    engine.start()
+    mode = "unified" if engine.unified_batch else "split"
+    print(f"profile: mixed engine up ({args.model}, {mode})", file=sys.stderr)
+    rng = np.random.default_rng(0)
+
+    def make_request() -> dict:
+        tokens = rng.integers(10, cfg.vocab_size - 10, size=args.isl).tolist()
+        return PreprocessedRequest(
+            token_ids=tokens,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=args.osl, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+
+    async def drive(req: dict) -> int:
+        count = 0
+        stream = await engine.generate(Context(req))
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is not None:
+                count += len(ann.data.token_ids)
+        return count
+
+    # warmup: two OVERLAPPING requests, so the mixed-window buckets (chunk
+    # plus live decode lanes) compile here and not mid-measurement
+    warm = [asyncio.ensure_future(drive(make_request()))]
+    await asyncio.sleep(args.arrival_ms / 1e3)
+    warm.append(asyncio.ensure_future(drive(make_request())))
+    await asyncio.gather(*warm)
+    before = engine.stats()
+    engine.phase_stats.clear()
+    t0 = time.monotonic()
+    tasks = []
+    for _ in range(args.requests):
+        tasks.append(asyncio.ensure_future(drive(make_request())))
+        await asyncio.sleep(args.arrival_ms / 1e3)
+    counts = await asyncio.gather(*tasks)
+    wall = time.monotonic() - t0
+    stats = engine.stats()
+    engine.stop()
+    dev = jax.devices()[0]
+    # decode-step cadence, not scheduler iterations: a unified iteration
+    # serves prefill AND decode in one window, so raw iteration counts
+    # would under-credit exactly the merge being measured
+    steps = stats["decode_steps_total"] - before["decode_steps_total"]
+    return {
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "model": args.model,
+        "mode": mode,
+        "iterations": (
+            stats["iterations_total"] - before["iterations_total"]
+        ),
+        "batch": args.batch,
+        "isl": args.isl,
+        "osl": args.osl,
+        "chunk": args.chunk,
+        "requests": args.requests,
+        "arrival_ms": args.arrival_ms,
+        "overlap": engine.decode_overlap,
+        "wall_s": round(wall, 2),
+        "tok_s": round(sum(counts) / wall, 1),
+        "steps_s": round(steps / wall, 2),
+        "admission_drains": (
+            stats["admission_drains_total"] - before["admission_drains_total"]
+        ),
+        "windows_unified": (
+            stats["decode_windows_unified_total"]
+            - before["decode_windows_unified_total"]
+        ),
+        "windows_overlapped": (
+            stats["decode_windows_overlapped_total"]
+            - before["decode_windows_overlapped_total"]
+        ),
+        "windows_sync": (
+            stats["decode_windows_sync_total"]
+            - before["decode_windows_sync_total"]
+        ),
+        "decode_phase_share": _decode_phase_shares(stats.get("phase_ms", {})),
+        "phase_ms": stats.get("phase_ms", {}),
+    }
+
+
 async def amain(args: argparse.Namespace) -> tuple[int, dict]:
     """Run the requested profile; returns (exit_code, result).  Importable
-    so the tier-1 smoke test can drive the A/B in-process."""
+    so the tier-1 smoke tests can drive the A/Bs in-process."""
+    if getattr(args, "mixed", False):
+        split = await run_mixed(args, unified=False)
+        uni = await run_mixed(args, unified=True)
+        speedup = uni["steps_s"] / split["steps_s"] if split["steps_s"] else 0.0
+        result = {
+            "mixed": True,
+            "model": args.model,
+            "batch": args.batch,
+            "isl": args.isl,
+            "osl": args.osl,
+            "chunk": args.chunk,
+            "requests": args.requests,
+            "arrival_ms": args.arrival_ms,
+            "unified_speedup_steps_s": round(speedup, 3),
+            "unified_speedup_tok_s": round(
+                uni["tok_s"] / split["tok_s"], 3
+            ) if split["tok_s"] else 0.0,
+            "admission_drains_split": split["admission_drains"],
+            "admission_drains_unified": uni["admission_drains"],
+            "windows_unified": uni["windows_unified"],
+            "split": split,
+            "unified": uni,
+        }
+        rc = 0
+        if speedup < args.mixed_min_speedup:
+            print(
+                f"profile: unified REGRESSED steps/s ({speedup:.3f}x < "
+                f"{args.mixed_min_speedup}x)", file=sys.stderr,
+            )
+            rc = 1
+        if uni["windows_unified"] and uni["admission_drains"]:
+            print(
+                "profile: unified mode still drained on admission "
+                f"({uni['admission_drains']} drains)", file=sys.stderr,
+            )
+            rc = 1
+        return rc, result
     if not args.ab:
         overlap = None if args.overlap is None else bool(args.overlap)
         return 0, await run(args, overlap=overlap)
@@ -247,6 +433,21 @@ def main() -> int:
     parser.add_argument("--ab-min-speedup", type=float, default=1.0,
                         help="minimum overlap/sync tok_s ratio for --ab to "
                              "exit 0 (1.0 = fail on any regression)")
+    parser.add_argument("--mixed", action="store_true",
+                        help="continuous-arrival mixed prefill+decode A/B: "
+                             "split step vs ragged unified-batch step; exit "
+                             "nonzero if unified regresses steps/s or still "
+                             "drains on admission")
+    parser.add_argument("--mixed-min-speedup", type=float, default=1.0,
+                        help="minimum unified/split steps_s ratio for "
+                             "--mixed to exit 0")
+    parser.add_argument("--requests", type=int, default=12,
+                        help="--mixed: requests in the arrival stream")
+    parser.add_argument("--arrival-ms", type=int, default=50,
+                        help="--mixed: inter-arrival gap (tight enough that "
+                             "admissions land while earlier requests decode)")
+    parser.add_argument("--chunk", type=int, default=32,
+                        help="--mixed: prefill_chunk_tokens for both modes")
     parser.add_argument("--out", default=None,
                         help="also write the JSON result to this path")
     args = parser.parse_args()

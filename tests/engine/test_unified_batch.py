@@ -1,0 +1,316 @@
+"""Ragged unified-batch step correctness: with ``unified_batch`` enabled the
+engine serves mixed prefill+decode as ONE dispatch and must emit
+BYTE-IDENTICAL token streams to the split path — across sync and overlapped
+windows, mid-window admission, chunked prefill, preemption, stop tokens and
+seeded sampling — while admission no longer drains the overlap pipeline
+(the drain counter stays flat) and the unified window counter proves the
+ragged path actually served."""
+
+import asyncio
+
+from dynamo_tpu.llm.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+from tests.engine.test_jax_engine import (
+    collect,
+    greedy_reference,
+    make_engine,
+    request,
+    sampled_request,
+)
+
+
+async def run_matrix(reqs, *, overlap, stagger_s=0.0, **engine_kw):
+    """Drive the same requests through a split and a unified engine; return
+    (split results, unified results, unified stats, split stats)."""
+    out, stats = [], []
+    for unified in (False, True):
+        engine = make_engine(
+            unified_batch=unified, decode_overlap=overlap, **engine_kw
+        )
+        try:
+            tasks = []
+            for r in reqs:
+                tasks.append(asyncio.ensure_future(collect(engine, r)))
+                if stagger_s:
+                    await asyncio.sleep(stagger_s)
+            results = await asyncio.gather(*tasks)
+            stats.append(engine.stats())
+        finally:
+            engine.stop()
+        out.append(results)
+    return out[0], out[1], stats[1], stats[0]
+
+
+async def test_unified_parity_sync_and_overlap():
+    prompts = [list(range(3 + i, 11 + i)) for i in range(3)]
+    reqs = [request(p, max_tokens=6, ignore_eos=True) for p in prompts]
+    for overlap in (False, True):
+        split, unified, stats, _ = await run_matrix(reqs, overlap=overlap)
+        assert unified == split
+        for p, (tokens, _) in zip(prompts, unified):
+            assert tokens == greedy_reference(p, 6)
+        assert stats["decode_windows_unified_total"] > 0
+
+
+async def test_unified_midwindow_admission_no_drain():
+    """THE acceptance property: with overlap on, a sequence admitted while
+    decode windows are in flight rides the next ragged window — the
+    admission-drain counter stays flat, where the split pipeline drains on
+    every admission.  Greedy output is stagger-independent, so the split
+    run retries with wider staggers until an admission demonstrably landed
+    mid-decode (a fast warm machine can finish a request inside a fixed
+    stagger, which would make a single-shot assert flaky)."""
+    prompts = [list(range(3, 11)), list(range(5, 13)), list(range(7, 15))]
+    reqs = [request(p, max_tokens=10, ignore_eos=True) for p in prompts]
+    split, unified, stats, split_stats = await run_matrix(
+        reqs, overlap=True, stagger_s=0.02
+    )
+    assert unified == split
+    for p, (tokens, _) in zip(prompts, unified):
+        assert tokens == greedy_reference(p, 10)
+    # the unified engine admitted every sequence into live windows
+    assert stats["admission_drains_total"] == 0
+    assert stats["decode_windows_unified_total"] > 0
+    # and the SAME traffic forces drains on the split engine: retry with
+    # wider staggers until an arrival lands while windows are in flight
+    for stagger in (0.02, 0.05, 0.1, 0.2):
+        if split_stats["admission_drains_total"] > 0:
+            break
+        split, _, _, split_stats = await run_matrix(
+            reqs, overlap=True, stagger_s=stagger
+        )
+        assert unified == split  # parity holds at every stagger
+    assert split_stats["admission_drains_total"] > 0
+
+
+async def test_unified_chunked_prefill_parity():
+    """Chunk windows ride decode windows: outputs stay bit-identical to the
+    split chunked path, and the decode stream never pauses for admission."""
+    long_prompt = list(range(3, 33))
+    short_prompt = list(range(5, 12))
+    reqs = [
+        request(short_prompt, max_tokens=8, ignore_eos=True),
+        request(long_prompt, max_tokens=6, ignore_eos=True),
+    ]
+    for overlap in (False, True):
+        split, unified, stats, _ = await run_matrix(
+            reqs, overlap=overlap, stagger_s=0.05, prefill_chunk_tokens=8,
+        )
+        assert unified == split
+        assert unified[0][0] == greedy_reference(short_prompt, 8)
+        assert unified[1][0] == greedy_reference(long_prompt, 6)
+        assert stats["decode_windows_unified_total"] > 0
+
+
+async def test_unified_stop_token_parity():
+    prompt = list(range(3, 12))
+    engine = make_engine()
+    try:
+        base, _ = await collect(
+            engine, request(prompt, max_tokens=8, ignore_eos=True)
+        )
+    finally:
+        engine.stop()
+    stop_tok = base[4]
+    req = PreprocessedRequest(
+        token_ids=prompt,
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=8, stop_token_ids=[stop_tok]),
+        eos_token_ids=[],
+    ).to_wire()
+    for overlap in (False, True):
+        split, unified, _, _ = await run_matrix([req], overlap=overlap)
+        assert unified == split
+        tokens, finish = unified[0]
+        assert finish == FinishReason.STOP
+        assert tokens[-1] == stop_tok
+        assert stop_tok not in tokens[:-1]
+
+
+async def test_unified_parity_under_preemption():
+    """Tight block pool: unified overlap drains + falls back to the
+    preempting split machinery on OOM, and recompute keeps greedy output
+    exact (the re-admitted prefill re-seeds its lane through the unified
+    seed scatter)."""
+    prompts = [list(range(3, 10)), list(range(5, 12)), list(range(2, 9))]
+    reqs = [request(p, max_tokens=8, ignore_eos=True) for p in prompts]
+    for overlap in (False, True):
+        engine = make_engine(
+            unified_batch=True, decode_overlap=overlap, max_batch_size=4,
+            num_blocks=10, max_model_len=40,
+        )
+        preempts = []
+        orig = engine.scheduler.preempt
+        engine.scheduler.preempt = (
+            lambda seq: (preempts.append(seq.seq_id), orig(seq))[1]
+        )
+        try:
+            results = await asyncio.gather(*[collect(engine, r) for r in reqs])
+        finally:
+            engine.stop()
+        assert preempts, "test geometry failed to force preemption"
+        for (tokens, _), p in zip(results, prompts):
+            assert tokens == greedy_reference(p, 8)
+
+
+async def test_unified_seeded_sampling_parity():
+    """Per-lane key fold rides context_lens in both paths, so even SAMPLED
+    streams (with penalties) are byte-identical split-vs-unified, chunked
+    included."""
+    prompt = list(range(3, 40))
+    req = sampled_request(
+        prompt, max_tokens=10, temperature=8.0, seed=1234,
+        frequency_penalty=2.0,
+    )
+    for overlap in (False, True):
+        split, unified, stats, _ = await run_matrix(
+            [req], overlap=overlap, prefill_chunk_tokens=8
+        )
+        assert unified == split
+        assert stats["decode_windows_unified_total"] > 0
+
+
+async def test_unified_top_logprobs_served_sync():
+    """top_logprobs lanes keep K-wide readback: the unified step serves
+    them on its synchronous mode with alternatives intact."""
+    prompt = list(range(3, 10))
+    engine = make_engine(unified_batch=True, decode_overlap=True)
+    try:
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True, top_logprobs=3),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+        from dynamo_tpu.llm.protocols.common import Annotated, LLMEngineOutput
+
+        stream = await engine.generate(Context(req))
+        tokens, top_rows = [], []
+        async for item in stream:
+            ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+            if ann.data is None:
+                continue
+            tokens.extend(ann.data.token_ids)
+            if ann.data.top_logprobs:
+                top_rows.extend(ann.data.top_logprobs)
+        stats = engine.stats()
+    finally:
+        engine.stop()
+    assert tokens == greedy_reference(prompt, 4)
+    assert len(top_rows) == len(tokens)
+    assert all(len(row) == 3 for row in top_rows)
+    assert stats["decode_windows_unified_total"] > 0
+    assert stats["decode_windows_overlapped_total"] == 0
+
+
+async def test_unified_disagg_prefill_falls_back():
+    """prefill_only (disagg extract) keeps its split route on a unified
+    engine — same first token and block count as a plain engine."""
+    prompt = list(range(3, 40))
+
+    def pre():
+        return PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(use_greedy=True),
+            stop=StopConditions(max_tokens=4),
+            eos_token_ids=[1],
+        )
+
+    plain = make_engine()
+    try:
+        tok_ref, _, _, _, n_ref = await plain.prefill_extract(pre())
+    finally:
+        plain.stop()
+    engine = make_engine(unified_batch=True)
+    try:
+        tok, _, _, _, n = await engine.prefill_extract(pre())
+    finally:
+        engine.stop()
+    assert tok == tok_ref
+    assert n == n_ref
+
+
+async def test_unified_knob_env_and_auto_disable(monkeypatch):
+    """DYN_UNIFIED_BATCH turns the path on; explicit config outranks the
+    env; geometries the ragged step cannot serve auto-disable loudly."""
+    engine = make_engine()
+    assert engine.unified_batch is False  # default off
+    engine.stop()
+    monkeypatch.setenv("DYN_UNIFIED_BATCH", "1")
+    engine = make_engine()
+    assert engine.unified_batch is True
+    engine.stop()
+    engine = make_engine(unified_batch=False)
+    assert engine.unified_batch is False
+    engine.stop()
+    monkeypatch.delenv("DYN_UNIFIED_BATCH")
+    # speculative lanes keep their verify route
+    engine = make_engine(unified_batch=True, speculative="ngram")
+    assert engine.unified_batch is False
+    engine.stop()
+    # fused multi-step windows cannot carry chunks
+    engine = make_engine(unified_batch=True, decode_steps=4)
+    assert engine.unified_batch is False
+    engine.stop()
+    # narrowed KV dtype breaks split-vs-unified byte parity
+    engine = make_engine(unified_batch=True, kv_cache_dtype="fp8")
+    assert engine.unified_batch is False
+    engine.stop()
+
+
+def test_scheduler_budget_charges_decode_lanes():
+    """Unified budget accounting: decode lanes already in the window draw
+    from the same per-step token budget the chunk planner spends."""
+    from dynamo_tpu.engine.kv_manager import BlockAllocator
+    from dynamo_tpu.engine.scheduler import Scheduler
+    from dynamo_tpu.engine.sequence import Sequence, SeqStatus
+
+    def mk(budget, unified, n_decode):
+        alloc = BlockAllocator(64, 4)
+        sched = Scheduler(
+            alloc, max_batch_size=8, prefill_chunk_tokens=budget,
+            unified_batch=unified,
+        )
+        for i in range(n_decode):
+            seq = Sequence(
+                seq_id=f"d{i}",
+                request=PreprocessedRequest(
+                    token_ids=list(range(3, 9)),
+                    stop=StopConditions(max_tokens=4),
+                    eos_token_ids=[],
+                ),
+            )
+            alloc.allocate_sequence(seq.seq_id, seq.context_len + 1)
+            seq.status = SeqStatus.RUNNING
+            seq.lane = sched._free_lanes.pop()
+            sched.running.append(seq)
+        long = Sequence(
+            seq_id="p0",
+            request=PreprocessedRequest(
+                token_ids=list(range(3, 67)),  # 64 tokens, chunks of <= budget
+                stop=StopConditions(max_tokens=4),
+                eos_token_ids=[],
+            ),
+        )
+        sched.add(long)
+        decision = sched.schedule()
+        return long, decision
+
+    # split mode: the chunk planner spends the whole budget
+    long, decision = mk(budget=16, unified=False, n_decode=4)
+    assert long in decision.prefills
+    assert long.chunk_target == 16
+    # unified mode: 4 decode tokens share the window → chunk shrinks
+    # block-aligned to 16 - 4 → 12
+    long, decision = mk(budget=16, unified=True, n_decode=4)
+    assert long in decision.prefills
+    assert long.chunk_target == 12
+    # decode-saturated window: no chunk budget left this step
+    long, decision = mk(budget=8, unified=True, n_decode=6)
+    assert long not in decision.prefills

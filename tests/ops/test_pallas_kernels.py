@@ -255,6 +255,153 @@ def test_mla_window_attention_kernel_matches_reference():
         )
 
 
+def ragged_meta(spans, lanes, tb=8, t_pad=None):
+    """Pack (lane, start_pos, q_len) spans into the ragged metadata the
+    unified kernel consumes: each span occupies whole token blocks, pads
+    carry lane 0 with fully-masked rows (the engine's packing)."""
+    total = sum(-(-l // tb) * tb for _, _, l in spans)
+    t_pad = t_pad or total
+    token_lane = np.full((t_pad,), lanes, np.int32)
+    token_pos = np.full((t_pad,), -1, np.int32)
+    tb_lane = np.zeros((t_pad // tb,), np.int32)
+    qstart = np.zeros((lanes,), np.int32)
+    qlen = np.zeros((lanes,), np.int32)
+    lstart = np.zeros((lanes,), np.int32)
+    ctx = np.zeros((lanes,), np.int32)
+    cur = 0
+    for lane, start, l in spans:
+        token_lane[cur : cur + l] = lane
+        token_pos[cur : cur + l] = np.arange(start, start + l)
+        ntb = -(-l // tb)
+        tb_lane[cur // tb : cur // tb + ntb] = lane
+        qstart[lane], qlen[lane], lstart[lane] = cur, l, start
+        ctx[lane] = start + l
+        cur += ntb * tb
+    return (
+        jnp.asarray(token_lane), jnp.asarray(token_pos),
+        jnp.asarray(tb_lane), jnp.asarray(qstart), jnp.asarray(qlen),
+        jnp.asarray(lstart), jnp.asarray(ctx),
+    )
+
+
+def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None):
+    """Kernel + pure-JAX twin over the shared test cache; returns
+    (kernel_out, ref_out, token_pos host array, q)."""
+    rng = jax.random.PRNGKey(0)
+    k_cache, v_cache, tables, _ = build_cache(rng)
+    token_lane, token_pos, tb_lane, qstart, qlen, lstart, ctx = ragged_meta(
+        spans, lanes, tb=tb, t_pad=t_pad
+    )
+    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
+    from dynamo_tpu.ops.pallas import ragged_paged_attention as ragged_kernel
+
+    t = token_lane.shape[0]
+    q = jax.random.normal(jax.random.fold_in(rng, q_key), (t, 4, 128), jnp.float32)
+    ref = ragged_ref(q, k_cache, v_cache, tables, ctx, token_lane, token_pos)
+    out = ragged_kernel(
+        q, k_cache, v_cache, tables, ctx, tb_lane, qstart, qlen, lstart,
+        tb_tokens=tb, interpret=True,
+    )
+    return np.asarray(out), np.asarray(ref), np.asarray(token_pos), q
+
+
+def test_ragged_attention_decode_only_matches_decode_kernel():
+    """A decode-only ragged batch (one token per lane) must equal both the
+    pure-JAX twin and the plain paged decode path row-for-row."""
+    spans = [(0, 4, 1), (1, 16, 1), (2, 28, 1)]
+    out, ref, token_pos, q = run_ragged(spans)
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
+    rng = jax.random.PRNGKey(0)
+    k_cache, v_cache, tables, _ = build_cache(rng)
+    rows = np.asarray([0, 8, 16])
+    dec = paged_decode_attention(
+        q[jnp.asarray(rows)], k_cache, v_cache, tables,
+        jnp.asarray([5, 17, 29], jnp.int32),
+    )
+    np.testing.assert_allclose(out[rows], np.asarray(dec), rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_attention_prefill_span_matches_reference():
+    """A prefill-only ragged batch: one 13-token span attending its own
+    in-cache prefix causally (positions 16..28 of lane 2's 29-long ctx)."""
+    out, ref, token_pos, _ = run_ragged([(2, 16, 13)])
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_attention_mixed_and_single_token_tail():
+    """Mixed batch: decode token + a mid-prompt chunk + a single-token
+    prefill tail (span length 1 — the chunk-boundary edge case)."""
+    spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
+    out, ref, token_pos, _ = run_ragged(spans)
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_attention_lane_holes_and_padding():
+    """Lane 1 is a hole (qlen 0) and the token axis pads past the spans:
+    every live row still matches, junk rows stay NaN-free."""
+    spans = [(0, 4, 1), (2, 20, 9)]
+    out, ref, token_pos, _ = run_ragged(spans, t_pad=32)
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
+    assert np.isfinite(out).all()
+
+
+def test_ragged_attention_chunked_gather_matches_direct():
+    """The fallback's bounded-memory token-chunk path (max_gather_tokens
+    exceeded → lax.map over chunks) is numerically identical to the direct
+    gather, including a chunk boundary that splits a span."""
+    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
+
+    spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
+    rng = jax.random.PRNGKey(0)
+    k_cache, v_cache, tables, _ = build_cache(rng)
+    token_lane, token_pos, _, _, _, _, ctx = ragged_meta(spans, 3)
+    t = token_lane.shape[0]
+    q = jax.random.normal(jax.random.fold_in(rng, 13), (t, 4, 128), jnp.float32)
+    direct = ragged_ref(
+        q, k_cache, v_cache, tables, ctx, token_lane, token_pos,
+        max_gather_tokens=4096,
+    )
+    chunked = ragged_ref(
+        q, k_cache, v_cache, tables, ctx, token_lane, token_pos,
+        max_gather_tokens=8,
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(direct), rtol=2e-6, atol=2e-6
+    )
+
+
+def test_ragged_attention_sliding_window_matches_fallback():
+    spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
+    rng = jax.random.PRNGKey(0)
+    k_cache, v_cache, tables, _ = build_cache(rng)
+    token_lane, token_pos, tb_lane, qstart, qlen, lstart, ctx = ragged_meta(
+        spans, 3
+    )
+    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
+    from dynamo_tpu.ops.pallas import ragged_paged_attention as ragged_kernel
+
+    t = token_lane.shape[0]
+    q = jax.random.normal(jax.random.fold_in(rng, 11), (t, 4, 128), jnp.float32)
+    for w in (4, 16):
+        ref = ragged_ref(
+            q, k_cache, v_cache, tables, ctx, token_lane, token_pos,
+            sliding_window=w,
+        )
+        out = ragged_kernel(
+            q, k_cache, v_cache, tables, ctx, tb_lane, qstart, qlen, lstart,
+            tb_tokens=8, interpret=True, sliding_window=w,
+        )
+        valid = np.asarray(token_pos) >= 0
+        np.testing.assert_allclose(
+            np.asarray(out)[valid], np.asarray(ref)[valid],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
 def test_paged_attention_sliding_window_matches_fallback():
     """Pallas decode kernel with a sliding window (interpret mode) must
     match the XLA gather fallback's windowed mask exactly."""
