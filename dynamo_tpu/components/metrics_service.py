@@ -17,6 +17,7 @@ from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
 
 from dynamo_tpu.llm.kv_router.metrics_aggregator import KvMetricsAggregator
 from dynamo_tpu.llm.kv_router.protocols import KV_HIT_RATE_SUBJECT, KvHitRateEvent
+from dynamo_tpu.planner.state import PLANNER_STATE_EVENT, PlannerStateEvent
 from dynamo_tpu.robustness import counters as robustness_counters
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.distributed import DistributedRuntime
@@ -230,6 +231,29 @@ class MetricsService:
             name: Gauge(name, help_text, registry=self.registry)
             for name, help_text in robustness_counters.HELP.items()
         }
+        # planner autopilot state (planner/state.py events on the component
+        # bus): latest decision targets, per-pool observed capacity, and the
+        # worst burn rate the planner consumed — WHY the fleet is its size
+        self.planner_target = Gauge(
+            "dyn_planner_target_replicas",
+            "Replica target from the planner's latest executed decision",
+            ["pool"], registry=self.registry,
+        )
+        self.planner_capacity = Gauge(
+            "dyn_planner_observed_capacity_tok_s",
+            "Planner's observed per-replica capacity estimate (EWMA at "
+            "saturation; 0 until measured)",
+            ["pool"], registry=self.registry,
+        )
+        self.planner_burn = Gauge(
+            "dyn_planner_burn_rate_input",
+            "Worst per-objective SLO burn rate the planner consumed for its "
+            "latest decision",
+            registry=self.registry,
+        )
+        self._planner_event: PlannerStateEvent | None = None
+        self._planner_sub = None
+        self._planner_task: asyncio.Task | None = None
         self._hit_sub = None
         self._hit_task: asyncio.Task | None = None
         self._runner: web.AppRunner | None = None
@@ -239,6 +263,10 @@ class MetricsService:
         bus = self.component.runtime.plane.bus
         self._hit_sub = await bus.subscribe(self.component.event_subject(KV_HIT_RATE_SUBJECT))
         self._hit_task = asyncio.ensure_future(self._hit_loop())
+        self._planner_sub = await bus.subscribe(
+            self.component.event_subject(PLANNER_STATE_EVENT)
+        )
+        self._planner_task = asyncio.ensure_future(self._planner_loop())
 
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
@@ -257,6 +285,10 @@ class MetricsService:
             await self._hit_sub.unsubscribe()
         if self._hit_task is not None:
             self._hit_task.cancel()
+        if self._planner_sub is not None:
+            await self._planner_sub.unsubscribe()
+        if self._planner_task is not None:
+            self._planner_task.cancel()
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -269,7 +301,21 @@ class MetricsService:
             self.hit_blocks.inc(event.overlap_blocks)
             self.isl_blocks.inc(max(event.isl_blocks, 0))
 
+    async def _planner_loop(self) -> None:
+        async for msg in self._planner_sub:
+            try:
+                self._planner_event = PlannerStateEvent.from_json(msg.payload)
+            except Exception:  # noqa: BLE001
+                continue
+
     def _refresh(self) -> None:
+        ev = self._planner_event
+        if ev is not None:
+            self.planner_target.labels("prefill").set(ev.target_prefill)
+            self.planner_target.labels("decode").set(ev.target_decode)
+            self.planner_capacity.labels("prefill").set(ev.observed_prefill_tok_s)
+            self.planner_capacity.labels("decode").set(ev.observed_decode_tok_s)
+            self.planner_burn.set(ev.burn_rate_input)
         for name, value in robustness_counters.snapshot().items():
             gauge = self.resilience.get(name)
             if gauge is not None:

@@ -46,6 +46,10 @@ class ForwardPassMetrics:
     cache usage)."""
 
     worker_id: int = 0
+    # disagg pool membership ("prefill"/"decode", "" = serves both): lets
+    # planner.sample_from_endpoints split a mixed fleet into per-pool
+    # capacity/occupancy without an out-of-band role map
+    role: str = ""
     kv_active_blocks: int = 0
     kv_total_blocks: int = 0
     gpu_cache_usage_perc: float = 0.0
@@ -104,6 +108,7 @@ class ForwardPassMetrics:
     def from_stats(cls, worker_id: int, stats: dict) -> "ForwardPassMetrics":
         return cls(
             worker_id=worker_id,
+            role=str(stats.get("role", "") or ""),
             kv_active_blocks=stats.get("kv_active_blocks", 0),
             kv_total_blocks=stats.get("kv_total_blocks", 0),
             gpu_cache_usage_perc=stats.get("gpu_cache_usage_perc", 0.0),

@@ -12,7 +12,9 @@ with hundreds of simulated workers on one CPU.
 from __future__ import annotations
 
 import asyncio
+import time
 import uuid
+from collections import deque
 from dataclasses import dataclass
 from typing import AsyncIterator, Callable
 
@@ -39,6 +41,11 @@ class MockerConfig:
     prefill_quadratic_s: float = 2e-8    # per token^2 (attention)
     decode_base_s: float = 0.01          # per decode iteration
     decode_per_block_s: float = 0.00005  # per active KV block
+    # disagg pool membership reported through stats()/ForwardPassMetrics
+    # ("prefill"/"decode", "" = serves both)
+    role: str = ""
+    # rolling window (wall seconds) for the goodput/prefill-rate/MFU stats
+    util_window_s: float = 2.0
 
 
 class MockerEngine:
@@ -60,6 +67,14 @@ class MockerEngine:
         self._task: asyncio.Task | None = None
         self._wake = asyncio.Event()
         self._iterations = 0
+        # utilization accounting: per-iteration samples of (wall_t, tokens
+        # emitted, prefill tokens served, simulated busy seconds) feed the
+        # rolling goodput/prefill-rate/MFU window; totals are cumulative
+        self._util: deque = deque()
+        self._t0: float | None = None
+        self._tokens_emitted_total = 0
+        self._prefill_tokens_total = 0
+        self._decode_tokens_total = 0
 
     def _sink(self, event: KvEvent) -> None:
         if self._event_sink is not None:
@@ -67,6 +82,7 @@ class MockerEngine:
 
     def start(self) -> None:
         if self._task is None:
+            self._t0 = time.monotonic()
             self._task = asyncio.ensure_future(self._loop())
 
     def stop(self) -> None:
@@ -74,8 +90,30 @@ class MockerEngine:
             self._task.cancel()
             self._task = None
 
+    def _util_rates(self) -> tuple[float, float, float]:
+        """(goodput tok/s, prefill tok/s, mfu fraction) over the rolling
+        window — wall-clock rates, so at speedup=S they read S× the
+        simulated-time rates (same compression as the cost model)."""
+        cfg = self.config
+        now = time.monotonic()
+        horizon = now - cfg.util_window_s
+        while self._util and self._util[0][0] < horizon:
+            self._util.popleft()
+        elapsed = cfg.util_window_s
+        if self._t0 is not None:
+            elapsed = min(elapsed, max(now - self._t0, 1e-3))
+        tokens = sum(s[1] for s in self._util)
+        prefill = sum(s[2] for s in self._util)
+        busy_sim = sum(s[3] for s in self._util)
+        # busy fraction in SIMULATED time: sim busy seconds / sim elapsed
+        # seconds — the mocker's stand-in for model FLOPs utilization
+        mfu = min(busy_sim / (elapsed * cfg.speedup), 1.0)
+        return tokens / elapsed, prefill / elapsed, mfu
+
     def stats(self) -> dict:
+        goodput, prefill_rate, mfu = self._util_rates()
         return {
+            "role": self.config.role,
             "kv_active_blocks": self.allocator.used_blocks,
             "kv_total_blocks": self.allocator.num_blocks,
             "gpu_cache_usage_perc": self.allocator.usage,
@@ -89,6 +127,15 @@ class MockerEngine:
                 self.scheduler.num_running / max(self.config.max_batch_size, 1)
             ),
             "num_preemptions_total": self.scheduler.preemptions_total,
+            # utilization accounting (same names as observability.perf) so
+            # planner capacity sampling and the soak's MFU/goodput floors
+            # work against mocker fleets
+            "goodput_tokens_per_second": goodput,
+            "prefill_tokens_per_second": prefill_rate,
+            "mfu_perc": mfu,
+            "tokens_emitted_total": self._tokens_emitted_total,
+            "prefill_tokens_total": self._prefill_tokens_total,
+            "decode_tokens_total": self._decode_tokens_total,
         }
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
@@ -139,6 +186,7 @@ class MockerEngine:
                 await self._wake.wait()
             decision = self.scheduler.schedule()
             cost = 0.0
+            prefill_tokens = 0
             for seq in decision.prefills:
                 # prefix-cache hits only pay for the NEW tokens, attending
                 # over the full context (reference: mocker/scheduler.rs:31
@@ -147,6 +195,7 @@ class MockerEngine:
                 # exploits, so the simulation must credit it
                 cached = seq.cached_tokens
                 new = max(seq.context_len - cached, 0)
+                prefill_tokens += new
                 cost += (
                     cfg.prefill_linear_s * new
                     + cfg.prefill_quadratic_s * (cached + new) * new
@@ -160,11 +209,13 @@ class MockerEngine:
             # regardless of prompt length or cache state)
             self._iterations += 1
             await asyncio.sleep(cost / cfg.speedup)
+            emitted_before = self._tokens_emitted_total
             for seq in decision.prefills:
                 if seq.status == SeqStatus.FINISHED:  # cancelled mid-sleep
                     continue
                 self.allocator.publish_stored(seq.seq_id, seq.all_token_ids)
                 self._emit_next(seq)
+            decode_before = self._tokens_emitted_total
             for seq in decodes:
                 if seq.status == SeqStatus.FINISHED:
                     continue
@@ -173,11 +224,20 @@ class MockerEngine:
                     self.scheduler.preempt(seq)
                     continue
                 self._emit_next(seq)
+            self._prefill_tokens_total += prefill_tokens
+            self._decode_tokens_total += self._tokens_emitted_total - decode_before
+            self._util.append((
+                time.monotonic(),
+                self._tokens_emitted_total - emitted_before,
+                prefill_tokens,
+                cost,
+            ))
 
     def _emit_next(self, seq: Sequence) -> None:
         # deterministic "generation": next token = (last + 1) mod 1000
         token = (seq.all_token_ids[-1] + 1) % 1000 if seq.all_token_ids else 0
         seq.output_ids.append(token)
+        self._tokens_emitted_total += 1
         finish = seq.hit_stop(token)
         if seq.emit:
             seq.emit([token], finish)

@@ -225,6 +225,12 @@ class SloTracker:
                 "good_total": good_total,
                 "bad_total": bad_total,
                 "windows": windows,
+                # per-objective worst window: the planner's burn-rate input
+                # (planner.burn_rates_from_slo) — which objective burns
+                # decides WHICH pool the autopilot grows
+                "worst_burn_rate": max(
+                    (w["burn_rate"] for w in windows.values()), default=0.0
+                ),
             }
         return {
             "objectives": objectives,

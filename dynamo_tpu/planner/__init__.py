@@ -5,6 +5,12 @@ prefill/decode replica counts from profiled performance, and scales through
 a connector (reference: components/planner — load-based planner_core.py and
 SLA planner_sla.py, predictors utils/load_predictor.py, interpolation
 utils/perf_interpolation.py, connectors local/kubernetes).
+
+SLO-native autopilot: WorkloadSample carries frontend burn rates and
+per-pool occupancy (sample_from_endpoints / burn_rates_from_slo), plan()
+escalates the burning pool and rebalances prefill↔decode at the chip
+budget, and state.PlannerStatePublisher mirrors every executed decision to
+the metrics service's dyn_planner_* gauges.
 """
 
 from dynamo_tpu.planner.load_predictor import (
@@ -14,7 +20,19 @@ from dynamo_tpu.planner.load_predictor import (
     make_predictor,
 )
 from dynamo_tpu.planner.perf_interpolation import PerfProfile, ProfilePoint
-from dynamo_tpu.planner.planner import Planner, PlannerConfig, PlannerDecision
+from dynamo_tpu.planner.planner import (
+    Planner,
+    PlannerConfig,
+    PlannerDecision,
+    WorkloadSample,
+    burn_rates_from_slo,
+    sample_from_endpoints,
+)
+from dynamo_tpu.planner.state import (
+    PLANNER_STATE_EVENT,
+    PlannerStateEvent,
+    PlannerStatePublisher,
+)
 
 __all__ = [
     "ConstantPredictor",
@@ -26,4 +44,10 @@ __all__ = [
     "Planner",
     "PlannerConfig",
     "PlannerDecision",
+    "WorkloadSample",
+    "burn_rates_from_slo",
+    "sample_from_endpoints",
+    "PLANNER_STATE_EVENT",
+    "PlannerStateEvent",
+    "PlannerStatePublisher",
 ]

@@ -1,0 +1,212 @@
+"""SoakFleet: a live, scalable mocker fleet for scenario soaks.
+
+Named pools ("prefill"/"decode") of MockerEngine workers served on one
+control-plane endpoint with real KV-event and load publishers, dispatched
+through PushRouter (optionally KV-affine via KvRouter), with a real
+in-process MetricsService and a minimal frontend surface exposing
+``/slo`` + ``/metrics`` — so ``scripts/dyn_top.collect_snapshot`` works
+against the soak exactly as against production.
+
+The fleet IS the planner's supervisor: it implements the
+``set_replicas(name, n)`` / ``replica_count(name)`` duck-type that
+``planner.connectors.LocalConnector`` drives, spawning and retiring live
+workers mid-soak.  That closes the loop the soak exists to prove — a
+planner decision becomes real capacity while traffic is in flight.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from aiohttp import web
+
+from dynamo_tpu.components.metrics_service import MetricsService
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher, WorkerMetricsPublisher
+from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.observability.slo import SloTracker
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.runtime.client import PushRouter, RouterMode
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.scenarios.spec import ScenarioSpec
+from dynamo_tpu.utils.config import RuntimeConfig
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("scenarios.fleet")
+
+
+@dataclass
+class _Worker:
+    pool: str
+    engine: MockerEngine
+    service: object
+    kv_pub: KvEventPublisher
+    metrics_pub: WorkerMetricsPublisher
+
+    @property
+    def worker_id(self) -> int:
+        return self.service.instance.instance_id
+
+
+@dataclass
+class SoakFleet:
+    spec: ScenarioSpec
+    slo: SloTracker
+    sim_now: object                     # () -> simulated seconds
+    name: str = "soak"
+
+    rt: DistributedRuntime = None
+    comp: object = None
+    ep: object = None
+    dispatcher: object = None
+    push: PushRouter = None
+    kv_router: KvRouter | None = None
+    metrics_service: MetricsService | None = None
+    frontend_url: str = ""
+    worker_url: str = ""
+    _pools: dict = field(default_factory=dict)     # pool → [_Worker]
+    _frontend_runner: web.AppRunner | None = None
+    _scale_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    scale_log: list = field(default_factory=list)  # executed scale ops
+
+    # -- bring-up / teardown -------------------------------------------------
+    async def start(self) -> None:
+        fl = self.spec.fleet
+        MemoryControlPlane.reset_named()
+        self.rt = await DistributedRuntime.create(
+            RuntimeConfig(control_plane=f"memory://{self.name}")
+        )
+        self.comp = self.rt.namespace("soak").component("backend")
+        self.ep = self.comp.endpoint("generate")
+        for pool, n in fl.pools.items():
+            self._pools[pool] = []
+            for _ in range(n):
+                self._pools[pool].append(await self._spawn(pool))
+        self.push = await PushRouter.from_endpoint(self.ep, mode=RouterMode.RANDOM)
+        if fl.policy == "kv":
+            self.kv_router = KvRouter(
+                self.comp, block_size=fl.block_size, enable_prefetch=False
+            )
+            await self.kv_router.start()
+            self.dispatcher = KvPushRouter(self.push, self.kv_router)
+        else:
+            self.dispatcher = self.push
+        await self.push.client.wait_for_instances(self.worker_count(), timeout=10)
+
+        # real metrics service (scrapeable by dyn_top / check_metrics)
+        self.metrics_service = MetricsService(self.comp, host="127.0.0.1", port=0)
+        await self.metrics_service.start()
+        self.worker_url = f"http://127.0.0.1:{self.metrics_service.port}"
+
+        # minimal frontend surface: /slo + /metrics on the simulated clock
+        app = web.Application()
+        app.router.add_get("/slo", self._handle_slo)
+        app.router.add_get("/metrics", self._handle_metrics)
+        self._frontend_runner = web.AppRunner(app, access_log=None)
+        await self._frontend_runner.setup()
+        site = web.TCPSite(self._frontend_runner, "127.0.0.1", 0)
+        await site.start()
+        port = next(iter(site._server.sockets)).getsockname()[1]
+        self.frontend_url = f"http://127.0.0.1:{port}"
+
+    async def stop(self) -> None:
+        if self._frontend_runner is not None:
+            await self._frontend_runner.cleanup()
+        if self.metrics_service is not None:
+            await self.metrics_service.stop()
+        if self.kv_router is not None:
+            await self.kv_router.stop()
+        for pool in list(self._pools):
+            for worker in self._pools[pool]:
+                await self._retire(worker)
+            self._pools[pool] = []
+        if self.rt is not None:
+            await self.rt.close()
+
+    # -- frontend surface ----------------------------------------------------
+    async def _handle_slo(self, request: web.Request) -> web.Response:
+        return web.json_response(self.slo.status(self.sim_now()))
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        body = self.slo.render(self.sim_now()) + counters.render()
+        return web.Response(body=body, content_type="text/plain")
+
+    # -- worker lifecycle ----------------------------------------------------
+    def _mocker_config(self, pool: str) -> MockerConfig:
+        fl = self.spec.fleet
+        overrides = dict(fl.mocker)
+        return MockerConfig(
+            num_blocks=fl.num_blocks,
+            block_size=fl.block_size,
+            max_batch_size=fl.max_batch_size,
+            speedup=self.spec.speedup,
+            role=pool,
+            **overrides,
+        )
+
+    async def _spawn(self, pool: str) -> _Worker:
+        engine = MockerEngine(self._mocker_config(pool))
+        service = await self.ep.serve(engine, stats_handler=engine.stats)
+        kv_pub = KvEventPublisher(self.comp, worker_id=service.instance.instance_id)
+        kv_pub.start()
+        engine._event_sink = kv_pub.sink
+        metrics_pub = WorkerMetricsPublisher(
+            self.comp, service.instance.instance_id, engine.stats,
+            period_s=self.spec.fleet.metrics_period_s / self.spec.speedup,
+        )
+        metrics_pub.start()
+        engine.start()
+        return _Worker(pool, engine, service, kv_pub, metrics_pub)
+
+    async def _retire(self, worker: _Worker) -> None:
+        await worker.metrics_pub.stop()
+        await worker.kv_pub.stop()
+        await worker.service.shutdown(drain_timeout=1)
+        worker.engine.stop()
+
+    # -- planner supervisor duck-type (connectors.LocalConnector) ------------
+    def replica_count(self, pool: str) -> int:
+        return len(self._pools.get(pool, []))
+
+    def worker_count(self) -> int:
+        return sum(len(ws) for ws in self._pools.values())
+
+    async def set_replicas(self, pool: str, n: int) -> None:
+        async with self._scale_lock:
+            workers = self._pools.setdefault(pool, [])
+            before = len(workers)
+            if n == before:
+                return
+            if n > before:
+                for _ in range(n - before):
+                    workers.append(await self._spawn(pool))
+                try:
+                    await self.push.client.wait_for_instances(
+                        self.worker_count(), timeout=5
+                    )
+                except TimeoutError:
+                    logger.warning("scale-up of %s not fully visible yet", pool)
+            else:
+                # retire newest-first: the oldest workers hold the warmest
+                # KV and the most session affinity
+                while len(workers) > n:
+                    await self._retire(workers.pop())
+            self.scale_log.append(
+                {"t": self.sim_now(), "pool": pool, "from": before, "to": n}
+            )
+            logger.info("pool %s: %d → %d replicas", pool, before, n)
+
+    # -- sampling ------------------------------------------------------------
+    def roles(self) -> dict[int, str]:
+        return {
+            w.worker_id: pool
+            for pool, ws in self._pools.items() for w in ws
+        }
+
+    def stat_sum(self, key: str) -> float:
+        return sum(
+            w.engine.stats().get(key, 0)
+            for ws in self._pools.values() for w in ws
+        )

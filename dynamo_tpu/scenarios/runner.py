@@ -1,0 +1,503 @@
+"""ScenarioRunner: drive a SoakFleet through a scenario spec.
+
+One run = phases in order.  Per phase the runner
+
+- replays the phase's traffic plan (open-loop Poisson arrivals and/or
+  closed-loop multi-turn sessions) against the fleet's dispatcher, with
+  frontend-style pre-first-token retries;
+- arms ``DYN_FAULTS`` schedules at their phase-relative times (chaos
+  mid-phase, exactly where production faults land);
+- feeds every TTFT/ITL/error outcome into the SloTracker on the SIMULATED
+  clock, and samples ``/slo`` + the metrics service each tick via
+  ``scripts/dyn_top.collect_snapshot`` (the artifact's time series);
+- steps the planner autopilot on its own cadence — burn rates and per-pool
+  utilization in, replica decisions out, executed live through
+  ``LocalConnector`` → ``SoakFleet.set_replicas`` while traffic flows;
+- evaluates the phase's assertions on PHASE-LOCAL counts when it drains.
+
+``run()`` returns the artifact dict (SCENARIO_SOAK.json): per-phase
+TTFT/ITL percentiles, burn rates, MFU/goodput, injected faults, planner
+decision log, dyn_top snapshots, and a pass/fail verdict per assertion.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import sys
+import time
+from pathlib import Path
+
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.observability.slo import SloConfig, SloObjective, SloTracker
+from dynamo_tpu.planner import (
+    PerfProfile,
+    Planner,
+    PlannerConfig,
+    PlannerStatePublisher,
+    ProfilePoint,
+    sample_from_endpoints,
+)
+from dynamo_tpu.planner.connectors import LocalConnector
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.scenarios.fleet import SoakFleet
+from dynamo_tpu.scenarios.spec import Phase, ScenarioSpec
+from dynamo_tpu.scenarios.traffic import PhasePlan, plan_phase, prompt_tokens
+from dynamo_tpu.utils.logging import get_logger
+
+logger = get_logger("scenarios.runner")
+
+# scripts/ is not a package; import dyn_top the way the tests do
+_SCRIPTS = str(Path(__file__).resolve().parents[2] / "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+from dyn_top import collect_snapshot  # noqa: E402
+
+
+def _pctile(xs: list[float], q: float) -> float | None:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(len(xs) * q))] if xs else None
+
+
+def _slo_config(spec: ScenarioSpec) -> SloConfig:
+    s = spec.slo
+    return SloConfig(
+        objectives=(
+            SloObjective("ttft", s.ttft_target, threshold_s=s.ttft_s),
+            SloObjective("itl", s.itl_target, threshold_s=s.itl_s),
+            SloObjective("error_rate", s.error_target),
+        ),
+        windows_s=tuple(float(w) for w in s.windows_s),
+        shed_burn_threshold=s.shed_burn,
+    )
+
+
+def _bootstrap_profile(spec: ScenarioSpec) -> PerfProfile:
+    p = spec.autopilot.profile
+    mk = lambda isl, osl: ProfilePoint(  # noqa: E731
+        isl=isl, osl=osl,
+        prefill_tok_s=float(p.get("prefill_tok_s", 50_000.0)),
+        decode_tok_s=float(p.get("decode_tok_s", 5_000.0)),
+        ttft_s=float(p.get("ttft_s", 0.02)),
+        itl_s=float(p.get("itl_s", 0.01)),
+    )
+    return PerfProfile([mk(16, 8), mk(8192, 1024)])
+
+
+class _PhaseStats:
+    """Phase-local observation store (assertions are evaluated on these, so
+    one phase's damage cannot fail its neighbor)."""
+
+    def __init__(self) -> None:
+        self.ttfts: list[float] = []
+        self.itls: list[float] = []
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.retries = 0
+        self.abandoned = 0
+        self.by_kind: dict[str, int] = {}
+
+    def burn(self, spec: ScenarioSpec) -> dict[str, float]:
+        s = spec.slo
+
+        def _rate(bad: int, total: int, target: float) -> float:
+            if not total:
+                return 0.0
+            return (bad / total) / max(1.0 - target, 1e-9)
+
+        ttft_bad = sum(1 for t in self.ttfts if t > s.ttft_s)
+        itl_bad = sum(1 for t in self.itls if t > s.itl_s)
+        finished = self.completed + self.failed
+        return {
+            "ttft": _rate(ttft_bad, len(self.ttfts), s.ttft_target),
+            "itl": _rate(itl_bad, len(self.itls), s.itl_target),
+            "error_rate": _rate(self.failed, finished, s.error_target),
+        }
+
+
+class ScenarioRunner:
+    def __init__(self, spec: ScenarioSpec, *, name: str | None = None):
+        self.spec = spec.validate()
+        self.fleet: SoakFleet | None = None
+        self.slo = SloTracker(_slo_config(spec))
+        self.planner: Planner | None = None
+        self.state_pub: PlannerStatePublisher | None = None
+        self._t0_wall = 0.0
+        self.decisions: list[dict] = []
+        self.ticks: list[dict] = []
+        self.top_snapshots: list[dict] = []
+        self._name = name or f"{spec.name}-{spec.seed}"
+        # autopilot sampling window state
+        self._window_submitted = 0
+        self._window_isl: list[int] = []
+        self._window_osl: list[int] = []
+        self._window_ttfts: list[float] = []
+        self._window_itls: list[float] = []
+        self._next_plan_t = 0.0
+
+    # -- simulated clock -----------------------------------------------------
+    def sim_now(self) -> float:
+        return (time.monotonic() - self._t0_wall) * self.spec.speedup
+
+    async def _sim_sleep_until(self, sim_t: float) -> None:
+        delay = (sim_t - self.sim_now()) / self.spec.speedup
+        if delay > 0:
+            await asyncio.sleep(delay)
+
+    # -- request execution ---------------------------------------------------
+    async def _execute(self, stats: _PhaseStats, tokens: list[int], osl: int,
+                       kind: str, history: list[int] | None = None) -> bool:
+        """Send one request; returns success.  Pre-first-token failures are
+        retried (the frontend's retry role — KV-affine dispatch is direct,
+        so PushRouter's own retry is bypassed and the caller must re-issue).
+        ``history`` (session mode) collects the streamed tokens."""
+        spec = self.spec
+        stats.submitted += 1
+        stats.by_kind[kind] = stats.by_kind.get(kind, 0) + 1
+        self._window_submitted += 1
+        self._window_isl.append(len(tokens))
+        self._window_osl.append(osl)
+        wire = PreprocessedRequest(
+            token_ids=list(tokens),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            eos_token_ids=[],
+        ).to_wire()
+        for attempt in range(spec.retry_max + 1):
+            t0 = self.sim_now()
+            ttft = None
+            last_emit = None
+            try:
+                stream = await self.fleet.dispatcher.generate(Context(dict(wire)))
+                async for item in stream:
+                    ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+                    if ann.data is None or not ann.data.token_ids:
+                        continue
+                    now = self.sim_now()
+                    if ttft is None:
+                        ttft = now - t0
+                        stats.ttfts.append(ttft)
+                        self._window_ttfts.append(ttft)
+                        self.slo.observe_latency("ttft", ttft, now=now)
+                    elif last_emit is not None:
+                        itl = now - last_emit
+                        stats.itls.append(itl)
+                        self._window_itls.append(itl)
+                        self.slo.observe_latency("itl", itl, now=now)
+                    last_emit = now
+                    if history is not None:
+                        history.extend(ann.data.token_ids)
+                stats.completed += 1
+                self.slo.observe_outcome("error_rate", True, now=self.sim_now())
+                return True
+            except asyncio.CancelledError:
+                stats.abandoned += 1
+                raise
+            except Exception as exc:  # noqa: BLE001 — chaos faults land here
+                if ttft is None and attempt < spec.retry_max:
+                    stats.retries += 1
+                    counters.incr("dyn_retries_total")
+                    continue
+                logger.debug("request failed (%s attempts): %s", attempt + 1, exc)
+                stats.failed += 1
+                self.slo.observe_outcome("error_rate", False, now=self.sim_now())
+                return False
+        return False
+
+    async def _run_arrival(self, stats: _PhaseStats, phase_t0: float,
+                           arrival, rng: random.Random) -> None:
+        await self._sim_sleep_until(phase_t0 + arrival.at_s)
+        await self._execute(
+            stats, prompt_tokens(arrival.isl, rng), arrival.osl, arrival.kind
+        )
+
+    async def _run_session(self, stats: _PhaseStats, phase_t0: float,
+                           sess) -> None:
+        """Closed-loop multi-turn session: each turn's prompt embeds the
+        actual streamed history (chat clients echo assistant tokens)."""
+        await self._sim_sleep_until(phase_t0 + sess.start_s)
+        history = list(sess.system_tokens)
+        for i, turn in enumerate(sess.turns):
+            if i and turn.arrival_gap_s:
+                await asyncio.sleep(turn.arrival_gap_s / self.spec.speedup)
+            history.extend(turn.user_tokens)
+            await self._execute(stats, history, turn.osl, "session",
+                                history=history)
+
+    # -- chaos ---------------------------------------------------------------
+    async def _arm_later(self, phase: Phase, ev, phase_t0: float,
+                         armed: list) -> None:
+        await self._sim_sleep_until(phase_t0 + ev.at_s)
+        FAULTS.arm(ev.schedule)
+        armed.append({"t": round(self.sim_now(), 3), "schedule": ev.schedule})
+        logger.info("phase %s: armed faults %r", phase.name, ev.schedule)
+
+    # -- autopilot -----------------------------------------------------------
+    async def _autopilot_step(self, phase_name: str) -> None:
+        ap = self.spec.autopilot
+        interval = max(ap.interval_s, 1e-6)
+        now = self.sim_now()
+        # request_rate in WALL req/s (sim rate × speedup) so demand matches
+        # the mocker's wall-clock goodput capacity units
+        rate_sim = self._window_submitted / interval
+        mean = lambda xs, d: (sum(xs) / len(xs)) if xs else d  # noqa: E731
+        sample = sample_from_endpoints(
+            self.fleet.metrics_service.aggregator.snapshot(),
+            request_rate=rate_sim * self.spec.speedup,
+            avg_isl=mean(self._window_isl, 64.0),
+            avg_osl=mean(self._window_osl, 16.0),
+            ttft_s=mean(self._window_ttfts, 0.0),
+            itl_s=mean(self._window_itls, 0.0),
+            roles=self.fleet.roles(),
+            slo_status=self.slo.status(now),
+        )
+        self._window_submitted = 0
+        self._window_isl.clear()
+        self._window_osl.clear()
+        self._window_ttfts.clear()
+        self._window_itls.clear()
+        decision = await self.planner.step(sample, now=now)
+        self.decisions.append({
+            "t": round(now, 3),
+            "phase": phase_name,
+            "reason": decision.reason,
+            "num_prefill": decision.num_prefill,
+            "num_decode": decision.num_decode,
+            "burn_input": round(self.planner.worst_burn_input, 4),
+            "request_rate_sim": round(rate_sim, 3),
+            "executed": {
+                pool: self.fleet.replica_count(pool)
+                for pool in self.spec.fleet.pools
+            },
+        })
+
+    # -- ticks ---------------------------------------------------------------
+    def _capture_top(self) -> dict:
+        return collect_snapshot(
+            frontend=self.fleet.frontend_url,
+            worker=self.fleet.worker_url,
+            timeout=3.0,
+        )
+
+    async def _tick(self, phase_name: str) -> None:
+        snap = await asyncio.to_thread(self._capture_top)
+        fleet = snap.get("fleet") or {}
+        now = self.sim_now()
+        self.ticks.append({
+            "t": round(now, 3),
+            "phase": phase_name,
+            "workers": fleet.get("workers", 0),
+            "goodput_tok_s": round(fleet.get("goodput_tokens_per_second", 0.0), 2),
+            "mfu": round(fleet.get("mfu_perc_avg", 0.0), 4),
+            "waiting": fleet.get("waiting", 0),
+            "running": fleet.get("running", 0),
+            "worst_burn": round(self.slo.worst_burn_rate(now), 3),
+            "planner": snap.get("planner"),
+        })
+
+    # -- phase ---------------------------------------------------------------
+    async def _run_phase(self, phase: Phase) -> dict:
+        spec = self.spec
+        plan: PhasePlan = plan_phase(phase, spec.seed)
+        stats = _PhaseStats()
+        rng = random.Random((spec.seed, phase.name, "prompts").__repr__())
+        phase_t0 = self.sim_now()
+        faults_before = counters.get("dyn_faults_injected_total")
+        armed: list = []
+        ticks_before = len(self.ticks)
+
+        work = [
+            asyncio.ensure_future(self._run_arrival(stats, phase_t0, a, rng))
+            for a in plan.arrivals
+        ] + [
+            asyncio.ensure_future(self._run_session(stats, phase_t0, s))
+            for s in plan.sessions
+        ]
+        chaos = [
+            asyncio.ensure_future(self._arm_later(phase, ev, phase_t0, armed))
+            for ev in phase.faults
+        ]
+
+        # tick/autopilot loop for the phase duration
+        mid_captured = False
+        while self.sim_now() - phase_t0 < phase.duration_s:
+            await asyncio.sleep(spec.tick_s / spec.speedup)
+            await self._tick(phase.name)
+            now = self.sim_now()
+            if spec.autopilot.enabled and now >= self._next_plan_t:
+                self._next_plan_t = now + spec.autopilot.interval_s
+                await self._autopilot_step(phase.name)
+            if not mid_captured and now - phase_t0 >= phase.duration_s / 2:
+                mid_captured = True
+                snap = await asyncio.to_thread(self._capture_top)
+                snap["phase"] = phase.name
+                self.top_snapshots.append(snap)
+
+        # drain: give in-flight requests a bounded grace window
+        if work:
+            done, pending = await asyncio.wait(
+                work, timeout=spec.drain_s / spec.speedup
+            )
+            for task in pending:
+                task.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+        for task in chaos:
+            task.cancel()
+        await asyncio.gather(*chaos, return_exceptions=True)
+
+        burn = stats.burn(spec)
+        phase_ticks = self.ticks[ticks_before:]
+        mean_tick = lambda key: (  # noqa: E731
+            sum(t[key] for t in phase_ticks) / len(phase_ticks)
+            if phase_ticks else 0.0
+        )
+        goodput = mean_tick("goodput_tok_s")
+        mfu = mean_tick("mfu")
+
+        failures: list[str] = []
+        a = phase.assertions
+        for objective, ceiling in (a.max_burn_rate or {}).items():
+            got = burn.get(objective)
+            if got is None:
+                failures.append(f"unknown objective in max_burn_rate: {objective}")
+            elif got > ceiling:
+                failures.append(
+                    f"burn[{objective}]={got:.2f} exceeds ceiling {ceiling}"
+                )
+        if a.min_goodput_tok_s and goodput < a.min_goodput_tok_s:
+            failures.append(
+                f"goodput {goodput:.1f} tok/s below floor {a.min_goodput_tok_s}"
+            )
+        if a.min_mfu and mfu < a.min_mfu:
+            failures.append(f"mfu {mfu:.3f} below floor {a.min_mfu}")
+        if a.min_completed and stats.completed < a.min_completed:
+            failures.append(
+                f"completed {stats.completed} below floor {a.min_completed}"
+            )
+
+        ms = lambda x: None if x is None else round(x * 1000.0, 2)  # noqa: E731
+        return {
+            "name": phase.name,
+            "traffic": phase.traffic.kind,
+            "duration_s": phase.duration_s,
+            "requests": {
+                "planned": plan.expected_requests,
+                "submitted": stats.submitted,
+                "completed": stats.completed,
+                "failed": stats.failed,
+                "retries": stats.retries,
+                "abandoned_in_drain": stats.abandoned,
+                "by_kind": stats.by_kind,
+            },
+            # simulated milliseconds (speedup-independent)
+            "ttft_sim_ms": {
+                "p50": ms(_pctile(stats.ttfts, 0.5)),
+                "p90": ms(_pctile(stats.ttfts, 0.9)),
+                "p99": ms(_pctile(stats.ttfts, 0.99)),
+            },
+            "itl_sim_ms": {
+                "p50": ms(_pctile(stats.itls, 0.5)),
+                "p90": ms(_pctile(stats.itls, 0.9)),
+                "p99": ms(_pctile(stats.itls, 0.99)),
+            },
+            "burn_rates": {k: round(v, 3) for k, v in burn.items()},
+            "goodput_tok_s_mean": round(goodput, 2),
+            "mfu_mean": round(mfu, 4),
+            "faults": {
+                "armed": armed,
+                "injected": counters.get("dyn_faults_injected_total") - faults_before,
+                "fired": dict(FAULTS.fired),
+            },
+            "assertions": {"passed": not failures, "failures": failures},
+        }
+
+    # -- the run -------------------------------------------------------------
+    async def run(self) -> dict:
+        spec = self.spec
+        FAULTS.reset()
+        wall_start = time.monotonic()
+        self._t0_wall = wall_start
+        self.fleet = SoakFleet(
+            spec=spec, slo=self.slo, sim_now=self.sim_now, name=self._name
+        )
+        phases: list[dict] = []
+        try:
+            await self.fleet.start()
+            if spec.autopilot.enabled:
+                ap = spec.autopilot
+                connector = LocalConnector(
+                    self.fleet, prefill_watcher="prefill", decode_watcher="decode"
+                )
+                self.planner = Planner(
+                    _bootstrap_profile(spec), connector,
+                    PlannerConfig(
+                        adjustment_interval_s=ap.interval_s,
+                        predictor="ewma",
+                        min_prefill=ap.min_prefill, max_prefill=ap.max_prefill,
+                        min_decode=ap.min_decode, max_decode=ap.max_decode,
+                        max_total_chips=ap.max_total_chips,
+                        burn_upscale=ap.burn_upscale,
+                        burn_hold=ap.burn_hold,
+                        cooldown_s=ap.cooldown_s,
+                        rebalance=ap.rebalance,
+                        rebalance_occupancy=ap.rebalance_occupancy,
+                        saturation_occupancy=ap.saturation_occupancy,
+                        scale_down_headroom=ap.scale_down_headroom,
+                    ),
+                    clock=self.sim_now,
+                )
+                self.state_pub = PlannerStatePublisher(
+                    self.fleet.comp, clock=self.sim_now
+                )
+                self.planner.state_publisher = self.state_pub
+
+            # re-zero the simulated clock: fleet bring-up wall time must not
+            # eat into phase 1's simulated window
+            self._t0_wall = time.monotonic()
+            self._next_plan_t = spec.autopilot.interval_s
+
+            for phase in spec.phases:
+                logger.info("phase %s starting at sim t=%.1fs",
+                            phase.name, self.sim_now())
+                phases.append(await self._run_phase(phase))
+        finally:
+            FAULTS.reset()
+            if self.fleet is not None:
+                await self.fleet.stop()
+
+        steered = [d for d in self.decisions if d["reason"] != "load"]
+        passed = all(p["assertions"]["passed"] for p in phases)
+        if spec.autopilot.expect_decision and not steered:
+            passed = False
+        return {
+            "scenario": spec.name,
+            "seed": spec.seed,
+            "speedup": spec.speedup,
+            "policy": spec.fleet.policy,
+            "pools": dict(spec.fleet.pools),
+            "wall_s": round(time.monotonic() - wall_start, 2),
+            "sim_s": round(self.sim_now(), 2),
+            "phases": phases,
+            "planner": {
+                "enabled": spec.autopilot.enabled,
+                "decisions": self.decisions,
+                "steering_decisions": len(steered),
+                "scale_events": list(self.fleet.scale_log),
+            },
+            "slo": self.slo.status(self.sim_now()),
+            "ticks": self.ticks,
+            "dyn_top_snapshots": self.top_snapshots,
+            "passed": passed,
+        }
+
+
+async def run_scenario(spec: ScenarioSpec, *, name: str | None = None) -> dict:
+    return await ScenarioRunner(spec, name=name).run()

@@ -4,9 +4,10 @@
 Brings up the full serving stack in one process — dynctl control-plane
 server, two echo workers, HTTP frontend with tight admission control — then:
 
-1. arms a fault schedule (``DYN_FAULTS`` env if set, else the canned
-   ``cp.recv:once;worker.generate:nth=2``: kill the control-plane
-   connection once and one worker stream pre-first-token);
+1. arms a fault schedule (``DYN_FAULTS`` env if set, else the schedule from
+   the canned scenario spec ``dynamo_tpu/scenarios/specs/chaos_smoke.json``
+   — kill the control-plane connection once and one worker stream
+   pre-first-token);
 2. runs a multi-request serve phase and asserts **every** request completed
    (reconnect + safe retry both observable:
    ``dyn_cp_reconnects_total >= 1``, ``dyn_retries_total >= 1``);
@@ -33,7 +34,28 @@ if str(_REPO_ROOT) not in sys.path:  # standalone runs (tests import us
     sys.path.insert(0, str(_REPO_ROOT))  # with the root already on path)
 
 MODEL_DIR = str(_REPO_ROOT / "tests" / "data" / "tiny-chat-model")
-DEFAULT_SCHEDULE = "cp.recv:once;worker.generate:nth=2"
+# last-resort fallback if the shipped spec file is missing/unreadable
+_FALLBACK_SCHEDULE = "cp.recv:once;worker.generate:nth=2"
+
+
+def _canned() -> tuple[int, int, str]:
+    """(requests, burst, schedule) from the shipped scenario spec — the
+    canned chaos phases live in specs/chaos_smoke.json, not in code."""
+    try:
+        from dynamo_tpu.scenarios.spec import ScenarioSpec, builtin_spec_path
+
+        spec = ScenarioSpec.load(builtin_spec_path("chaos_smoke"))
+        serve, burst = spec.phases[0], spec.phases[1]
+        return (
+            serve.traffic.requests or 6,
+            burst.traffic.requests or 20,
+            serve.faults[0].schedule if serve.faults else _FALLBACK_SCHEDULE,
+        )
+    except Exception:  # noqa: BLE001 — the gate must run even if the spec rots
+        return 6, 20, _FALLBACK_SCHEDULE
+
+
+DEFAULT_SCHEDULE = _canned()[2]
 
 
 async def _chat(client, i: int) -> int:
@@ -49,7 +71,10 @@ async def _chat(client, i: int) -> int:
     return r.status_code
 
 
-async def amain(requests: int = 6, burst: int = 20, schedule: str | None = None) -> int:
+async def amain(
+    requests: int | None = None, burst: int | None = None,
+    schedule: str | None = None,
+) -> int:
     import os
 
     import httpx
@@ -61,7 +86,10 @@ async def amain(requests: int = 6, burst: int = 20, schedule: str | None = None)
     from dynamo_tpu.serve import serve_frontend, serve_worker
     from dynamo_tpu.utils.config import RuntimeConfig
 
-    schedule = schedule or os.environ.get("DYN_FAULTS") or DEFAULT_SCHEDULE
+    spec_requests, spec_burst, spec_schedule = _canned()
+    requests = spec_requests if requests is None else requests
+    burst = spec_burst if burst is None else burst
+    schedule = schedule or os.environ.get("DYN_FAULTS") or spec_schedule
     # a DYN_FAULTS env schedule is armed at import — disarm it for bring-up
     # (the schedule targets the serve phase; cp.recv:once firing on the
     # connect handshake would fail setup, not test recovery) and start the
@@ -185,8 +213,10 @@ async def amain(requests: int = 6, burst: int = 20, schedule: str | None = None)
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
-    parser.add_argument("--requests", type=int, default=6)
-    parser.add_argument("--burst", type=int, default=20)
+    parser.add_argument("--requests", type=int, default=None,
+                        help="serve-phase request count (default: from spec)")
+    parser.add_argument("--burst", type=int, default=None,
+                        help="burst size (default: from spec)")
     parser.add_argument("--faults", help=f"fault schedule (default {DEFAULT_SCHEDULE})")
     args = parser.parse_args(argv)
     return asyncio.run(amain(args.requests, args.burst, args.faults))

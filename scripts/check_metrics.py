@@ -92,6 +92,14 @@ UNIFIED_FAMILIES = (
     "dyn_worker_admission_drains",
 )
 
+# planner autopilot state (dynamo_tpu/planner/state.py events mirrored by
+# the metrics service): latest decision targets + the burn input behind them
+PLANNER_FAMILIES = (
+    "dyn_planner_target_replicas",
+    "dyn_planner_observed_capacity_tok_s",
+    "dyn_planner_burn_rate_input",
+)
+
 # metrics service registry (dynamo_tpu/components/metrics_service.py)
 WORKER_FAMILIES = (
     "dyn_worker_kv_active_blocks",
@@ -106,7 +114,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

@@ -61,6 +61,13 @@ TIER_FIELDS = {
     "dyn_worker_offload_blocks_pinned": "pinned",
 }
 
+# planner autopilot gauges (labeled by pool, not worker): latest decision
+# targets and observed per-replica capacity, nested under snap["planner"]
+PLANNER_FIELDS = {
+    "dyn_planner_target_replicas": "target_replicas",
+    "dyn_planner_observed_capacity_tok_s": "observed_capacity_tok_s",
+}
+
 
 def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
     """Minimal text-exposition parser: (family, labels, value) samples."""
@@ -109,7 +116,17 @@ def collect_snapshot(
             snap["workers_error"] = str(exc)
             samples = []
         workers: dict[str, dict] = {}
+        planner: dict = {}
         for name, labels, value in samples:
+            if name == "dyn_planner_burn_rate_input":
+                planner["burn_rate_input"] = value
+                continue
+            pkey = PLANNER_FIELDS.get(name)
+            if pkey is not None and "pool" in labels:
+                planner.setdefault("pools", {}).setdefault(
+                    labels["pool"], {}
+                )[pkey] = value
+                continue
             if "worker" not in labels:
                 continue
             tier_key = TIER_FIELDS.get(name)
@@ -128,6 +145,8 @@ def collect_snapshot(
             if judged:
                 row["prefetch_hit_ratio"] = row.get("prefetch_hits", 0.0) / judged
         snap["workers"] = workers
+        if planner:
+            snap["planner"] = planner
         if workers:
             rows = list(workers.values())
             snap["fleet"] = {
@@ -236,6 +255,19 @@ def render_table(snap: dict) -> str:
                 f"{_pct(fleet.get('kv_usage_perc_avg')):>7} {'':>7} "
                 f"{_num(fleet.get('running'), 5)} {_num(fleet.get('waiting'), 5)}"
             )
+    planner = snap.get("planner") or {}
+    if planner:
+        cells = []
+        for pool in sorted(planner.get("pools") or {}):
+            row = planner["pools"][pool]
+            cell = f"{pool}={row.get('target_replicas', 0):g}"
+            cap = row.get("observed_capacity_tok_s")
+            if cap:
+                cell += f" ({cap:.0f} tok/s/replica)"
+            cells.append(cell)
+        burn = planner.get("burn_rate_input")
+        tail = f"   burn-in={burn:.2f}" if burn is not None else ""
+        lines.append("  PLANNER    targets: " + "  ".join(cells) + tail)
     front = snap.get("frontend") or {}
     if front:
         lines.append("")

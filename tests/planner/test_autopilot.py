@@ -1,0 +1,257 @@
+"""SLO-native autopilot: burn-rate escalation, hold/cooldown, chip-budget
+rebalance, split-pool sampling, and the planner-state event plumbing."""
+
+from types import SimpleNamespace
+
+from dynamo_tpu.planner import (
+    PLANNER_STATE_EVENT,
+    PerfProfile,
+    Planner,
+    PlannerConfig,
+    PlannerStateEvent,
+    PlannerStatePublisher,
+    ProfilePoint,
+    WorkloadSample,
+    burn_rates_from_slo,
+    sample_from_endpoints,
+)
+from dynamo_tpu.planner.connectors import RecordingConnector
+from dynamo_tpu.planner.state import event_from_planner
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.utils.config import RuntimeConfig
+
+# generous profile: the demand math alone never asks for more than the
+# minimums, so any growth in these tests is attributable to burn/SLA terms
+GENEROUS = PerfProfile([
+    ProfilePoint(isl=16, osl=8, prefill_tok_s=1e6, decode_tok_s=1e5,
+                 ttft_s=0.01, itl_s=0.005),
+    ProfilePoint(isl=8192, osl=1024, prefill_tok_s=1e6, decode_tok_s=1e5,
+                 ttft_s=0.01, itl_s=0.005),
+])
+
+
+def _planner(clock=lambda: 0.0, **cfg):
+    defaults = dict(min_prefill=1, max_prefill=8, min_decode=1, max_decode=8,
+                    max_total_chips=16, cooldown_s=60.0)
+    defaults.update(cfg)
+    return Planner(GENEROUS, RecordingConnector(), PlannerConfig(**defaults),
+                   clock=clock)
+
+
+def _sample(**kw):
+    defaults = dict(request_rate=1.0, avg_isl=64, avg_osl=16,
+                    num_prefill_replicas=2, num_decode_replicas=2)
+    defaults.update(kw)
+    return WorkloadSample(**defaults)
+
+
+def test_ttft_burn_grows_prefill():
+    p = _planner()
+    p.observe(_sample(ttft_burn_rate=3.0))
+    d = p.plan(now=0.0)
+    assert d.num_prefill == 3          # current 2 + 1
+    assert "ttft_burn" in d.reason
+
+
+def test_itl_burn_grows_decode():
+    p = _planner()
+    p.observe(_sample(itl_burn_rate=2.5))
+    d = p.plan(now=0.0)
+    assert d.num_decode == 3
+    assert "itl_burn" in d.reason
+
+
+def test_error_burn_grows_both_pools():
+    p = _planner()
+    p.observe(_sample(error_burn_rate=4.0))
+    d = p.plan(now=0.0)
+    assert (d.num_prefill, d.num_decode) == (3, 3)
+    assert "error_burn" in d.reason
+
+
+def test_zero_burn_keeps_legacy_demand_math():
+    p = _planner()
+    p.observe(_sample())
+    d = p.plan(now=0.0)
+    assert (d.num_prefill, d.num_decode) == (1, 1)
+    assert d.reason == "load"
+
+
+def test_burn_hold_refuses_scale_down_while_burning():
+    p = _planner()
+    # burn above the hold threshold but below the upscale threshold: no
+    # growth, but the idle-looking fleet must not shrink mid-incident
+    p.observe(_sample(ttft_burn_rate=0.5, num_prefill_replicas=3,
+                      num_decode_replicas=4))
+    d = p.plan(now=0.0)
+    assert (d.num_prefill, d.num_decode) == (3, 4)
+    assert "burn_hold" in d.reason
+
+
+def test_cooldown_blocks_the_scale_down_flap():
+    t = {"now": 0.0}
+    p = _planner(clock=lambda: t["now"])
+    p.observe(_sample(ttft_burn_rate=3.0))
+    d = p.plan()
+    assert d.num_prefill == 3 and "ttft_burn" in d.reason
+
+    # burn cleared, fleet looks oversized — but we just grew it
+    t["now"] = 10.0
+    p.observe(_sample(num_prefill_replicas=3, num_decode_replicas=2))
+    d = p.plan()
+    assert d.num_prefill >= 3
+
+    # past the cooldown the demand math may shrink again
+    t["now"] = 120.0
+    p.observe(_sample(num_prefill_replicas=3, num_decode_replicas=2))
+    d = p.plan()
+    assert (d.num_prefill, d.num_decode) == (1, 1)
+
+
+def test_rebalance_shifts_replica_to_burning_pool_at_chip_budget():
+    p = _planner(max_total_chips=4)
+    # prefill burning, decode idle and not burning: at the budget the
+    # planner moves a decode replica instead of refusing to act
+    p.observe(_sample(ttft_burn_rate=2.0, num_prefill_replicas=2,
+                      num_decode_replicas=2, prefill_occupancy=0.95,
+                      decode_occupancy=0.1))
+    d = p.plan(now=0.0)
+    assert (d.num_prefill, d.num_decode) == (3, 1)
+    assert "rebalance_to_prefill" in d.reason
+
+
+def test_rebalance_respects_donor_burn():
+    p = _planner(max_total_chips=4)
+    # decode idle by occupancy but its own objective is burning: no donation
+    p.observe(_sample(ttft_burn_rate=2.0, itl_burn_rate=2.0,
+                      num_prefill_replicas=2, num_decode_replicas=2,
+                      prefill_occupancy=0.95, decode_occupancy=0.1))
+    d = p.plan(now=0.0)
+    assert d.num_decode >= 2
+
+
+# -- split-pool sampling ----------------------------------------------------
+
+def _metrics(role="", goodput=0.0, prefill=0.0, occ=0.0, mfu=0.0):
+    return SimpleNamespace(
+        role=role, goodput_tokens_per_second=goodput,
+        prefill_tokens_per_second=prefill, batch_occupancy_perc=occ,
+        mfu_perc=mfu,
+    )
+
+
+def test_sample_from_endpoints_splits_pools_by_role():
+    endpoints = SimpleNamespace(workers={
+        1: _metrics(role="prefill", prefill=1000.0, occ=0.9, mfu=0.5),
+        2: _metrics(role="decode", goodput=400.0, occ=0.3, mfu=0.2),
+        3: _metrics(role="decode", goodput=600.0, occ=0.5, mfu=0.3),
+    })
+    s = sample_from_endpoints(endpoints, request_rate=5, avg_isl=100, avg_osl=20)
+    assert s.num_prefill_replicas == 1
+    assert s.num_decode_replicas == 2
+    assert s.observed_prefill_tok_s == 1000.0
+    assert s.observed_decode_tok_s == 1000.0
+    assert abs(s.prefill_occupancy - 0.9) < 1e-9
+    assert abs(s.decode_occupancy - 0.4) < 1e-9
+    assert abs(s.avg_mfu - (0.5 + 0.2 + 0.3) / 3) < 1e-9
+
+
+def test_sample_from_endpoints_roles_override_self_reports():
+    endpoints = SimpleNamespace(workers={
+        1: _metrics(role="decode", goodput=100.0, prefill=900.0),
+        2: _metrics(role="decode", goodput=300.0),
+    })
+    s = sample_from_endpoints(
+        endpoints, request_rate=1, avg_isl=10, avg_osl=5,
+        roles={1: "prefill"},
+    )
+    assert s.num_prefill_replicas == 1
+    assert s.num_decode_replicas == 1
+    assert s.observed_prefill_tok_s == 900.0
+    assert s.observed_decode_tok_s == 300.0
+
+
+def test_sample_from_endpoints_carries_burn_rates():
+    endpoints = SimpleNamespace(workers={})
+    status = {"objectives": {
+        "ttft": {"worst_burn_rate": 2.5},
+        "itl": {"windows": {"60": {"burn_rate": 0.4}, "300": {"burn_rate": 0.9}}},
+        "error_rate": {"worst_burn_rate": 0.1},
+    }}
+    s = sample_from_endpoints(endpoints, request_rate=1, avg_isl=10,
+                              avg_osl=5, slo_status=status)
+    assert s.ttft_burn_rate == 2.5
+    assert s.itl_burn_rate == 0.9     # window fallback takes the max
+    assert s.error_burn_rate == 0.1
+
+
+def test_burn_rates_from_slo_tolerates_empty():
+    assert burn_rates_from_slo(None) == {}
+    assert burn_rates_from_slo({}) == {}
+
+
+# -- planner state events ---------------------------------------------------
+
+def test_state_event_json_roundtrip():
+    ev = PlannerStateEvent(target_prefill=3, target_decode=2,
+                           observed_prefill_tok_s=1234.5, burn_rate_input=1.5,
+                           reason="ttft_burn", ts=42.0)
+    back = PlannerStateEvent.from_json(ev.to_json())
+    assert back == ev
+    # unknown keys from a newer writer are ignored, not fatal
+    assert PlannerStateEvent.from_json(
+        b'{"target_prefill": 1, "future_field": true}'
+    ).target_prefill == 1
+
+
+def test_event_from_planner_snapshots_burn_input():
+    p = _planner()
+    p.observe(_sample(ttft_burn_rate=3.0))
+    d = p.plan(now=0.0)
+    ev = event_from_planner(p, d, ts=7.0)
+    assert ev.target_prefill == d.num_prefill
+    assert ev.burn_rate_input == 3.0
+    assert ev.reason == d.reason
+    assert ev.ts == 7.0
+
+
+async def test_state_publisher_reaches_the_bus():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://autopilot-test")
+    )
+    try:
+        comp = rt.namespace("test").component("planner")
+        pub = PlannerStatePublisher(comp, clock=lambda: 99.0)
+        sub = await rt.plane.bus.subscribe(
+            comp.event_subject(PLANNER_STATE_EVENT)
+        )
+        p = _planner()
+        p.observe(_sample(itl_burn_rate=2.0))
+        d = p.plan(now=0.0)
+        await pub.publish_decision(p, d)
+        msg = await anext(aiter(sub))
+        ev = PlannerStateEvent.from_json(msg.payload)
+        assert ev.target_decode == d.num_decode
+        assert ev.ts == 99.0
+        assert pub.published == [ev]
+        await sub.unsubscribe()
+    finally:
+        await rt.close()
+
+
+async def test_step_publishes_after_scale():
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://autopilot-step-test")
+    )
+    try:
+        comp = rt.namespace("test").component("planner")
+        p = _planner()
+        p.state_publisher = PlannerStatePublisher(comp)
+        d = await p.step(_sample(ttft_burn_rate=3.0), now=0.0)
+        assert p.connector.decisions == [d]
+        assert [e.target_prefill for e in p.state_publisher.published] == [3]
+    finally:
+        await rt.close()

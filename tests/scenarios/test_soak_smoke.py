@@ -1,0 +1,128 @@
+"""Tier-1 scenario soak smoke: a scaled-down two-phase soak (burst overload
++ mid-phase chaos fault) against a real routed fleet with the autopilot
+live.  Asserts the full loop: burn-driven planner decision EXECUTED
+mid-soak, phase assertions evaluated, dyn_top snapshots (with the
+dyn_planner_* gauges) captured into the artifact."""
+
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.scenarios.runner import run_scenario
+from dynamo_tpu.scenarios.spec import ScenarioSpec
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+SMOKE = {
+    "name": "soak_smoke",
+    "seed": 11,
+    "speedup": 10.0,
+    "tick_s": 1.0,
+    "drain_s": 6.0,
+    "retry_max": 2,
+    "slo": {
+        "ttft_s": 0.5, "ttft_target": 0.9,
+        "itl_s": 0.15, "itl_target": 0.9,
+        "error_target": 0.99, "windows_s": [4.0, 12.0],
+    },
+    "fleet": {
+        "pools": {"prefill": 1, "decode": 1},
+        "policy": "kv",
+        "max_batch_size": 2,
+        "num_blocks": 512,
+        "metrics_period_s": 0.5,
+    },
+    "autopilot": {
+        "enabled": True, "interval_s": 2.0,
+        "min_prefill": 1, "max_prefill": 3,
+        "min_decode": 1, "max_decode": 3,
+        "max_total_chips": 8,
+        "cooldown_s": 5.0,
+        "expect_decision": True,
+    },
+    "phases": [
+        {
+            "name": "burst",
+            "duration_s": 10.0,
+            "traffic": {
+                "kind": "burst", "rate": 2.0, "isl": 96, "osl": 24,
+                "burst_rate": 22.0, "burst_start_s": 1.0,
+                "burst_duration_s": 5.0,
+            },
+            "assertions": {
+                "max_burn_rate": {"error_rate": 1.0},
+                "min_completed": 40,
+            },
+        },
+        {
+            "name": "chaos",
+            "duration_s": 8.0,
+            "traffic": {"kind": "constant", "rate": 4.0, "isl": 96, "osl": 24},
+            "faults": [
+                {"at_s": 1.5, "schedule": "worker.generate:every=3:times=4"},
+            ],
+            "assertions": {
+                "max_burn_rate": {"error_rate": 4.0},
+                "min_completed": 15,
+            },
+        },
+    ],
+}
+
+
+async def test_soak_smoke_end_to_end():
+    spec = ScenarioSpec.from_dict(SMOKE)
+    artifact = await run_scenario(spec, name="soak-smoke-test")
+
+    assert artifact["passed"], artifact["phases"]
+    assert [p["name"] for p in artifact["phases"]] == ["burst", "chaos"]
+
+    # every phase's assertions held on phase-local counts
+    for phase in artifact["phases"]:
+        assert phase["assertions"]["passed"], phase["assertions"]["failures"]
+        assert phase["requests"]["completed"] > 0
+        assert phase["ttft_sim_ms"]["p50"] is not None
+
+    # the burst must have overloaded the seed fleet into measurable burn...
+    burst = artifact["phases"][0]
+    assert burst["burn_rates"]["ttft"] > 1.0
+
+    # ...and the autopilot must have EXECUTED a burn/SLA-driven scale-up
+    # while traffic was in flight
+    assert artifact["planner"]["steering_decisions"] >= 1
+    grew = [e for e in artifact["planner"]["scale_events"] if e["to"] > e["from"]]
+    assert grew, artifact["planner"]["scale_events"]
+    burn_reasons = {
+        d["reason"] for d in artifact["planner"]["decisions"]
+        if d["reason"] != "load"
+    }
+    assert any("burn" in r or "sla" in r for r in burn_reasons), burn_reasons
+
+    # chaos phase: the armed schedule actually fired mid-phase
+    chaos = artifact["phases"][1]
+    assert chaos["faults"]["armed"], "fault event never armed"
+    assert chaos["faults"]["injected"] >= 1
+    assert chaos["faults"]["fired"].get("worker.generate", 0) >= 1
+
+    # dyn_top snapshots captured into the artifact, with planner gauges live
+    assert len(artifact["dyn_top_snapshots"]) == 2
+    planner_views = [
+        s.get("planner") for s in artifact["dyn_top_snapshots"]
+        if s.get("planner")
+    ]
+    assert planner_views, "dyn_planner_* gauges never reached dyn_top"
+    pools = planner_views[-1]["pools"]
+    assert {"prefill", "decode"} <= set(pools)
+    assert all("target_replicas" in p for p in pools.values())
+
+    # tick time series present for the SLO plane
+    assert len(artifact["ticks"]) >= 10
+    assert all("worst_burn" in t for t in artifact["ticks"])

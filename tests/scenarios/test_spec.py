@@ -1,0 +1,159 @@
+"""Scenario spec format: JSON round-trip, validation, shipped specs, and
+deterministic traffic planning."""
+
+import pytest
+
+from dynamo_tpu.scenarios.spec import (
+    FaultEvent,
+    Phase,
+    ScenarioSpec,
+    TrafficShape,
+    builtin_spec_path,
+)
+from dynamo_tpu.scenarios.traffic import plan_phase
+
+
+def _minimal(**overrides) -> dict:
+    data = {
+        "name": "t",
+        "phases": [
+            {"name": "p1", "duration_s": 5.0,
+             "traffic": {"kind": "constant", "rate": 2.0}},
+        ],
+    }
+    data.update(overrides)
+    return data
+
+
+def test_round_trip_preserves_the_spec():
+    spec = ScenarioSpec.load(builtin_spec_path("default_soak"))
+    again = ScenarioSpec.from_dict(spec.to_dict())
+    assert again.to_dict() == spec.to_dict()
+
+
+def test_unknown_keys_are_rejected_not_silently_dropped():
+    with pytest.raises(ValueError, match="unknown spec keys"):
+        ScenarioSpec.from_dict(_minimal(typo_field=1))
+    bad_phase = _minimal()
+    bad_phase["phases"][0]["traffic"]["ratee"] = 9
+    with pytest.raises(ValueError, match="unknown spec keys"):
+        ScenarioSpec.from_dict(bad_phase)
+
+
+def test_duplicate_phase_names_rejected():
+    data = _minimal()
+    data["phases"].append(dict(data["phases"][0]))
+    with pytest.raises(ValueError, match="duplicate phase names"):
+        ScenarioSpec.from_dict(data)
+
+
+def test_bad_traffic_kind_rejected():
+    data = _minimal()
+    data["phases"][0]["traffic"]["kind"] = "tsunami"
+    with pytest.raises(ValueError, match="unknown traffic kind"):
+        ScenarioSpec.from_dict(data)
+
+
+def test_bad_fault_grammar_rejected_at_load_time():
+    data = _minimal()
+    data["phases"][0]["faults"] = [{"at_s": 1.0, "schedule": "worker.generate"}]
+    with pytest.raises(ValueError):
+        ScenarioSpec.from_dict(data)
+
+
+def test_shipped_specs_load_and_validate():
+    soak = ScenarioSpec.load(builtin_spec_path("default_soak"))
+    kinds = [p.traffic.kind for p in soak.phases]
+    assert len(soak.phases) >= 3
+    assert "burst" in kinds
+    assert "session_swarm" in kinds
+    assert any(p.faults for p in soak.phases), "soak must include chaos"
+    assert soak.autopilot.enabled and soak.autopilot.expect_decision
+
+    smoke = ScenarioSpec.load(builtin_spec_path("chaos_smoke"))
+    assert smoke.phases[0].faults[0].schedule
+    assert smoke.phases[0].traffic.requests > 0
+
+
+def test_fault_event_validates_grammar():
+    FaultEvent(at_s=0, schedule="worker.generate:nth=2").validate()
+    with pytest.raises(ValueError):
+        FaultEvent(at_s=0, schedule="").validate()
+
+
+# -- traffic planning -------------------------------------------------------
+
+def test_plan_phase_is_deterministic_per_seed():
+    phase = Phase(name="p", duration_s=10.0,
+                  traffic=TrafficShape(kind="constant", rate=5.0))
+    a = plan_phase(phase, seed=3)
+    b = plan_phase(phase, seed=3)
+    c = plan_phase(phase, seed=4)
+    assert [x.at_s for x in a.arrivals] == [x.at_s for x in b.arrivals]
+    assert [x.at_s for x in a.arrivals] != [x.at_s for x in c.arrivals]
+
+
+def test_burst_concentrates_arrivals_in_the_window():
+    phase = Phase(name="p", duration_s=12.0, traffic=TrafficShape(
+        kind="burst", rate=1.0, burst_rate=30.0,
+        burst_start_s=4.0, burst_duration_s=4.0,
+    ))
+    plan = plan_phase(phase, seed=1)
+    inside = [a for a in plan.arrivals if 4.0 <= a.at_s < 8.0]
+    outside = [a for a in plan.arrivals if not (4.0 <= a.at_s < 8.0)]
+    # 4s at 30/s vs 8s at 1/s — the burst must dominate by an order
+    assert len(inside) > 5 * max(len(outside), 1)
+    assert all(0 <= a.at_s < 12.0 for a in plan.arrivals)
+
+
+def test_diurnal_rate_oscillates():
+    phase = Phase(name="p", duration_s=20.0, traffic=TrafficShape(
+        kind="diurnal", rate=2.0, peak_rate=40.0, period_s=20.0,
+    ))
+    plan = plan_phase(phase, seed=2)
+    crest = [a for a in plan.arrivals if 2.0 <= a.at_s < 8.0]   # sin > 0
+    trough = [a for a in plan.arrivals if 12.0 <= a.at_s < 18.0]  # sin < 0
+    assert len(crest) > 2 * max(len(trough), 1)
+
+
+def test_closed_request_count_is_exact_and_even():
+    phase = Phase(name="p", duration_s=30.0,
+                  traffic=TrafficShape(kind="constant", rate=2.0, requests=6))
+    plan = plan_phase(phase, seed=0)
+    assert [a.at_s for a in plan.arrivals] == pytest.approx(
+        [0.0, 0.5, 1.0, 1.5, 2.0, 2.5]
+    )
+
+
+def test_session_swarm_plans_sessions_inside_the_phase():
+    phase = Phase(name="p", duration_s=10.0, traffic=TrafficShape(
+        kind="session_swarm", num_sessions=5, turns_per_session=2,
+        isl=32, osl=8,
+    ))
+    plan = plan_phase(phase, seed=9)
+    assert len(plan.sessions) == 5
+    assert plan.expected_requests == 10
+    assert all(0 <= s.start_s < phase.duration_s for s in plan.sessions)
+    assert all(len(t.user_tokens) == 32 for s in plan.sessions for t in s.turns)
+
+
+def test_long_context_tags_stragglers():
+    phase = Phase(name="p", duration_s=40.0, traffic=TrafficShape(
+        kind="long_context", rate=5.0, isl=64, osl=8, long_fraction=0.3,
+    ))
+    plan = plan_phase(phase, seed=5)
+    long = [a for a in plan.arrivals if a.kind == "long"]
+    assert long, "some arrivals must be stragglers"
+    assert all(a.isl == 64 * 8 for a in long)
+    frac = len(long) / len(plan.arrivals)
+    assert 0.15 < frac < 0.45
+
+
+def test_guided_mix_extends_decode():
+    phase = Phase(name="p", duration_s=40.0, traffic=TrafficShape(
+        kind="guided_mix", rate=5.0, isl=64, osl=8, guided_fraction=0.5,
+        osl_guided=40,
+    ))
+    plan = plan_phase(phase, seed=6)
+    guided = [a for a in plan.arrivals if a.kind == "guided"]
+    assert guided and all(a.osl == 40 for a in guided)
