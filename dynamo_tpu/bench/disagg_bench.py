@@ -16,6 +16,17 @@ engine — the cost of the disagg plumbing itself, which on real multi-chip
 deployments is the part this framework owns (compute overlap is the
 hardware's business).
 
+Two further sections exercise this PR's streamed-transfer path:
+
+- ``streamed_ab`` — same disagg stack, chunked prefill engine, streamed
+  (DYN_KV_STREAM-style multi-part) vs single-shot transfer: TTFT p50/p99
+  per mode, parts shipped, and the transfer-hidden fraction (share of
+  transfer wall time overlapped with prefill compute).
+- ``fleet`` — a second decode candidate behind an unequal link: requests
+  share a prefix held by the "near" (ici) worker while the "far" worker
+  sits behind dcn; the KV-locality/link-cost scorer routes each request
+  and the section records pick counts + fleet TTFT.
+
 Usage:
     python -m dynamo_tpu.bench.disagg_bench                # auto geometry
     python -m dynamo_tpu.bench.disagg_bench --model tiny   # CPU smoke
@@ -32,7 +43,8 @@ import time
 
 
 def _build_engine(model: str, quant: str | None, kv_dtype: str, isl: int,
-                  osl: int, batch: int, prefill_only: bool = False):
+                  osl: int, batch: int, prefill_only: bool = False,
+                  chunk: int | None | str = "auto"):
     import jax
     import numpy as np
 
@@ -73,9 +85,13 @@ def _build_engine(model: str, quant: str | None, kv_dtype: str, isl: int,
             max_batch_size=batch,
             max_model_len=max_len,
             # chunked prefill keeps the compile small at ISL 3000 (same
-            # rationale as bench.py's accelerator default)
+            # rationale as bench.py's accelerator default); callers force
+            # ``chunk`` when streamed transfer needs chunks at tiny ISL
             prefill_buckets=(min(512, isl),),
-            prefill_chunk_tokens=min(512, isl) if isl > 512 else None,
+            prefill_chunk_tokens=(
+                (min(512, isl) if isl > 512 else None)
+                if chunk == "auto" else chunk
+            ),
             decode_steps=1 if prefill_only else 8,
             top_logprobs_k=0,
             logit_bias_k=0,
@@ -171,9 +187,12 @@ async def run(args: argparse.Namespace) -> dict:
     # the PrefillWorker handles one request at a time (its loop awaits each
     # _handle serially), so the prefill engine needs blocks for ~1 sequence
     # — batch-sizing it would waste several GB of the shared chip's HBM
+    # chunked even at tiny ISL so the streamed transfer has parts to
+    # overlap (chunk = 2 blocks for the tiny smoke; 512 for real models)
     prefill_engine, _ = _build_engine(
         args.model, quant, args.kv_dtype, args.isl, args.osl, batch=2,
         prefill_only=True,
+        chunk=min(512, args.isl) if args.isl > 512 else 8,
     )
     print(
         f"disagg-bench: engines up in {time.monotonic()-t0:.1f}s",
@@ -186,8 +205,9 @@ async def run(args: argparse.Namespace) -> dict:
     )
     rng = np.random.default_rng(0)
 
-    def make_request() -> dict:
-        tokens = rng.integers(10, cfg.vocab_size - 10, size=args.isl).tolist()
+    def make_request(tokens: list[int] | None = None) -> dict:
+        if tokens is None:
+            tokens = rng.integers(10, cfg.vocab_size - 10, size=args.isl).tolist()
         return PreprocessedRequest(
             token_ids=tokens,
             sampling=SamplingOptions(use_greedy=True),
@@ -196,6 +216,7 @@ async def run(args: argparse.Namespace) -> dict:
         ).to_wire()
 
     itls: list[float] = []
+    ttfts: list[float] = []
     spans: list[tuple[float, float, int]] = []
 
     async def drive(gen, req: dict) -> int:
@@ -210,11 +231,24 @@ async def run(args: argparse.Namespace) -> dict:
             t_last = time.monotonic()
             if ttft is None:
                 ttft = t_last - t0
+                ttfts.append(ttft)
             count += len(ann.data.token_ids)
         if ttft is not None and count > 1:
             itls.append((t_last - t0 - ttft) / (count - 1))
             spans.append((t0 + ttft, t_last, count))
         return count
+
+    def _pctile(xs: list[float], q: float) -> float | None:
+        if not xs:
+            return None
+        s = sorted(xs)
+        return s[min(len(s) - 1, round(q * (len(s) - 1)))]
+
+    def ttft_stats() -> dict:
+        return {
+            "ttft_p50_ms": round(1e3 * _pctile(ttfts, 0.5), 2) if ttfts else None,
+            "ttft_p99_ms": round(1e3 * _pctile(ttfts, 0.99), 2) if ttfts else None,
+        }
 
     def phase_stats() -> dict:
         if not spans:
@@ -238,10 +272,11 @@ async def run(args: argparse.Namespace) -> dict:
         "batch": args.batch,
     }
     disagg = prefill_worker = router = None
+    disagg2 = decode2 = None
     try:
         # -- aggregated reference: same workload, one engine does both ----
         await drive(decode_engine.generate, make_request())  # warm compiles
-        itls.clear(); spans.clear()
+        itls.clear(); spans.clear(); ttfts.clear()
         t0 = time.monotonic()
         counts = await asyncio.gather(
             *[drive(decode_engine.generate, make_request())
@@ -252,6 +287,7 @@ async def run(args: argparse.Namespace) -> dict:
             "wall_s": round(agg_wall, 2),
             "req_s": round(args.requests / agg_wall, 3),
             "tok_s": round(sum(counts) / agg_wall, 2),
+            **ttft_stats(),
             **phase_stats(),
         }
 
@@ -268,7 +304,7 @@ async def run(args: argparse.Namespace) -> dict:
         prefill_worker.start()
 
         await drive(disagg.generate, make_request())  # warm prefill engine
-        itls.clear(); spans.clear()
+        itls.clear(); spans.clear(); ttfts.clear()
         warm_remote = disagg.remote_prefills  # exclude warmup from the count
         t0 = time.monotonic()
         counts = await asyncio.gather(
@@ -285,11 +321,116 @@ async def run(args: argparse.Namespace) -> dict:
             # silently fell back to local prefill
             "remote_prefills": remote,
             "all_prefills_remote": remote == args.requests,
+            **ttft_stats(),
             **phase_stats(),
         }
         result["disagg_overhead_pct"] = round(
             (dis_wall - agg_wall) / agg_wall * 100, 1
         )
+
+        # -- streamed vs single-shot A/B over the same disagg stack -------
+        # (the main disagg section above already ran with the default
+        # streaming knob; these two runs pin the worker's mode explicitly)
+        ab: dict = {}
+        # single-shot first so the worker left running for the fleet section
+        # below is the (default-on) streamed one
+        for mode_name, mode in (("single_shot", False), ("streamed", True)):
+            await prefill_worker.stop()
+            prefill_worker = PrefillWorker(
+                rt, prefill_engine, queue, stream=mode
+            )
+            prefill_worker.start()
+            base = disagg.stats()
+            itls.clear(); spans.clear(); ttfts.clear()
+            t0 = time.monotonic()
+            await asyncio.gather(
+                *[drive(disagg.generate, make_request())
+                  for _ in range(args.requests)]
+            )
+            wall = time.monotonic() - t0
+            cur = disagg.stats()
+            xfer_s = (cur["disagg_kv_transfer_seconds_total"]
+                      - base["disagg_kv_transfer_seconds_total"])
+            hidden_s = (cur["disagg_kv_transfer_hidden_seconds_total"]
+                        - base["disagg_kv_transfer_hidden_seconds_total"])
+            ab[mode_name] = {
+                "wall_s": round(wall, 2),
+                "kv_parts": (cur["disagg_kv_transfer_parts_total"]
+                             - base["disagg_kv_transfer_parts_total"]),
+                "transfer_hidden_fraction": (
+                    round(hidden_s / xfer_s, 3) if xfer_s > 0 else 0.0
+                ),
+                **ttft_stats(),
+            }
+        if ab["streamed"]["ttft_p50_ms"] and ab["single_shot"]["ttft_p50_ms"]:
+            ab["ttft_p50_speedup"] = round(
+                ab["single_shot"]["ttft_p50_ms"] / ab["streamed"]["ttft_p50_ms"], 3
+            )
+        result["streamed_ab"] = ab
+
+        # -- routed fleet: 2 decode candidates, unequal overlap + links ---
+        # requests share a prefix the "near" (ici) candidate already holds;
+        # the "far" candidate sits behind dcn with a cold cache — the
+        # KV-locality/link-cost scorer should send the traffic near
+        from dynamo_tpu.llm.kv_router import (
+            KvScheduler,
+            RadixTree,
+            TransferCostModel,
+            compute_block_hashes,
+        )
+        from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
+
+        decode2, _ = _build_engine(
+            args.model, quant, args.kv_dtype, args.isl, args.osl, args.batch
+        )
+        disagg2 = DisaggDecodeEngine(rt, decode2, router, queue)
+        await disagg2.start()
+        shared = rng.integers(10, cfg.vocab_size - 10, size=args.isl // 2).tolist()
+        tree = RadixTree()
+        tree.apply(RouterEvent(
+            worker_id=1,
+            event=KvCacheEvent(
+                kind="stored", block_hashes=compute_block_hashes(shared, bs)
+            ),
+        ))
+        cost_model = TransferCostModel()
+        cost_model.update_link(1, hop="ici")
+        cost_model.update_link(2, hop="dcn")
+        sched = KvScheduler()
+        fleet_engines = {1: disagg, 2: disagg2}
+        picks = {1: 0, 2: 0}
+        itls.clear(); spans.clear(); ttfts.clear()
+
+        async def fleet_one() -> None:
+            tokens = shared + rng.integers(
+                10, cfg.vocab_size - 10, size=args.isl - len(shared)
+            ).tolist()
+            hashes = compute_block_hashes(tokens, bs)
+            overlap = tree.find_matches(hashes)
+            missing = {
+                w: len(hashes) - overlap.scores.get(w, 0) for w in (1, 2)
+            }
+            costs = cost_model.costs([1, 2], missing)
+            wid, _ratio = sched.select_worker(
+                [1, 2], overlap, len(hashes), transfer_costs=costs
+            )
+            picks[wid] += 1
+            await drive(fleet_engines[wid].generate, make_request(tokens))
+
+        t0 = time.monotonic()
+        await asyncio.gather(*[fleet_one() for _ in range(args.requests)])
+        fleet_wall = time.monotonic() - t0
+        result["fleet"] = {
+            "decode_workers": 2,
+            "near": {"worker": 1, "hop": "ici",
+                     "overlap_blocks": len(compute_block_hashes(shared, bs)),
+                     "picks": picks[1]},
+            "far": {"worker": 2, "hop": "dcn", "overlap_blocks": 0,
+                    "picks": picks[2]},
+            "preferred_is_near": picks[1] > picks[2],
+            "wall_s": round(fleet_wall, 2),
+            **ttft_stats(),
+        }
         dev = jax.devices()[0]
         result["platform"] = dev.platform
         result["device_kind"] = dev.device_kind
@@ -303,11 +444,15 @@ async def run(args: argparse.Namespace) -> dict:
             await prefill_worker.stop()
         if disagg is not None:
             await disagg.stop()
+        if disagg2 is not None:
+            await disagg2.stop()
         if router is not None:
             await router.stop()
         await rt.close()
         decode_engine.stop()
         prefill_engine.stop()
+        if decode2 is not None:
+            decode2.stop()
     return result
 
 

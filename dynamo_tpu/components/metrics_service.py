@@ -186,6 +186,62 @@ class MetricsService:
             "prefetch (cumulative)",
             ["worker"], registry=self.registry,
         )
+        # disagg streamed KV transfer (llm/disagg.DisaggDecodeEngine stats):
+        # canonical dyn_disagg_* family names — mirrored remote counters, so
+        # gauges (same rationale as the prefetch family above).  The hidden
+        # ratio is the headline: what fraction of transfer wall time the
+        # streamed protocol moved off the TTFT critical path.
+        self.disagg_remote_prefills = Gauge(
+            "dyn_disagg_remote_prefills_total",
+            "Prefills served by a remote prefill worker (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_local_prefills = Gauge(
+            "dyn_disagg_local_prefills_total",
+            "Prefills served locally after the disagg router declined remote "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_prefill_timeouts = Gauge(
+            "dyn_disagg_prefill_timeouts_total",
+            "Remote prefills abandoned for local fallback after timeout "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_transfer_bytes = Gauge(
+            "dyn_disagg_kv_transfer_bytes_total",
+            "KV bytes received from prefill workers (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_transfer_seconds = Gauge(
+            "dyn_disagg_kv_transfer_seconds_total",
+            "Wall seconds spent receiving+injecting KV transfer parts "
+            "(cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_transfer_hidden = Gauge(
+            "dyn_disagg_kv_transfer_hidden_seconds_total",
+            "KV transfer seconds overlapped with remote prefill compute "
+            "instead of exposed to TTFT (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_transfer_parts = Gauge(
+            "dyn_disagg_kv_transfer_parts_total",
+            "Streamed KV transfer parts received (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_hidden_ratio = Gauge(
+            "dyn_disagg_transfer_hidden_ratio",
+            "Fraction of KV transfer wall time hidden behind prefill "
+            "compute (cumulative ratio, 0-1)",
+            ["worker"], registry=self.registry,
+        )
+        self.disagg_bandwidth = Gauge(
+            "dyn_disagg_kv_transfer_bandwidth_bps",
+            "Measured inbound KV transfer bandwidth, bytes/second "
+            "(cumulative mean; 0 until measured)",
+            ["worker"], registry=self.registry,
+        )
         # offload-tier occupancy (engine offload_tiers snapshot): capacity
         # and usage per mounted tier (g2 host / g3 disk / g4 remote)
         self.offload_blocks = Gauge(
@@ -213,6 +269,11 @@ class MetricsService:
             self.preempted_tokens, self.spec_rejected, self.wasted_tokens,
             self.prefetch_hits, self.prefetch_misses, self.prefetch_stale,
             self.prefetch_hidden,
+            self.disagg_remote_prefills, self.disagg_local_prefills,
+            self.disagg_prefill_timeouts, self.disagg_transfer_bytes,
+            self.disagg_transfer_seconds, self.disagg_transfer_hidden,
+            self.disagg_transfer_parts, self.disagg_hidden_ratio,
+            self.disagg_bandwidth,
         )
         self._seen_workers: set[str] = set()
         self._seen_phases: set[tuple[str, str]] = set()
@@ -377,6 +438,31 @@ class MetricsService:
             self.prefetch_misses.labels(label).set(m.prefetch_misses_total)
             self.prefetch_stale.labels(label).set(m.prefetch_stale_total)
             self.prefetch_hidden.labels(label).set(m.prefetch_hidden_seconds_total)
+            self.disagg_remote_prefills.labels(label).set(
+                m.disagg_remote_prefills_total
+            )
+            self.disagg_local_prefills.labels(label).set(
+                m.disagg_local_prefills_total
+            )
+            self.disagg_prefill_timeouts.labels(label).set(
+                m.disagg_prefill_timeouts_total
+            )
+            self.disagg_transfer_bytes.labels(label).set(
+                m.disagg_kv_transfer_bytes_total
+            )
+            self.disagg_transfer_seconds.labels(label).set(
+                m.disagg_kv_transfer_seconds_total
+            )
+            self.disagg_transfer_hidden.labels(label).set(
+                m.disagg_kv_transfer_hidden_seconds_total
+            )
+            self.disagg_transfer_parts.labels(label).set(
+                m.disagg_kv_transfer_parts_total
+            )
+            self.disagg_hidden_ratio.labels(label).set(
+                m.disagg_transfer_hidden_ratio
+            )
+            self.disagg_bandwidth.labels(label).set(m.kv_transfer_bandwidth_bps)
             for tier, row in (m.offload_tiers or {}).items():
                 self.offload_blocks.labels(label, tier).set(row.get("blocks", 0))
                 self.offload_blocks_used.labels(label, tier).set(row.get("used", 0))

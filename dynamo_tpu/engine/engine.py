@@ -1557,19 +1557,29 @@ class JaxLlmEngine:
 
     # -- disaggregation API ------------------------------------------------
     async def prefill_extract(
-        self, pre: PreprocessedRequest, *, device: bool = False
+        self, pre: PreprocessedRequest, *, device: bool = False,
+        on_chunk=None,
     ) -> tuple[int, float, list | None, dict, int]:
         """Prefill-worker side: run prefill only, return (first_token,
         first_token_logprob, first_token_top_logprobs, blocks, n_blocks).  ``blocks`` is the cache pytree restricted to the
         sequence's blocks, e.g. llama ``{"k": [L, n, bs, kvh, d], "v": ...}``
         — host numpy by default, device arrays with ``device=True`` (the
-        same-process/ICI transfer path: no host staging)."""
+        same-process/ICI transfer path: no host staging).
+
+        ``on_chunk`` (streamed disagg transfer): called from the DEVICE
+        thread as ``on_chunk(start_block, leaves, count)`` for each run of
+        fully-written blocks after an intermediate prefill chunk, while
+        later chunks still compute.  The final return then carries only the
+        TAIL blocks past the streamed watermark (``n_blocks`` stays the
+        sequence total).  Requires chunked prefill to fire; without it the
+        call degenerates to the single-shot contract."""
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
         seq = Sequence(
             seq_id=uuid.uuid4().hex, request=pre, prefill_only=True,
             extract_device=device,
         )
+        seq.on_chunk_done = on_chunk
         if pre.output_format is not None:
             # constrain the FIRST sampled token on the prefill side so the
             # decode worker's cursor (generate_prefilled) accepts it — this
@@ -3082,6 +3092,38 @@ class JaxLlmEngine:
         self._lane_keys[seq.lane if seq.lane >= 0 else 0] = row
         return row
 
+    def _extract_block_range(
+        self, blocks: list[int], start_b: int, end_b: int, device: bool
+    ):
+        """Gather cache leaves for ``blocks[start_b:end_b]`` (device thread).
+        The gather table is bucketed like _jit_extract's full-sequence use so
+        streamed chunks reuse the same compiled gathers."""
+        count = end_b - start_b
+        ids = np.zeros((self._table_len(count),), np.int32)
+        ids[:count] = blocks[start_b:end_b]
+        gathered = self._jit_extract(self.cache, jnp.asarray(ids))
+        if device:
+            return jax.tree.map(lambda x: x[:, :count], gathered)
+        return jax.tree.map(lambda x: np.asarray(x)[:, :count], gathered)
+
+    def _stream_prefill_chunk(self, seq: Sequence, blocks: list[int], end: int) -> None:
+        """Streamed disagg transfer: after an intermediate chunk wrote KV up
+        to token ``end``, extract the newly COMPLETED blocks (never a
+        partially-written one) and hand them to ``seq.on_chunk_done`` while
+        later chunks compute.  The watermark only moves forward, so a
+        preemption recompute re-runs chunks without re-streaming blocks the
+        receiver already injected."""
+        done_b = end // self.config.block_size
+        if done_b <= seq.streamed_blocks:
+            return
+        start_b = seq.streamed_blocks
+        out = self._extract_block_range(blocks, start_b, done_b, seq.extract_device)
+        seq.streamed_blocks = done_b
+        try:
+            seq.on_chunk_done(start_b, out, done_b - start_b)
+        except Exception:  # noqa: BLE001 — a sink bug must not kill the device loop
+            logger.exception("on_chunk_done failed for %s", seq.seq_id)
+
     def _run_prefill(self, seq: Sequence) -> None:
         tokens = seq.all_token_ids
         n = len(tokens)
@@ -3208,19 +3250,22 @@ class JaxLlmEngine:
             # intermediate chunk: KV written, no token sampled; publish the
             # completed blocks so routers (and future prompts) can hit them
             self.allocator.publish_stored(seq.seq_id, tokens[:end])
+            if seq.prefill_only and seq.on_chunk_done is not None:
+                self._stream_prefill_chunk(seq, blocks, end)
             return
         if seq.status == SeqStatus.PREFILLING:
             seq.status = SeqStatus.RUNNING  # last chunk done → decode
         if seq.prefill_only:
-            # disagg prefill worker: hand back first token + the KV blocks
+            # disagg prefill worker: hand back first token + the KV blocks.
+            # With streaming, earlier chunks already shipped blocks up to the
+            # watermark — extract only the tail past it (the final chunk's
+            # last block is never complete before now, so the tail is always
+            # non-empty and the closing part always carries blocks).
             n_used = self.allocator.blocks_needed(n)
-            ids = np.zeros((self._table_len(n_used),), np.int32)
-            ids[: len(blocks)] = blocks[: len(ids)]
-            gathered = self._jit_extract(self.cache, jnp.asarray(ids))
-            if seq.extract_device:
-                blocks_out = jax.tree.map(lambda x: x[:, :n_used], gathered)
-            else:
-                blocks_out = jax.tree.map(lambda x: np.asarray(x)[:, :n_used], gathered)
+            start_b = min(seq.streamed_blocks, n_used)
+            blocks_out = self._extract_block_range(
+                blocks, start_b, n_used, seq.extract_device
+            )
             want_top = seq.request.sampling.top_logprobs
             top_rows = None
             if want_top > 0:

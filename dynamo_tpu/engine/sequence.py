@@ -67,9 +67,17 @@ class Sequence:
     # measures only the re-admission wait, while TTFT keeps arrival_ts)
     queue_start_ts: float = 0.0
     decode_start_ts: float = 0.0  # wall-clock start of this seq's decode span
+    # streamed disagg extraction (prefill_only): blocks already handed to
+    # on_chunk_done.  Monotonic across preemption recompute — re-run chunks
+    # below the watermark are not re-streamed (the receiver already holds
+    # them; recompute is deterministic).
+    streamed_blocks: int = 0
     # callbacks into the async world (set by the engine)
     emit=None                 # Callable[[Sequence, list[int], FinishReason|None], None]
     on_prefill_done=None      # Callable[[Sequence, int], None] for prefill_only
+    # per-completed-chunk KV extraction callback, device thread:
+    # (start_block, cache-leaves [L, count, ...], count) — None = no streaming
+    on_chunk_done=None
 
     @property
     def mm_len(self) -> int:

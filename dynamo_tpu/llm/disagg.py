@@ -22,7 +22,7 @@ import json
 import os
 import time
 import uuid
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from dynamo_tpu.engine.engine import JaxLlmEngine
 from dynamo_tpu.llm.protocols.common import PreprocessedRequest
@@ -56,10 +56,22 @@ def _payload_bytes(blocks) -> int:
     return int(sum(getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(blocks)))
 
 
+def kv_stream_enabled() -> bool:
+    """Streamed (multi-part, overlapped-with-prefill) KV transfer knob.
+    Default ON; ``DYN_KV_STREAM=0`` falls back to the single-shot
+    post-prefill transfer."""
+    return os.environ.get("DYN_KV_STREAM", "1").lower() not in ("0", "false", "off")
+
+
 @dataclass
 class DisaggConfig:
     max_local_prefill_length: int = 512
     max_prefill_queue_size: int = 16
+    # link-cost guard: skip remote prefill when the estimated KV transfer
+    # (prompt blocks / measured inbound bandwidth) would take longer than
+    # this — behind a slow DCN hop, local prefill beats shipping the cache.
+    # 0 = guard off; unmeasured links are never gated.
+    max_transfer_seconds: float = 0.0
 
 
 class DisaggRouter:
@@ -123,6 +135,9 @@ class DisaggRouter:
                     max_prefill_queue_size=d.get(
                         "max_prefill_queue_size", self.config.max_prefill_queue_size
                     ),
+                    max_transfer_seconds=d.get(
+                        "max_transfer_seconds", self.config.max_transfer_seconds
+                    ),
                 )
                 logger.info("disagg config reloaded: %s", self.config)
             except Exception:  # noqa: BLE001
@@ -132,10 +147,16 @@ class DisaggRouter:
                 # loop hot
                 await asyncio.sleep(0.1)
 
-    def prefill_remote(self, prefill_length: int, queue_size: int) -> bool:
+    def prefill_remote(
+        self, prefill_length: int, queue_size: int, est_transfer_s: float = 0.0
+    ) -> bool:
         return (
             prefill_length > self.config.max_local_prefill_length
             and queue_size < self.config.max_prefill_queue_size
+            and (
+                self.config.max_transfer_seconds <= 0
+                or est_transfer_s <= self.config.max_transfer_seconds
+            )
         )
 
 
@@ -170,6 +191,37 @@ class PrefillQueue:
         return await self.runtime.plane.bus.queue_len(self.queue_name)
 
 
+@dataclass
+class _StreamAssembly:
+    """Decode-side state for one in-flight multi-part KV stream.
+
+    Parts may arrive out of order (a re-dialed client connection gets its
+    own server task) and duplicated (an ack lost to a reset makes the
+    client re-send over a fresh connection); ``received`` makes injection
+    idempotent per part and completion order-free.  Timing splits into
+    ``active_seconds`` (sum of per-part receive→inject work) and the
+    exposure window after the closing part lands — their difference is the
+    transfer time HIDDEN behind prefill compute, the quantity streaming
+    exists to maximize."""
+
+    received: set[int] = field(default_factory=set)   # arrival dedup
+    injected: set[int] = field(default_factory=set)   # scatter completed
+    last_index: int | None = None
+    first_token: int | None = None
+    first_token_logprob: float | None = None
+    first_token_top_logprobs: list | None = None
+    bytes: int = 0
+    blocks_received: int = 0
+    active_seconds: float = 0.0
+    last_part_arrival: float | None = None  # monotonic; set when ``last`` lands
+    inflight: int = 0                       # parts currently inside inject_blocks
+    # set when the requester abandons the stream while a part is mid-inject:
+    # the landing blocks stay reserved until the last in-flight inject
+    # drains, then ITS handler releases them (never free under a writer)
+    abandoned_blocks: list[int] | None = None
+    span: object = None
+
+
 class DisaggDecodeEngine:
     """Engine wrapper on the decode worker implementing the remote-prefill
     flow; wire-compatible AsyncEngine."""
@@ -195,10 +247,20 @@ class DisaggDecodeEngine:
         # keeps a LATE transfer from scattering stale KV into blocks that
         # were released and re-allocated to a live sequence.
         self._pending: dict[str, tuple[asyncio.Future, list[int], object]] = {}
+        # streamed transfers: seq_id -> partial assembly.  Intermediate
+        # parts inject into their own landing-block subrange WITHOUT popping
+        # _pending (the requester still owns the entry); only stream
+        # completion — every part 0..last injected — claims it.
+        self._assembly: dict[str, _StreamAssembly] = {}
         self.prefill_timeout_s = float(
             os.environ.get("DYN_DISAGG_PREFILL_TIMEOUT_S", "300")
         )
         self.transfer_server = KvTransferServer(self._on_transfer, host=transfer_host)
+        # link characterization for the router's transfer-cost model: hop
+        # class this decode worker sits behind relative to the prefill pool
+        # ("local"|"ici"|"dcn"; "" = unknown → the router keeps its prior)
+        self.transfer_hop = os.environ.get("DYN_TRANSFER_HOP", "")
+        self._bytes_per_block: int | None = None  # lazy, for the transfer guard
         # observability
         self.remote_prefills = 0
         self.local_prefills = 0
@@ -207,6 +269,13 @@ class DisaggDecodeEngine:
         # also land on each trace's kv.transfer span)
         self.kv_transfer_bytes_total = 0
         self.kv_transfer_seconds_total = 0.0
+        # streamed-transfer accounting: parts injected, duplicate parts
+        # dropped, and inject seconds HIDDEN behind prefill compute (a
+        # single-shot transfer hides nothing — its whole inject is exposed)
+        self.kv_transfer_parts_total = 0
+        self.kv_transfer_duplicate_parts_total = 0
+        self.kv_transfer_hidden_seconds_total = 0.0
+        self.kv_transfer_streams_total = 0
 
     async def start(self) -> None:
         await self.transfer_server.start()
@@ -214,7 +283,29 @@ class DisaggDecodeEngine:
     async def stop(self) -> None:
         await self.transfer_server.stop()
 
+    def _release_landing(self, seq_id: str, block_ids: list[int]) -> None:
+        """Release a sequence's landing blocks — DEFERRED while any streamed
+        part is still inside inject_blocks (freeing under a writer would let
+        the allocator hand the blocks to a live sequence mid-scatter).  The
+        last in-flight part's handler performs the actual release."""
+        asm = self._assembly.pop(seq_id, None)
+        if asm is not None and asm.span is not None:
+            asm.span.end(status="error", error="abandoned")
+            asm.span = None
+        if asm is not None and asm.inflight > 0:
+            asm.abandoned_blocks = list(block_ids)
+        else:
+            self.engine.release_blocks(block_ids)
+
     async def _on_transfer(self, payload: KvTransferPayload) -> None:
+        # legacy fast path: a one-part stream with no assembly in progress
+        # is exactly the pre-streaming wire contract (atomic pop-claim)
+        if payload.part_index == 0 and payload.last and payload.seq_id not in self._assembly:
+            await self._on_transfer_single(payload)
+            return
+        await self._on_transfer_part(payload)
+
+    async def _on_transfer_single(self, payload: KvTransferPayload) -> None:
         entry = self._pending.pop(payload.seq_id, None)
         if entry is None:
             # the requester already gave up AND released the landing blocks
@@ -243,6 +334,8 @@ class DisaggDecodeEngine:
             return
         self.kv_transfer_bytes_total += nbytes
         self.kv_transfer_seconds_total += time.monotonic() - t0
+        self.kv_transfer_parts_total += 1
+        self.kv_transfer_streams_total += 1
         if span is not None:
             span.end()
         if fut.cancelled():
@@ -259,10 +352,154 @@ class DisaggDecodeEngine:
                 )
             )
 
+    async def _on_transfer_part(self, payload: KvTransferPayload) -> None:
+        """One part of a streamed transfer: inject its block subrange while
+        the requester still owns the pending entry, complete the stream when
+        every part 0..last has been injected."""
+        seq_id = payload.seq_id
+        entry = self._pending.get(seq_id)
+        if entry is None:
+            # requester gone (timeout → local fallback, or cancel): drop the
+            # part and forget any partial assembly — the blocks are released
+            # (or pending deferred release) elsewhere
+            self._assembly.pop(seq_id, None)
+            logger.warning(
+                "dropping late KV transfer part %d for %s (request abandoned)",
+                payload.part_index, seq_id,
+            )
+            return
+        fut, block_ids, trace = entry
+        asm = self._assembly.get(seq_id)
+        if asm is None:
+            asm = self._assembly[seq_id] = _StreamAssembly()
+            asm.span = get_recorder().start(
+                "kv.transfer", trace, component="decode_worker",
+                attrs={"streamed": True},
+            )
+        if payload.part_index in asm.received:
+            # duplicate delivery (client re-send over a re-dialed
+            # connection): the blocks are already injected — drop
+            self.kv_transfer_duplicate_parts_total += 1
+            return
+        asm.received.add(payload.part_index)
+        if payload.last:
+            asm.last_index = payload.part_index
+            asm.first_token = payload.first_token
+            asm.first_token_logprob = payload.first_token_logprob
+            asm.first_token_top_logprobs = payload.first_token_top_logprobs
+            asm.last_part_arrival = time.monotonic()
+        nbytes = _payload_bytes(payload.blocks)
+        part_span = get_recorder().start(
+            "kv.transfer.part", trace, component="decode_worker",
+            attrs={
+                "part": payload.part_index, "bytes": nbytes,
+                "blocks": len(payload.block_ids), "last": payload.last,
+            },
+        )
+        t0 = time.monotonic()
+        asm.inflight += 1
+        try:
+            if payload.block_ids:
+                await self.engine.inject_blocks(payload.block_ids, payload.blocks)
+        except Exception as exc:  # noqa: BLE001
+            asm.inflight -= 1
+            if part_span is not None:
+                part_span.end(status="error", error=repr(exc))
+            if asm.abandoned_blocks is not None:
+                # requester abandoned mid-inject; we may be the last writer
+                if asm.inflight == 0:
+                    blocks_to_free, asm.abandoned_blocks = asm.abandoned_blocks, None
+                    self.engine.release_blocks(blocks_to_free)
+                return
+            entry2 = self._pending.pop(seq_id, None)
+            if entry2 is None:
+                return  # abandonment raced us; its release path owns the blocks
+            if fut.cancelled():
+                # requester is gone and can't run its release path — do it
+                # here through the deferral protocol (sibling parts may
+                # still be scattering into these blocks)
+                self._release_landing(seq_id, block_ids)
+            elif not fut.done():
+                # requester wakes with the exception and releases through
+                # _release_landing (generate()); the assembly stays in the
+                # dict until then so the deferral state survives
+                fut.set_exception(exc)
+            return
+        asm.inflight -= 1
+        asm.injected.add(payload.part_index)
+        asm.active_seconds += time.monotonic() - t0
+        asm.bytes += nbytes
+        asm.blocks_received += len(payload.block_ids)
+        self.kv_transfer_parts_total += 1
+        if part_span is not None:
+            part_span.end()
+        if asm.abandoned_blocks is not None:
+            # requester abandoned while we were injecting: blocks stayed
+            # reserved (deferred release), so the scatter was harmless —
+            # the last writer out frees them
+            if asm.inflight == 0:
+                blocks_to_free, asm.abandoned_blocks = asm.abandoned_blocks, None
+                self.engine.release_blocks(blocks_to_free)
+            return
+        # completion gates on INJECTED parts (a part that has merely arrived
+        # may still be mid-scatter on a concurrent handler — admitting the
+        # sequence then would race decode against its own KV landing)
+        if asm.last_index is not None and len(asm.injected) == asm.last_index + 1:
+            self._finish_stream(seq_id, asm)
+
+    def _finish_stream(self, seq_id: str, asm: _StreamAssembly) -> None:
+        """All parts injected: claim the pending entry and admit the
+        sequence.  Exposure = time since the closing part arrived (the tail
+        the requester actually waited on); everything before it was hidden
+        behind prefill compute on the remote worker."""
+        entry = self._pending.pop(seq_id, None)
+        self._assembly.pop(seq_id, None)
+        if entry is None:
+            return  # raced an abandonment; release was handled there
+        fut, block_ids, trace = entry
+        now = time.monotonic()
+        exposed = max(0.0, now - (asm.last_part_arrival or now))
+        hidden = max(0.0, asm.active_seconds - exposed)
+        self.kv_transfer_bytes_total += asm.bytes
+        self.kv_transfer_seconds_total += asm.active_seconds
+        self.kv_transfer_hidden_seconds_total += hidden
+        self.kv_transfer_streams_total += 1
+        if asm.span is not None:
+            asm.span.end(
+                bytes=asm.bytes, blocks=asm.blocks_received,
+                parts=len(asm.received), hidden_s=round(hidden, 6),
+            )
+            asm.span = None
+        if fut.cancelled():
+            self.engine.release_blocks(block_ids)
+        elif not fut.done():
+            fut.set_result(
+                (asm.first_token, asm.first_token_logprob, asm.first_token_top_logprobs)
+            )
+
+    def _est_transfer_seconds(self, n_tokens: int) -> float:
+        """Estimated inbound KV transfer time for a prompt, from measured
+        bandwidth (0.0 while unmeasured — never gate on a guess)."""
+        secs = self.kv_transfer_seconds_total
+        if secs <= 0 or self.kv_transfer_bytes_total <= 0:
+            return 0.0
+        if self._bytes_per_block is None:
+            import jax
+
+            self._bytes_per_block = sum(
+                leaf.nbytes // max(leaf.shape[1], 1)
+                for leaf in jax.tree.leaves(self.engine.cache)
+            )
+        blocks = self.engine.allocator.blocks_needed(n_tokens)
+        return blocks * self._bytes_per_block / (self.kv_transfer_bytes_total / secs)
+
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         pre = PreprocessedRequest.from_wire(request.data)
         queue_size = await self.queue.size()
-        if not self.router.prefill_remote(len(pre.token_ids), queue_size):
+        if not self.router.prefill_remote(
+            len(pre.token_ids), queue_size,
+            est_transfer_s=self._est_transfer_seconds(len(pre.token_ids)),
+        ):
             self.local_prefills += 1
             return await self.engine.generate(request)
 
@@ -306,8 +543,10 @@ class DisaggDecodeEngine:
         except (asyncio.TimeoutError, asyncio.CancelledError) as err:
             if self._pending.pop(seq_id, None) is not None:
                 # we still own the landing blocks — a transfer that arrives
-                # from here on finds no pending entry and is dropped
-                self.engine.release_blocks(block_ids)
+                # from here on finds no pending entry and is dropped.
+                # (_release_landing defers the actual free while a streamed
+                # part is mid-inject into these blocks)
+                self._release_landing(seq_id, block_ids)
             # else: _on_transfer claimed the entry; it observes the
             # cancelled future and releases the blocks itself
             if isinstance(err, asyncio.CancelledError):
@@ -326,9 +565,10 @@ class DisaggDecodeEngine:
             return await self.engine.generate(request)
         except Exception:
             # inject failed after the transfer claimed the entry; blocks
-            # were never handed to a sequence — release here
+            # were never handed to a sequence — release here (deferred if a
+            # sibling streamed part is still scattering into them)
             self._pending.pop(seq_id, None)
-            self.engine.release_blocks(block_ids)
+            self._release_landing(seq_id, block_ids)
             raise
         return await self.engine.generate_prefilled(
             request, block_ids, first_token, first_token_logprob=first_lp,
@@ -342,6 +582,27 @@ class DisaggDecodeEngine:
         stats["remote_prefill_timeouts"] = self.remote_prefill_timeouts
         stats["kv_transfer_bytes_total"] = self.kv_transfer_bytes_total
         stats["kv_transfer_seconds_total"] = self.kv_transfer_seconds_total
+        # canonical dyn_disagg_* names (ForwardPassMetrics → metrics service)
+        stats["disagg_remote_prefills_total"] = self.remote_prefills
+        stats["disagg_local_prefills_total"] = self.local_prefills
+        stats["disagg_prefill_timeouts_total"] = self.remote_prefill_timeouts
+        stats["disagg_kv_transfer_bytes_total"] = self.kv_transfer_bytes_total
+        stats["disagg_kv_transfer_seconds_total"] = self.kv_transfer_seconds_total
+        stats["disagg_kv_transfer_parts_total"] = self.kv_transfer_parts_total
+        stats["disagg_kv_transfer_hidden_seconds_total"] = (
+            self.kv_transfer_hidden_seconds_total
+        )
+        secs = self.kv_transfer_seconds_total
+        stats["disagg_transfer_hidden_ratio"] = (
+            self.kv_transfer_hidden_seconds_total / secs if secs > 0 else 0.0
+        )
+        # link characterization for the router's transfer-cost model:
+        # measured inbound bandwidth (bytes over decode-side inject-active
+        # seconds — a conservative floor for the link) + configured hop class
+        stats["transfer_hop"] = self.transfer_hop
+        stats["kv_transfer_bandwidth_bps"] = (
+            self.kv_transfer_bytes_total / secs if secs > 0 else 0.0
+        )
         return stats
 
 
@@ -349,7 +610,10 @@ class PrefillWorker:
     """Prefill-side pump: dequeue → prefill → ship KV → (decode worker
     continues).  One pump per prefill engine instance."""
 
-    def __init__(self, runtime: DistributedRuntime, engine: JaxLlmEngine, queue: PrefillQueue):
+    def __init__(
+        self, runtime: DistributedRuntime, engine: JaxLlmEngine,
+        queue: PrefillQueue, *, stream: bool | None = None,
+    ):
         self.runtime = runtime
         self.engine = engine
         self.queue = queue
@@ -357,6 +621,12 @@ class PrefillWorker:
         self._task: asyncio.Task | None = None
         self.prefills_done = 0
         self.stale_dropped = 0
+        # streamed multi-part transfer: ship completed chunks while later
+        # chunks compute.  None = DYN_KV_STREAM env gate; effective only
+        # when the engine actually chunks prefill (otherwise there is one
+        # chunk and the send degenerates to the single-part wire format).
+        self.stream = kv_stream_enabled() if stream is None else stream
+        self.kv_parts_sent_total = 0
         # tolerated cross-host clock disagreement: a dequeued item is only
         # dropped as stale once it is past its TTL by MORE than this margin,
         # so a skewed requester clock degrades to the occasional wasted
@@ -417,6 +687,7 @@ class PrefillWorker:
         return {
             "prefills_done": self.prefills_done,
             "stale_dropped": self.stale_dropped,
+            "kv_parts_sent_total": self.kv_parts_sent_total,
         }
 
     async def _handle(self, item: dict, queue_age_s: float | None = None) -> None:
@@ -442,25 +713,77 @@ class PrefillWorker:
         # block/transfer/strategy.rs:345): same-process destinations keep
         # blocks on device (ICI-class copy), remote ones stage to host
         local = item["transfer_address"] in LOCAL_SERVERS
+        address = item["transfer_address"]
+        dst_ids = item["dst_block_ids"]
+        # streamed transfer needs chunked prefill to have anything to
+        # overlap; without it the single-part send below is the whole story
+        streaming = self.stream and getattr(self.engine, "chunk_tokens", None) is not None
+        loop = asyncio.get_running_loop()
+        part_tasks: list[asyncio.Task] = []
+        parts_sent = 0
+        streamed_blocks = 0
+        bytes_sent = 0
+
+        def ship_part(payload: KvTransferPayload) -> None:
+            part_tasks.append(asyncio.ensure_future(self.client.send(address, payload)))
+
+        def on_chunk(start_b: int, leaves: dict, count: int) -> None:
+            # DEVICE thread: build the part payload and hand the send to the
+            # event loop.  call_soon_threadsafe is FIFO, so every part send
+            # is scheduled before prefill_extract's own resolve callback —
+            # the closing part below can never overtake an intermediate one
+            # into the task list.
+            nonlocal parts_sent, streamed_blocks, bytes_sent
+            payload = KvTransferPayload(
+                seq_id=item["seq_id"],
+                first_token=-1,  # only the closing part samples
+                block_ids=list(dst_ids[start_b : start_b + count]),
+                blocks=leaves,
+                part_index=parts_sent,
+                last=False,
+                block_start=start_b,
+            )
+            parts_sent += 1
+            streamed_blocks = start_b + count
+            bytes_sent += _payload_bytes(leaves)
+            loop.call_soon_threadsafe(ship_part, payload)
+
         try:
             first_token, first_lp, first_top, blocks, n = await self.engine.prefill_extract(
-                pre, device=local
+                pre, device=local, on_chunk=on_chunk if streaming else None
             )
+            # intermediate parts must have landed (or failed loudly) before
+            # the closing part marks the stream complete — a lost part with
+            # a delivered closing part would leave the decode side waiting
+            # on an index that never comes
+            if part_tasks:
+                await asyncio.gather(*part_tasks)
+            tail_start = min(streamed_blocks, n)
+            bytes_sent += _payload_bytes(blocks)
             await self.client.send(
-                item["transfer_address"],
+                address,
                 KvTransferPayload(
                     seq_id=item["seq_id"],
                     first_token=first_token,
                     first_token_logprob=first_lp,
                     first_token_top_logprobs=first_top,
-                    block_ids=item["dst_block_ids"][:n],
+                    block_ids=list(dst_ids[tail_start:n]),
                     blocks=blocks,
+                    part_index=parts_sent,
+                    last=True,
+                    block_start=tail_start,
                 ),
             )
         except BaseException as exc:
+            for t in part_tasks:
+                t.cancel()
+            if part_tasks:
+                # retrieve outcomes so failed sends don't log as unawaited
+                await asyncio.gather(*part_tasks, return_exceptions=True)
             if span is not None:
                 span.end(status="error", error=repr(exc))
             raise
+        self.kv_parts_sent_total += parts_sent + 1
         if span is not None:
-            span.end(bytes=_payload_bytes(blocks), blocks=n)
+            span.end(bytes=bytes_sent, blocks=n, parts=parts_sent + 1)
         self.prefills_done += 1  # actual prefills only, not dropped items

@@ -8,11 +8,17 @@ view, and scores workers as
     logit = overlap_weight * overlap_norm
           - usage_weight * cache_usage
           - waiting_weight * waiting_norm
+          - transfer_cost_weight * transfer_cost
 
 (reference: lib/llm/src/kv_router/scheduler.rs:248-330, weights
-kv_router.rs:59-82), picking the argmax with random tie-break.
+kv_router.rs:59-82), picking the argmax with random tie-break.  The
+``transfer_cost`` term is the normalized KV-transfer cost of the missing
+prefix blocks over the candidate's link (cost.TransferCostModel: ICI-vs-DCN
+hop class + measured bandwidth EWMA); it is zero until any worker's link
+has been characterized.
 """
 
+from dynamo_tpu.llm.kv_router.cost import LinkEstimate, TransferCostModel
 from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer, RadixTree
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -33,7 +39,9 @@ __all__ = [
     "KvRouter",
     "KvRouterConfig",
     "KvScheduler",
+    "LinkEstimate",
     "OverlapScores",
     "RadixTree",
     "RouterEvent",
+    "TransferCostModel",
 ]

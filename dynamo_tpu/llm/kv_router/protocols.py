@@ -94,6 +94,20 @@ class ForwardPassMetrics:
     prefetch_blocks_restored_total: int = 0
     prefetch_blocks_onboarded_total: int = 0
     offload_tiers: dict = field(default_factory=dict)
+    # disagg streamed KV transfer (llm/disagg.DisaggDecodeEngine): decode-side
+    # prefill routing outcomes + transfer totals, and the link fields the
+    # router's transfer-cost model consumes (hop class + measured inbound
+    # bandwidth; "" / 0.0 = uncharacterized)
+    disagg_remote_prefills_total: int = 0
+    disagg_local_prefills_total: int = 0
+    disagg_prefill_timeouts_total: int = 0
+    disagg_kv_transfer_bytes_total: int = 0
+    disagg_kv_transfer_seconds_total: float = 0.0
+    disagg_kv_transfer_hidden_seconds_total: float = 0.0
+    disagg_kv_transfer_parts_total: int = 0
+    disagg_transfer_hidden_ratio: float = 0.0
+    transfer_hop: str = ""
+    kv_transfer_bandwidth_bps: float = 0.0
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -157,6 +171,28 @@ class ForwardPassMetrics:
                 for tier, row in (stats.get("offload_tiers") or {}).items()
                 if isinstance(row, dict)
             },
+            disagg_remote_prefills_total=stats.get("disagg_remote_prefills_total", 0),
+            disagg_local_prefills_total=stats.get("disagg_local_prefills_total", 0),
+            disagg_prefill_timeouts_total=stats.get(
+                "disagg_prefill_timeouts_total", 0
+            ),
+            disagg_kv_transfer_bytes_total=stats.get(
+                "disagg_kv_transfer_bytes_total", 0
+            ),
+            disagg_kv_transfer_seconds_total=stats.get(
+                "disagg_kv_transfer_seconds_total", 0.0
+            ),
+            disagg_kv_transfer_hidden_seconds_total=stats.get(
+                "disagg_kv_transfer_hidden_seconds_total", 0.0
+            ),
+            disagg_kv_transfer_parts_total=stats.get(
+                "disagg_kv_transfer_parts_total", 0
+            ),
+            disagg_transfer_hidden_ratio=stats.get(
+                "disagg_transfer_hidden_ratio", 0.0
+            ),
+            transfer_hop=str(stats.get("transfer_hop", "") or ""),
+            kv_transfer_bandwidth_bps=stats.get("kv_transfer_bandwidth_bps", 0.0),
         )
 
 

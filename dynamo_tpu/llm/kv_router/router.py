@@ -8,6 +8,7 @@ lib/llm/src/kv_router.rs:104 KvRouter, :220 KvPushRouter).
 from __future__ import annotations
 
 import asyncio
+from dynamo_tpu.llm.kv_router.cost import TransferCostModel
 from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
 from dynamo_tpu.llm.kv_router.indexer import KvIndexer
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -43,6 +44,10 @@ class KvRouter:
         self.block_size = block_size
         self.indexer = KvIndexer()
         self.scheduler = KvScheduler(config)
+        # KV-locality/link-cost selection: fed link fields from the workers'
+        # load metrics (transfer_hop + measured inbound bandwidth); until any
+        # link is characterized, scheduling stays overlap/load-only
+        self.cost_model = TransferCostModel()
         self._subs = []
         self._tasks: list[asyncio.Task] = []
         # predictive prefetch (prefetch/forwarder.py): hints forwarded to
@@ -90,20 +95,34 @@ class KvRouter:
     async def _load_loop(self, sub) -> None:
         async for msg in sub:
             try:
-                self.scheduler.update_metrics(ForwardPassMetrics.from_json(msg.payload))
+                metrics = ForwardPassMetrics.from_json(msg.payload)
+                self.scheduler.update_metrics(metrics)
+                self.cost_model.update_from_metrics(metrics)
             except Exception:  # noqa: BLE001
                 logger.exception("bad load metrics")
 
     def remove_worker(self, worker_id: int) -> None:
         self.indexer.remove_worker(worker_id)
         self.scheduler.remove_worker(worker_id)
+        self.cost_model.remove_worker(worker_id)
 
     async def schedule(self, token_ids: list[int], worker_ids: list[int]) -> tuple[int, int]:
         """Pick a worker for a tokenized request.  Returns
         (worker_id, matched_prefix_blocks)."""
         hashes = compute_block_hashes(token_ids, self.block_size)
         overlap = self.indexer.find_matches(hashes)
-        worker_id, ratio = self.scheduler.select_worker(worker_ids, overlap, len(hashes))
+        costs = None
+        if self.cost_model.known():
+            # a candidate's transfer bill is the prefix blocks it does NOT
+            # already hold, priced by its link (hop prior or measured bps)
+            missing = {
+                wid: len(hashes) - overlap.scores.get(wid, 0)
+                for wid in worker_ids
+            }
+            costs = self.cost_model.costs(worker_ids, missing)
+        worker_id, ratio = self.scheduler.select_worker(
+            worker_ids, overlap, len(hashes), transfer_costs=costs
+        )
         matched = overlap.scores.get(worker_id, 0)
         # hit-rate observability event (best-effort)
         try:

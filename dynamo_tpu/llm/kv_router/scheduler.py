@@ -6,8 +6,13 @@ lib/llm/src/kv_router.rs:59-82):
     logit = overlap_weight * (matched_blocks / request_blocks)
           - usage_weight   * cache_usage
           - waiting_weight * (waiting / total_slots)
+          - transfer_cost_weight * transfer_cost        # 0 when unknown
 
-argmax with random tie-break.  Load comes from ForwardPassMetrics events
+argmax with random tie-break.  ``transfer_cost`` is the normalized
+KV-transfer cost of the candidate's missing prefix blocks over its link
+(kv_router/cost.TransferCostModel — NetKV-style selection); the router
+passes None until any link has been characterized, leaving selection
+exactly overlap/load-driven.  Load comes from ForwardPassMetrics events
 pushed by workers; staleness beyond ``metrics_ttl`` zeroes a worker's load
 contribution rather than excluding it (prefer availability).
 """
@@ -30,6 +35,11 @@ class KvRouterConfig:
     gpu_cache_usage_weight: float = 1.0
     waiting_requests_weight: float = 1.0
     metrics_ttl_s: float = 10.0
+    # weight on the normalized estimated KV-transfer cost of the missing
+    # prefix blocks over the candidate's link (ICI-vs-DCN hop class +
+    # measured bandwidth); only applies when the router's cost model has
+    # link information for at least one worker
+    transfer_cost_weight: float = 1.0
 
 
 class KvScheduler:
@@ -66,8 +76,12 @@ class KvScheduler:
         worker_ids: list[int],
         overlap: OverlapScores,
         request_blocks: int,
+        transfer_costs: dict[int, float] | None = None,
     ) -> tuple[int, float]:
-        """Returns (worker_id, matched_block_ratio_of_winner)."""
+        """Returns (worker_id, matched_block_ratio_of_winner).
+
+        ``transfer_costs``: normalized [0,1] per-candidate KV-transfer cost
+        (TransferCostModel.costs); None or a missing key contributes 0."""
         if not worker_ids:
             raise RuntimeError("no workers available")
         cfg = self.config
@@ -82,6 +96,8 @@ class KvScheduler:
                 - cfg.gpu_cache_usage_weight * usage
                 - cfg.waiting_requests_weight * waiting
             )
+            if transfer_costs is not None:
+                logit -= cfg.transfer_cost_weight * transfer_costs.get(wid, 0.0)
             if logit > best_logit + 1e-12:
                 best, best_logit = [wid], logit
             elif abs(logit - best_logit) <= 1e-12:

@@ -17,11 +17,17 @@ Paths, selected automatically per destination:
   Works across hosts and processes.
 
 Wire: header {seq_id, first_token, block_ids, parts} + payload bytes.
+Streamed transfers (FlowKV-style, arxiv 2504.03775) ship one frame per
+completed prefill chunk: the header additionally carries
+{part_index, last, block_start} and the final frame alone holds the
+sampled first token.  Legacy single-shot payloads are the degenerate
+one-part stream (part_index=0, last=True) and decode unchanged.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 from typing import Awaitable, Callable
 
@@ -62,6 +68,15 @@ class KvTransferPayload:
     first_token_logprob: float | None = None
     # [[token_id, logprob], ...] alternatives for first_token (when asked)
     first_token_top_logprobs: list | None = None
+    # streamed multi-part protocol: ``part_index`` orders the parts of one
+    # sequence's transfer, ``last`` marks the stream-closing part (the only
+    # one whose first_token* fields are meaningful — intermediates carry
+    # first_token=-1), ``block_start`` is the part's offset into the
+    # sequence's landing zone.  The defaults make every pre-existing
+    # single-shot payload a well-formed one-part stream.
+    part_index: int = 0
+    last: bool = True
+    block_start: int = 0
 
 
 class KvTransferServer:
@@ -124,6 +139,11 @@ class KvTransferServer:
                     first_token_top_logprobs=h.get("first_token_top_logprobs"),
                     block_ids=list(h["block_ids"]),
                     blocks=blocks,
+                    # mixed-version compat: a pre-streaming sender omits the
+                    # part fields — decode as a one-part stream
+                    part_index=int(h.get("part_index", 0)),
+                    last=bool(h.get("last", True)),
+                    block_start=int(h.get("block_start", 0)),
                 )
                 await self.sink(payload)
                 writer.write(encode_frame(TwoPartMessage(header={"ok": True, "seq_id": h["seq_id"]})))
@@ -134,11 +154,26 @@ class KvTransferServer:
             writer.close()
 
 
-class KvTransferClient:
-    """Prefill-worker side: pooled connections to decode workers."""
+# socket-class failures a pooled connection can hit mid-exchange: the
+# cached connection is garbage (peer restarted, idle reset by a middlebox)
+# but the payload is intact — evict and re-dial instead of failing the send
+_RETRYABLE = (ConnectionError, asyncio.IncompleteReadError, BrokenPipeError, OSError)
 
-    def __init__(self) -> None:
+
+class KvTransferClient:
+    """Prefill-worker side: pooled connections to decode workers.
+
+    Beyond pooling, the client measures each TCP exchange and keeps a
+    per-destination bandwidth EWMA — the measured half of the router's
+    transfer-cost model (hop class supplies the prior until a destination
+    has been observed)."""
+
+    def __init__(self, *, ewma_alpha: float = 0.25) -> None:
         self._conns: dict[str, tuple[asyncio.StreamReader, asyncio.StreamWriter, asyncio.Lock]] = {}
+        self._ewma_alpha = ewma_alpha
+        # address -> measured bytes/second EWMA over write→ack exchanges
+        self.bandwidth_bps: dict[str, float] = {}
+        self.evictions_total = 0
 
     async def _conn(self, address: str):
         entry = self._conns.get(address)
@@ -150,6 +185,24 @@ class KvTransferClient:
         self._conns[address] = entry
         return entry
 
+    def _evict(self, address: str, writer: asyncio.StreamWriter) -> None:
+        """Drop a broken pooled connection — only if the pool still holds
+        THIS writer (a concurrent sender may have re-dialed already)."""
+        writer.close()
+        entry = self._conns.get(address)
+        if entry is not None and entry[1] is writer:
+            del self._conns[address]
+            self.evictions_total += 1
+
+    def _observe(self, address: str, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 0:
+            return
+        bps = nbytes / seconds
+        prev = self.bandwidth_bps.get(address)
+        self.bandwidth_bps[address] = (
+            bps if prev is None else prev + self._ewma_alpha * (bps - prev)
+        )
+
     async def send(self, address: str, payload: KvTransferPayload) -> None:
         # chaos seam: a failed KV shipment (the decode side's prefill wait
         # times out and degrades to a local prefill)
@@ -158,7 +211,6 @@ class KvTransferClient:
         if local is not None:
             await local.deliver_local(payload)
             return
-        reader, writer, lock = await self._conn(address)
 
         # Host staging (layout copies + byte serialization of multi-MB KV
         # slices) runs OUTSIDE the per-connection lock and OFF the event
@@ -177,6 +229,9 @@ class KvTransferClient:
                 "first_token_logprob": payload.first_token_logprob,
                 "first_token_top_logprobs": payload.first_token_top_logprobs,
                 "block_ids": payload.block_ids,
+                "part_index": payload.part_index,
+                "last": payload.last,
+                "block_start": payload.block_start,
                 "parts": [
                     {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}
                     for n, a in zip(names, arrays)
@@ -186,14 +241,42 @@ class KvTransferClient:
 
         loop = asyncio.get_running_loop()
         header, body = await loop.run_in_executor(None, stage)
-        # only the write→ack round-trip holds the lock (frame interleaving
-        # on one socket is the one thing that must serialize)
-        async with lock:
-            writer.write(encode_frame(TwoPartMessage(header=header, payload=body)))
-            await writer.drain()
-            ack = await read_two_part(reader)
-            if ack is None or not ack.header.get("ok"):
+        frame = encode_frame(TwoPartMessage(header=header, payload=body))
+        last_err: Exception | None = None
+        for _attempt in range(2):
+            reader, writer, lock = await self._conn(address)
+            try:
+                # only the write→ack round-trip holds the lock (frame
+                # interleaving on one socket is the one thing that must
+                # serialize)
+                async with lock:
+                    t0 = time.perf_counter()
+                    writer.write(frame)
+                    await writer.drain()
+                    ack = await read_two_part(reader)
+                    elapsed = time.perf_counter() - t0
+            except _RETRYABLE as exc:
+                # pooled connection died under us (peer restart / reset):
+                # the payload never landed — evict and re-dial once
+                self._evict(address, writer)
+                last_err = exc
+                continue
+            if ack is None:
+                # clean EOF before the ack: same remedy as a reset
+                self._evict(address, writer)
+                last_err = ConnectionError(
+                    f"kv transfer to {address}: connection closed before ack"
+                )
+                continue
+            if not ack.header.get("ok"):
+                # the server SAW the frame and refused it — re-sending the
+                # same bytes cannot help; fail loudly
                 raise ConnectionError(f"kv transfer to {address} failed")
+            self._observe(address, len(body), elapsed)
+            return
+        raise ConnectionError(
+            f"kv transfer to {address} failed after re-dial: {last_err}"
+        )
 
     async def close(self) -> None:
         for _, writer, _ in self._conns.values():

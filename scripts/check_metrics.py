@@ -85,6 +85,21 @@ PREFETCH_FAMILIES = (
     "dyn_worker_offload_blocks_pinned",
 )
 
+# disagg streamed KV transfer (dynamo_tpu/llm/disagg.py via engine stats →
+# ForwardPassMetrics → metrics service): routing outcomes, transfer totals,
+# and the hidden-fraction headline
+DISAGG_FAMILIES = (
+    "dyn_disagg_remote_prefills_total",
+    "dyn_disagg_local_prefills_total",
+    "dyn_disagg_prefill_timeouts_total",
+    "dyn_disagg_kv_transfer_bytes_total",
+    "dyn_disagg_kv_transfer_seconds_total",
+    "dyn_disagg_kv_transfer_hidden_seconds_total",
+    "dyn_disagg_kv_transfer_parts_total",
+    "dyn_disagg_transfer_hidden_ratio",
+    "dyn_disagg_kv_transfer_bandwidth_bps",
+)
+
 # ragged unified-batch step (engine unified_batch knob → engine stats →
 # ForwardPassMetrics → metrics service)
 UNIFIED_FAMILIES = (
@@ -114,7 +129,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

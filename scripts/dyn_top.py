@@ -51,6 +51,9 @@ WORKER_FIELDS = {
     "dyn_prefetch_misses_total": "prefetch_misses",
     "dyn_prefetch_stale_total": "prefetch_stale",
     "dyn_prefetch_hidden_seconds": "prefetch_hidden_seconds",
+    "dyn_disagg_remote_prefills_total": "disagg_remote_prefills",
+    "dyn_disagg_kv_transfer_parts_total": "disagg_kv_transfer_parts",
+    "dyn_disagg_transfer_hidden_ratio": "disagg_transfer_hidden_ratio",
 }
 
 # offload-tier occupancy gauges carry a second label (tier) and nest under
@@ -217,7 +220,8 @@ def render_table(snap: dict) -> str:
         lines.append(
             f"  {'WORKER':<10} {'MFU':>7} {'BW':>7} {'GOODPUT/s':>10} "
             f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} "
-            f"{'WASTED':>8} {'PF-HIT':>7} {'UNI':>6} {'DRAIN':>6}"
+            f"{'WASTED':>8} {'PF-HIT':>7} {'UNI':>6} {'DRAIN':>6} "
+            f"{'XFER-HID':>8}"
         )
         for wid in sorted(workers):
             r = workers[wid]
@@ -231,7 +235,8 @@ def render_table(snap: dict) -> str:
                 f"{_num(r.get('preemptions'), 8)} {_num(r.get('wasted_tokens'), 8)} "
                 f"{_pct(r.get('prefetch_hit_ratio')):>7} "
                 f"{_num(r.get('unified_windows'), 6)} "
-                f"{_num(r.get('admission_drains'), 6)}"
+                f"{_num(r.get('admission_drains'), 6)} "
+                f"{_pct(r.get('disagg_transfer_hidden_ratio') if r.get('disagg_remote_prefills') else None):>8}"
             )
             tiers = r.get("offload_tiers") or {}
             if tiers:

@@ -1,0 +1,38 @@
+"""Scaled-down disagg bench smoke (the DISAGG_BENCH gate for the streamed
+transfer path): real engines, real queue/transfer plane — asserting the
+mechanism (parts shipped, hidden time accounted, fleet routed near), not
+CPU timings."""
+
+from types import SimpleNamespace
+
+from dynamo_tpu.bench.disagg_bench import run
+
+ARGS = SimpleNamespace(
+    model="tiny", quant="none", kv_dtype="bf16",
+    isl=24, osl=8, batch=4, requests=4,
+)
+
+
+async def test_streamed_ab_and_fleet_sections():
+    result = await run(ARGS)
+    assert "skipped" not in result
+    assert result["disagg"]["all_prefills_remote"]
+
+    ab = result["streamed_ab"]
+    # single-shot: one part per request, nothing overlapped
+    assert ab["single_shot"]["kv_parts"] == ARGS.requests
+    assert ab["single_shot"]["transfer_hidden_fraction"] == 0.0
+    # streamed: chunked prefill (isl 24, chunk 8) ships 3 parts per request
+    # and moves inject time off the TTFT critical path
+    assert ab["streamed"]["kv_parts"] == 3 * ARGS.requests
+    assert ab["streamed"]["transfer_hidden_fraction"] > 0.0
+    assert ab["streamed"]["ttft_p50_ms"] > 0
+
+    fleet = result["fleet"]
+    # the near candidate holds the shared prefix AND the cheap link: the
+    # KV-locality/link-cost scorer must send every request its way
+    assert fleet["preferred_is_near"]
+    assert fleet["near"]["picks"] == ARGS.requests
+    assert fleet["far"]["picks"] == 0
+    assert fleet["near"]["overlap_blocks"] > 0
+    assert fleet["ttft_p50_ms"] > 0

@@ -581,7 +581,10 @@ async def test_remote_prefill_timeout_falls_back_to_local(monkeypatch):
                 await asyncio.sleep(0.02)
             assert worker.stale_dropped == 1
             assert worker.prefills_done == 0
-            assert worker.stats() == {"prefills_done": 0, "stale_dropped": 1}
+            assert worker.stats() == {
+                "prefills_done": 0, "stale_dropped": 1,
+                "kv_parts_sent_total": 0,
+            }
         finally:
             await worker.stop()
             prefill_engine.stop()

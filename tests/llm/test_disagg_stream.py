@@ -1,0 +1,396 @@
+"""Streamed (multi-part) KV transfer for disagg: decode parity vs the
+single-shot path must be byte-identical, hidden-time accounting must credit
+the overlapped parts, and the decode-side assembly must tolerate the wire's
+failure modes — out-of-order parts, duplicates, mixed-version senders, and
+a requester abandoning the stream while a part is mid-inject."""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    DisaggRouter,
+    PrefillQueue,
+    PrefillWorker,
+    kv_stream_enabled,
+)
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.parallel.kv_transfer import KvTransferPayload
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+from tests.engine.test_jax_engine import greedy_reference
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+def make_engine(**overrides):
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=CFG, num_blocks=64, block_size=4, max_batch_size=4,
+            prefill_buckets=(16, 32), max_model_len=64, **overrides,
+        ),
+        params=PARAMS,
+    )
+    engine.start()
+    return engine
+
+
+def request(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens),
+        eos_token_ids=[1],
+    ).to_wire()
+
+
+async def collect(stream):
+    tokens = []
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is not None:
+            tokens.extend(ann.data.token_ids)
+    return tokens
+
+
+def leaves_for(engine, n_blocks: int) -> dict:
+    return {
+        k: np.zeros((v.shape[0], n_blocks, *v.shape[2:]), np.float32)
+        for k, v in dict(engine.cache).items()
+    }
+
+
+async def test_streamed_parity_and_hidden_accounting():
+    """Chunked prefill (24 tokens, chunk 8 → 2 intermediate parts + the
+    closing part) over forced TCP: output must equal the single-engine
+    greedy reference AND the single-shot transfer of the same prompt, with
+    the intermediate parts' inject time accounted as hidden."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dstream1"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine(prefill_chunk_tokens=8)
+    disagg = prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-stream", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS.pop(disagg.transfer_server.address, None)  # force TCP
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue, stream=True)
+        prefill_worker.start()
+
+        prompt = list(range(3, 27))  # 24 tokens, 6 blocks
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        streamed_tokens = await collect(stream)
+
+        ref = greedy_reference(prompt, 6)
+        assert streamed_tokens == ref, f"streamed {streamed_tokens} != ref {ref}"
+        assert disagg.remote_prefills == 1
+        # 24-token prompt / 8-token chunks: parts 0,1 ship blocks 0-1 and
+        # 2-3 mid-prefill; the closing part carries the tail + first token
+        assert prefill_worker.kv_parts_sent_total == 3
+        assert disagg.kv_transfer_parts_total == 3
+        assert disagg.kv_transfer_streams_total == 1
+        assert disagg.kv_transfer_duplicate_parts_total == 0
+        # the worker gathers intermediate acks BEFORE sending the closing
+        # part, so parts 0 and 1 were fully injected before the exposure
+        # window even opened — their inject time is hidden by construction
+        assert disagg.kv_transfer_hidden_seconds_total > 0
+        stats = disagg.stats()
+        assert 0 < stats["disagg_transfer_hidden_ratio"] <= 1
+        assert stats["disagg_kv_transfer_parts_total"] == 3
+        assert stats["kv_transfer_bandwidth_bps"] > 0
+
+        # single-shot leg of the parity claim: same prompt, stream off —
+        # byte-identical decode
+        await prefill_worker.stop()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue, stream=False)
+        prefill_worker.start()
+        single_tokens = await collect(
+            await disagg.generate(Context(request(prompt, max_tokens=6)))
+        )
+        assert single_tokens == streamed_tokens
+        assert disagg.kv_transfer_streams_total == 2
+        assert disagg.kv_transfer_parts_total == 4  # one part for leg two
+        # single-shot hides nothing: the hidden total did not move
+        assert (disagg.stats()["disagg_kv_transfer_hidden_seconds_total"]
+                == disagg.kv_transfer_hidden_seconds_total)
+
+        # both engines drain clean
+        assert prefill_engine.allocator.used_blocks == 0
+        for _ in range(100):
+            if decode_engine.allocator.used_blocks == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert decode_engine.allocator.used_blocks == 0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
+async def test_kv_stream_env_gate(monkeypatch):
+    """DYN_KV_STREAM gates the worker default; an explicit ``stream=``
+    argument wins over the env."""
+    monkeypatch.setenv("DYN_KV_STREAM", "0")
+    assert not kv_stream_enabled()
+    worker = PrefillWorker(None, None, None)
+    assert worker.stream is False
+    await worker.stop()
+    monkeypatch.setenv("DYN_KV_STREAM", "1")
+    assert kv_stream_enabled()
+    worker = PrefillWorker(None, None, None, stream=False)
+    assert worker.stream is False
+    await worker.stop()
+    monkeypatch.delenv("DYN_KV_STREAM")
+    assert kv_stream_enabled()  # default on
+
+
+async def test_streamed_fallback_when_stream_disabled():
+    """DYN_KV_STREAM=0-style worker against a chunked prefill engine:
+    everything arrives as one legacy part and decode still matches."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dstream0"))
+    decode_engine = make_engine()
+    prefill_engine = make_engine(prefill_chunk_tokens=8)
+    disagg = prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-nostream", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue, stream=False)
+        prefill_worker.start()
+
+        prompt = list(range(3, 27))
+        tokens = await collect(
+            await disagg.generate(Context(request(prompt, max_tokens=6)))
+        )
+        assert tokens == greedy_reference(prompt, 6)
+        assert prefill_worker.kv_parts_sent_total == 1
+        assert disagg.kv_transfer_parts_total == 1
+        assert disagg.kv_transfer_hidden_seconds_total == 0.0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
+async def test_mixed_version_payloads_through_one_sink():
+    """The same ``_on_transfer`` sink serves a legacy (pre-streaming)
+    single-part sender and a multi-part stream: the legacy payload takes
+    the atomic pop-claim path, the stream assembles."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dmixed"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-mixed", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+        loop = asyncio.get_running_loop()
+
+        # legacy single-part (field defaults = one-part stream)
+        legacy_blocks = engine.reserve_blocks(8)
+        fut1 = loop.create_future()
+        disagg._pending["legacy"] = (fut1, legacy_blocks, None)
+        await disagg._on_transfer(KvTransferPayload(
+            seq_id="legacy", first_token=7,
+            block_ids=legacy_blocks[:2], blocks=leaves_for(engine, 2),
+        ))
+        assert fut1.result()[0] == 7
+        assert disagg.kv_transfer_streams_total == 1
+        assert not disagg._assembly
+
+        # multi-part stream for a different sequence, through the same sink
+        stream_blocks = engine.reserve_blocks(8)
+        fut2 = loop.create_future()
+        disagg._pending["streamy"] = (fut2, stream_blocks, None)
+        await disagg._on_transfer(KvTransferPayload(
+            seq_id="streamy", first_token=-1,
+            block_ids=stream_blocks[:1], blocks=leaves_for(engine, 1),
+            part_index=0, last=False, block_start=0,
+        ))
+        assert not fut2.done()  # stream open until the closing part lands
+        assert "streamy" in disagg._assembly
+        await disagg._on_transfer(KvTransferPayload(
+            seq_id="streamy", first_token=9,
+            block_ids=stream_blocks[1:2], blocks=leaves_for(engine, 1),
+            part_index=1, last=True, block_start=1,
+        ))
+        assert fut2.result()[0] == 9
+        assert disagg.kv_transfer_streams_total == 2
+        assert not disagg._assembly and not disagg._pending
+        engine.release_blocks(legacy_blocks)
+        engine.release_blocks(stream_blocks)
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
+
+
+async def test_out_of_order_and_duplicate_parts():
+    """Parts may arrive out of order (re-dialed connections race) and
+    duplicated (re-send after a lost ack): completion waits for every index
+    0..last to be INJECTED, duplicates are dropped and counted."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dooo"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-ooo", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+        blocks = engine.reserve_blocks(12)
+        fut = asyncio.get_running_loop().create_future()
+        disagg._pending["ooo"] = (fut, blocks, None)
+
+        def part(idx: int, last: bool) -> KvTransferPayload:
+            return KvTransferPayload(
+                seq_id="ooo", first_token=42 if last else -1,
+                block_ids=blocks[idx : idx + 1], blocks=leaves_for(engine, 1),
+                part_index=idx, last=last, block_start=idx,
+            )
+
+        await disagg._on_transfer(part(2, last=True))   # closing part FIRST
+        assert not fut.done()
+        await disagg._on_transfer(part(0, last=False))
+        assert not fut.done()
+        await disagg._on_transfer(part(0, last=False))  # duplicate delivery
+        assert disagg.kv_transfer_duplicate_parts_total == 1
+        assert not fut.done()
+        await disagg._on_transfer(part(1, last=False))  # final missing index
+        assert fut.result()[0] == 42
+        assert disagg.kv_transfer_parts_total == 3  # duplicate not counted
+        assert not disagg._assembly
+        engine.release_blocks(blocks)
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
+
+
+async def test_abandoned_mid_inject_defers_release(monkeypatch):
+    """The requester abandons (timeout path) while a part is INSIDE
+    inject_blocks: the landing blocks must stay reserved until that inject
+    drains, then be released exactly once by the last writer out."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dabandon"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-abandon", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+        baseline = engine.allocator.used_blocks
+        blocks = engine.reserve_blocks(8)
+        reserved = engine.allocator.used_blocks
+        fut = asyncio.get_running_loop().create_future()
+        disagg._pending["aband"] = (fut, blocks, None)
+
+        gate = asyncio.Event()
+        entered = asyncio.Event()
+
+        async def slow_inject(block_ids, leaves):
+            entered.set()
+            await gate.wait()
+
+        monkeypatch.setattr(engine, "inject_blocks", slow_inject)
+        task = asyncio.ensure_future(disagg._on_transfer(KvTransferPayload(
+            seq_id="aband", first_token=-1,
+            block_ids=blocks[:1], blocks=leaves_for(engine, 1),
+            part_index=0, last=False, block_start=0,
+        )))
+        await entered.wait()
+
+        # timeout path: requester pops the entry and releases — which must
+        # DEFER while the part above is mid-scatter
+        assert disagg._pending.pop("aband") is not None
+        disagg._release_landing("aband", blocks)
+        assert engine.allocator.used_blocks == reserved  # still reserved
+
+        gate.set()
+        await task
+        assert engine.allocator.used_blocks == baseline  # freed exactly once
+        assert not disagg._assembly
+
+        # a straggler part after the cleanup is dropped harmlessly
+        await disagg._on_transfer(KvTransferPayload(
+            seq_id="aband", first_token=9,
+            block_ids=blocks[1:2], blocks=leaves_for(engine, 1),
+            part_index=1, last=True, block_start=1,
+        ))
+        assert engine.allocator.used_blocks == baseline
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()
+
+
+async def test_part_inject_failure_surfaces_to_requester(monkeypatch):
+    """An inject failure on a streamed part must wake the requester with
+    the exception (its generate() path then releases the landing zone)."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(RuntimeConfig(control_plane="memory://dfail"))
+    engine = make_engine()
+    disagg = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-fail", "backend")
+        disagg = DisaggDecodeEngine(rt, engine, router, queue)
+        await disagg.start()
+        blocks = engine.reserve_blocks(8)
+        fut = asyncio.get_running_loop().create_future()
+        disagg._pending["boom"] = (fut, blocks, None)
+
+        async def broken_inject(block_ids, leaves):
+            raise RuntimeError("scatter failed")
+
+        monkeypatch.setattr(engine, "inject_blocks", broken_inject)
+        await disagg._on_transfer(KvTransferPayload(
+            seq_id="boom", first_token=-1,
+            block_ids=blocks[:1], blocks=leaves_for(engine, 1),
+            part_index=0, last=False, block_start=0,
+        ))
+        with pytest.raises(RuntimeError, match="scatter failed"):
+            fut.result()
+        assert "boom" not in disagg._pending
+        # the requester's except path performs the release
+        disagg._release_landing("boom", blocks)
+        assert not disagg._assembly
+    finally:
+        if disagg:
+            await disagg.stop()
+        engine.stop()
+        await rt.close()

@@ -13,7 +13,9 @@ from dynamo_tpu.llm.kv_router import (
     KvRouter,
     KvRouterConfig,
     KvScheduler,
+    LinkEstimate,
     RadixTree,
+    TransferCostModel,
     compute_block_hashes,
 )
 from dynamo_tpu.llm.kv_router.protocols import (
@@ -148,6 +150,79 @@ def test_scheduler_random_tiebreak_spreads():
     sched = KvScheduler(rng=random.Random(0))
     seen = {sched.select_worker([1, 2, 3], OverlapScores(), 1)[0] for _ in range(50)}
     assert seen == {1, 2, 3}
+
+
+# ---------------------------------------------------------------------------
+# transfer-cost model (NetKV-style link-aware selection)
+# ---------------------------------------------------------------------------
+
+
+def test_transfer_cost_breaks_tie_toward_cheap_link():
+    sched = KvScheduler(rng=random.Random(0))
+    overlap = OverlapScores(scores={1: 2, 2: 2}, total_blocks=4)
+    # without costs the tie is broken at random across many draws
+    seen = {sched.select_worker([1, 2], overlap, 4)[0] for _ in range(30)}
+    assert seen == {1, 2}
+    # with costs the cheap-link candidate wins every draw
+    costs = {1: 0.0, 2: 1.0}
+    picks = {
+        sched.select_worker([1, 2], overlap, 4, transfer_costs=costs)[0]
+        for _ in range(30)
+    }
+    assert picks == {1}
+
+
+def test_cheap_link_outweighs_slightly_better_overlap():
+    """The NetKV trade: a candidate with marginally more prefix overlap but
+    a DCN-class link loses to one slightly colder behind ICI — shipping 4
+    blocks over DCN costs more latency than recomputing one block's worth
+    of overlap advantage."""
+    sched = KvScheduler(KvRouterConfig(overlap_score_weight=2.0), rng=random.Random(0))
+    model = TransferCostModel()
+    model.update_link(1, hop="ici")
+    model.update_link(2, hop="dcn")
+    assert model.known()
+    overlap = OverlapScores(scores={1: 3, 2: 4}, total_blocks=8)
+    missing = {1: 8 - 3, 2: 8 - 4}
+    costs = model.costs([1, 2], missing)
+    # dcn is 10x slower: even with fewer missing blocks it is the dear link
+    assert costs[2] == 1.0 and costs[1] < 0.2
+    # overlap alone would pick worker 2...
+    assert sched.select_worker([1, 2], overlap, 8)[0] == 2
+    # ...the cost-folded logit picks worker 1
+    assert sched.select_worker([1, 2], overlap, 8, transfer_costs=costs)[0] == 1
+
+
+def test_cost_model_priors_measurement_and_gating():
+    model = TransferCostModel(ewma_alpha=0.25)
+    # unknown workers score against the worst-case (DCN) prior and the
+    # model stays un-"known" — selection must not shift on uniform noise
+    assert not model.known()
+    assert model.bandwidth_bps(7) == LinkEstimate(hop="dcn").bandwidth_bps()
+    assert model.costs([1, 2], {1: 4, 2: 4}) == {1: 1.0, 2: 1.0}
+    assert model.costs([1, 2], {1: 0, 2: 0}) == {1: 0.0, 2: 0.0}
+
+    # hop prior → measured EWMA → metrics ingestion
+    model.update_link(1, hop="ici")
+    assert model.known()
+    model.observe_transfer(1, nbytes=100, seconds=1.0)
+    assert model.bandwidth_bps(1) == 100.0
+    model.observe_transfer(1, nbytes=200, seconds=1.0)
+    assert model.bandwidth_bps(1) == pytest.approx(125.0)
+    model.observe_transfer(1, nbytes=0, seconds=1.0)  # degenerate: ignored
+    assert model.bandwidth_bps(1) == pytest.approx(125.0)
+    model.update_from_metrics(ForwardPassMetrics(
+        worker_id=2, transfer_hop="ici", kv_transfer_bandwidth_bps=500.0,
+    ))
+    assert model.bandwidth_bps(2) == 500.0
+    assert model.estimate_seconds(2, 1000) == pytest.approx(2.0)
+    # a metrics snapshot with no link info must not mark the worker known
+    model.update_from_metrics(ForwardPassMetrics(worker_id=9))
+    assert model.bandwidth_bps(9) == LinkEstimate(hop="dcn").bandwidth_bps()
+
+    model.remove_worker(1)
+    model.remove_worker(2)
+    assert not model.known()
 
 
 # ---------------------------------------------------------------------------

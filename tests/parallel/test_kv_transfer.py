@@ -1,17 +1,20 @@
 """KvTransferClient/Server over real TCP: payload integrity through the
 staged send path (host staging now runs in an executor OUTSIDE the
-per-connection lock, so concurrent shipments to one worker pipeline), and
-the same-process local short-cut."""
+per-connection lock, so concurrent shipments to one worker pipeline), the
+same-process local short-cut, the streamed multi-part wire fields, and the
+pool's evict+re-dial hardening."""
 
 import asyncio
 
 import numpy as np
+import pytest
 
 from dynamo_tpu.parallel.kv_transfer import (
     KvTransferClient,
     KvTransferPayload,
     KvTransferServer,
 )
+from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
 
 
 def payload(i: int) -> KvTransferPayload:
@@ -59,6 +62,131 @@ async def test_concurrent_sends_over_tcp_arrive_intact():
             assert got.first_token_logprob == p.first_token_logprob
             for name, arr in p.blocks.items():
                 np.testing.assert_array_equal(got.blocks[name], np.ascontiguousarray(arr))
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_multipart_fields_roundtrip_over_tcp():
+    """Streamed parts carry part_index/last/block_start through the codec;
+    the closing part alone holds the sampled first token."""
+    received: list[KvTransferPayload] = []
+
+    async def sink(p: KvTransferPayload) -> None:
+        received.append(p)
+
+    server = KvTransferServer(sink)
+    await server.start()
+    from dynamo_tpu.parallel import kv_transfer as mod
+
+    mod.LOCAL_SERVERS.pop(server.address, None)
+    client = KvTransferClient()
+    try:
+        rng = np.random.default_rng(0)
+        for idx, last in ((0, False), (1, False), (2, True)):
+            await client.send(server.address, KvTransferPayload(
+                seq_id="stream-1",
+                first_token=42 if last else -1,
+                block_ids=[idx * 2, idx * 2 + 1],
+                blocks={"k": rng.standard_normal((2, 2, 4)).astype(np.float32)},
+                part_index=idx,
+                last=last,
+                block_start=idx * 2,
+            ))
+        assert [p.part_index for p in received] == [0, 1, 2]
+        assert [p.last for p in received] == [False, False, True]
+        assert [p.block_start for p in received] == [0, 2, 4]
+        assert [p.first_token for p in received] == [-1, -1, 42]
+    finally:
+        await client.close()
+        await server.stop()
+
+
+async def test_send_redials_after_peer_drops_first_connection():
+    """A pooled connection the peer drops before acking is evicted and the
+    send retried over a fresh dial — the payload still lands exactly once."""
+    received: list[KvTransferPayload] = []
+
+    async def sink(p: KvTransferPayload) -> None:
+        received.append(p)
+
+    inner = KvTransferServer(sink)  # only its _handle protocol loop is used
+    state = {"dropped": 0}
+
+    async def handler(reader, writer):
+        if state["dropped"] == 0:
+            state["dropped"] += 1
+            writer.close()
+            return
+        await inner._handle(reader, writer)
+
+    server = await asyncio.start_server(handler, "127.0.0.1", 0)
+    address = f"127.0.0.1:{server.sockets[0].getsockname()[1]}"
+    client = KvTransferClient()
+    try:
+        await client.send(address, payload(1))
+        assert state["dropped"] == 1
+        assert client.evictions_total == 1
+        assert [p.seq_id for p in received] == ["seq-1"]
+        # the re-dialed connection is pooled and healthy: next send reuses it
+        await client.send(address, payload(2))
+        assert client.evictions_total == 1
+        assert len(received) == 2
+    finally:
+        await client.close()
+        server.close()
+        await server.wait_closed()
+
+
+async def test_refused_ack_is_not_retried():
+    """A server that SAW the frame and refused it gets no re-send — the
+    same bytes cannot succeed, and blind retry would double-inject."""
+    conns = {"n": 0}
+
+    async def handler(reader, writer):
+        conns["n"] += 1
+        await read_two_part(reader)
+        writer.write(encode_frame(TwoPartMessage(header={"ok": False})))
+        await writer.drain()
+
+    server = await asyncio.start_server(handler, "127.0.0.1", 0)
+    address = f"127.0.0.1:{server.sockets[0].getsockname()[1]}"
+    client = KvTransferClient()
+    try:
+        with pytest.raises(ConnectionError, match="failed"):
+            await client.send(address, payload(3))
+        assert conns["n"] == 1
+        assert client.evictions_total == 0
+    finally:
+        await client.close()
+        server.close()
+        await server.wait_closed()
+
+
+async def test_bandwidth_ewma():
+    """Successful TCP exchanges feed the per-destination bandwidth EWMA
+    (the measured half of the router's transfer-cost model)."""
+    client = KvTransferClient(ewma_alpha=0.25)
+    client._observe("w:1", 100, 1.0)
+    assert client.bandwidth_bps["w:1"] == 100.0
+    client._observe("w:1", 200, 1.0)
+    assert client.bandwidth_bps["w:1"] == pytest.approx(125.0)
+    # degenerate observations never poison the estimate
+    client._observe("w:1", 0, 1.0)
+    client._observe("w:1", 100, 0.0)
+    assert client.bandwidth_bps["w:1"] == pytest.approx(125.0)
+
+    async def sink(p: KvTransferPayload) -> None:
+        pass
+
+    server = KvTransferServer(sink)
+    await server.start()
+    from dynamo_tpu.parallel import kv_transfer as mod
+
+    mod.LOCAL_SERVERS.pop(server.address, None)
+    try:
+        await client.send(server.address, payload(0))
+        assert client.bandwidth_bps[server.address] > 0
     finally:
         await client.close()
         await server.stop()
