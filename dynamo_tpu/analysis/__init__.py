@@ -1,0 +1,91 @@
+"""dynlint — project-native static analysis for dynamo-tpu.
+
+Five AST passes purpose-built for this codebase's failure surfaces (silent
+asyncio bugs, JAX hot-path hazards, knob/doc drift, metric-name drift), run
+as a tier-1 gate with a baseline ratchet.  See docs/analysis.md for the pass
+catalog, suppression syntax, and the ratchet workflow; scripts/dynlint.py is
+the CLI.
+
+Stdlib-only on purpose: the gate must run without importing the package
+under analysis (no JAX, no prometheus_client).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from dynamo_tpu.analysis import (
+    async_hygiene,
+    jit_purity,
+    knob_registry,
+    lock_discipline,
+    metric_names,
+)
+from dynamo_tpu.analysis.core import (
+    ASYNC_HYGIENE,
+    BASELINE_NAME,
+    JIT_PURITY,
+    KNOB_REGISTRY,
+    LOCK_DISCIPLINE,
+    METRIC_NAMES,
+    PASS_IDS,
+    SUMMARY_NAME,
+    Context,
+    Finding,
+    apply_pragmas,
+    diff_baseline,
+    fingerprints,
+    load_baseline,
+    load_modules,
+    write_baseline,
+)
+
+PASSES = {
+    ASYNC_HYGIENE: async_hygiene.run,
+    LOCK_DISCIPLINE: lock_discipline.run,
+    JIT_PURITY: jit_purity.run,
+    KNOB_REGISTRY: knob_registry.run,
+    METRIC_NAMES: metric_names.run,
+}
+
+DEFAULT_ROOTS = ("dynamo_tpu", "scripts")
+
+
+def analyze(
+    repo_root: Path, roots: tuple[str, ...] = DEFAULT_ROOTS,
+    passes: tuple[str, ...] | None = None,
+) -> tuple[list[Finding], dict]:
+    """Run the selected passes; -> (pragma-filtered findings, summary dict).
+
+    The summary carries per-pass found/suppressed counts — the artifact CI
+    diffs across PRs the way SCENARIO_SOAK.json diffs soak results.
+    """
+    modules, load_findings = load_modules(repo_root, list(roots))
+    ctx = Context(repo_root=Path(repo_root), modules=modules)
+    raw: list[Finding] = list(load_findings)
+    selected = passes or tuple(PASSES)
+    per_pass_found: dict[str, int] = {}
+    for pass_id in selected:
+        produced = PASSES[pass_id](ctx)
+        per_pass_found[pass_id] = len(produced)
+        raw.extend(produced)
+    findings, suppressed = apply_pragmas(modules, raw)
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.rule))
+    summary = {
+        "files_scanned": len(modules),
+        "findings": len(findings),
+        "suppressed": suppressed,
+        "per_pass": {
+            pass_id: sum(1 for f in findings if f.pass_id == pass_id)
+            for pass_id in (*selected, "pragma")
+        },
+        "per_pass_pre_suppression": per_pass_found,
+    }
+    return findings, summary
+
+
+__all__ = [
+    "PASSES", "PASS_IDS", "DEFAULT_ROOTS", "BASELINE_NAME", "SUMMARY_NAME",
+    "Context", "Finding", "analyze", "apply_pragmas", "diff_baseline",
+    "fingerprints", "load_baseline", "load_modules", "write_baseline",
+]

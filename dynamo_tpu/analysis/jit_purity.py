@@ -1,0 +1,140 @@
+"""jit-purity pass: host syncs and impure Python inside jit-reachable code.
+
+Builds, per module, the set of *jit roots* — functions decorated with
+``@jax.jit`` / ``@partial(jax.jit, ...)`` or wrapped via
+``name = jax.jit(fn)`` — then walks the intra-module call graph reachable
+from them (plain ``f(...)`` and ``self.f(...)`` edges) and flags operations
+that force a device→host sync or break tracing purity:
+
+- ``.item()`` / ``.tolist()`` — forces a blocking device readback; inside a
+  jitted trace it is an escape hatch that either fails or silently falls
+  back to eager;
+- ``jax.device_get`` / ``.block_until_ready()`` — explicit host syncs;
+- ``np.asarray`` / ``np.array`` / ``np.frombuffer`` on a tracer — silently
+  materializes on host and constant-folds into the compiled graph;
+- ``print`` and ``time.time``-family calls — trace-time side effects that
+  fire once per *compile*, not per step, which is never what the author
+  meant in a step function.
+
+The decode retire/readback seams in ``engine/engine.py`` legitimately sync —
+they are host-side; suppress with an inline pragma (disable=jit-purity plus
+a reason) where the call graph cannot see the jit boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dynamo_tpu.analysis.core import Context, Finding, JIT_PURITY, Module
+
+JIT_WRAPPERS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+HOST_SYNC_METHODS = {
+    "item": "`.item()` forces a blocking device->host readback",
+    "tolist": "`.tolist()` forces a blocking device->host readback",
+    "block_until_ready": "`.block_until_ready()` is an explicit host sync",
+}
+HOST_SYNC_DOTTED = {
+    "jax.device_get": "`jax.device_get` is an explicit host sync",
+    "numpy.asarray": "`np.asarray` on a tracer materializes it on host",
+    "numpy.array": "`np.array` on a tracer materializes it on host",
+    "numpy.frombuffer": "`np.frombuffer` inside jitted code is host-only",
+}
+TRACE_TIME_EFFECTS = {
+    "print": "`print` inside jitted code fires at trace time, once per compile",
+    "time.time": "`time.time` inside jitted code is evaluated at trace time",
+    "time.perf_counter": "`time.perf_counter` inside jitted code is evaluated at trace time",
+    "time.monotonic": "`time.monotonic` inside jitted code is evaluated at trace time",
+}
+
+
+def _is_jit_expr(mod: Module, node: ast.AST) -> bool:
+    """True for ``jax.jit``, ``partial(jax.jit, ...)`` and ``jax.jit(...)``
+    used as a decorator expression."""
+    if mod.dotted(node) in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        dotted = mod.dotted(node.func)
+        if dotted in JIT_WRAPPERS:
+            return True
+        if dotted in PARTIAL_NAMES and node.args and _is_jit_expr(mod, node.args[0]):
+            return True
+    return False
+
+
+def _collect(mod: Module) -> tuple[dict[str, ast.AST], set[str]]:
+    """-> (function name -> def node, jit root names)."""
+    functions: dict[str, ast.AST] = {}
+    roots: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            functions.setdefault(node.name, node)
+            if any(_is_jit_expr(mod, d) for d in node.decorator_list):
+                roots.add(node.name)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _is_jit_expr(mod, call.func) or (
+                mod.dotted(call.func) in JIT_WRAPPERS
+            ):
+                # name = jax.jit(fn) / self._step_fn = jax.jit(self._step)
+                for arg in call.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        roots.add(arg.id)
+                    elif isinstance(arg, ast.Attribute):
+                        roots.add(arg.attr)
+    return functions, roots
+
+
+def _reachable(functions: dict[str, ast.AST], roots: set[str]) -> set[str]:
+    seen: set[str] = set()
+    frontier = [r for r in roots if r in functions]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        node = functions[name]
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee: str | None = None
+                if isinstance(sub.func, ast.Name):
+                    callee = sub.func.id
+                elif isinstance(sub.func, ast.Attribute) and isinstance(
+                    sub.func.value, ast.Name
+                ) and sub.func.value.id in ("self", "cls"):
+                    callee = sub.func.attr
+                if callee and callee in functions and callee not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in ctx.modules:
+        functions, roots = _collect(mod)
+        if not roots:
+            continue
+        for name in sorted(_reachable(functions, roots)):
+            node = functions[name]
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and sub is not node:
+                    continue  # nested defs get their own entry if reachable
+                if not isinstance(sub, ast.Call):
+                    continue
+                message: str | None = None
+                if isinstance(sub.func, ast.Attribute) and sub.func.attr in HOST_SYNC_METHODS:
+                    message = HOST_SYNC_METHODS[sub.func.attr]
+                else:
+                    dotted = mod.dotted(sub.func)
+                    if dotted in HOST_SYNC_DOTTED:
+                        message = HOST_SYNC_DOTTED[dotted]
+                    elif dotted in TRACE_TIME_EFFECTS:
+                        message = TRACE_TIME_EFFECTS[dotted]
+                if message is not None:
+                    findings.append(Finding(
+                        JIT_PURITY, "host-sync", mod.rel, sub.lineno,
+                        f"{message} (reachable from a @jax.jit root)",
+                        context=name,
+                    ))
+    return findings

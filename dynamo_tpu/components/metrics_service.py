@@ -23,6 +23,7 @@ from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import configure_logging, get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("components.metrics")
 
@@ -323,11 +324,11 @@ class MetricsService:
         await self.aggregator.start()
         bus = self.component.runtime.plane.bus
         self._hit_sub = await bus.subscribe(self.component.event_subject(KV_HIT_RATE_SUBJECT))
-        self._hit_task = asyncio.ensure_future(self._hit_loop())
+        self._hit_task = spawn_logged(self._hit_loop())
         self._planner_sub = await bus.subscribe(
             self.component.event_subject(PLANNER_STATE_EVENT)
         )
-        self._planner_task = asyncio.ensure_future(self._planner_loop())
+        self._planner_task = spawn_logged(self._planner_loop())
 
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)

@@ -24,6 +24,7 @@ from dynamo_tpu.deploy.crds import (
     DynamoGraphDeployment,
 )
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("deploy.operator")
 
@@ -488,11 +489,11 @@ class Operator:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._tasks = [
-            asyncio.ensure_future(self._watch_loop(DynamoGraphDeployment.kind)),
+            spawn_logged(self._watch_loop(DynamoGraphDeployment.kind)),
             # child Deployment changes (readiness) feed back into status
-            asyncio.ensure_future(self._watch_loop("Deployment")),
-            asyncio.ensure_future(self._resync_loop()),
-            asyncio.ensure_future(self._worker()),
+            spawn_logged(self._watch_loop("Deployment")),
+            spawn_logged(self._resync_loop()),
+            spawn_logged(self._worker()),
         ]
 
     async def stop(self) -> None:

@@ -62,6 +62,8 @@ from dynamo_tpu.ops.sampling import (
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("engine")
 
@@ -89,7 +91,7 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
     import os
     import statistics
 
-    explicit = os.environ.get("DYN_KERNEL_PERF")
+    explicit = knobs.get("DYN_KERNEL_PERF")
     path = explicit or os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
         "KERNEL_PERF.json",
@@ -582,7 +584,7 @@ class JaxLlmEngine:
         # (the axon tunnel adds ~6ms per host<->device sync) where the loop's
         # cost profile is unrecognizable vs a local chip — upload/dispatch/
         # readback must be separable from device compute to tune anything.
-        self._phase_timing = os.environ.get("DYN_ENGINE_PHASE_TIMING") == "1"
+        self._phase_timing = knobs.get("DYN_ENGINE_PHASE_TIMING")
         self.phase_stats: dict[str, list[float]] = {}
         # Step telemetry: batch occupancy / queue depth / KV pool usage per
         # scheduler iteration, merged into stats() → load-metrics publisher
@@ -604,7 +606,7 @@ class JaxLlmEngine:
         # DYN_XPROF_ANNOTATE=1: wrap hot steps in jax.profiler
         # TraceAnnotation so host-side spans line up with xprof device
         # traces (adds a TraceMe per step — keep off unless profiling)
-        self._xprof_annotate = os.environ.get("DYN_XPROF_ANNOTATE") == "1"
+        self._xprof_annotate = knobs.get("DYN_XPROF_ANNOTATE")
         # DYN_PROFILER_TRACE_DIR: set when start() opened a device trace
         self._profiler_trace_dir: str | None = None
         # Sampling-tail upload cache: the per-window device copies of the
@@ -617,11 +619,11 @@ class JaxLlmEngine:
         self._tail_cache: tuple | None = None
         # Overlapped decode pipeline (see EngineConfig.decode_overlap): the
         # single in-flight window plus counters for stats()/A-B profiling.
-        env_overlap = os.environ.get("DYN_DECODE_OVERLAP")
+        env_overlap = knobs.get("DYN_DECODE_OVERLAP")  # tri-state bool
         if config.decode_overlap is not None:
             self.decode_overlap = bool(config.decode_overlap)
         elif env_overlap is not None:
-            self.decode_overlap = env_overlap.lower() not in ("0", "false", "off")
+            self.decode_overlap = env_overlap
         else:
             self.decode_overlap = True
         if self.decode_overlap and config.speculative:
@@ -642,11 +644,11 @@ class JaxLlmEngine:
         # prefill+decode in one launch.  Auto-disables loudly when the
         # engine's geometry cannot serve it — the split path is always the
         # fallback, never a silent behavior change.
-        env_unified = os.environ.get("DYN_UNIFIED_BATCH")
+        env_unified = knobs.get("DYN_UNIFIED_BATCH")  # tri-state bool
         if config.unified_batch is not None:
             unified = bool(config.unified_batch)
         elif env_unified is not None:
-            unified = env_unified.lower() not in ("0", "false", "off")
+            unified = env_unified
         else:
             unified = False
         if unified:
@@ -793,14 +795,12 @@ class JaxLlmEngine:
                 from dynamo_tpu.observability import TraceContext
 
                 self.prefetch_pager = PrefetchPager(
-                    ttl_s=float(os.environ.get("DYN_PREFETCH_TTL", "30")),
-                    blocks_per_step=int(os.environ.get("DYN_PREFETCH_BLOCKS", "64")),
+                    ttl_s=knobs.get("DYN_PREFETCH_TTL"),
+                    blocks_per_step=knobs.get("DYN_PREFETCH_BLOCKS"),
                 )
                 self._prefetch_trace = TraceContext.new_root()
                 self.allocator.prefetch_tracker = self.prefetch_pager
-                headroom_frac = float(
-                    os.environ.get("DYN_PREFETCH_HEADROOM", "0.05")
-                )
+                headroom_frac = knobs.get("DYN_PREFETCH_HEADROOM")
                 self._prefetch_headroom_blocks = max(
                     self.allocator.watermark_blocks,
                     int(config.num_blocks * headroom_frac),
@@ -1505,7 +1505,7 @@ class JaxLlmEngine:
         self._submit_q.put(("add", seq))
         self._wake.set()
 
-        cancel_task = asyncio.ensure_future(self._watch_cancel(ctx, seq))
+        cancel_task = spawn_logged(self._watch_cancel(ctx, seq))
 
         async def gen() -> AsyncIterator[dict]:
             try:
@@ -1714,7 +1714,7 @@ class JaxLlmEngine:
         else:
             self.allocator.free_sequence(seq.seq_id)
 
-        cancel_task = asyncio.ensure_future(self._watch_cancel(ctx, seq))
+        cancel_task = spawn_logged(self._watch_cancel(ctx, seq))
 
         async def gen() -> AsyncIterator[dict]:
             try:

@@ -32,6 +32,7 @@ import numpy as np
 
 from dynamo_tpu.llm.block_manager.manager import KvbmConfig, KvBlockManager
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("engine.offload")
 
@@ -90,12 +91,9 @@ class HostOffloadTier:
         # (system prompt) can never cascade to disk.  Budgeted to a
         # fraction of the host pool so pins cannot starve offloads (put()
         # fails when the tier is full of pins).
-        import os as _os
-
-        self.pin_hits = int(_os.environ.get("DYN_PREFETCH_PIN_HITS", "3"))
-        self.pin_max = int(
-            _os.environ.get("DYN_PREFETCH_PIN_MAX", str(max(1, num_blocks // 4)))
-        )
+        self.pin_hits = knobs.get("DYN_PREFETCH_PIN_HITS")
+        pin_max = knobs.get("DYN_PREFETCH_PIN_MAX")
+        self.pin_max = pin_max if pin_max is not None else max(1, num_blocks // 4)
         # the engine clears this when the prefetch pager is off: nothing
         # would ever drain _hot_pending, and DYN_PREFETCH=0 must be
         # bookkeeping-free demand paging

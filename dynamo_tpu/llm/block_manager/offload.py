@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 
 from dynamo_tpu.llm.block_manager.pool import BlockPool
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("llm.block_manager.offload")
 
@@ -64,7 +65,7 @@ class OffloadManager:
     def start(self, workers: int = MAX_CONCURRENT_TRANSFERS) -> None:
         if not self._workers:
             self._workers = [
-                asyncio.ensure_future(self._worker()) for _ in range(workers)
+                spawn_logged(self._worker()) for _ in range(workers)
             ]
 
     async def stop(self, drain_timeout: float = 5.0) -> None:

@@ -40,6 +40,8 @@ from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("llm.disagg")
 
@@ -60,7 +62,7 @@ def kv_stream_enabled() -> bool:
     """Streamed (multi-part, overlapped-with-prefill) KV transfer knob.
     Default ON; ``DYN_KV_STREAM=0`` falls back to the single-shot
     post-prefill transfer."""
-    return os.environ.get("DYN_KV_STREAM", "1").lower() not in ("0", "false", "off")
+    return knobs.get("DYN_KV_STREAM")
 
 
 @dataclass
@@ -86,7 +88,7 @@ class DisaggRouter:
 
     async def start(self) -> None:
         self._watch = self.runtime.plane.kv.watch_prefix(disagg_config_key(self.model))
-        self._task = asyncio.ensure_future(self._loop())
+        self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._watch is not None:
@@ -252,14 +254,12 @@ class DisaggDecodeEngine:
         # _pending (the requester still owns the entry); only stream
         # completion — every part 0..last injected — claims it.
         self._assembly: dict[str, _StreamAssembly] = {}
-        self.prefill_timeout_s = float(
-            os.environ.get("DYN_DISAGG_PREFILL_TIMEOUT_S", "300")
-        )
+        self.prefill_timeout_s = knobs.get("DYN_DISAGG_PREFILL_TIMEOUT_S")
         self.transfer_server = KvTransferServer(self._on_transfer, host=transfer_host)
         # link characterization for the router's transfer-cost model: hop
         # class this decode worker sits behind relative to the prefill pool
         # ("local"|"ici"|"dcn"; "" = unknown → the router keeps its prior)
-        self.transfer_hop = os.environ.get("DYN_TRANSFER_HOP", "")
+        self.transfer_hop = knobs.get("DYN_TRANSFER_HOP")
         self._bytes_per_block: int | None = None  # lazy, for the transfer guard
         # observability
         self.remote_prefills = 0
@@ -631,13 +631,11 @@ class PrefillWorker:
         # dropped as stale once it is past its TTL by MORE than this margin,
         # so a skewed requester clock degrades to the occasional wasted
         # prefill instead of silently dropping all disagg traffic
-        self.clock_skew_margin_s = float(
-            os.environ.get("DYN_DISAGG_CLOCK_SKEW_S", "30")
-        )
+        self.clock_skew_margin_s = knobs.get("DYN_DISAGG_CLOCK_SKEW_S")
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -25,6 +25,8 @@ from dynamo_tpu.runtime.component import ROOT_PATH
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("llm.discovery")
 
@@ -115,7 +117,7 @@ class ModelWatcher:
 
     async def start(self) -> None:
         self._watch = self.runtime.plane.kv.watch_prefix(MODELS_PREFIX)
-        self._task = asyncio.ensure_future(self._loop())
+        self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._watch is not None:
@@ -254,7 +256,7 @@ class ModelWatcher:
         # the prompt's leading blocks — the part offload tiers hold the
         # longest — and truncation can at worst invalidate the final
         # partial block's hash
-        max_chars = int(os.environ.get("DYN_PREFETCH_HINT_CHARS", "16384"))
+        max_chars = knobs.get("DYN_PREFETCH_HINT_CHARS")
 
         def tokenize(request_model) -> list[int] | None:
             if isinstance(request_model, ChatCompletionRequest):

@@ -41,6 +41,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 import numpy as np
+from dynamo_tpu.utils import knobs
 
 WS = " \t\n\r"
 DIGITS = "0123456789"
@@ -356,7 +357,8 @@ def build_for_tokenizer(
     digest.update(repr((specials, eos_ids)).encode())
     cache_root = Path(
         cache_dir
-        or os.environ.get("DYN_CACHE_DIR", os.path.expanduser("~/.cache/dynamo_tpu"))
+        or knobs.get("DYN_CACHE_DIR")
+        or os.path.expanduser("~/.cache/dynamo_tpu")
     )
     cache_path = cache_root / f"json_masks_{digest.hexdigest()[:24]}.npz"
     if cache_path.exists():

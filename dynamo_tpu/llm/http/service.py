@@ -43,6 +43,7 @@ from dynamo_tpu.llm.protocols.openai import (
 )
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.utils.logging import get_logger, log_fields
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("llm.http")
 
@@ -599,7 +600,7 @@ async def _generate_fanout(engine, request_model, n: int, trace_ctx=None):
         finally:
             await queue.put((i, None))
 
-    tasks = [asyncio.ensure_future(pump(i, st)) for i, st in enumerate(streams)]
+    tasks = [spawn_logged(pump(i, st)) for i, st in enumerate(streams)]
 
     async def gen():
         done = 0

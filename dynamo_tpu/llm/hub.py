@@ -20,6 +20,7 @@ from pathlib import Path
 from typing import Callable
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("llm.hub")
 
@@ -40,7 +41,7 @@ def cache_base(cache_dir: str | Path | None = None) -> Path:
     """Shared on-disk cache root (hub snapshots, MDC artifacts)."""
     return Path(
         cache_dir
-        or os.environ.get("DYN_CACHE_DIR")
+        or knobs.get("DYN_CACHE_DIR")
         or Path.home() / ".cache" / "dynamo_tpu"
     )
 
@@ -103,7 +104,7 @@ def resolve_model(
         return dest
     _reject_unloadable_spm(name, dest)
 
-    if not allow_download or os.environ.get("DYN_OFFLINE") == "1":
+    if not allow_download or knobs.get("DYN_OFFLINE"):
         raise FileNotFoundError(
             f"model {name!r} is not cached at {dest} and downloads are "
             "disabled (DYN_OFFLINE=1 / allow_download=False)"

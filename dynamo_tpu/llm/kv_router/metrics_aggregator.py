@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 
 from dynamo_tpu.llm.kv_router.protocols import LOAD_METRICS_SUBJECT, ForwardPassMetrics
 from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.utils.tasks import spawn_logged
 
 
 @dataclass
@@ -50,7 +51,7 @@ class KvMetricsAggregator:
     async def start(self) -> None:
         bus = self.component.runtime.plane.bus
         self._sub = await bus.subscribe(self.component.event_subject(LOAD_METRICS_SUBJECT))
-        self._task = asyncio.ensure_future(self._loop())
+        self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._sub is not None:

@@ -21,6 +21,7 @@ from dynamo_tpu.llm.kv_router.protocols import (
 )
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("llm.kv_router.publisher")
 
@@ -44,7 +45,7 @@ class KvEventPublisher:
     def start(self) -> None:
         self._loop = asyncio.get_event_loop()
         if self._task is None:
-            self._task = asyncio.ensure_future(self._pump())
+            self._task = spawn_logged(self._pump())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -87,7 +88,7 @@ class WorkerMetricsPublisher:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -125,7 +126,7 @@ class ClearKvListener:
         self._sub = None
 
     def start(self) -> None:
-        self._task = asyncio.ensure_future(self._loop())
+        self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._sub is not None:

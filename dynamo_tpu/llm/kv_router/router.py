@@ -25,6 +25,7 @@ from dynamo_tpu.runtime.client import InstanceNotFound, PushRouter
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.engine import Context, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("llm.kv_router")
 
@@ -70,8 +71,8 @@ class KvRouter:
         load_sub = await bus.subscribe(self.component.event_subject(LOAD_METRICS_SUBJECT))
         self._subs = [kv_sub, load_sub]
         self._tasks = [
-            asyncio.ensure_future(self._kv_loop(kv_sub)),
-            asyncio.ensure_future(self._load_loop(load_sub)),
+            spawn_logged(self._kv_loop(kv_sub)),
+            spawn_logged(self._load_loop(load_sub)),
         ]
         if self.prefetch_forwarder is not None:
             await self.prefetch_forwarder.start()

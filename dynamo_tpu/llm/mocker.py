@@ -28,6 +28,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.utils.tasks import spawn_logged
 
 
 @dataclass
@@ -83,7 +84,7 @@ class MockerEngine:
     def start(self) -> None:
         if self._task is None:
             self._t0 = time.monotonic()
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = spawn_logged(self._loop())
 
     def stop(self) -> None:
         if self._task is not None:
@@ -156,7 +157,7 @@ class MockerEngine:
         self.scheduler.add(seq)
         self._wake.set()
 
-        watcher = asyncio.ensure_future(self._watch_cancel(ctx, seq))
+        watcher = spawn_logged(self._watch_cancel(ctx, seq))
 
         async def gen() -> AsyncIterator[dict]:
             try:

@@ -31,6 +31,7 @@ import io
 import numpy as np
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("llm.multimodal")
 
@@ -147,7 +148,7 @@ def _reject_private_host(url: str) -> None:
     import urllib.parse
     from ipaddress import ip_address
 
-    if os.environ.get(ALLOW_PRIVATE_ENV):
+    if knobs.get(ALLOW_PRIVATE_ENV):
         return
     host = urllib.parse.urlsplit(url).hostname
     if not host:

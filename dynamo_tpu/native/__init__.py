@@ -13,6 +13,7 @@ import subprocess
 from pathlib import Path
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("native")
 
@@ -24,7 +25,7 @@ _libs: dict[str, ctypes.CDLL | None] = {}
 
 def load_native(name: str) -> ctypes.CDLL | None:
     """Compile (cached) + load ``csrc/<name>.cpp`` as lib<name>.so."""
-    if os.environ.get("DYN_DISABLE_NATIVE"):
+    if knobs.get("DYN_DISABLE_NATIVE"):
         return None
     if name in _libs:
         return _libs[name]

@@ -33,6 +33,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from dynamo_tpu.utils import knobs
 from collections import deque
 from dataclasses import dataclass
 
@@ -165,8 +166,8 @@ def model_cost(
 def detect_peaks() -> tuple[float, float]:
     """(peak FLOPs/s, peak bytes/s) for this host: env override →
     device-kind table → conservative fallback."""
-    env_tflops = os.environ.get("DYN_PEAK_TFLOPS")
-    env_gbps = os.environ.get("DYN_PEAK_GBPS")
+    env_tflops = knobs.get("DYN_PEAK_TFLOPS")
+    env_gbps = knobs.get("DYN_PEAK_GBPS")
     kind = ""
     if not (env_tflops and env_gbps):
         try:
@@ -181,9 +182,9 @@ def detect_peaks() -> tuple[float, float]:
             flops, gbps = f, b
             break
     if env_tflops:
-        flops = float(env_tflops) * 1e12
+        flops = env_tflops * 1e12
     if env_gbps:
-        gbps = float(env_gbps) * 1e9
+        gbps = env_gbps * 1e9
     return flops, gbps
 
 
@@ -225,7 +226,7 @@ class UtilizationTracker:
         self.peak_flops = max(float(peak_flops), 1.0)
         self.peak_bytes_per_s = max(float(peak_bytes_per_s), 1.0)
         if window_s is None:
-            window_s = float(os.environ.get("DYN_UTIL_WINDOW_S", "10"))
+            window_s = knobs.get("DYN_UTIL_WINDOW_S")
         self.window_s = max(window_s, 0.1)
         self._samples: deque[_Sample] = deque()
         self._lock = threading.Lock()

@@ -33,6 +33,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from dynamo_tpu.observability.trace import TraceContext
+from dynamo_tpu.utils import knobs
 
 _DEFAULT_BUFFER = 4096
 
@@ -111,15 +112,12 @@ class SpanRecorder:
         max_jsonl_bytes: int | None = None,
     ):
         if max_spans is None:
-            max_spans = int(os.environ.get("DYN_TRACE_BUFFER", _DEFAULT_BUFFER))
+            max_spans = knobs.get("DYN_TRACE_BUFFER")
         self._spans: deque[Span] = deque(maxlen=max(max_spans, 1))
         self._lock = threading.Lock()
-        self._jsonl_path = jsonl_path or os.environ.get("DYN_TRACE_JSONL") or None
+        self._jsonl_path = jsonl_path or knobs.get("DYN_TRACE_JSONL") or None
         if max_jsonl_bytes is None:
-            try:
-                max_jsonl_bytes = int(os.environ.get("DYN_TRACE_MAX_BYTES", "0"))
-            except ValueError:
-                max_jsonl_bytes = 0
+            max_jsonl_bytes = knobs.get("DYN_TRACE_MAX_BYTES")
         self._max_jsonl_bytes = max(max_jsonl_bytes, 0)
         self._file_lock = threading.Lock()
         self._jsonl_bytes = 0

@@ -32,6 +32,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from dynamo_tpu.utils import knobs
 from dataclasses import dataclass, field
 
 DEFAULT_WINDOWS_S = (300.0, 3600.0)
@@ -58,20 +59,14 @@ class SloConfig:
 
     @classmethod
     def from_env(cls) -> "SloConfig":
-        def _f(name: str, default: float) -> float:
-            try:
-                return float(os.environ.get(name, default))
-            except ValueError:
-                return default
-
         objectives = (
-            SloObjective("ttft", _f("DYN_SLO_TTFT_TARGET", 0.99),
-                         threshold_s=_f("DYN_SLO_TTFT_S", 2.0)),
-            SloObjective("itl", _f("DYN_SLO_ITL_TARGET", 0.99),
-                         threshold_s=_f("DYN_SLO_ITL_S", 0.2)),
-            SloObjective("error_rate", _f("DYN_SLO_ERROR_TARGET", 0.999)),
+            SloObjective("ttft", knobs.get("DYN_SLO_TTFT_TARGET"),
+                         threshold_s=knobs.get("DYN_SLO_TTFT_S")),
+            SloObjective("itl", knobs.get("DYN_SLO_ITL_TARGET"),
+                         threshold_s=knobs.get("DYN_SLO_ITL_S")),
+            SloObjective("error_rate", knobs.get("DYN_SLO_ERROR_TARGET")),
         )
-        raw = os.environ.get("DYN_SLO_WINDOWS", "")
+        raw = knobs.get("DYN_SLO_WINDOWS")
         windows: list[float] = []
         for part in raw.split(","):
             part = part.strip()
@@ -86,7 +81,7 @@ class SloConfig:
         return cls(
             objectives=objectives,
             windows_s=tuple(windows) or DEFAULT_WINDOWS_S,
-            shed_burn_threshold=_f("DYN_SLO_SHED_BURN", 0.0),
+            shed_burn_threshold=knobs.get("DYN_SLO_SHED_BURN"),
         )
 
 

@@ -32,6 +32,7 @@ from dataclasses import dataclass, field
 from dynamo_tpu.planner.load_predictor import make_predictor
 from dynamo_tpu.planner.perf_interpolation import PerfProfile
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("planner")
 
@@ -435,7 +436,7 @@ class Planner:
     def start(self, metrics_source) -> None:
         """metrics_source: async callable returning WorkloadSample."""
         self.metrics_source = metrics_source
-        self._task = asyncio.ensure_future(self._loop())
+        self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._task is not None:

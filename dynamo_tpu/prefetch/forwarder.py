@@ -24,6 +24,7 @@ from dynamo_tpu.prefetch.hints import (
 )
 from dynamo_tpu.prefetch.session import SessionPredictor
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("prefetch.forwarder")
 
@@ -59,8 +60,8 @@ class PrefetchForwarder:
             self.component.event_subject(PREFETCH_HINT_SUBJECT)
         )
         self._tasks = [
-            asyncio.ensure_future(self._hint_loop()),
-            asyncio.ensure_future(self._predict_loop()),
+            spawn_logged(self._hint_loop()),
+            spawn_logged(self._predict_loop()),
         ]
 
     async def stop(self) -> None:

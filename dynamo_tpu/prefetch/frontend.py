@@ -21,6 +21,7 @@ from typing import Callable
 from dynamo_tpu.llm.kv_router.hashing import compute_block_hashes
 from dynamo_tpu.prefetch.hints import SOURCE_ARRIVAL, PrefetchHint
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("prefetch.frontend")
 
@@ -74,4 +75,4 @@ class FrontendHinter:
             except Exception:  # noqa: BLE001
                 logger.debug("prefetch hint publish failed", exc_info=True)
 
-        asyncio.ensure_future(_publish())
+        spawn_logged(_publish())

@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import os
 import time
+from dynamo_tpu.utils import knobs
 from dataclasses import asdict, dataclass, field
 
 PREFETCH_HINT_SUBJECT = "prefetch_hints"
@@ -40,10 +41,10 @@ SOURCE_PRIORITY = {SOURCE_QUEUED: 0, SOURCE_ARRIVAL: 10, SOURCE_PREDICTED: 20}
 def prefetch_enabled(default: bool = True) -> bool:
     """The ``DYN_PREFETCH`` gate (0/false/off disables; default on).
     ``DYN_PREFETCH=0`` restores fully demand-driven paging everywhere."""
-    value = os.environ.get("DYN_PREFETCH")
+    value = knobs.get_raw("DYN_PREFETCH")
     if value is None:
         return default
-    return value.lower() not in ("0", "false", "off")
+    return knobs.parse_bool(value, default)
 
 
 @dataclass

@@ -12,6 +12,7 @@ import asyncio
 
 from dynamo_tpu.prefetch.hints import PREFETCH_TARGET_SUBJECT, TargetedPrefetchHint
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("prefetch.worker")
 
@@ -28,7 +29,7 @@ class PrefetchListener:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            self._task = spawn_logged(self._loop())
 
     async def stop(self) -> None:
         if self._sub is not None:

@@ -28,6 +28,7 @@ from dataclasses import dataclass
 
 from dynamo_tpu.robustness import counters
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("robustness.admission")
 
@@ -50,16 +51,15 @@ class AdmissionConfig:
 
     @classmethod
     def from_env(cls) -> "AdmissionConfig":
-        max_inflight = int(os.environ.get("DYN_ADMISSION_MAX_INFLIGHT", "0"))
+        max_inflight = knobs.get("DYN_ADMISSION_MAX_INFLIGHT")
+        queue_depth = knobs.get("DYN_ADMISSION_QUEUE")
         return cls(
             max_inflight=max_inflight,
-            max_queue_depth=int(
-                os.environ.get("DYN_ADMISSION_QUEUE", str(2 * max_inflight))
+            max_queue_depth=(
+                queue_depth if queue_depth is not None else 2 * max_inflight
             ),
-            queue_timeout_s=float(
-                os.environ.get("DYN_ADMISSION_QUEUE_TIMEOUT_S", "2.0")
-            ),
-            retry_after_s=float(os.environ.get("DYN_ADMISSION_RETRY_AFTER_S", "1.0")),
+            queue_timeout_s=knobs.get("DYN_ADMISSION_QUEUE_TIMEOUT_S"),
+            retry_after_s=knobs.get("DYN_ADMISSION_RETRY_AFTER_S"),
         )
 
 

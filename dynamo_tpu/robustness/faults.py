@@ -35,6 +35,7 @@ import threading
 
 from dynamo_tpu.robustness import counters
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("robustness.faults")
 
@@ -149,7 +150,7 @@ class FaultRegistry:
                 self._specs.setdefault(spec.point, []).append(spec)
 
     def arm_from_env(self) -> None:
-        schedule = os.environ.get("DYN_FAULTS", "")
+        schedule = knobs.get("DYN_FAULTS")
         if schedule:
             self.arm(schedule)
 

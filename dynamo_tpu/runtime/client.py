@@ -27,6 +27,8 @@ from dynamo_tpu.runtime.dataplane import PendingStream
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("runtime.client")
 
@@ -67,7 +69,7 @@ class Client:
             self.endpoint.name,
         )
         self._watch = self.runtime.plane.kv.watch_prefix(prefix)
-        self._watch_task = asyncio.ensure_future(self._watch_loop())
+        self._watch_task = spawn_logged(self._watch_loop())
         # Don't return until the watch's initial snapshot has been applied:
         # a request served before this sees an empty instance view even
         # though workers are registered (startup race).
@@ -166,7 +168,7 @@ class PushRouter:
         # stays in the instance view until its lease is reaped — or forever
         # if the watch was lost — and per-request exclusion alone would
         # re-pay the connect timeout on every other request)
-        self.dark_ttl_s = float(os.environ.get("DYN_DARK_WORKER_TTL_S", "30"))
+        self.dark_ttl_s = knobs.get("DYN_DARK_WORKER_TTL_S")
         self._dark: dict[int, float] = {}  # instance_id -> retry-after monotonic
 
     @classmethod
@@ -243,7 +245,7 @@ class PushRouter:
         """
         tried: set[int] = set()
         pending, inst_id = await self._rendezvous(request, instance_id, tried)
-        retry_max = int(os.environ.get("DYN_RETRY_MAX", "1"))
+        retry_max = knobs.get("DYN_RETRY_MAX")
         if instance_id is not None or retry_max <= 0:
             # direct routing keeps affinity decisions with the scheduler
             # (KV router does its own reschedule-excluding-failed failover)
@@ -312,14 +314,14 @@ class PushRouter:
         runtime = self.client.runtime
         server = await runtime.data_server()
         ctx = request.ctx
-        connect_timeout = float(os.environ.get("DYN_CONNECT_TIMEOUT_S", "30"))
+        connect_timeout = knobs.get("DYN_CONNECT_TIMEOUT_S")
         # quarantined instances get a short probe window instead of the
         # full connect timeout: during a full-fleet outage healthy_ids
         # returns the dark set rather than hard-failing, and without this a
         # request would serially re-pay 30s per dark instance — a latency
         # storm instead of a fast, diagnosable failure
         dark_probe_timeout = min(
-            connect_timeout, float(os.environ.get("DYN_DARK_PROBE_TIMEOUT_S", "5"))
+            connect_timeout, knobs.get("DYN_DARK_PROBE_TIMEOUT_S")
         )
         # hard cap on TOTAL rendezvous time across failovers; generation
         # time is unbounded as ever — this only bounds how long a request
@@ -327,9 +329,7 @@ class PushRouter:
         # with the connect timeout so raising DYN_CONNECT_TIMEOUT_S (e.g.
         # for first-compile rendezvous on a loaded CI box) is never
         # silently undone by a smaller fixed budget.
-        budget = float(
-            os.environ.get("DYN_RENDEZVOUS_BUDGET_S", "0")
-        ) or 3.0 * connect_timeout
+        budget = knobs.get("DYN_RENDEZVOUS_BUDGET_S") or 3.0 * connect_timeout
         t_start = time.monotonic()
         last_err: Exception | None = None
         dark_started: dict[int, float] = {}  # instance -> first dark publish

@@ -53,12 +53,14 @@ from dynamo_tpu.runtime.controlplane.wire import (
     with_trace,
 )
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("runtime.controlplane.client")
 
 
 def _reconnect_default() -> bool:
-    return os.environ.get("DYN_CP_RECONNECT", "1").lower() not in ("0", "false", "off")
+    return knobs.get("DYN_CP_RECONNECT")
 
 
 class RpcConnection:
@@ -113,7 +115,7 @@ class RpcConnection:
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._gen += 1
         self._transport_up.set()
-        self._read_task = asyncio.ensure_future(self._read_loop())
+        self._read_task = spawn_logged(self._read_loop())
 
     async def _read_loop(self) -> None:
         reader = self._reader
@@ -169,7 +171,7 @@ class RpcConnection:
 
     def _ensure_reconnect(self) -> None:
         if self._reconnect_task is None or self._reconnect_task.done():
-            self._reconnect_task = asyncio.ensure_future(self._reconnect_loop())
+            self._reconnect_task = spawn_logged(self._reconnect_loop())
 
     async def _reconnect_loop(self) -> None:
         backoff = Backoff.from_env("DYN_CP_RECONNECT", initial=0.05, max_delay=2.0)
@@ -341,11 +343,11 @@ class _ReconnectingWatch:
             original_cancel()
             self.conn.remove_resync_hook(self)
             if self._stream_id is not None and not self.conn.closed:
-                asyncio.ensure_future(self._release())
+                spawn_logged(self._release())
 
         self.outer.cancel = cancel  # type: ignore[method-assign]
         self.conn.add_resync_hook(self, self.resync)
-        asyncio.ensure_future(self._run())
+        spawn_logged(self._run())
 
     async def _establish(self, *, wait_ready: bool) -> None:
         stream_id = await self.conn.call(
@@ -485,7 +487,7 @@ class _ReconnectingSub:
         """First establishment; errors propagate to the subscribe() caller."""
         await self._establish(wait_ready=True)
         self.conn.add_resync_hook(self, self.resync)
-        asyncio.ensure_future(self._pump())
+        spawn_logged(self._pump())
 
         original_unsub = self.outer.unsubscribe
 
@@ -637,7 +639,7 @@ class RemoteKV(KeyValueStore):
         lease_id = await self._conn.call("kv.grant_lease", ttl)
         lease = Lease(id=lease_id, ttl=ttl)
         self._lease_records[id(lease)] = _LeaseRecord(lease)
-        self._keepalive_tasks[id(lease)] = asyncio.ensure_future(
+        self._keepalive_tasks[id(lease)] = spawn_logged(
             self._keepalive_loop(lease)
         )
         return lease

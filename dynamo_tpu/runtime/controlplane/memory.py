@@ -29,6 +29,7 @@ from dynamo_tpu.runtime.controlplane.interface import (
     subject_matches,
 )
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("runtime.controlplane.memory")
 
@@ -56,7 +57,7 @@ class MemoryKV(KeyValueStore):
 
     def _ensure_reaper(self) -> None:
         if self._reaper is None or self._reaper.done():
-            self._reaper = asyncio.get_running_loop().create_task(self._reap_loop())
+            self._reaper = spawn_logged(self._reap_loop())
 
     async def _reap_loop(self) -> None:
         while self._leases:

@@ -22,6 +22,7 @@ from dynamo_tpu.runtime.controlplane.wire import (
     read_frame,
 )
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("runtime.controlplane.server")
 
@@ -126,7 +127,7 @@ class ControlPlaneServer:
                 stream_id = next(self._stream_ids)
                 watch = kv.watch_prefix(args[0])
                 watches[stream_id] = watch
-                pumps.append(asyncio.ensure_future(pump_watch(stream_id, watch)))
+                pumps.append(spawn_logged(pump_watch(stream_id, watch)))
                 return stream_id
             if method == "kv.cancel_watch":
                 watch = watches.pop(args[0], None)
@@ -141,7 +142,7 @@ class ControlPlaneServer:
                 stream_id = next(self._stream_ids)
                 sub = await bus.subscribe(args[0], args[1])
                 subs[stream_id] = sub
-                pumps.append(asyncio.ensure_future(pump_sub(stream_id, sub)))
+                pumps.append(spawn_logged(pump_sub(stream_id, sub)))
                 return stream_id
             if method == "bus.unsubscribe":
                 sub = subs.pop(args[0], None)
@@ -194,7 +195,7 @@ class ControlPlaneServer:
                     break
                 # blocking calls (queue_pop, bus.request) must not stall the
                 # connection; every request runs as its own task.
-                asyncio.ensure_future(handle_request(frame))
+                spawn_logged(handle_request(frame))
         finally:
             self._client_writers.discard(writer)
             for watch in watches.values():

@@ -33,6 +33,7 @@ from dynamo_tpu.runtime.codec import (
 )
 from dynamo_tpu.runtime.engine import EngineContext
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("runtime.dataplane")
 
@@ -193,7 +194,7 @@ class ResponseStreamServer:
                 await stream.ctx.stopped()
                 await stream.send_control("kill" if stream.ctx.is_killed else "stop")
 
-            control_task = asyncio.ensure_future(watch_cancel())
+            control_task = spawn_logged(watch_cancel())
 
             finished = False
             async for frame in iter_frames(reader):
@@ -257,7 +258,7 @@ class ResponseStreamSender:
         )
         self._writer.write(encode_frame(TwoPartMessage(header=header)))
         await self._writer.drain()
-        self._control_task = asyncio.ensure_future(self._control_loop())
+        self._control_task = spawn_logged(self._control_loop())
 
     async def _control_loop(self) -> None:
         """Surface caller stop/kill on the worker-side context."""

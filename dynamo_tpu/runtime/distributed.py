@@ -16,6 +16,7 @@ from dynamo_tpu.runtime.dataplane import ResponseStreamServer
 from dynamo_tpu.utils.config import RuntimeConfig
 from dynamo_tpu.utils.logging import configure_logging, get_logger
 from dynamo_tpu.utils.tasks import CriticalTaskGroup
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("runtime.distributed")
 
@@ -67,7 +68,7 @@ class DistributedRuntime:
                 await asyncio.sleep(max(lease.ttl / 3.0, 0.05))
                 await self.plane.kv.keep_alive(lease)
 
-        self._keepalive_loops[lease.id] = asyncio.ensure_future(loop())
+        self._keepalive_loops[lease.id] = spawn_logged(loop())
 
     # -- lifecycle ---------------------------------------------------------
     def _on_critical_failure(self, exc: BaseException) -> None:

@@ -22,6 +22,7 @@ from dynamo_tpu.runtime.component import Instance, instance_key, stats_subject
 from dynamo_tpu.runtime.dataplane import ConnectionInfo, ResponseStreamSender
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineContext
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("runtime.ingress")
 
@@ -62,8 +63,8 @@ class EndpointService:
         self._lease = await plane.kv.grant_lease(lease_ttl)
         self._sub = await plane.bus.subscribe(self.instance.subject)
         self._stats_sub = await plane.bus.subscribe(stats_subject(self.instance.subject))
-        self._loop_task = asyncio.ensure_future(self._serve_loop())
-        self._stats_task = asyncio.ensure_future(self._stats_loop())
+        self._loop_task = spawn_logged(self._serve_loop())
+        self._stats_task = spawn_logged(self._stats_loop())
         self.runtime.register_keepalive(self._lease)
         # register *after* subscribing so no request can race the subscription
         await plane.kv.put(instance_key(self.instance), self.instance.to_json(), self._lease.id)

@@ -32,6 +32,7 @@ import os
 from dataclasses import dataclass, field
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("sdk.allocator")
 
@@ -69,9 +70,9 @@ class ChipInventory:
         visible = env.get(VISIBLE_CHIPS_ENV)
         if visible:
             return cls(chips=tuple(int(c) for c in visible.split(",") if c != ""))
-        count = env.get("DYN_TPU_CHIP_COUNT")
+        count = knobs.get("DYN_TPU_CHIP_COUNT", env=env)
         if count:
-            return cls(chips=tuple(range(int(count))))
+            return cls(chips=tuple(range(count)))
         try:
             import jax
             from jax._src import xla_bridge
@@ -176,7 +177,7 @@ def plan_resource_envs(
     processes then see whatever the parent saw, exactly like the reference
     with DYN_DISABLE_AUTO_GPU_ALLOCATION set."""
     env = os.environ if env is None else env
-    if env.get(DISABLE_ENV):
+    if knobs.get(DISABLE_ENV, env=env):
         return {}
     inventory = ChipInventory.detect(env) if inventory is None else inventory
     requested = {

@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass, field
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils.tasks import spawn_logged
 
 logger = get_logger("sdk.supervisor")
 
@@ -65,7 +66,7 @@ class ProcessSupervisor:
         for name in self._specs:
             await self._reconcile(name)
         if self._monitor is None:
-            self._monitor = asyncio.ensure_future(self._monitor_loop())
+            self._monitor = spawn_logged(self._monitor_loop())
 
     async def set_replicas(self, name: str, n: int) -> None:
         self._targets[name] = max(0, n)

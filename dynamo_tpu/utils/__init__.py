@@ -1,4 +1,4 @@
 from dynamo_tpu.utils.logging import configure_logging, get_logger
-from dynamo_tpu.utils.tasks import CriticalTaskGroup
+from dynamo_tpu.utils.tasks import CriticalTaskGroup, spawn_logged
 
-__all__ = ["configure_logging", "get_logger", "CriticalTaskGroup"]
+__all__ = ["configure_logging", "get_logger", "CriticalTaskGroup", "spawn_logged"]

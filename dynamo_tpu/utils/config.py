@@ -13,6 +13,7 @@ from pathlib import Path
 from typing import Any, Type, TypeVar
 
 import yaml
+from dynamo_tpu.utils import knobs
 
 T = TypeVar("T")
 
@@ -67,7 +68,7 @@ class RuntimeConfig:
 
     # Control-plane (discovery + messaging) endpoint, ``host:port`` of a
     # dynctl server, or "memory" for fully in-process static/dev mode.
-    control_plane: str = os.environ.get("DYN_CONTROL_PLANE", "memory")
+    control_plane: str = knobs.get("DYN_CONTROL_PLANE")
     # Worker identity
     namespace: str = "dynamo"
     # Graceful shutdown drain window (seconds)

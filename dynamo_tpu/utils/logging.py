@@ -15,6 +15,7 @@ import logging
 import os
 import sys
 import time
+from dynamo_tpu.utils import knobs
 
 _LEVELS = {
     "trace": 5,
@@ -89,9 +90,9 @@ def configure_logging(level: str | None = None, *, force: bool = False) -> None:
         return
     _configured = True
 
-    spec = level or os.environ.get("DYN_LOG", "info")
+    spec = level or knobs.get("DYN_LOG")
     root_level, targets = _parse_filter(spec)
-    jsonl = os.environ.get("DYN_LOGGING_JSONL", "") not in ("", "0", "false")
+    jsonl = knobs.get("DYN_LOGGING_JSONL")
 
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(JsonlFormatter() if jsonl else TextFormatter())

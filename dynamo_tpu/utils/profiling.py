@@ -19,6 +19,7 @@ import contextlib
 import os
 
 from dynamo_tpu.utils.logging import get_logger
+from dynamo_tpu.utils import knobs
 
 logger = get_logger("utils.profiling")
 
@@ -38,9 +39,9 @@ def start_server(port: int = 9012) -> None:
 
 
 def maybe_start_from_env() -> None:
-    port = os.environ.get("DYN_PROFILER_PORT")
+    port = knobs.get("DYN_PROFILER_PORT")
     if port:
-        start_server(int(port))
+        start_server(port)
 
 
 def maybe_start_trace_from_env() -> str | None:
@@ -49,7 +50,7 @@ def maybe_start_trace_from_env() -> str | None:
     the directory when THIS call started the trace, else None — the caller
     that got the directory owns the matching ``maybe_stop_trace``."""
     global _trace_dir
-    log_dir = os.environ.get("DYN_PROFILER_TRACE_DIR")
+    log_dir = knobs.get("DYN_PROFILER_TRACE_DIR")
     if not log_dir or _trace_dir is not None:
         return None
     import jax

@@ -16,6 +16,33 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger("utils.tasks")
 
 
+def _log_if_failed(task: asyncio.Task) -> None:
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.error("background task %s crashed: %r", task.get_name(), exc)
+
+
+def spawn_logged(coro: Coroutine, *, name: str | None = None) -> asyncio.Task:
+    """``create_task`` with a guaranteed exception surface.
+
+    A raw ``asyncio.ensure_future``/``create_task`` whose handle is only ever
+    ``.cancel()``-ed swallows any crash until interpreter shutdown prints
+    "Task exception was never retrieved".  This helper attaches a
+    done-callback that logs non-cancellation exceptions the moment the task
+    dies, so a background loop that crashes is visible in the logs instead of
+    silently stopping.  It is the sanctioned spawn path dynlint's
+    async-hygiene pass steers fire-and-forget sites toward.
+    """
+    task = asyncio.ensure_future(coro)
+    label = name or getattr(coro, "__qualname__", None)
+    if label:
+        task.set_name(label)
+    task.add_done_callback(_log_if_failed)
+    return task
+
+
 class CriticalTaskGroup:
     """Tracks supervised background tasks.
 

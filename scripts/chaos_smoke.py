@@ -33,6 +33,8 @@ _REPO_ROOT = Path(__file__).parent.parent
 if str(_REPO_ROOT) not in sys.path:  # standalone runs (tests import us
     sys.path.insert(0, str(_REPO_ROOT))  # with the root already on path)
 
+from dynamo_tpu.utils import knobs  # noqa: E402  (needs the path bootstrap)
+
 MODEL_DIR = str(_REPO_ROOT / "tests" / "data" / "tiny-chat-model")
 # last-resort fallback if the shipped spec file is missing/unreadable
 _FALLBACK_SCHEDULE = "cp.recv:once;worker.generate:nth=2"
@@ -89,7 +91,7 @@ async def amain(
     spec_requests, spec_burst, spec_schedule = _canned()
     requests = spec_requests if requests is None else requests
     burst = spec_burst if burst is None else burst
-    schedule = schedule or os.environ.get("DYN_FAULTS") or spec_schedule
+    schedule = schedule or knobs.get("DYN_FAULTS") or spec_schedule
     # a DYN_FAULTS env schedule is armed at import — disarm it for bring-up
     # (the schedule targets the serve phase; cp.recv:once firing on the
     # connect handshake would fail setup, not test recovery) and start the

@@ -1,0 +1,30 @@
+"""Fixture: every async-hygiene rule fires here (bad twin of good.py)."""
+import asyncio
+import time
+
+import requests
+
+
+async def work():
+    return 1
+
+
+async def fetch():
+    time.sleep(1)                      # blocking-call
+    requests.get("http://example")     # blocking-call
+
+
+class Service:
+    def __init__(self):
+        self._task = None
+
+    async def start(self):
+        asyncio.ensure_future(work())            # fire-and-forget (discarded)
+        self._task = asyncio.create_task(work())  # fire-and-forget (cancel-only)
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+
+    async def kick(self):
+        work()                                    # unawaited-coroutine

@@ -1,0 +1,32 @@
+"""Fixture: async-hygiene-clean twin of bad.py — no rule may fire."""
+import asyncio
+
+
+async def work():
+    return 1
+
+
+class Service:
+    def __init__(self):
+        self._task = None
+        self._writer = None
+
+    async def start(self):
+        self._task = asyncio.ensure_future(work())
+        self._task.add_done_callback(lambda t: None)
+
+    async def run_all(self):
+        tasks = [asyncio.ensure_future(work()) for _ in range(3)]
+        await asyncio.gather(*tasks)
+
+    async def poll(self):
+        await asyncio.sleep(0.1)
+        await work()
+
+    async def close(self):
+        await asyncio.sleep(0)
+
+    def shutdown(self):
+        # a sync .close() on a *different* object must not be confused with
+        # the module's own async close (StreamWriter.close regression)
+        self._writer.close()

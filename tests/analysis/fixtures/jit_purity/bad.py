@@ -1,0 +1,31 @@
+"""Fixture: jit-purity host-sync findings fire here (bad twin of good.py)."""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    log(x)
+    return x + 1
+
+
+def log(x):
+    print(float_of(x))      # host-sync: trace-time print, reachable from step
+
+
+def float_of(x):
+    return x.item()         # host-sync: .item(), reachable from step
+
+
+@partial(jax.jit, static_argnums=0)
+def other(n, x):
+    return np.asarray(x) + n   # host-sync: np.asarray on a tracer
+
+
+def run_fn(x):
+    return x.block_until_ready()   # host-sync, via the jax.jit(...) root below
+
+
+run_jit = jax.jit(run_fn)

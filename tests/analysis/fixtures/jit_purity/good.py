@@ -1,0 +1,18 @@
+"""Fixture: jit-purity-clean twin of bad.py — no rule may fire."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return helper(x) + 1
+
+
+def helper(x):
+    return jnp.sum(x)
+
+
+def host_readback(x):
+    # not reachable from any jit root: host syncing here is fine
+    return float(np.asarray(x).item())

@@ -1,0 +1,9 @@
+"""Fixture: every knob-registry read rule fires here (bad twin of good.py)."""
+import os
+
+from dynamo_tpu.utils import knobs
+
+RAW = os.environ.get("DYN_FIX_RAW", "")   # raw-env-read (and unregistered)
+ALSO = os.getenv("DYN_FIX_GOOD")          # raw-env-read
+SUB = os.environ["DYN_FIX_GOOD"]          # raw-env-read (subscript load)
+GHOST = knobs.get("DYN_FIX_GHOST")        # unregistered-knob

@@ -1,0 +1,8 @@
+"""Fixture: knob-registry-clean twin of bad.py — no rule may fire."""
+import os
+
+from dynamo_tpu.utils import knobs
+
+VAL = knobs.get("DYN_FIX_GOOD")
+os.environ["DYN_FIX_GOOD"] = "1"   # env writes are how supervisors configure children
+HOME = os.environ.get("HOME")      # non-DYN_* reads are out of scope

@@ -1,0 +1,9 @@
+"""Fixture knob registry (the pass reads register(...) literals only)."""
+
+
+def register(name, **kwargs):
+    return name
+
+
+K_GOOD = register("DYN_FIX_GOOD", type="bool", default=False, doc="documented")
+K_SILENT = register("DYN_FIX_SILENT", type="int", default=0, doc="not in docs")

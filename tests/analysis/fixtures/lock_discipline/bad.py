@@ -1,0 +1,23 @@
+"""Fixture: both lock-discipline rules fire here (bad twin of good.py)."""
+import asyncio
+import threading
+
+
+async def noop():
+    pass
+
+
+class State:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    async def update(self):
+        with self._lock:
+            await asyncio.sleep(0.1)   # lock-across-await
+
+    async def offload(self):
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, self._sync_work)
+
+    def _sync_work(self):
+        asyncio.create_task(noop())    # asyncio-from-thread

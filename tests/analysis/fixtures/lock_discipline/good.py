@@ -1,0 +1,35 @@
+"""Fixture: lock-discipline-clean twin of bad.py — no rule may fire."""
+import asyncio
+import threading
+
+
+async def noop():
+    pass
+
+
+class State:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._alock = asyncio.Lock()
+        self._loop = None
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self.n += 1
+
+    async def update(self):
+        with self._lock:
+            self.n += 1
+        await asyncio.sleep(0.1)
+
+    async def aupdate(self):
+        async with self._alock:
+            await asyncio.sleep(0.1)
+
+    async def offload(self):
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(None, self._sync_work)
+
+    def _sync_work(self):
+        asyncio.run_coroutine_threadsafe(noop(), self._loop)

@@ -1,0 +1,9 @@
+"""Fixture: metric-names findings fire here (bad twin of good.py)."""
+from prometheus_client import Counter, Gauge
+
+PREFIX = "dyn_fixture"
+
+REQS = Counter("dyn_fixture_requests", "counter missing _total")
+LAT = Gauge("dyn_fixture_latency_ms", "forbidden suffix, and not _seconds")
+ROGUE = Gauge("fixture_depth", "not dyn_-prefixed")
+FMT = Gauge(f"{PREFIX}_queue_pct", "f-string resolved; forbidden suffix")

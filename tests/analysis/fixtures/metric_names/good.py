@@ -1,0 +1,8 @@
+"""Fixture: metric-names-clean twin of bad.py — no rule may fire."""
+from prometheus_client import Counter, Gauge
+
+PREFIX = "dyn_fixture"
+
+REQS = Counter("dyn_fixture_requests_total", "requests")
+LAT = Gauge("dyn_fixture_latency_seconds", "latency")
+DEPTH = Gauge(f"{PREFIX}_queue_depth", "depth")

@@ -1,0 +1,18 @@
+"""Fixture: pragma handling — suppression, next-line form, reason policy."""
+import asyncio
+import time
+
+
+async def slow():
+    time.sleep(0.5)  # dynlint: disable=async-hygiene -- fixture: sanctioned sleep
+    await asyncio.sleep(0)
+
+
+async def next_line_form():
+    # dynlint: disable=async-hygiene -- fixture: comment-line applies below
+    time.sleep(0.1)
+    await asyncio.sleep(0)
+
+
+async def reasonless():
+    time.sleep(0.2)  # dynlint: disable=async-hygiene

@@ -1,0 +1,8 @@
+"""Fixture: a pragma naming a pass that does not exist."""
+import asyncio
+import time
+
+
+async def slow():
+    time.sleep(0.5)  # dynlint: disable=flux-capacitor -- no such pass
+    await asyncio.sleep(0)

@@ -1,0 +1,46 @@
+"""The tier-1 dynlint gate: the repo must be clean against its recorded
+baseline.  This is the in-process twin of ``scripts/dynlint.py --check`` —
+pure AST, no JAX import — so analyzer debt cannot grow without failing the
+suite, and paid-down debt cannot linger in the baseline unrecorded."""
+
+import json
+from pathlib import Path
+
+from dynamo_tpu import analysis
+from dynamo_tpu.analysis import core
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_repo_has_a_baseline():
+    path = REPO_ROOT / core.BASELINE_NAME
+    assert path.exists(), "run scripts/dynlint.py --write-baseline"
+    data = json.loads(path.read_text())
+    assert data["version"] == 1
+    assert isinstance(data["counts"], dict)
+
+
+def test_repo_is_dynlint_clean_against_baseline():
+    findings, summary = analysis.analyze(REPO_ROOT)
+    baseline = core.load_baseline(REPO_ROOT / core.BASELINE_NAME)
+    new, stale = core.diff_baseline(findings, baseline)
+    assert not new, (
+        "NEW analyzer findings (fix, pragma with a reason, or re-record the "
+        "baseline deliberately):\n" + "\n".join(f.render() for f in new)
+    )
+    assert not stale, (
+        "STALE baseline entries (debt was paid down — re-record with "
+        "scripts/dynlint.py --write-baseline):\n" + "\n".join(stale)
+    )
+    assert summary["files_scanned"] > 100  # the scan actually covered the tree
+
+
+def test_all_dyn_spawns_and_env_reads_are_sanctioned():
+    """PR 12's acceptance bar, pinned: zero *current* findings at all — the
+    async-hygiene and knob-registry migrations drove real debt to zero, so
+    the committed baseline must stay empty rather than accrete."""
+    baseline = core.load_baseline(REPO_ROOT / core.BASELINE_NAME)
+    assert baseline == {}, (
+        "the baseline is expected to be empty; new debt should be fixed or "
+        "explicitly pragma'd, not baselined: " + ", ".join(baseline)
+    )

@@ -1,0 +1,75 @@
+"""The typed knob registry: parse semantics, registration guards, and the
+docs contract (every registered knob's generated table row appears verbatim
+in docs/knobs.md, so ``--knob-table`` output and the docs cannot drift)."""
+
+from pathlib import Path
+
+import pytest
+
+from dynamo_tpu.utils import knobs
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+def test_str_knob_default_and_override():
+    assert knobs.get("DYN_LOG", env={}) == "info"
+    assert knobs.get("DYN_LOG", env={"DYN_LOG": "debug"}) == "debug"
+
+
+def test_bool_knob_semantics():
+    assert knobs.get("DYN_KV_STREAM", env={}) is True
+    for raw in ("0", "false", "off", "no", ""):
+        assert knobs.get("DYN_KV_STREAM", env={"DYN_KV_STREAM": raw}) is False
+    for raw in ("1", "true", "yes", "on"):
+        assert knobs.get("DYN_KV_STREAM", env={"DYN_KV_STREAM": raw}) is True
+    # an unrecognized token keeps the default — DYN_CP_RECONNECT=2 must not
+    # silently disable reconnect
+    assert knobs.get("DYN_CP_RECONNECT", env={"DYN_CP_RECONNECT": "2"}) is True
+
+
+def test_tri_state_bool_distinguishes_unset():
+    assert knobs.get("DYN_DECODE_OVERLAP", env={}) is None
+    assert knobs.get("DYN_DECODE_OVERLAP", env={"DYN_DECODE_OVERLAP": "0"}) is False
+    assert knobs.get("DYN_DECODE_OVERLAP", env={"DYN_DECODE_OVERLAP": "1"}) is True
+
+
+def test_numeric_knobs_degrade_to_default_on_garbage():
+    assert knobs.get("DYN_RETRY_MAX", env={"DYN_RETRY_MAX": "3"}) == 3
+    assert knobs.get("DYN_RETRY_MAX", env={"DYN_RETRY_MAX": "zz"}) == 1
+    assert knobs.get("DYN_CONNECT_TIMEOUT_S", env={"DYN_CONNECT_TIMEOUT_S": "2.5"}) == 2.5
+    assert knobs.get("DYN_CONNECT_TIMEOUT_S", env={}) == 30.0
+
+
+def test_unregistered_name_raises():
+    with pytest.raises(KeyError):
+        knobs.get("DYN_NO_SUCH_KNOB")
+    with pytest.raises(KeyError):
+        knobs.get_raw("DYN_NO_SUCH_KNOB")
+
+
+def test_registration_guards():
+    with pytest.raises(ValueError):
+        knobs.register("DYN_LOG", type="str", doc="duplicate")
+    with pytest.raises(ValueError):
+        knobs.register("DYN_TEST_NO_DOC", type="str")
+    with pytest.raises(ValueError):
+        knobs.register("DYN_TEST_BAD_TYPE", type="blob", doc="x")
+
+
+def test_is_set(monkeypatch):
+    assert knobs.is_set("DYN_LOG", env={"DYN_LOG": "info"})
+    assert not knobs.is_set("DYN_LOG", env={})
+
+
+def test_every_knob_table_row_is_in_docs():
+    docs = (REPO_ROOT / "docs" / "knobs.md").read_text()
+    for section in (knobs.OBS, knobs.PERF, knobs.ROBUST, knobs.ARCH):
+        for row in knobs.knob_table(section).splitlines()[2:]:
+            assert row in docs, f"docs/knobs.md is missing the row: {row}"
+
+
+def test_every_knob_has_a_section_table():
+    # each registered knob belongs to one of the four documented sections
+    sections = {knobs.OBS, knobs.PERF, knobs.ROBUST, knobs.ARCH}
+    for knob in knobs.all_knobs():
+        assert knob.section in sections, knob.name

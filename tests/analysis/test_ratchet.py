@@ -1,0 +1,112 @@
+"""Baseline-ratchet semantics, unit-level and through the CLI:
+
+- a finding not in the baseline is NEW and fails the gate;
+- a baselined finding passes;
+- a baseline entry whose debt no longer exists is STALE and fails the gate
+  (the baseline only shrinks via a deliberate ``--write-baseline``).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+from dynamo_tpu.analysis import core
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
+import dynlint  # noqa: E402
+
+FIXTURE_ROOT = "tests/analysis/fixtures/async_hygiene"
+
+
+def _finding(rule="blocking-call", path="x.py", line=3, context="f"):
+    return core.Finding("async-hygiene", rule, path, line, "msg", context=context)
+
+
+# -- unit-level --------------------------------------------------------------
+
+def test_new_finding_is_flagged():
+    new, stale = core.diff_baseline([_finding()], {})
+    assert len(new) == 1 and not stale
+
+
+def test_baselined_finding_passes():
+    f = _finding()
+    baseline = core.fingerprints([f])
+    new, stale = core.diff_baseline([f], baseline)
+    assert not new and not stale
+
+
+def test_fingerprints_are_line_free():
+    baseline = core.fingerprints([_finding(line=3)])
+    new, stale = core.diff_baseline([_finding(line=300)], baseline)
+    assert not new and not stale  # the same debt moved — not new, not paid
+
+
+def test_repeat_beyond_baselined_count_is_new():
+    f = _finding()
+    baseline = core.fingerprints([f])  # count 1
+    new, stale = core.diff_baseline([f, _finding(line=9)], baseline)
+    assert len(new) == 1 and not stale
+
+
+def test_stale_entry_is_flagged():
+    baseline = core.fingerprints([_finding()])
+    new, stale = core.diff_baseline([], baseline)
+    assert not new and stale == list(baseline)
+
+
+def test_baseline_round_trip(tmp_path):
+    f = _finding()
+    path = tmp_path / core.BASELINE_NAME
+    core.write_baseline(path, [f])
+    assert core.load_baseline(path) == core.fingerprints([f])
+
+
+# -- through the CLI ---------------------------------------------------------
+
+def _cli(tmp_path, *args, root=FIXTURE_ROOT):
+    baseline = tmp_path / "baseline.json"
+    summary = tmp_path / "summary.json"
+    rc = dynlint.main([
+        *args, "--baseline", str(baseline), "--summary", str(summary), root,
+    ])
+    return rc, baseline, summary
+
+
+def test_check_fails_without_baseline(tmp_path):
+    rc, _, summary = _cli(tmp_path, "--check")
+    assert rc == 1
+    assert json.loads(summary.read_text())["new"] > 0
+
+
+def test_check_passes_after_write_baseline(tmp_path):
+    rc, baseline, _ = _cli(tmp_path, "--write-baseline")
+    assert rc == 0 and baseline.exists()
+    rc, _, summary = _cli(tmp_path, "--check")
+    assert rc == 0
+    data = json.loads(summary.read_text())
+    assert data["new"] == 0 and data["stale_baseline_entries"] == 0
+
+
+def test_check_fails_on_stale_baseline(tmp_path):
+    _cli(tmp_path, "--write-baseline")
+    baseline = tmp_path / "baseline.json"
+    data = json.loads(baseline.read_text())
+    # pretend we also recorded debt that the tree does not have (the twin of
+    # "a finding was fixed but the baseline was not re-recorded")
+    data["counts"]["async-hygiene|ghost.py|blocking-call|f"] = 1
+    baseline.write_text(json.dumps(data))
+    rc, _, summary = _cli(tmp_path, "--check")
+    assert rc == 1
+    assert json.loads(summary.read_text())["stale_baseline_entries"] == 1
+
+
+def test_check_fails_on_new_debt(tmp_path):
+    _cli(tmp_path, "--write-baseline")  # baseline: the async_hygiene fixture
+    rc, _, summary = _cli(
+        tmp_path, "--check", root="tests/analysis/fixtures/lock_discipline"
+    )
+    assert rc == 1  # different tree, different debt -> new + stale
+    data = json.loads(summary.read_text())
+    assert data["new"] > 0 and data["stale_baseline_entries"] > 0

@@ -1,28 +1,23 @@
-"""Metric-naming lint: every family the frontend and the metrics service
-expose must follow the repo conventions, so new metrics cannot silently
-drift — ``dyn_`` prefix, canonical unit suffixes (``_seconds`` for time,
-``_total`` for counters, ``_perc``/``_ratio`` for fractions — never ``_ms``,
-``_pct``, ``_count``), and no duplicate family registrations."""
+"""Metric-naming lint, render-time half: every family the frontend and the
+metrics service actually expose must follow the repo conventions.
 
-import re
+The rules themselves live in ``dynamo_tpu.analysis.metric_names`` — shared
+with the pure-AST ``metric-names`` pass of ``scripts/dynlint.py``, which
+lints the same conventions at ``Counter(...)``/``Gauge(...)`` construction
+sites without importing prometheus_client.  This test keeps the rendered
+registries honest (label wiring, duplicate registrations, and families the
+AST pass cannot resolve statically).
+"""
+
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent.parent.parent / "scripts"))
 from check_metrics import duplicate_families, exposed_families  # noqa: E402
 
+from dynamo_tpu.analysis.metric_names import lint_exposition
 from dynamo_tpu.components.metrics_service import MetricsService
 from dynamo_tpu.llm.http.metrics import FrontendMetrics
-
-NAME_RE = re.compile(r"^dyn_[a-z0-9_]+$")
-
-# unit spellings that have a canonical form in this repo
-FORBIDDEN_SUFFIXES = (
-    "_ms", "_us", "_millis", "_milliseconds", "_microseconds", "_sec",
-    "_secs", "_percent", "_pct", "_count", "_num",
-)
-
-_TYPE_RE = re.compile(r"^# TYPE (\S+) (\S+)$", re.MULTILINE)
 
 
 def _frontend_text() -> str:
@@ -41,22 +36,9 @@ def _worker_text() -> str:
 
 
 def _lint(text: str) -> list[str]:
-    problems: list[str] = []
     families = exposed_families(text)
     assert families, "no families exposed — lint would vacuously pass"
-    for name in sorted(families):
-        if not NAME_RE.match(name):
-            problems.append(f"{name}: not dyn_-prefixed lower_snake")
-        for suffix in FORBIDDEN_SUFFIXES:
-            if name.endswith(suffix):
-                problems.append(f"{name}: forbidden unit suffix {suffix}")
-        if any(tok in name for tok in ("duration", "latency", "_time_")) and not (
-            name.endswith("_seconds") or name.endswith("_seconds_total")
-        ):
-            problems.append(f"{name}: time-valued family must end in _seconds")
-    for name, mtype in _TYPE_RE.findall(text):
-        if mtype == "counter" and not name.endswith("_total"):
-            problems.append(f"{name}: counter families must end in _total")
+    problems = lint_exposition(text, families)
     problems.extend(f"{name}: declared twice" for name in duplicate_families(text))
     return problems
 

@@ -1,0 +1,110 @@
+"""spawn_logged: background tasks crash loudly, not silently.
+
+The regression these tests pin down: a raw ``asyncio.ensure_future`` whose
+handle is only ever ``.cancel()``-ed swallows its exception until interpreter
+GC prints "Task exception was never retrieved" — long after the background
+loop died.  ``spawn_logged`` (the sanctioned spawn path dynlint's
+async-hygiene pass enforces) logs the crash the moment the task dies.
+"""
+
+import asyncio
+import contextlib
+import logging
+
+from dynamo_tpu.utils.tasks import spawn_logged
+
+
+class _Capture(logging.Handler):
+    """The package logger sets propagate=False, so capture directly."""
+
+    def __init__(self):
+        super().__init__(logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@contextlib.contextmanager
+def capture_task_logs():
+    logger = logging.getLogger("dynamo_tpu.utils.tasks")
+    handler = _Capture()
+    logger.addHandler(handler)
+    try:
+        yield handler.records
+    finally:
+        logger.removeHandler(handler)
+
+
+async def _settle(task):
+    with contextlib.suppress(BaseException):
+        await task
+    await asyncio.sleep(0)  # let the done-callback run
+
+
+async def test_crashing_task_is_logged():
+    async def boom():
+        raise RuntimeError("kaput-7391")
+
+    with capture_task_logs() as records:
+        task = spawn_logged(boom())
+        await _settle(task)
+    messages = [r.getMessage() for r in records if r.levelno >= logging.ERROR]
+    assert any("kaput-7391" in m for m in messages), messages
+    # the task is named after the coroutine so the log line says *which*
+    # background loop died
+    assert any("boom" in m for m in messages), messages
+
+
+async def test_cancellation_is_not_an_error():
+    async def forever():
+        await asyncio.Event().wait()
+
+    with capture_task_logs() as records:
+        task = spawn_logged(forever())
+        await asyncio.sleep(0)
+        task.cancel()
+        await _settle(task)
+    assert not records, [r.getMessage() for r in records]
+
+
+async def test_clean_exit_is_silent():
+    async def quick():
+        return 42
+
+    with capture_task_logs() as records:
+        task = spawn_logged(quick())
+        await _settle(task)
+    assert task.result() == 42
+    assert not records
+
+
+async def test_explicit_name_wins():
+    async def boom():
+        raise ValueError("x")
+
+    with capture_task_logs() as records:
+        task = spawn_logged(boom(), name="hit-loop")
+        await _settle(task)
+    assert task.get_name() == "hit-loop"
+    assert any("hit-loop" in r.getMessage() for r in records)
+
+
+async def test_kv_publisher_crash_surfaces_in_logs():
+    """Fault-injected regression on a real migrated site: before PR 12,
+    KvEventPublisher.start() used a raw ensure_future, so a broken runtime
+    wiring made the pump loop die silently and KV events just stopped
+    flowing.  Now the crash lands in the logs."""
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+    class _BrokenComponent:
+        # no .runtime attribute: the pump crashes on its first statement
+        def event_subject(self, subject):
+            return f"test.{subject}"
+
+    pub = KvEventPublisher(_BrokenComponent(), worker_id=7)
+    with capture_task_logs() as records:
+        pub.start()
+        await _settle(pub._task)
+    errors = [r.getMessage() for r in records if r.levelno >= logging.ERROR]
+    assert any("_pump" in m and "AttributeError" in m for m in errors), errors
