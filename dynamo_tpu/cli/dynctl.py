@@ -7,6 +7,7 @@ Usage:
   python -m dynamo_tpu.cli.dynctl list-models   [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl list-instances [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl remove-model NAME [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl drain INSTANCE_ID [--timeout S] [--control-plane H:P]
 """
 
 from __future__ import annotations
@@ -48,6 +49,47 @@ async def _amain(args) -> int:
         elif args.cmd == "remove-model":
             n = await plane.kv.delete_prefix(f"{MODELS_PREFIX}{args.name}/")
             print(f"removed {n} registration(s) for {args.name}")
+        elif args.cmd == "drain":
+            from dynamo_tpu.runtime.component import ctl_subject
+
+            needle = args.instance.lower()
+            if needle.startswith("0x"):
+                needle = needle[2:]
+            matches = []
+            for e in await plane.kv.get_prefix(ROOT_PATH):
+                if "/instances/" not in e.key:
+                    continue
+                d = json.loads(e.value)
+                hex16 = f"{d['instance_id']:016x}"
+                if needle in (hex16, f"{d['instance_id']:x}") or hex16.startswith(needle):
+                    matches.append(d)
+            if not matches:
+                print(f"no instance matches {args.instance!r}")
+                return 1
+            if len(matches) > 1:
+                print(f"ambiguous instance id {args.instance!r} ({len(matches)} matches)")
+                return 1
+            inst = matches[0]
+            budget = args.timeout or 30.0
+            reply = await plane.bus.request(
+                ctl_subject(inst["subject"]),
+                json.dumps({"op": "drain", "timeout_s": args.timeout}).encode(),
+                timeout=budget + 10.0,
+            )
+            result = json.loads(reply.decode())
+            # the lease is revoked before the worker replies; confirm the
+            # instance really is gone from the view
+            gone = not any(
+                "/instances/" in e.key
+                and json.loads(e.value)["instance_id"] == inst["instance_id"]
+                for e in await plane.kv.get_prefix(ROOT_PATH)
+            )
+            print(
+                f"drained {inst['subject']}: ok={result.get('ok')} "
+                f"handed_off={result.get('handed_off')} "
+                f"duration={result.get('duration_s')}s deregistered={gone}"
+            )
+            return 0 if result.get("ok") and gone else 1
     finally:
         await plane.close()
     return 0
@@ -65,6 +107,13 @@ def main() -> int:
     rm = sub.add_parser("remove-model")
     rm.add_argument("name")
     rm.add_argument("--control-plane", default="127.0.0.1:2379")
+    drain = sub.add_parser(
+        "drain", help="gracefully empty a worker, then deregister it"
+    )
+    drain.add_argument("instance", help="instance id (hex, prefix ok)")
+    drain.add_argument("--timeout", type=float, default=None,
+                       help="drain budget in seconds (default DYN_DRAIN_TIMEOUT_S)")
+    drain.add_argument("--control-plane", default="127.0.0.1:2379")
     args = parser.parse_args()
     return asyncio.run(_amain(args))
 

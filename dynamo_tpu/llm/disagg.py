@@ -33,6 +33,7 @@ from dynamo_tpu.parallel.kv_transfer import (
     KvTransferPayload,
     KvTransferServer,
 )
+from dynamo_tpu.robustness import counters
 from dynamo_tpu.robustness.faults import FAULTS, PREFILL_DEQUEUE
 from dynamo_tpu.robustness.retry import Backoff
 from dynamo_tpu.runtime.component import ROOT_PATH
@@ -208,6 +209,9 @@ class _StreamAssembly:
 
     received: set[int] = field(default_factory=set)   # arrival dedup
     injected: set[int] = field(default_factory=set)   # scatter completed
+    # landing-block offsets whose KV has fully landed — the resume cursor
+    # for re-enqueueing a stream whose prefill worker died mid-flight
+    covered_blocks: set[int] = field(default_factory=set)
     last_index: int | None = None
     first_token: int | None = None
     first_token_logprob: float | None = None
@@ -222,6 +226,15 @@ class _StreamAssembly:
     # drains, then ITS handler releases them (never free under a writer)
     abandoned_blocks: list[int] | None = None
     span: object = None
+
+    def contiguous_blocks(self) -> int:
+        """Blocks 0..n-1 all fully injected — where a resumed prefill
+        stream can safely skip to (anything past a gap must be re-shipped,
+        so only the contiguous prefix counts)."""
+        n = 0
+        while n in self.covered_blocks:
+            n += 1
+        return n
 
 
 class DisaggDecodeEngine:
@@ -265,6 +278,7 @@ class DisaggDecodeEngine:
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_prefill_timeouts = 0
+        self.remote_prefill_requeues = 0
         # KV-transfer observability (cumulative; per-request latency/bytes
         # also land on each trace's kv.transfer span)
         self.kv_transfer_bytes_total = 0
@@ -427,6 +441,9 @@ class DisaggDecodeEngine:
             return
         asm.inflight -= 1
         asm.injected.add(payload.part_index)
+        asm.covered_blocks.update(
+            range(payload.block_start, payload.block_start + len(payload.block_ids))
+        )
         asm.active_seconds += time.monotonic() - t0
         asm.bytes += nbytes
         asm.blocks_received += len(payload.block_ids)
@@ -536,40 +553,100 @@ class DisaggDecodeEngine:
                 "deadline_ts": time.time() + self.prefill_timeout_s,
             }, trace)
         )
-        try:
-            first_token, first_lp, first_top = await asyncio.wait_for(
-                fut, timeout=self.prefill_timeout_s
-            )
-        except (asyncio.TimeoutError, asyncio.CancelledError) as err:
-            if self._pending.pop(seq_id, None) is not None:
-                # we still own the landing blocks — a transfer that arrives
-                # from here on finds no pending entry and is dropped.
-                # (_release_landing defers the actual free while a streamed
-                # part is mid-inject into these blocks)
+        requeued = False
+        while True:
+            try:
+                first_token, first_lp, first_top = await asyncio.wait_for(
+                    fut, timeout=self.prefill_timeout_s
+                )
+                break
+            except (asyncio.TimeoutError, asyncio.CancelledError) as err:
+                # resume cursor BEFORE abandoning the assembly: the
+                # contiguous prefix of landing blocks already injected is
+                # work a replacement prefill worker need not re-ship
+                asm = self._assembly.get(seq_id)
+                skip_blocks = asm.contiguous_blocks() if asm is not None else 0
+                owned = self._pending.pop(seq_id, None) is not None
+                if isinstance(err, asyncio.CancelledError):
+                    if owned:
+                        self._release_landing(seq_id, block_ids)
+                    raise  # caller went away; nothing to serve
+                # requeue only when a prefill worker demonstrably picked the
+                # item up and started streaming (an assembly exists).  A dead
+                # fleet leaves the original item queued — re-enqueueing would
+                # duplicate it and still serve nobody; degrade to the local
+                # prefill instead.
+                if (
+                    owned and not requeued and asm is not None
+                    and knobs.get("DYN_RESUME")
+                ):
+                    # the prefill worker died (or stalled) mid-KV-stream:
+                    # re-enqueue the REMAINING work for another prefill
+                    # worker instead of burning a cold local prefill.  A
+                    # fresh sub-stream id quarantines the dead stream (its
+                    # late parts find no pending entry and drop); the
+                    # landing blocks are KEPT — already-injected KV stays
+                    # valid, and the replacement skips shipping it.  Old
+                    # parts still mid-inject rewrite identical deterministic
+                    # KV into the same blocks, which is harmless.
+                    old = self._assembly.pop(seq_id, None)
+                    if old is not None and old.span is not None:
+                        old.span.end(status="error", error="requeued")
+                        old.span = None
+                    requeued = True
+                    self.remote_prefill_requeues += 1
+                    counters.incr("dyn_resume_prefill_requeues_total")
+                    seq_id = f"{seq_id}#r1"
+                    fut = asyncio.get_running_loop().create_future()
+                    self._pending[seq_id] = (fut, block_ids, trace)
+                    logger.warning(
+                        "remote prefill stream stalled at %d contiguous "
+                        "block(s); re-enqueueing remaining work as %s",
+                        skip_blocks, seq_id,
+                    )
+                    try:
+                        await self.queue.enqueue(
+                            stamp_trace({
+                                "seq_id": seq_id,
+                                "request": request.data,
+                                "dst_block_ids": block_ids[:n_kv_blocks],
+                                "skip_blocks": skip_blocks,
+                                "transfer_address": self.transfer_server.address,
+                                "ttl_s": self.prefill_timeout_s,
+                                "deadline_ts": time.time() + self.prefill_timeout_s,
+                            }, trace)
+                        )
+                        continue
+                    except Exception:  # noqa: BLE001 — queue down: go local
+                        self._pending.pop(seq_id, None)
+                        self._release_landing(seq_id, block_ids)
+                elif owned:
+                    # we still own the landing blocks — a transfer that
+                    # arrives from here on finds no pending entry and is
+                    # dropped.  (_release_landing defers the actual free
+                    # while a streamed part is mid-inject into these blocks)
+                    self._release_landing(seq_id, block_ids)
+                # else: _on_transfer claimed the entry; it observes the
+                # cancelled future and releases the blocks itself
+                # the prefill fleet is slow/dead, but this worker still owns
+                # the request and a whole engine: serve it locally (slower
+                # TTFT beats a failed request — the reference's disagg also
+                # degrades to aggregated serving when remote prefill is
+                # unavailable)
+                self.remote_prefill_timeouts += 1
+                self.local_prefills += 1  # counted like the no-blocks fallback
+                logger.warning(
+                    "remote prefill for %s timed out after %.1fs; prefilling locally",
+                    seq_id, self.prefill_timeout_s,
+                )
+                return await self.engine.generate(request)
+            except Exception:
+                # inject failed after the transfer claimed the entry; blocks
+                # were never handed to a sequence — release here (deferred if
+                # a sibling streamed part is still scattering into them)
+                self._pending.pop(seq_id, None)
                 self._release_landing(seq_id, block_ids)
-            # else: _on_transfer claimed the entry; it observes the
-            # cancelled future and releases the blocks itself
-            if isinstance(err, asyncio.CancelledError):
-                raise  # caller went away; nothing to serve
-            # the prefill fleet is slow/dead, but this worker still owns
-            # the request and a whole engine: serve it locally (slower
-            # TTFT beats a failed request — the reference's disagg also
-            # degrades to aggregated serving when remote prefill is
-            # unavailable)
-            self.remote_prefill_timeouts += 1
-            self.local_prefills += 1  # counted like the no-blocks fallback
-            logger.warning(
-                "remote prefill for %s timed out after %.1fs; prefilling locally",
-                seq_id, self.prefill_timeout_s,
-            )
-            return await self.engine.generate(request)
-        except Exception:
-            # inject failed after the transfer claimed the entry; blocks
-            # were never handed to a sequence — release here (deferred if a
-            # sibling streamed part is still scattering into them)
-            self._pending.pop(seq_id, None)
-            self._release_landing(seq_id, block_ids)
-            raise
+                raise
         return await self.engine.generate_prefilled(
             request, block_ids, first_token, first_token_logprob=first_lp,
             first_token_top_logprobs=first_top,
@@ -580,12 +657,14 @@ class DisaggDecodeEngine:
         stats["remote_prefills"] = self.remote_prefills
         stats["local_prefills"] = self.local_prefills
         stats["remote_prefill_timeouts"] = self.remote_prefill_timeouts
+        stats["remote_prefill_requeues"] = self.remote_prefill_requeues
         stats["kv_transfer_bytes_total"] = self.kv_transfer_bytes_total
         stats["kv_transfer_seconds_total"] = self.kv_transfer_seconds_total
         # canonical dyn_disagg_* names (ForwardPassMetrics → metrics service)
         stats["disagg_remote_prefills_total"] = self.remote_prefills
         stats["disagg_local_prefills_total"] = self.local_prefills
         stats["disagg_prefill_timeouts_total"] = self.remote_prefill_timeouts
+        stats["disagg_prefill_requeues_total"] = self.remote_prefill_requeues
         stats["disagg_kv_transfer_bytes_total"] = self.kv_transfer_bytes_total
         stats["disagg_kv_transfer_seconds_total"] = self.kv_transfer_seconds_total
         stats["disagg_kv_transfer_parts_total"] = self.kv_transfer_parts_total
@@ -713,6 +792,13 @@ class PrefillWorker:
         local = item["transfer_address"] in LOCAL_SERVERS
         address = item["transfer_address"]
         dst_ids = item["dst_block_ids"]
+        # resumed stream (decode side re-enqueued after its first prefill
+        # worker died mid-KV-stream): blocks below ``skip`` already landed —
+        # compute everything (later chunks need the full KV context) but
+        # don't re-ship chunks that land entirely inside the skipped prefix.
+        # A chunk straddling the boundary ships whole: re-writing identical
+        # deterministic KV is harmless, a hole is not.
+        skip = int(item.get("skip_blocks", 0) or 0)
         # streamed transfer needs chunked prefill to have anything to
         # overlap; without it the single-part send below is the whole story
         streaming = self.stream and getattr(self.engine, "chunk_tokens", None) is not None
@@ -732,6 +818,9 @@ class PrefillWorker:
             # the closing part below can never overtake an intermediate one
             # into the task list.
             nonlocal parts_sent, streamed_blocks, bytes_sent
+            streamed_blocks = start_b + count
+            if start_b + count <= skip:
+                return  # decode side already holds these blocks (resume)
             payload = KvTransferPayload(
                 seq_id=item["seq_id"],
                 first_token=-1,  # only the closing part samples
@@ -742,7 +831,6 @@ class PrefillWorker:
                 block_start=start_b,
             )
             parts_sent += 1
-            streamed_blocks = start_b + count
             bytes_sent += _payload_bytes(leaves)
             loop.call_soon_threadsafe(ship_part, payload)
 

@@ -28,6 +28,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.engine import Context, ResponseStream
+from dynamo_tpu.runtime.resume import ack_item, apply_resume
 from dynamo_tpu.utils.tasks import spawn_logged
 
 
@@ -140,9 +141,18 @@ class MockerEngine:
         }
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
-        pre = PreprocessedRequest.from_wire(request.data)
+        # continuation-mode resume: a re-dispatched stream carries the
+        # accepted tokens in ``resume_from`` — extend the prompt with them,
+        # shrink the remaining budget, and ack as the FIRST item so the
+        # dispatcher's dedupe cursor knows not to drop anything.  The
+        # (last+1) mod 1000 "model" makes continuation exactly equal to a
+        # replay's tail, which is what resume-aware real engines promise.
+        wire, accepted = apply_resume(request.data)
+        pre = PreprocessedRequest.from_wire(wire)
         ctx = request.ctx
         out_q: asyncio.Queue = asyncio.Queue()
+        if accepted:
+            out_q.put_nowait(ack_item(accepted))
         seq = Sequence(seq_id=ctx.id or uuid.uuid4().hex, request=pre)
 
         def emit(tokens: list[int], finish: FinishReason | None) -> None:

@@ -35,6 +35,7 @@ import numpy as np
 
 from dynamo_tpu.robustness.faults import FAULTS, KV_TRANSFER
 from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
+from dynamo_tpu.utils import knobs
 from dynamo_tpu.utils.logging import get_logger
 
 logger = get_logger("parallel.kv_transfer")
@@ -180,7 +181,18 @@ class KvTransferClient:
         if entry is not None and not entry[1].is_closing():
             return entry
         host, _, port = address.rpartition(":")
-        reader, writer = await asyncio.open_connection(host, int(port))
+        # bound the dial: a black-holed peer (SYN into a dead route) would
+        # otherwise park the send — and the prefill pump behind it — on the
+        # kernel's connect timeout, which can be minutes
+        dial_timeout = knobs.get("DYN_KV_DIAL_TIMEOUT_S")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), timeout=dial_timeout
+            )
+        except asyncio.TimeoutError:
+            raise ConnectionError(
+                f"KV transfer dial to {address} timed out after {dial_timeout:.1f}s"
+            ) from None
         entry = (reader, writer, asyncio.Lock())
         self._conns[address] = entry
         return entry

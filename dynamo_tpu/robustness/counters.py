@@ -13,6 +13,12 @@ Known families (always rendered, zero-valued until the first event):
 - ``dyn_retries_total``       — requests re-dispatched pre-first-token
 - ``dyn_shed_total``          — requests shed by frontend admission control
 - ``dyn_faults_injected_total`` — faults fired by the injection registry
+- ``dyn_resume_attempts_total`` — mid-stream resume re-dispatches attempted
+- ``dyn_resume_success_total``  — streams completed after >= 1 resume
+- ``dyn_resume_prefill_requeues_total`` — disagg prefill work re-enqueued
+- ``dyn_drain_started_total``   — worker drains initiated
+- ``dyn_drain_completed_total`` — worker drains finished inside the budget
+- ``dyn_drain_handoff_total``   — in-flight requests handed off by a drain
 """
 
 from __future__ import annotations
@@ -24,6 +30,12 @@ HELP = {
     "dyn_retries_total": "Requests safely re-dispatched after a pre-first-token stream failure",
     "dyn_shed_total": "Requests shed (429/503) by frontend admission control",
     "dyn_faults_injected_total": "Faults fired by the DYN_FAULTS injection registry",
+    "dyn_resume_attempts_total": "Mid-stream resume re-dispatches after a post-first-token failure",
+    "dyn_resume_success_total": "Streams completed exactly-once after at least one mid-stream resume",
+    "dyn_resume_prefill_requeues_total": "Disagg prefill work re-enqueued after a mid-KV-stream loss",
+    "dyn_drain_started_total": "Worker graceful drains initiated (dynctl drain / SIGTERM / scale-down)",
+    "dyn_drain_completed_total": "Worker graceful drains that emptied within the budget",
+    "dyn_drain_handoff_total": "In-flight requests handed off (resume-redispatch) by a draining worker",
 }
 
 _lock = threading.Lock()

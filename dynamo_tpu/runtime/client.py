@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.component import Endpoint, Instance, instances_prefix
 from dynamo_tpu.runtime.dataplane import PendingStream
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
+from dynamo_tpu.runtime.resume import GenerationJournal, dedupe_stream
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.tasks import spawn_logged
 from dynamo_tpu.utils import knobs
@@ -237,72 +238,156 @@ class PushRouter:
         After the rendezvous, a stream that fails BEFORE its first item is
         re-dispatched to another healthy instance (up to ``DYN_RETRY_MAX``
         times, counted in ``dyn_retries_total`` and visible as a
-        ``dispatch.retry`` span).  First-token is the retry boundary: with
-        zero items delivered the request provably had no observable effect
-        on the client, so re-running it cannot duplicate output; once
-        anything has streamed, the error surfaces as a clean truncation
-        error instead.
+        ``dispatch.retry`` span): with zero items delivered the request
+        provably had no observable effect on the client, so re-running it
+        cannot duplicate output.
+
+        A stream that fails AFTER its first item is *resumed* when the
+        request is deterministic-replayable (greedy or seeded — see
+        runtime/resume.py) and ``DYN_RESUME`` is on: the generation journal
+        re-dispatches the original request plus a ``resume_from`` cursor,
+        and a dedupe cursor over the new stream guarantees exactly-once
+        token delivery.  Non-deterministic requests keep the honest
+        truncation error.  Direct (pinned) dispatch never fails over
+        pre-first-token — the KV router owns that reschedule — but DOES
+        resume mid-stream, un-pinned: the affinity bet is already burned
+        once the pinned worker died with the stream half-delivered.
         """
         tried: set[int] = set()
         pending, inst_id = await self._rendezvous(request, instance_id, tried)
         retry_max = knobs.get("DYN_RETRY_MAX")
+        journal: GenerationJournal | None = None
+        if retry_max > 0 and knobs.get("DYN_RESUME") and isinstance(request.data, dict):
+            journal = GenerationJournal(request.data)
+            if not journal.resumable:
+                journal = None
         if instance_id is not None or retry_max <= 0:
             # direct routing keeps affinity decisions with the scheduler
-            # (KV router does its own reschedule-excluding-failed failover)
-            return ResponseStream(pending, request.ctx)
+            # (KV router does its own reschedule-excluding-failed failover),
+            # so pre-first-token retries stay off here (retry_max=0 below)
+            if journal is None:
+                return ResponseStream(pending, request.ctx)
+            return ResponseStream(
+                self._stream_with_retry(
+                    request, pending, inst_id, tried, 0,
+                    journal=journal, resume_max=retry_max,
+                ),
+                request.ctx,
+            )
         return ResponseStream(
-            self._stream_with_retry(request, pending, inst_id, tried, retry_max),
+            self._stream_with_retry(
+                request, pending, inst_id, tried, retry_max,
+                journal=journal, resume_max=retry_max if journal else 0,
+            ),
             request.ctx,
         )
 
     async def _stream_with_retry(
         self, request: Context[dict], pending, inst_id: int, tried: set[int],
-        retry_max: int,
+        retry_max: int, journal: GenerationJournal | None = None,
+        resume_max: int = 0,
     ):
         retries = 0
+        resumes = 0
+        resume_counted = False
         while True:
             streamed_any = False
             try:
                 async for item in pending:
                     streamed_any = True
+                    if journal is not None:
+                        journal.record(item)
+                    if (
+                        resumes and not resume_counted
+                        and isinstance(item, dict)
+                        and isinstance(item.get("data"), dict)
+                        and item["data"].get("finish_reason")
+                    ):
+                        # success is counted at the FINISH item, not at
+                        # generator exhaustion: consumers stop pulling once
+                        # they see the finish, so a post-loop increment may
+                        # never run
+                        resume_counted = True
+                        counters.incr("dyn_resume_success_total")
                     yield item
+                if resumes and not resume_counted:
+                    resume_counted = True
+                    counters.incr("dyn_resume_success_total")
                 return
             except Exception as exc:  # noqa: BLE001 — retry decision below
-                if (
-                    streamed_any
-                    or retries >= retry_max
-                    or request.ctx.is_killed
-                    or not _is_transient_stream_error(exc)
-                ):
+                if request.ctx.is_killed or not _is_transient_stream_error(exc):
                     raise
-                retries += 1
-                counters.incr("dyn_retries_total")
+                accepted = journal.accepted if journal is not None else []
+                if not streamed_any and not accepted:
+                    # pre-first-token: safe plain re-dispatch
+                    if retries >= retry_max:
+                        raise
+                    retries += 1
+                    counters.incr("dyn_retries_total")
+                    tried.add(inst_id)
+                    self.quarantine(inst_id)
+                    logger.warning(
+                        "stream from instance %x failed pre-first-token (%s); "
+                        "re-dispatching (retry %d/%d)",
+                        inst_id, exc, retries, retry_max,
+                    )
+                    span = get_recorder().start(
+                        "dispatch.retry", getattr(request.ctx, "trace", None),
+                        component="frontend",
+                        attrs={
+                            "failed_instance": f"{inst_id:x}",
+                            "attempt": retries,
+                            "error": repr(exc),
+                        },
+                    )
+                    try:
+                        pending, inst_id = await self._rendezvous(request, None, tried)
+                    except BaseException as redispatch_exc:
+                        if span is not None:
+                            span.end(status="error", error=repr(redispatch_exc))
+                        # surface the original stream failure; the re-dispatch
+                        # failure (usually "no instances left") rides as cause
+                        raise exc from redispatch_exc
+                    if span is not None:
+                        span.end(instance=f"{inst_id:x}")
+                    continue
+                # mid-stream: resume from the journal (or truncate honestly)
+                if journal is None or resumes >= resume_max:
+                    raise
+                resumes += 1
+                journal.resumes = resumes
+                counters.incr("dyn_resume_attempts_total")
                 tried.add(inst_id)
                 self.quarantine(inst_id)
                 logger.warning(
-                    "stream from instance %x failed pre-first-token (%s); "
-                    "re-dispatching (retry %d/%d)",
-                    inst_id, exc, retries, retry_max,
+                    "stream from instance %x failed after %d accepted "
+                    "token(s) (%s); resuming (resume %d/%d)",
+                    inst_id, len(accepted), exc, resumes, resume_max,
                 )
                 span = get_recorder().start(
-                    "dispatch.retry", getattr(request.ctx, "trace", None),
+                    "dispatch.resume", getattr(request.ctx, "trace", None),
                     component="frontend",
                     attrs={
                         "failed_instance": f"{inst_id:x}",
-                        "attempt": retries,
+                        "accepted_tokens": len(accepted),
+                        "attempt": resumes,
                         "error": repr(exc),
                     },
                 )
+                # un-pinned re-dispatch of the ORIGINAL request + cursor; a
+                # resume-aware engine continues (and acks), everything else
+                # replays — riding the prefix cache — and the dedupe cursor
+                # drops the replayed prefix
+                resumed = Context(journal.resume_request(), request.ctx)
                 try:
-                    pending, inst_id = await self._rendezvous(request, None, tried)
+                    raw, inst_id = await self._rendezvous(resumed, None, tried)
                 except BaseException as redispatch_exc:
                     if span is not None:
                         span.end(status="error", error=repr(redispatch_exc))
-                    # surface the original stream failure; the re-dispatch
-                    # failure (usually "no instances left") rides as cause
                     raise exc from redispatch_exc
                 if span is not None:
                     span.end(instance=f"{inst_id:x}")
+                pending = dedupe_stream(raw, len(accepted))
 
     async def _rendezvous(
         self, request: Context[dict], instance_id: int | None, tried: set[int]

@@ -78,6 +78,11 @@ def stats_subject(subject: str) -> str:
     return f"_stats.{subject}"
 
 
+def ctl_subject(subject: str) -> str:
+    """Request/reply subject for per-instance control verbs (drain)."""
+    return f"_ctl.{subject}"
+
+
 class Namespace:
     def __init__(self, runtime: "DistributedRuntime", name: str):
         self.runtime = runtime

@@ -17,10 +17,17 @@ import msgpack
 
 from dynamo_tpu.observability import get_recorder
 from dynamo_tpu.observability.trace import read_trace
+from dynamo_tpu.robustness import counters
 from dynamo_tpu.robustness.faults import FAULTS, WORKER_GENERATE
-from dynamo_tpu.runtime.component import Instance, instance_key, stats_subject
+from dynamo_tpu.runtime.component import (
+    Instance,
+    ctl_subject,
+    instance_key,
+    stats_subject,
+)
 from dynamo_tpu.runtime.dataplane import ConnectionInfo, ResponseStreamSender
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineContext
+from dynamo_tpu.utils import knobs
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.tasks import spawn_logged
 
@@ -45,9 +52,14 @@ class EndpointService:
         self._lease = None
         self._sub = None
         self._stats_sub = None
+        self._ctl_sub = None
         self._tasks: set[asyncio.Task] = set()
         self._loop_task: asyncio.Task | None = None
         self._stats_task: asyncio.Task | None = None
+        self._ctl_task: asyncio.Task | None = None
+        self._draining = False
+        self._drain_task: asyncio.Task | None = None
+        self._stopped = False
         self._in_flight = 0
         self._arrived_total = 0
         self._last_arrival = 0.0  # event-loop time of the newest request
@@ -63,8 +75,10 @@ class EndpointService:
         self._lease = await plane.kv.grant_lease(lease_ttl)
         self._sub = await plane.bus.subscribe(self.instance.subject)
         self._stats_sub = await plane.bus.subscribe(stats_subject(self.instance.subject))
+        self._ctl_sub = await plane.bus.subscribe(ctl_subject(self.instance.subject))
         self._loop_task = spawn_logged(self._serve_loop())
         self._stats_task = spawn_logged(self._stats_loop())
+        self._ctl_task = spawn_logged(self._ctl_loop())
         self.runtime.register_keepalive(self._lease)
         # register *after* subscribing so no request can race the subscription
         await plane.kv.put(instance_key(self.instance), self.instance.to_json(), self._lease.id)
@@ -79,6 +93,11 @@ class EndpointService:
         through the drain window or those requests are silently dropped
         and their callers wait out the rendezvous timeout (found by the
         runtime soak test's churn wave)."""
+        if self._stopped:
+            # a graceful drain already tore everything down (it leaves the
+            # control loop alive just long enough to publish its reply)
+            await self._close_ctl()
+            return
         plane = self.runtime.plane
         await plane.kv.delete(instance_key(self.instance))
         if self._stats_sub is not None:
@@ -125,6 +144,7 @@ class EndpointService:
                 break
         if self._sub is not None:
             await self._sub.unsubscribe()
+        await self._close_ctl()
         for task in (self._loop_task, self._stats_task):
             if task is not None:
                 task.cancel()
@@ -132,6 +152,156 @@ class EndpointService:
             task.cancel()
         if self._lease is not None:
             await plane.kv.revoke_lease(self._lease)
+        self._stopped = True
+
+    async def abort(self) -> None:
+        """Crash-style teardown (chaos/worker-kill seam): no drain, no
+        grace — the lease is revoked and every handler task is cancelled
+        mid-stream, exactly like a process dying under a supervisor.  The
+        cancelled handlers' error frames give the dispatcher its mid-stream
+        failure to resume from."""
+        plane = self.runtime.plane
+        await plane.kv.delete(instance_key(self.instance))
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self._stats_sub is not None:
+            await self._stats_sub.unsubscribe()
+        await self._close_ctl()
+        for task in (self._loop_task, self._stats_task):
+            if task is not None:
+                task.cancel()
+        tasks = [t for t in list(self._tasks) if not t.done()]
+        for task in tasks:
+            task.cancel()
+        if tasks:
+            await asyncio.wait(tasks, timeout=5)
+        if self._lease is not None:
+            await plane.kv.revoke_lease(self._lease)
+        self._stopped = True
+
+    async def _close_ctl(self) -> None:
+        if self._ctl_sub is not None:
+            await self._ctl_sub.unsubscribe()
+            self._ctl_sub = None
+        task, self._ctl_task = self._ctl_task, None
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
+
+    # -- graceful drain ----------------------------------------------------
+    async def drain(self, timeout_s: float | None = None) -> dict:
+        """Empty this worker without killing any request.
+
+        State machine: (1) admissions stop instantly — the instance key is
+        deleted so routers stop picking us, and any stale-view envelope
+        that still lands gets an immediate ``worker shutting down`` error
+        frame the dispatcher treats as a safe pre-first-token retry;
+        (2) in-flight requests get ~half the budget to finish naturally;
+        (3) survivors are handed off — their handler tasks are cancelled,
+        whose error frames the dispatcher resumes from its generation
+        journal on a healthy peer; (4) the lease is revoked, so the
+        instance is gone from every view BEFORE the process exits.
+
+        Idempotent and concurrency-safe: every caller (dynctl, SIGTERM,
+        planner scale-down, a racing shutdown) awaits the same underlying
+        drain and gets the same result dict.
+        """
+        if self._drain_task is None:
+            self._drain_task = asyncio.ensure_future(self._drain(timeout_s))
+        return await asyncio.shield(self._drain_task)
+
+    async def _drain(self, timeout_s: float | None) -> dict:
+        if timeout_s is None or timeout_s <= 0:
+            timeout_s = knobs.get("DYN_DRAIN_TIMEOUT_S")
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        deadline = t0 + timeout_s
+        self._draining = True
+        counters.incr("dyn_drain_started_total")
+        span = get_recorder().start(
+            "engine.drain", None, component="worker",
+            attrs={"subject": self.instance.subject,
+                   "instance": f"{self.instance.instance_id:x}",
+                   "in_flight": self._in_flight,
+                   "timeout_s": timeout_s},
+        )
+        plane = self.runtime.plane
+        await plane.kv.delete(instance_key(self.instance))
+        # phase 1 — natural completion: short sequences just finish
+        natural_deadline = t0 + timeout_s * 0.5
+        while (self._tasks or self._in_flight) and loop.time() < natural_deadline:
+            try:
+                await asyncio.wait_for(
+                    self._drained.wait(),
+                    max(min(0.1, natural_deadline - loop.time()), 0.01),
+                )
+            except asyncio.TimeoutError:
+                pass
+            await asyncio.sleep(0)  # let _serve_loop spawn queued envelopes
+        # phase 2 — handoff: cancel survivors; their CancelledError path
+        # sends "worker shutting down", which the dispatcher's journal
+        # resumes on another worker with exactly-once delivery
+        me = asyncio.current_task()
+        handoff = [t for t in list(self._tasks) if t is not me and not t.done()]
+        for task in handoff:
+            task.cancel()
+        if handoff:
+            counters.incr("dyn_drain_handoff_total", len(handoff))
+            await asyncio.wait(handoff, timeout=max(deadline - loop.time(), 0.5))
+        emptied = not self._tasks and self._in_flight == 0
+        # phase 3 — teardown: revoke the lease before anyone can exit us
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self._stats_sub is not None:
+            await self._stats_sub.unsubscribe()
+        for task in (self._loop_task, self._stats_task):
+            if task is not None and task is not me:
+                task.cancel()
+        if self._lease is not None:
+            await plane.kv.revoke_lease(self._lease)
+        self._stopped = True
+        if emptied:
+            counters.incr("dyn_drain_completed_total")
+        result = {
+            "op": "drain",
+            "ok": emptied,
+            "instance_id": f"{self.instance.instance_id:x}",
+            "subject": self.instance.subject,
+            "handed_off": len(handoff),
+            "duration_s": round(loop.time() - t0, 3),
+        }
+        if span is not None:
+            span.end(**{k: v for k, v in result.items() if k != "op"})
+        logger.info(
+            "drained %s: ok=%s handed_off=%d in %.2fs",
+            self.instance.subject, emptied, len(handoff), result["duration_s"],
+        )
+        return result
+
+    async def _ctl_loop(self) -> None:
+        """Request/reply control verbs on ``_ctl.<subject>`` (dynctl drain)."""
+        assert self._ctl_sub is not None
+        async for msg in self._ctl_sub:
+            try:
+                op = json.loads(msg.payload.decode())
+            except Exception:  # noqa: BLE001
+                logger.warning("malformed ctl message on %s", self.instance.subject)
+                continue
+            if op.get("op") != "drain":
+                if msg.reply_to:
+                    await self.runtime.plane.bus.publish(
+                        msg.reply_to,
+                        json.dumps({"ok": False, "error": f"unknown op {op.get('op')!r}"}).encode(),
+                    )
+                continue
+            result = await self.drain(op.get("timeout_s"))
+            if msg.reply_to:
+                await self.runtime.plane.bus.publish(
+                    msg.reply_to, json.dumps(result).encode()
+                )
+            # the drain tore the instance down; close our own subscription
+            # and exit (we cannot be cancelled mid-reply this way)
+            await self._close_ctl()
+            return
 
     # -- serving -----------------------------------------------------------
     async def _serve_loop(self) -> None:
@@ -161,6 +331,18 @@ class EndpointService:
         )
         ctx.trace = span.ctx if span is not None else None
         sender = ResponseStreamSender(ConnectionInfo.from_dict(control["ci"]), ctx)
+        if self._draining or self._stopped:
+            # admission stop: a stale-view client published to a draining
+            # worker — connect back only to deliver the error frame, which
+            # the dispatcher treats as a safe pre-first-token retry
+            try:
+                await sender.connect()
+                await sender.error("worker shutting down")
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                pass
+            if span is not None:
+                span.end(status="error", error="draining: admission stopped")
+            return
         self._in_flight += 1
         self._arrived_total += 1
         self._last_arrival = asyncio.get_running_loop().time()
@@ -216,6 +398,7 @@ class EndpointService:
         data = {
             "subject": self.instance.subject,
             "instance_id": self.instance.instance_id,
+            "draining": self._draining,
             "in_flight": self._in_flight,
             "handled_total": self._handled_total,
             "errors_total": self._errors_total,

@@ -161,10 +161,39 @@ class SoakFleet:
         return _Worker(pool, engine, service, kv_pub, metrics_pub)
 
     async def _retire(self, worker: _Worker) -> None:
+        # graceful scale-down IS the drain state machine: admissions stop,
+        # stragglers hand off via resume-redispatch instead of being killed
+        await worker.service.drain(2.0)
         await worker.metrics_pub.stop()
         await worker.kv_pub.stop()
-        await worker.service.shutdown(drain_timeout=1)
         worker.engine.stop()
+
+    async def kill_worker(self, pool: str, *, mode: str = "kill") -> int | None:
+        """Chaos seam for worker-kill scenarios: take one live worker out of
+        ``pool`` mid-soak.  ``kill`` is abrupt (lease revoked, handlers
+        cancelled mid-stream — the dispatcher's generation journal must
+        resume those streams on a peer); ``drain`` runs the graceful state
+        machine.  Returns the removed worker id, or None if the pool is
+        empty."""
+        async with self._scale_lock:
+            workers = self._pools.get(pool) or []
+            if not workers:
+                return None
+            # oldest first: it holds the most in-flight work and the
+            # warmest KV — the hardest worker to lose
+            worker = workers.pop(0)
+        if mode == "drain":
+            await worker.service.drain()
+        else:
+            await worker.service.abort()
+        await worker.metrics_pub.stop()
+        await worker.kv_pub.stop()
+        worker.engine.stop()
+        self.scale_log.append(
+            {"t": self.sim_now(), "pool": pool, "op": mode,
+             "worker": f"{worker.worker_id:x}"}
+        )
+        return worker.worker_id
 
     # -- planner supervisor duck-type (connectors.LocalConnector) ------------
     def replica_count(self, pool: str) -> int:

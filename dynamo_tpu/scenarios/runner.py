@@ -239,6 +239,17 @@ class ScenarioRunner:
         armed.append({"t": round(self.sim_now(), 3), "schedule": ev.schedule})
         logger.info("phase %s: armed faults %r", phase.name, ev.schedule)
 
+    async def _kill_later(self, phase: Phase, ev, phase_t0: float,
+                          killed: list) -> None:
+        await self._sim_sleep_until(phase_t0 + ev.at_s)
+        wid = await self.fleet.kill_worker(ev.pool, mode=ev.mode)
+        killed.append({
+            "t": round(self.sim_now(), 3), "pool": ev.pool, "mode": ev.mode,
+            "worker": None if wid is None else f"{wid:x}",
+        })
+        logger.info("phase %s: %s worker %s in pool %s",
+                    phase.name, ev.mode, wid, ev.pool)
+
     # -- autopilot -----------------------------------------------------------
     async def _autopilot_step(self, phase_name: str) -> None:
         ap = self.spec.autopilot
@@ -320,9 +331,13 @@ class ScenarioRunner:
             asyncio.ensure_future(self._run_session(stats, phase_t0, s))
             for s in plan.sessions
         ]
+        killed: list = []
         chaos = [
             asyncio.ensure_future(self._arm_later(phase, ev, phase_t0, armed))
             for ev in phase.faults
+        ] + [
+            asyncio.ensure_future(self._kill_later(phase, ev, phase_t0, killed))
+            for ev in phase.worker_kills
         ]
 
         # tick/autopilot loop for the phase duration
@@ -415,6 +430,11 @@ class ScenarioRunner:
                 "armed": armed,
                 "injected": counters.get("dyn_faults_injected_total") - faults_before,
                 "fired": dict(FAULTS.fired),
+            },
+            "worker_kills": killed,
+            "resumes": {
+                "attempts": counters.get("dyn_resume_attempts_total"),
+                "succeeded": counters.get("dyn_resume_success_total"),
             },
             "assertions": {"passed": not failures, "failures": failures},
         }

@@ -94,6 +94,27 @@ class FaultEvent:
 
 
 @dataclass
+class WorkerKillEvent:
+    """Take one live worker out of a pool at a phase-relative simulated time.
+
+    ``mode="kill"`` is abrupt: the worker's lease is revoked and its handler
+    tasks are cancelled with no grace, so in-flight streams break mid-stream
+    and the dispatcher's generation journal must resume them on a peer.
+    ``mode="drain"`` runs the graceful drain state machine instead (the
+    operator/scale-down path)."""
+
+    at_s: float = 0.0
+    pool: str = "decode"
+    mode: str = "kill"
+
+    def validate(self) -> None:
+        if self.mode not in ("kill", "drain"):
+            raise ValueError(
+                f"worker kill mode must be kill|drain, got {self.mode!r}"
+            )
+
+
+@dataclass
 class PhaseAssertions:
     """What must hold when the phase drains.  Burn-rate ceilings are
     evaluated on PHASE-LOCAL counts ((bad/total)/budget over exactly the
@@ -112,6 +133,7 @@ class Phase:
     duration_s: float = 10.0         # simulated seconds
     traffic: TrafficShape = field(default_factory=TrafficShape)
     faults: list = field(default_factory=list)        # [FaultEvent]
+    worker_kills: list = field(default_factory=list)  # [WorkerKillEvent]
     assertions: PhaseAssertions = field(default_factory=PhaseAssertions)
 
     def validate(self) -> None:
@@ -119,6 +141,8 @@ class Phase:
             raise ValueError(f"phase {self.name!r}: duration_s must be > 0")
         self.traffic.validate()
         for ev in self.faults:
+            ev.validate()
+        for ev in self.worker_kills:
             ev.validate()
 
 
@@ -238,6 +262,9 @@ class ScenarioSpec:
                 casts={
                     "traffic": lambda t: _build(TrafficShape, t),
                     "faults": lambda fs: [_build(FaultEvent, f) for f in fs],
+                    "worker_kills": lambda ks: [
+                        _build(WorkerKillEvent, k) for k in ks
+                    ],
                     "assertions": lambda a: _build(PhaseAssertions, a),
                 },
             )

@@ -34,13 +34,55 @@ class WorkerHandle:
     service: object
     engine: object
     publishers: list
+    _closed: bool = False
 
     async def shutdown(self) -> None:
+        await self.service.shutdown()
+        await self._close()
+
+    async def drain(self, timeout_s: float | None = None) -> dict:
+        """Gracefully empty this worker: admissions stop at once, in-flight
+        requests finish or hand off (resume-redispatch), the lease is
+        revoked — then the engine stops.  The scale-down path for planners
+        and operators (``dynctl drain`` / SIGTERM) instead of hard kills."""
+        result = await self.service.drain(timeout_s)
+        await self._close()
+        return result
+
+    async def _close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         for pub in self.publishers:
             await pub.stop()
-        await self.service.shutdown()
         if hasattr(self.engine, "stop"):
             self.engine.stop()
+
+
+def install_drain_on_sigterm(handle: WorkerHandle, *, timeout_s: float | None = None):
+    """Opt-in SIGTERM → graceful drain for CLI launch paths (k8s preStop /
+    operator scale-down).  Must run on the main thread of a live event loop
+    (``loop.add_signal_handler`` constraint), so library/test embedders call
+    ``handle.drain()`` directly instead.  Returns the scheduled drain task
+    holder (a one-element list filled when the signal fires)."""
+    import asyncio
+    import signal
+
+    from dynamo_tpu.utils.tasks import spawn_logged
+
+    loop = asyncio.get_running_loop()
+    fired: list = []
+
+    def _on_term() -> None:
+        if not fired:
+            logger.info("SIGTERM: draining worker before exit")
+            fired.append(spawn_logged(handle.drain(timeout_s), name="sigterm-drain"))
+
+    try:
+        loop.add_signal_handler(signal.SIGTERM, _on_term)
+    except (NotImplementedError, RuntimeError) as exc:
+        logger.warning("SIGTERM drain handler unavailable: %r", exc)
+    return fired
 
 
 def build_jax_engine(model_dir: str | Path, mdc: ModelDeploymentCard, **overrides):

@@ -286,6 +286,18 @@ K_RENDEZVOUS_BUDGET_S = register(
     "DYN_RENDEZVOUS_BUDGET_S", type="float", default=0.0,
     doc="hard cap on total rendezvous time across failovers (0 = 3x the "
         "connect timeout)", section=ROBUST)
+K_RESUME = register(
+    "DYN_RESUME", type="bool", default=True,
+    doc="mid-stream resume: re-dispatch a failed stream with a `resume_from` "
+        "journal instead of truncating (`0` restores truncation)", section=ROBUST)
+K_DRAIN_TIMEOUT_S = register(
+    "DYN_DRAIN_TIMEOUT_S", type="float", default=30.0,
+    doc="graceful drain budget: admissions stop immediately, in-flight work "
+        "gets this long to finish or hand off before cancellation", section=ROBUST)
+K_KV_DIAL_TIMEOUT_S = register(
+    "DYN_KV_DIAL_TIMEOUT_S", type="float", default=5.0,
+    doc="KV-transfer pool dial timeout per connection attempt (a black-holed "
+        "peer fails the send instead of blocking forever)", section=ROBUST)
 K_ADMISSION_MAX_INFLIGHT = register(
     "DYN_ADMISSION_MAX_INFLIGHT", type="int", default=0,
     doc="frontend admission gate: max in-flight requests (0 = off)", section=ROBUST)

@@ -12,7 +12,12 @@ server, two echo workers, HTTP frontend with tight admission control — then:
    (reconnect + safe retry both observable:
    ``dyn_cp_reconnects_total >= 1``, ``dyn_retries_total >= 1``);
 3. fires a saturation burst and asserts overload surfaces as 429/503 with
-   ``Retry-After`` (``dyn_shed_total >= 1``) instead of timeouts.
+   ``Retry-After`` (``dyn_shed_total >= 1``) instead of timeouts;
+4. kills a worker stream **mid-decode** (``dp.send:nth=4``) and asserts the
+   dispatcher's generation journal resumed it on the peer with zero
+   client-visible failures (``dyn_resume_success_total >= 1``);
+5. gracefully drains one worker and asserts it deregistered (instance gone
+   from the control-plane view) while the survivor keeps serving 200s.
 
 Exit code 0 = recovered; 1 = a request failed or a recovery counter stayed
 flat (printed).  Runs in tier-1 via tests/robustness/test_chaos_smoke.py.
@@ -195,6 +200,49 @@ async def amain(
             check(
                 "dyn_cp_reconnects_total" in r.text and "dyn_shed_total" in r.text,
                 "resilience counters exported on /metrics",
+            )
+
+            # phase 3 — worker kill mid-decode: the 4th mid-stream write
+            # dies AFTER tokens reached the client; the dispatcher's
+            # generation journal must resume the stream on the peer with
+            # exactly-once delivery (no client-visible failure)
+            FAULTS.reset()
+            FAULTS.arm("dp.send:nth=4")
+            resumes_before = counters.get("dyn_resume_success_total")
+            statuses = [await _chat(client, 100 + i) for i in range(3)]
+            check(
+                all(s == 200 for s in statuses),
+                f"worker-kill phase: {statuses.count(200)}/3 requests ok "
+                f"(statuses {sorted(set(statuses))})",
+            )
+            check(
+                counters.get("dyn_resume_success_total") >= resumes_before + 1,
+                f"mid-stream resume happened (dyn_resume_success_total="
+                f"{counters.get('dyn_resume_success_total')})",
+            )
+
+            # phase 4 — graceful drain: one worker empties and deregisters;
+            # the survivor keeps serving with zero 5xx
+            FAULTS.reset()
+            import json as _json
+
+            from dynamo_tpu.runtime.component import ROOT_PATH
+
+            drained = workers[-1]
+            drained_id = drained.service.instance.instance_id
+            result = await drained.drain()
+            check(bool(result.get("ok")), f"drain completed: {result}")
+            gone = not any(
+                "/instances/" in e.key
+                and _json.loads(e.value)["instance_id"] == drained_id
+                for e in await runtime.plane.kv.get_prefix(ROOT_PATH)
+            )
+            check(gone, "drained instance deregistered from control plane")
+            statuses = [await _chat(client, 200 + i) for i in range(3)]
+            check(
+                all(s == 200 for s in statuses),
+                f"post-drain: {statuses.count(200)}/3 requests ok on survivor "
+                f"(statuses {sorted(set(statuses))})",
             )
     finally:
         if watcher is not None:

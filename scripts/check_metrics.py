@@ -37,6 +37,17 @@ RESILIENCE_FAMILIES = (
     "dyn_faults_injected_total",
 )
 
+# mid-stream resume + graceful drain (dynamo_tpu/runtime/resume.py and the
+# ingress drain state machine), exported next to the other resilience counters
+RESUME_DRAIN_FAMILIES = (
+    "dyn_resume_attempts_total",
+    "dyn_resume_success_total",
+    "dyn_resume_prefill_requeues_total",
+    "dyn_drain_started_total",
+    "dyn_drain_completed_total",
+    "dyn_drain_handoff_total",
+)
+
 # SLO burn-rate families (dynamo_tpu/observability/slo.py), appended to the
 # frontend exposition next to the resilience counters
 SLO_FAMILIES = (
@@ -55,7 +66,7 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-) + RESILIENCE_FAMILIES + SLO_FAMILIES
+) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + SLO_FAMILIES
 
 # utilization accounting (dynamo_tpu/observability/perf.py → engine stats →
 # ForwardPassMetrics → metrics service)
@@ -129,7 +140,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

@@ -1,0 +1,186 @@
+"""Disagg hardening: the prefill worker dies mid-KV-stream and the decode
+assembler re-enqueues the REMAINING work (resuming at the last contiguous
+landing block) onto the prefill queue instead of timing out into a cold
+local-prefill fallback.  Decode output must stay byte-identical to the
+single-engine greedy reference."""
+
+import jax
+import pytest
+
+from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+from dynamo_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggDecodeEngine,
+    DisaggRouter,
+    PrefillQueue,
+    PrefillWorker,
+)
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.utils.config import RuntimeConfig
+
+from tests.engine.test_jax_engine import greedy_reference
+
+CFG = LlamaConfig.tiny()
+PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+def make_engine(**overrides):
+    engine = JaxLlmEngine(
+        EngineConfig(
+            model=CFG, num_blocks=64, block_size=4, max_batch_size=4,
+            prefill_buckets=(16, 32), max_model_len=64, **overrides,
+        ),
+        params=PARAMS,
+    )
+    engine.start()
+    return engine
+
+
+def request(tokens, max_tokens=6):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=max_tokens),
+        eos_token_ids=[1],
+    ).to_wire()
+
+
+async def collect(stream):
+    tokens = []
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is not None:
+            tokens.extend(ann.data.token_ids)
+    return tokens
+
+
+async def test_prefill_death_mid_stream_requeues_remaining_work(monkeypatch):
+    """Chunked prefill ships parts 0,1 + closing part; the 2nd shipment is
+    killed.  The decode side's prefill wait expires, re-enqueues with
+    ``skip_blocks`` at the contiguous covered prefix, and the SAME worker's
+    next pass ships only the uncovered tail — no local fallback, output
+    byte-identical."""
+    # short wait so the stalled stream is detected quickly (read at
+    # DisaggDecodeEngine construction)
+    monkeypatch.setenv("DYN_DISAGG_PREFILL_TIMEOUT_S", "1.0")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://drequeue")
+    )
+    decode_engine = make_engine()
+    prefill_engine = make_engine(prefill_chunk_tokens=8)
+    disagg = prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-requeue", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS.pop(disagg.transfer_server.address, None)  # force TCP
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue, stream=True)
+        prefill_worker.start()
+
+        # warm-up until a fault-free remote prefill SUCCEEDS: the first
+        # attempts may time out into the local fallback while JAX compiles,
+        # but each pays the compile down, and the requeue under test only
+        # triggers once a streamed part demonstrably arrives in the wait
+        # window.  Same 24-token bucket as the faulted prompt.
+        warm = list(range(40, 64))
+        for _ in range(5):
+            await collect(await disagg.generate(Context(request(warm, max_tokens=2))))
+            if disagg.remote_prefills:
+                break
+        assert disagg.remote_prefills == 1, "warm-up never completed remotely"
+        counters.reset()
+        local0 = disagg.local_prefills
+
+        # the 2nd KV shipment of the stream dies: part 0 lands (2 blocks
+        # covered), part 1 never arrives, the closing part is never sent
+        FAULTS.arm("kv.transfer:nth=2")
+        prompt = list(range(3, 27))  # 24 tokens, 6 blocks, chunks of 8
+        stream = await disagg.generate(Context(request(prompt, max_tokens=6)))
+        tokens = await collect(stream)
+
+        assert FAULTS.fired.get("kv.transfer") == 1
+        assert tokens == greedy_reference(prompt, 6)
+        # remote resume, not local fallback
+        assert disagg.remote_prefill_requeues == 1
+        assert disagg.local_prefills == local0
+        assert disagg.remote_prefills == 2  # warm-up + faulted run
+        assert counters.get("dyn_resume_prefill_requeues_total") == 1
+        stats = disagg.stats()
+        assert stats["disagg_prefill_requeues_total"] == 1
+        # both engines drain clean (landing blocks were kept across the
+        # requeue, then handed to the live sequence exactly once)
+        assert prefill_engine.allocator.used_blocks == 0
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()
+
+
+async def test_requeue_disabled_falls_back_to_local_prefill(monkeypatch):
+    """DYN_RESUME=0 restores the old contract: a stalled stream degrades to
+    the cold local prefill after the wait — the request still completes."""
+    monkeypatch.setenv("DYN_DISAGG_PREFILL_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("DYN_RESUME", "0")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://dnoresume")
+    )
+    decode_engine = make_engine()
+    prefill_engine = make_engine(prefill_chunk_tokens=8)
+    disagg = prefill_worker = None
+    try:
+        router = DisaggRouter(rt, "tiny", DisaggConfig(max_local_prefill_length=4))
+        queue = PrefillQueue(rt, "ns-noresume", "backend")
+        disagg = DisaggDecodeEngine(rt, decode_engine, router, queue)
+        await disagg.start()
+        from dynamo_tpu.parallel.kv_transfer import LOCAL_SERVERS
+
+        LOCAL_SERVERS.pop(disagg.transfer_server.address, None)
+        prefill_worker = PrefillWorker(rt, prefill_engine, queue, stream=True)
+        prefill_worker.start()
+
+        FAULTS.arm("kv.transfer:nth=2")
+        prompt = list(range(3, 27))
+        tokens = await collect(
+            await disagg.generate(Context(request(prompt, max_tokens=6)))
+        )
+        assert tokens == greedy_reference(prompt, 6)
+        assert disagg.remote_prefill_requeues == 0
+        assert disagg.local_prefills == 1
+        assert disagg.remote_prefill_timeouts == 1
+    finally:
+        if prefill_worker:
+            await prefill_worker.stop()
+        if disagg:
+            await disagg.stop()
+        decode_engine.stop()
+        prefill_engine.stop()
+        await rt.close()

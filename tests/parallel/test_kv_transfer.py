@@ -192,6 +192,28 @@ async def test_bandwidth_ewma():
         await server.stop()
 
 
+async def test_dial_timeout_bounds_a_blackholed_peer(monkeypatch):
+    """A SYN into a dead route must fail the send within
+    ``DYN_KV_DIAL_TIMEOUT_S`` — not park the prefill pump on the kernel's
+    connect timeout (minutes)."""
+    import time
+
+    monkeypatch.setenv("DYN_KV_DIAL_TIMEOUT_S", "0.2")
+
+    async def blackhole(host, port):
+        await asyncio.sleep(3600)
+
+    monkeypatch.setattr(asyncio, "open_connection", blackhole)
+    client = KvTransferClient()
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError, match="timed out after 0.2s"):
+            await client.send("10.255.255.1:9", payload(0))
+        assert time.monotonic() - t0 < 1.5
+    finally:
+        await client.close()
+
+
 async def test_local_shortcut_skips_codec():
     received: list[KvTransferPayload] = []
 

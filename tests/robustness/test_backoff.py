@@ -1,0 +1,57 @@
+"""Backoff jitter contract: equal-jitter stays within ±jitter of the capped
+exponential schedule, full-jitter spans [0, cap], and a long-lived reconnect
+loop never overflows ``factor ** attempts``."""
+
+import random
+
+from dynamo_tpu.robustness.retry import Backoff
+
+
+def test_equal_jitter_bounds_pin_the_schedule():
+    b = Backoff(initial=0.1, factor=2.0, max_delay=2.0, jitter=0.2,
+                rng=random.Random(3))
+    for n in range(16):
+        expected = min(0.1 * 2.0 ** n, 2.0)
+        delay = b.next()
+        assert expected * 0.8 <= delay <= expected * 1.2, (n, delay)
+
+
+def test_full_jitter_spans_zero_to_the_capped_delay():
+    b = Backoff(initial=0.1, factor=2.0, max_delay=2.0, jitter=0.2,
+                rng=random.Random(7), full_jitter=True)
+    delays = []
+    for n in range(200):
+        cap = min(0.1 * 2.0 ** min(n, 16), 2.0)
+        delay = b.next()
+        assert 0.0 <= delay <= cap, (n, delay)
+        delays.append(delay)
+    # the spread actually covers the interval (that's the de-sync point):
+    # equal-jitter could never produce delays below 80% of the schedule
+    assert min(delays[8:]) < 0.5
+    assert max(delays) > 1.5
+
+
+def test_full_jitter_is_deterministic_under_a_seeded_rng():
+    a = Backoff(initial=0.05, max_delay=1.0, rng=random.Random(11),
+                full_jitter=True)
+    b = Backoff(initial=0.05, max_delay=1.0, rng=random.Random(11),
+                full_jitter=True)
+    assert [a.next() for _ in range(10)] == [b.next() for _ in range(10)]
+
+
+def test_days_of_attempts_never_overflow():
+    b = Backoff(initial=0.05, factor=2.0, max_delay=2.0, jitter=0.2)
+    b.attempts = 5000  # 2.0**5000 would raise OverflowError unclamped
+    for _ in range(3):
+        delay = b.next()
+        assert 0.0 <= delay <= 2.0 * 1.2
+    b.full_jitter = True
+    assert 0.0 <= b.next() <= 2.0
+
+
+def test_reset_restarts_the_schedule():
+    b = Backoff(initial=0.1, factor=2.0, max_delay=2.0, jitter=0.0)
+    first = b.next()
+    b.next()
+    b.reset()
+    assert b.next() == first == 0.1

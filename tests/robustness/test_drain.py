@@ -1,0 +1,186 @@
+"""Graceful worker drain: admissions stop instantly, in-flight requests
+finish or hand off via resume-redispatch, and the lease is revoked before
+the process exits — no request dies with its worker, no 5xx during a
+scale-down.  Covers the library path (``WorkerHandle.drain``), the operator
+path (``dynctl drain`` over a real TCP control plane), and idempotence."""
+
+import argparse
+import asyncio
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.component import ROOT_PATH
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.serve import serve_frontend, serve_worker
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+async def make_stack(n_workers: int, control_plane="memory://drain"):
+    if control_plane.startswith("memory://"):
+        MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane=control_plane)
+    )
+    workers = [
+        await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        for _ in range(n_workers)
+    ]
+    service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+    return rt, workers, service, watcher
+
+
+async def teardown(rt, workers, service, watcher):
+    await watcher.stop()
+    await service.stop()
+    for w in workers:
+        await w.shutdown()  # drain-safe: already-drained workers no-op
+    await rt.close()
+
+
+async def wait_for_model(client, name="tiny", timeout=10.0):
+    for _ in range(int(timeout / 0.1)):
+        r = await client.get("/v1/models")
+        if name in [m["id"] for m in r.json().get("data", [])]:
+            return
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"model {name} never appeared")
+
+
+async def _instance_gone(runtime, instance_id: int) -> bool:
+    return not any(
+        "/instances/" in e.key
+        and json.loads(e.value)["instance_id"] == instance_id
+        for e in await runtime.plane.kv.get_prefix(ROOT_PATH)
+    )
+
+
+async def test_drain_under_load_loses_no_request():
+    """Drain one of two loaded workers while requests are in flight: every
+    request completes 200 (finished in place or handed off), the drained
+    instance deregisters, and the survivor keeps serving."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}",
+            limits=httpx.Limits(max_connections=32),
+        ) as client:
+            await wait_for_model(client)
+
+            async def chat(i: int) -> int:
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [
+                            {"role": "user", "content": f"drain load {i} "
+                             + "alpha beta gamma delta epsilon zeta"}
+                        ],
+                    },
+                    timeout=30,
+                )
+                return r.status_code
+
+            inflight = [asyncio.ensure_future(chat(i)) for i in range(8)]
+            await asyncio.sleep(0)  # let the burst start dispatching
+            drained = workers[0]
+            drained_id = drained.service.instance.instance_id
+            result = await drained.drain(10.0)
+            statuses = await asyncio.gather(*inflight)
+
+            assert result["ok"], result
+            assert statuses == [200] * len(statuses)
+            assert await _instance_gone(rt, drained_id)
+            assert counters.get("dyn_drain_started_total") == 1
+            assert counters.get("dyn_drain_completed_total") == 1
+            # the survivor still serves after the fleet shrank
+            assert await chat(99) == 200
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_drain_is_idempotent_and_stops_admissions():
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            drained = workers[0]
+            result = await drained.drain(5.0)
+            assert result["ok"]
+            # second drain joins the finished state machine, same outcome
+            again = await drained.service.drain(5.0)
+            assert again["ok"] == result["ok"]
+            assert counters.get("dyn_drain_started_total") == 1
+            # a stale-view envelope landing on the drained worker is turned
+            # away with "worker shutting down" → the dispatcher re-dispatches
+            # pre-first-token; the client only ever sees the survivor's 200
+            for i in range(3):
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={"model": "tiny",
+                          "messages": [{"role": "user", "content": f"post {i}"}]},
+                    timeout=30,
+                )
+                assert r.status_code == 200
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_dynctl_drain_empties_a_worker_over_tcp():
+    """The operator path end-to-end: ``dynctl drain <hex>`` resolves the
+    instance in the control-plane view, sends the control-verb request,
+    and exits 0 only when the worker reports ok AND its lease is gone."""
+    from dynamo_tpu.cli.dynctl import _amain
+    from dynamo_tpu.runtime.controlplane.server import ControlPlaneServer
+
+    cp = ControlPlaneServer(port=0)
+    await cp.start()
+    rt, workers, service, watcher = await make_stack(
+        2, control_plane=f"127.0.0.1:{cp.port}"
+    )
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            drained_id = workers[0].service.instance.instance_id
+            rc = await _amain(argparse.Namespace(
+                cmd="drain", instance=f"{drained_id:016x}",
+                timeout=10.0, control_plane=f"127.0.0.1:{cp.port}",
+            ))
+            assert rc == 0
+            assert await _instance_gone(rt, drained_id)
+            # an unknown instance id is a clean failure, not a hang
+            rc = await _amain(argparse.Namespace(
+                cmd="drain", instance="ffffffffffffffff",
+                timeout=2.0, control_plane=f"127.0.0.1:{cp.port}",
+            ))
+            assert rc == 1
+            r = await client.post(
+                "/v1/chat/completions",
+                json={"model": "tiny",
+                      "messages": [{"role": "user", "content": "survivor"}]},
+                timeout=30,
+            )
+            assert r.status_code == 200
+    finally:
+        await teardown(rt, workers, service, watcher)
+        await cp.stop()

@@ -1,0 +1,120 @@
+"""Quarantine demotion is expiry-aware: a LIVE quarantine entry demotes the
+rendezvous to the short dark-probe window, but an EXPIRED entry that nobody
+pruned (direct dispatch skips ``healthy_ids``, the only other pruner) must
+get the full connect timeout back — otherwise a recovered worker keeps
+paying the probe window forever on pinned traffic.
+
+The harness is a stub runtime whose connect-back never arrives, so each
+test measures exactly which timeout the rendezvous applied."""
+
+import asyncio
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from dynamo_tpu.runtime.client import Client, PushRouter
+from dynamo_tpu.runtime.component import Instance
+from dynamo_tpu.runtime.engine import Context
+
+PROBE_S = 0.15
+CONNECT_S = 0.8
+
+
+class _Pending:
+    """A registered stream whose worker never dials back."""
+
+    def __init__(self):
+        self.connected = asyncio.Event()
+        self.trace = None
+
+
+class _ConnInfo:
+    def to_dict(self):
+        return {"host": "127.0.0.1", "port": 1, "stream_id": "stub"}
+
+
+class _Server:
+    def register(self, stream_id, ctx):
+        return _Pending()
+
+    def connection_info(self, stream_id):
+        return _ConnInfo()
+
+    def unregister(self, stream_id):
+        pass
+
+
+class _Bus:
+    async def publish(self, subject, envelope, trace=None):
+        return 1  # delivered — the worker just never connects back
+
+
+class _Runtime:
+    def __init__(self):
+        self.plane = SimpleNamespace(bus=_Bus())
+        self._server = _Server()
+
+    async def data_server(self):
+        return self._server
+
+
+INSTANCE = Instance(
+    namespace="ns", component="c", endpoint="e",
+    instance_id=0xABC, subject="ns.c.e.abc",
+)
+
+
+@pytest.fixture
+def router(monkeypatch):
+    monkeypatch.setenv("DYN_CONNECT_TIMEOUT_S", str(CONNECT_S))
+    monkeypatch.setenv("DYN_DARK_PROBE_TIMEOUT_S", str(PROBE_S))
+    monkeypatch.setenv("DYN_RENDEZVOUS_BUDGET_S", "10.0")
+    client = Client(
+        _Runtime(), SimpleNamespace(path="ns/c/e"),
+        static_instances=[INSTANCE],
+    )
+    return PushRouter(client)
+
+
+async def _elapsed_failure(router, **kwargs) -> float:
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        await router.generate(Context({"token_ids": [1]}), **kwargs)
+    return time.monotonic() - t0
+
+
+async def test_live_quarantine_demotes_to_the_probe_window(router):
+    router.quarantine(INSTANCE.instance_id)
+    elapsed = await _elapsed_failure(router)
+    assert PROBE_S * 0.8 <= elapsed < CONNECT_S * 0.75, elapsed
+
+
+async def test_live_quarantine_probe_applies_to_direct_dispatch(router):
+    router.quarantine(INSTANCE.instance_id)
+    elapsed = await _elapsed_failure(
+        router, instance_id=INSTANCE.instance_id
+    )
+    assert elapsed < CONNECT_S * 0.75, elapsed
+
+
+async def test_expired_entry_restores_the_full_connect_timeout(router):
+    """The race: the quarantine expired between the failure that created it
+    and this dispatch, but direct dispatch never calls ``healthy_ids`` so
+    the stale entry is still in the dict.  The attempt-timeout comparison
+    must check expiry itself — a recovered worker gets the full window."""
+    router._dark[INSTANCE.instance_id] = time.monotonic() - 5.0
+    elapsed = await _elapsed_failure(
+        router, instance_id=INSTANCE.instance_id
+    )
+    assert elapsed >= CONNECT_S * 0.9, elapsed
+
+
+async def test_expired_entry_is_pruned_on_the_routed_path(router):
+    """Routed dispatch prunes via ``dark_instances()``: the expired entry
+    vanishes and the instance is treated as healthy (full timeout)."""
+    router._dark[INSTANCE.instance_id] = time.monotonic() - 5.0
+    elapsed = await _elapsed_failure(router)
+    assert elapsed >= CONNECT_S * 0.9, elapsed
+    # the failed rendezvous re-quarantined it with a fresh deadline
+    assert router._dark[INSTANCE.instance_id] > time.monotonic()

@@ -1,0 +1,292 @@
+"""Mid-stream resumable generation: the journal/dedupe protocol in
+isolation, then end-to-end — a routed fleet where a worker dies mid-decode
+and the dispatcher's generation journal resumes the stream on a peer with
+exactly-once delivery (greedy output byte-identical to an unkilled run)."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.resume import (
+    RESUME_ACK_EVENT,
+    GenerationJournal,
+    ack_item,
+    apply_resume,
+    dedupe_stream,
+)
+from dynamo_tpu.serve import serve_frontend, serve_worker
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+# -- journal ----------------------------------------------------------------
+
+def wire(sampling=None, token_ids=(1, 2, 3), max_tokens=10):
+    return {
+        "token_ids": list(token_ids),
+        "sampling": dict(sampling or {"use_greedy": True}),
+        "stop": {"max_tokens": max_tokens},
+    }
+
+
+def test_journal_resume_eligibility():
+    # deterministic replays only: greedy, seeded, or temperature unset/<=0
+    assert GenerationJournal(wire({"use_greedy": True})).resumable
+    assert GenerationJournal(wire({"seed": 7, "temperature": 0.9})).resumable
+    assert GenerationJournal(wire({})).resumable  # temperature unset
+    assert GenerationJournal(wire({"temperature": 0.0})).resumable
+    assert not GenerationJournal(wire({"temperature": 0.9})).resumable
+    # non-LLM payloads (no token_ids list) must never replay-duplicate
+    assert not GenerationJournal({"sampling": {"use_greedy": True}}).resumable
+    assert not GenerationJournal({"blob": "x"}).resumable
+
+
+def test_journal_records_accepted_tokens_and_builds_the_cursor():
+    journal = GenerationJournal(wire())
+    journal.record({"data": {"token_ids": [10, 11]}})
+    journal.record({"data": {"token_ids": [12]}})
+    journal.record({"event": "note", "comment": ["x"]})  # annotation: no-op
+    assert journal.accepted == [10, 11, 12]
+
+    resumed = journal.resume_request()
+    assert resumed["token_ids"] == [1, 2, 3]  # original prompt untouched
+    payload = resumed["resume_from"]
+    assert payload["v"] == 1
+    assert payload["accepted"] == [10, 11, 12]
+    assert payload["sampling"] == {"use_greedy": True}
+    # same prompt → same hash; the journal never mutates the request
+    assert payload["prompt_hash"] == GenerationJournal(wire()).prompt_hash
+
+
+def test_apply_resume_extends_prompt_and_shrinks_budget():
+    resumed, n = apply_resume({**wire(max_tokens=10),
+                               "resume_from": {"accepted": [10, 11, 12]}})
+    assert n == 3
+    assert resumed["token_ids"] == [1, 2, 3, 10, 11, 12]
+    assert resumed["stop"]["max_tokens"] == 7
+    assert "resume_from" not in resumed
+    # budget never collapses to zero: an over-accepted resume still emits
+    resumed, n = apply_resume({**wire(max_tokens=2),
+                               "resume_from": {"accepted": [9, 9, 9]}})
+    assert n == 3 and resumed["stop"]["max_tokens"] == 1
+
+
+def test_apply_resume_without_payload_is_identity():
+    req = wire()
+    out, n = apply_resume(req)
+    assert n == 0 and out == req
+    out, n = apply_resume({**req, "resume_from": {"accepted": []}})
+    assert n == 0 and "resume_from" not in out
+
+
+# -- dedupe cursor ----------------------------------------------------------
+
+async def _drain(gen):
+    return [item async for item in gen]
+
+
+async def _stream(items):
+    for item in items:
+        yield item
+
+
+async def test_dedupe_replay_drops_exactly_the_accepted_prefix():
+    items = [{"data": {"token_ids": [10, 11]}},
+             {"data": {"token_ids": [12]}},
+             {"data": {"token_ids": [13], "finish_reason": "length"}}]
+    out = await _drain(dedupe_stream(_stream(items), 3))
+    assert out == [{"data": {"token_ids": [13], "finish_reason": "length"}}]
+
+
+async def test_dedupe_splits_an_item_straddling_the_cursor():
+    items = [{"data": {"token_ids": [10, 11, 12, 13]}}]
+    out = await _drain(dedupe_stream(_stream(items), 2))
+    assert out == [{"data": {"token_ids": [12, 13]}}]
+
+
+async def test_dedupe_preserves_finish_reason_inside_the_dropped_prefix():
+    # a finish landing inside the prefix still terminates the stream
+    items = [{"data": {"token_ids": [10, 11], "finish_reason": "stop"}}]
+    out = await _drain(dedupe_stream(_stream(items), 5))
+    assert out == [{"data": {"token_ids": [], "finish_reason": "stop"}}]
+
+
+async def test_dedupe_is_count_based_not_content_based():
+    # a NEW token equal to an old one must not be dropped
+    items = [{"data": {"token_ids": [10]}}, {"data": {"token_ids": [10]}}]
+    out = await _drain(dedupe_stream(_stream(items), 1))
+    assert out == [{"data": {"token_ids": [10]}}]
+
+
+async def test_dedupe_ack_mode_swallows_the_ack_and_drops_nothing():
+    items = [ack_item(3), {"data": {"token_ids": [20]}},
+             {"data": {"token_ids": [21]}}]
+    out = await _drain(dedupe_stream(_stream(items), 3))
+    assert out == [{"data": {"token_ids": [20]}}, {"data": {"token_ids": [21]}}]
+    assert all(i.get("event") != RESUME_ACK_EVENT for i in out)
+
+
+# -- end-to-end: routed fleet, worker dies mid-decode -----------------------
+
+async def make_stack(n_workers: int):
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://resume-e2e")
+    )
+    workers = [
+        await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        for _ in range(n_workers)
+    ]
+    service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+    return rt, workers, service, watcher
+
+
+async def teardown(rt, workers, service, watcher):
+    await watcher.stop()
+    await service.stop()
+    for w in workers:
+        await w.shutdown()
+    await rt.close()
+
+
+async def wait_for_model(client, name="tiny", timeout=10.0):
+    for _ in range(int(timeout / 0.1)):
+        r = await client.get("/v1/models")
+        if name in [m["id"] for m in r.json().get("data", [])]:
+            return
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"model {name} never appeared")
+
+
+PROMPT = "one two three four five six seven eight"
+
+
+async def _stream_text(client) -> tuple[str, list]:
+    """(concatenated delta text, error events) for one streamed chat."""
+    from dynamo_tpu.llm.protocols.sse import SseDecoder
+
+    decoder = SseDecoder()
+    text, errors = [], []
+    async with client.stream(
+        "POST",
+        "/v1/chat/completions",
+        json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": PROMPT}],
+            "stream": True,
+        },
+        timeout=30,
+    ) as r:
+        assert r.status_code == 200
+        async for chunk in r.aiter_bytes():
+            for ev in decoder.feed(chunk):
+                if not ev["data"] or ev["data"] == "[DONE]":
+                    continue
+                payload = json.loads(ev["data"])
+                if "error" in payload:
+                    errors.append(payload)
+                for choice in payload.get("choices", []):
+                    text.append(choice.get("delta", {}).get("content") or "")
+    return "".join(text), errors
+
+
+async def test_stream_resumes_mid_decode_byte_identical():
+    """The 4th mid-stream write dies AFTER tokens reached the client; the
+    journal re-dispatches to the peer and the client stream is byte-identical
+    to an unkilled run — exactly-once, no error event, no plain retry."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            baseline, errors = await _stream_text(client)
+            assert baseline and not errors
+
+            counters.reset()
+            FAULTS.arm("dp.send:nth=4")
+            resumed, errors = await _stream_text(client)
+            assert FAULTS.fired.get("dp.send") == 1
+            assert not errors, f"resume leaked an error event: {errors}"
+            assert resumed == baseline
+            assert counters.get("dyn_resume_attempts_total") == 1
+            assert counters.get("dyn_resume_success_total") == 1
+            # mid-stream failure is a resume, never a pre-first-token retry
+            assert counters.get("dyn_retries_total") == 0
+            # and the counters reach the scrape surface
+            m = await client.get("/metrics")
+            assert "dyn_resume_success_total 1" in m.text
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_unary_resumes_mid_decode_identical_content():
+    """Same failure through the aggregating (non-stream) path: the client
+    sees a plain 200 with content identical to an unkilled run."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+
+            async def chat():
+                r = await client.post(
+                    "/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": PROMPT}],
+                    },
+                    timeout=30,
+                )
+                return r
+
+            baseline = await chat()
+            assert baseline.status_code == 200
+            counters.reset()
+            FAULTS.arm("dp.send:nth=4")
+            resumed = await chat()
+            assert resumed.status_code == 200
+            assert (resumed.json()["choices"][0]["message"]["content"]
+                    == baseline.json()["choices"][0]["message"]["content"])
+            assert counters.get("dyn_resume_success_total") == 1
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_resume_disabled_restores_honest_truncation(monkeypatch):
+    """DYN_RESUME=0 restores the PR-3 contract even with a healthy peer
+    available: a post-first-token death surfaces as a clean truncation
+    error, not a silent re-dispatch."""
+    monkeypatch.setenv("DYN_RESUME", "0")
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            FAULTS.arm("dp.send:nth=4")
+            text, errors = await _stream_text(client)
+            assert text, "stream produced nothing before the fault"
+            assert errors and errors[-1]["error"]["type"] == "internal_error"
+            assert counters.get("dyn_resume_attempts_total") == 0
+            assert counters.get("dyn_retries_total") == 0
+    finally:
+        await teardown(rt, workers, service, watcher)

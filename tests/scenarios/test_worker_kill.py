@@ -1,0 +1,110 @@
+"""Worker-kill soak: the shipped ``worker_kill`` spec validates, bad kill
+modes are rejected at load time, and a scaled-down soak that abruptly kills
+a decode worker mid-phase finishes with ZERO failed requests — the
+dispatcher's resume journal and the drain state machine absorb the loss."""
+
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.scenarios.runner import run_scenario
+from dynamo_tpu.scenarios.spec import (
+    ScenarioSpec,
+    WorkerKillEvent,
+    builtin_spec_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+def test_shipped_worker_kill_spec_loads_and_round_trips():
+    spec = ScenarioSpec.load(builtin_spec_path("worker_kill"))
+    assert [p.name for p in spec.phases] == ["kill_mid_stream", "drain_survivor"]
+    kills = [ev for p in spec.phases for ev in p.worker_kills]
+    assert {k.mode for k in kills} == {"kill", "drain"}
+    assert all(k.pool == "decode" for k in kills)
+    # "no request dies with its worker" is spelled as a hard zero in-spec
+    assert all(
+        p.assertions.max_burn_rate.get("error_rate") == 0.0 for p in spec.phases
+    )
+    assert not spec.autopilot.enabled  # kills must not be backfilled
+    again = ScenarioSpec.from_dict(spec.to_dict())
+    assert again.to_dict() == spec.to_dict()
+
+
+def test_bad_kill_mode_and_unknown_keys_rejected():
+    with pytest.raises(ValueError, match="kill|drain"):
+        WorkerKillEvent(at_s=1.0, mode="explode").validate()
+    data = {
+        "name": "t",
+        "phases": [{
+            "name": "p1", "duration_s": 5.0,
+            "traffic": {"kind": "constant", "rate": 2.0},
+            "worker_kills": [{"at_s": 1.0, "mode": "explode"}],
+        }],
+    }
+    with pytest.raises(ValueError, match="kill|drain"):
+        ScenarioSpec.from_dict(data)
+    data["phases"][0]["worker_kills"] = [{"at_s": 1.0, "modee": "kill"}]
+    with pytest.raises(ValueError, match="unknown spec keys"):
+        ScenarioSpec.from_dict(data)
+
+
+SMOKE = {
+    "name": "worker_kill_smoke",
+    "seed": 7,
+    "speedup": 10.0,
+    "tick_s": 1.0,
+    "drain_s": 8.0,
+    "retry_max": 2,
+    "slo": {
+        "ttft_s": 5.0, "ttft_target": 0.5,
+        "itl_s": 2.0, "itl_target": 0.5,
+        "error_target": 0.99, "windows_s": [4.0, 12.0],
+    },
+    "fleet": {
+        "pools": {"decode": 2},
+        "policy": "random",
+        "max_batch_size": 8,
+        "num_blocks": 512,
+        "metrics_period_s": 0.5,
+    },
+    "autopilot": {"enabled": False},
+    "phases": [
+        {
+            "name": "kill",
+            "duration_s": 8.0,
+            "traffic": {"kind": "constant", "rate": 2.0, "isl": 64, "osl": 48},
+            "worker_kills": [{"at_s": 3.0, "pool": "decode", "mode": "kill"}],
+            "assertions": {
+                "max_burn_rate": {"error_rate": 0.0},
+                "min_completed": 10,
+            },
+        },
+    ],
+}
+
+
+async def test_worker_kill_soak_zero_client_visible_failures():
+    artifact = await run_scenario(
+        ScenarioSpec.from_dict(SMOKE), name="worker-kill-test"
+    )
+    assert artifact["passed"], artifact["phases"]
+    phase = artifact["phases"][0]
+    assert phase["assertions"]["passed"], phase["assertions"]["failures"]
+    # the kill actually happened, mid-phase, to a live worker
+    assert phase["worker_kills"], "kill event never fired"
+    assert phase["worker_kills"][0]["mode"] == "kill"
+    assert phase["worker_kills"][0]["worker"] is not None
+    # and no request died with it
+    assert phase["requests"]["failed"] == 0
+    assert phase["requests"]["completed"] >= 10
+    # resume accounting is surfaced in the artifact
+    assert "attempts" in phase["resumes"] and "succeeded" in phase["resumes"]
