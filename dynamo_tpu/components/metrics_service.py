@@ -80,6 +80,12 @@ class MetricsService:
             "(cumulative)",
             ["worker"], registry=self.registry,
         )
+        self.unified_fallbacks = Gauge(
+            f"{PREFIX}_unified_fallbacks_total",
+            "Unified-batch windows (or engine inits) downgraded to the "
+            "split step, by reason slug (cumulative mirrored counter)",
+            ["worker", "reason"], registry=self.registry,
+        )
         # mirrored remote counters need .set(), so they are gauges —
         # named WITHOUT the counter-reserved _total suffix
         self.prefix_hits = Gauge(
@@ -278,6 +284,7 @@ class MetricsService:
         )
         self._seen_workers: set[str] = set()
         self._seen_phases: set[tuple[str, str]] = set()
+        self._seen_fallback_reasons: set[tuple[str, str]] = set()
         self._seen_tiers: set[tuple[str, str]] = set()
         self.hit_blocks = Counter(
             f"{PREFIX}_kv_hit_blocks_total", "Matched prefix blocks routed", registry=self.registry
@@ -399,6 +406,13 @@ class MetricsService:
                 except KeyError:
                     pass
                 self._seen_phases.discard((label, phase))
+        for label, reason in list(self._seen_fallback_reasons):
+            if label not in live:
+                try:
+                    self.unified_fallbacks.remove(label, reason)
+                except KeyError:
+                    pass
+                self._seen_fallback_reasons.discard((label, reason))
         for label, tier in list(self._seen_tiers):
             if label not in live:
                 for g in (
@@ -422,6 +436,19 @@ class MetricsService:
             self.preemptions.labels(label).set(m.num_preemptions_total)
             self.unified_windows.labels(label).set(m.decode_windows_unified_total)
             self.admission_drains.labels(label).set(m.admission_drains_total)
+            reasons_now = set(m.unified_fallbacks or {})
+            for reason, count in (m.unified_fallbacks or {}).items():
+                self.unified_fallbacks.labels(label, reason).set(count)
+                self._seen_fallback_reasons.add((label, reason))
+            # a worker restart can clear a fallback reason (e.g. the knob
+            # flipped): drop its stale series like the phase gauges do
+            for seen_label, reason in list(self._seen_fallback_reasons):
+                if seen_label == label and reason not in reasons_now:
+                    try:
+                        self.unified_fallbacks.remove(label, reason)
+                    except KeyError:
+                        pass
+                    self._seen_fallback_reasons.discard((label, reason))
             self.prefix_hits.labels(label).set(m.prefix_hits_total)
             self.prefix_cached_tokens.labels(label).set(m.prefix_cached_tokens_total)
             self.spec_accepted.labels(label).set(m.spec_accepted_tokens_total)

@@ -244,7 +244,8 @@ class EngineConfig:
     # 2604.15464).  Prefill admission stops being a separate dispatch, so
     # the overlap pipeline no longer drains when a new sequence joins: its
     # first chunk simply rides the next window.  None = DYN_UNIFIED_BATCH
-    # env (default off).  The split prefill/decode path remains compiled
+    # env (default ON; "0" disables).  The split prefill/decode path remains
+    # compiled
     # and serves as fallback — speculative/guided/multimodal/disagg-prefill
     # lanes keep their current routes, and engines whose geometry the
     # unified step cannot serve (fused decode_steps>1, multi-chip meshes,
@@ -650,17 +651,30 @@ class JaxLlmEngine:
         elif env_unified is not None:
             unified = env_unified
         else:
-            unified = False
+            # default ON: every registered family with a unified forward
+            # serves mixed windows; the auto-disable matrix below downgrades
+            # unsupported configs to the split step loudly, never silently
+            unified = True
+        # unified-batch fallback bookkeeping: reason-slug → count, surfaced
+        # in stats() as dyn_worker_unified_fallbacks_total{reason}; each
+        # reason logs once per engine (_unified_skip) — the per-step route
+        # checks fire every iteration and must not spam
+        self._unified_fallbacks: dict[str, int] = {}
+        self._unified_fallback_logged: set[str] = set()
         if unified:
-            reason = None
+            reason = slug = None
             if self.family.forward_unified is None:
                 reason = f"family {config.model_family!r} has no unified forward"
+                slug = "no_family_forward"
             elif config.speculative:
                 reason = "speculative lanes keep their verify route"
+                slug = "speculative"
             elif config.decode_steps > 1:
                 reason = "fused multi-step decode windows cannot carry chunks"
+                slug = "multi_step_decode"
             elif self.mesh is not None:
                 reason = "multi-chip meshes keep the split step"
+                slug = "mesh"
             else:
                 resolved = resolve_kv_cache_dtype(config.kv_cache_dtype)
                 if resolved is not None and jnp.dtype(resolved) != jnp.dtype(
@@ -674,19 +688,26 @@ class JaxLlmEngine:
                         f"kv_cache_dtype {config.kv_cache_dtype!r} narrows "
                         "the cache below the activation dtype"
                     )
+                    slug = "narrowed_kv_dtype"
             if reason is not None:
-                logger.info("unified batch disabled: %s", reason)
+                self._unified_skip(slug, reason)
                 unified = False
         self.unified_batch = unified
         self._unified_windows = 0     # mixed windows served by one dispatch
         self._admission_drains = 0    # pipeline drains forced by admission
-        # ragged token-block granularity: every span pads to whole blocks
-        # of this many tokens (the kernel grid routes one lane per block);
-        # gcd keeps every compile bucket — powers of two plus block-rounded
-        # chunk windows — block-packable
+        # ragged token-block granularity: the flat token axis pads to whole
+        # kernel blocks of this many tokens; lanes PACK within a block (per
+        # -row routing), so this is launch-grid granularity only — gcd keeps
+        # every compile bucket — powers of two plus block-rounded chunk
+        # windows — block-packable
         import math as _math
 
         self._unified_tb = _math.gcd(config.block_size, 8) or 1
+        # fixed per-engine worklist width for the packed kernel: a token
+        # block holds at most tb lanes, each owning at most
+        # max_blocks_per_seq pages — ONE static shape per token bucket, so
+        # compiles (and AOT warming) never churn on batch composition
+        self._unified_ps = self._unified_tb * self.max_blocks_per_seq
         self._fb_zero = None          # resident all-zero feedback tokens
         self._seed_none = None        # resident no-op seed scatter args
         # Per-lane block-table host rows, rewritten only for lanes whose
@@ -728,13 +749,11 @@ class JaxLlmEngine:
                 if self.unified_batch:
                     # the steady-state MIXED window is a full chunk plus one
                     # decode token per lane: give it its own bucket too, or
-                    # every unified window pads up to the next prompt bucket
-                    pack = (
-                        self._unified_tb
-                        if self.attention_impl.startswith("pallas") else 1
-                    )
+                    # every unified window pads up to the next prompt bucket.
+                    # Decode lanes PACK into shared kernel token blocks on
+                    # both attention paths, so each costs exactly one slot.
                     mixed = -(-(
-                        self.chunk_tokens + self.config.max_batch_size * pack
+                        self.chunk_tokens + self.config.max_batch_size
                     ) // 8) * 8
                     if mixed < self.max_len:
                         self.buckets = sorted(set(self.buckets) | {mixed})
@@ -1235,8 +1254,8 @@ class JaxLlmEngine:
     def _build_unified(self):
         """Ragged unified-batch step: ONE launch computes chunked-prefill
         spans and decode tokens from different sequences (flat token axis +
-        per-lane span metadata, forward_unified → ragged paged attention),
-        then samples one token per lane.  Key-fold, penalty, bias and
+        per-token lane/pos metadata + packed page worklist, forward_unified
+        → ragged paged attention), then samples one token per lane.  Key-fold, penalty, bias and
         guided-free logits math mirror the split programs bit-for-bit so
         the two paths keep byte-identical outputs:
 
@@ -1259,8 +1278,8 @@ class JaxLlmEngine:
 
         def step(params, cache, gen_counts, prompt_counts, token_ids,
                  feedback, use_fb, block_tables, context_lens, token_pos,
-                 token_slot, token_lane, tb_lane, lane_qstart, lane_qlen,
-                 lane_start, sample_rows, sample_gate, seed_lanes,
+                 token_slot, token_lane, page_phys, page_lane, page_ord,
+                 page_count, sample_rows, sample_gate, seed_lanes,
                  seed_prompt, seed_gen, keys, temp, top_k, top_p, greedy,
                  pres, freq, rep, bias_ids, bias_vals, cos, sin):
             lane_c = jnp.clip(token_lane, 0, lanes - 1)
@@ -1270,8 +1289,8 @@ class JaxLlmEngine:
             tok = jnp.where(use_fb, feedback[lane_c], token_ids)
             logits, cache = self.family.forward_unified(
                 params, cfg, tok, cache, block_tables, context_lens,
-                token_pos, token_slot, token_lane, tb_lane, lane_qstart,
-                lane_qlen, lane_start, sample_rows, cos, sin,
+                token_pos, token_slot, token_lane, page_phys, page_lane,
+                page_ord, page_count, sample_rows, cos, sin,
                 attention=self.attention_impl, tb_tokens=tb,
             )  # [lanes, vocab]
             prompt_counts = prompt_counts.at[seed_lanes].set(
@@ -1942,6 +1961,39 @@ class JaxLlmEngine:
                  lanes_i, win_a, sds((lanes,), jnp.bool_), keys_a,
                  *tail(lanes), cos_a, sin_a),
             )
+        if self.unified_batch and self._jit_unified is not None:
+            # unified compile buckets: every reachable token-axis bucket —
+            # bounded by one chunk window plus a full complement of packed
+            # decode lanes — gets its mixed program warmed with the exact
+            # avals _run_unified ships (page worklist shapes included), so
+            # the first mixed window after a cold start never compiles on
+            # the device thread
+            nseed = self._unified_seed_slots
+            tb = self._unified_tb
+            pallas = self.attention_impl.startswith("pallas")
+            ps = self._unified_ps if pallas else 1
+            if self.chunk_tokens is not None:
+                ucap = self._bucket_len(
+                    min(self.chunk_tokens + lanes, self.max_len)
+                )
+            else:
+                ucap = self.buckets[-1]
+            for b in (x for x in self.buckets if x <= ucap):
+                if pallas and b % tb:
+                    continue  # unpackable bucket: the route check skips it
+                ntb = max(1, b // tb)
+                tok_a = sds((b,), jnp.int32)
+                jobs[("unified", b)] = (
+                    self._jit_unified,
+                    (params_a, cache_a, counts_a, counts_a, tok_a, lanes_i,
+                     sds((b,), jnp.bool_), tables_a, lanes_i, tok_a, tok_a,
+                     tok_a, sds((ntb, ps), jnp.int32),
+                     sds((ntb, ps), jnp.int32), sds((ntb, ps), jnp.int32),
+                     sds((ntb,), jnp.int32), lanes_i, lanes_i,
+                     sds((nseed,), jnp.int32), sds((nseed, vocab), jnp.int32),
+                     sds((nseed, vocab), jnp.int32), keys_a, *tail(lanes),
+                     cos_a, sin_a),
+                )
 
         import concurrent.futures as cf
 
@@ -2015,6 +2067,9 @@ class JaxLlmEngine:
             "decode_windows_sync_total": self._sync_windows,
             "decode_windows_unified_total": self._unified_windows,
             "admission_drains_total": self._admission_drains,
+            # reason-slug → count of windows (or the engine init) that fell
+            # back from the unified step; each reason also logged once
+            "unified_fallbacks": dict(self._unified_fallbacks),
             "decode_steps_total": self._decode_steps_total,
             "guided_requests_total": self._guided_requests,
             "guided_completions_total": self._guided_completions,
@@ -2181,6 +2236,21 @@ class JaxLlmEngine:
             self._sync_pipeline()
 
     # -- ragged unified-batch step ----------------------------------------
+    def _unified_skip(self, reason: str, detail: str | None = None) -> None:
+        """Record a unified-batch fallback under a short reason slug
+        (stats() → dyn_worker_unified_fallbacks_total{reason}) and log it
+        once per engine per reason — the per-step route checks run every
+        scheduler iteration, so unconditional logging would spam."""
+        self._unified_fallbacks[reason] = (
+            self._unified_fallbacks.get(reason, 0) + 1
+        )
+        if reason not in self._unified_fallback_logged:
+            self._unified_fallback_logged.add(reason)
+            logger.info(
+                "unified batch fallback [%s]: %s", reason, detail or
+                "window served by the split step"
+            )
+
     def _maybe_run_unified(self, decision) -> bool:
         """Serve this iteration as ONE ragged dispatch mixing prefill
         chunks and decode tokens.  Returns False when the step needs the
@@ -2196,11 +2266,15 @@ class JaxLlmEngine:
             return False  # idle / window-retire-only: split loop handles
         for seq in prefills:
             if seq.prefill_only or seq.mm_embeds is not None:
-                return False  # disagg extract / multimodal keep their routes
+                # disagg extract / multimodal keep their routes
+                self._unified_skip("disagg_or_mm")
+                return False
             if seq.guided is not None:
+                self._unified_skip("guided")
                 return False
         for seq in decodes:
             if seq.guided is not None:
+                self._unified_skip("guided")
                 return False
 
         spans: list[tuple[Sequence, int, int]] = []
@@ -2211,7 +2285,9 @@ class JaxLlmEngine:
                 self.chunk_tokens is not None and seq.chunk_target
             ) else n
             if end <= start:
-                return False  # degenerate window: split path owns it
+                # degenerate window: split path owns it
+                self._unified_skip("degenerate_span")
+                return False
             spans.append((seq, start, end))
         if not spans:
             # decode-only iterations keep the exact-lane decode program: the
@@ -2221,26 +2297,29 @@ class JaxLlmEngine:
             # split path pays a second dispatch and (under overlap) an
             # admission drain.  Windows from either program chain through
             # the same feedback array, so alternating costs nothing.
+            # (Deliberately uncounted: this is the designed route, not a
+            # fallback.)
             return False
-        # packing granularity: the Pallas kernel routes KV pages per token
-        # block, so spans pack to whole blocks there; the XLA fallback
-        # routes per token and packs densely
-        pack = (
-            self._unified_tb
-            if self.attention_impl.startswith("pallas") else 1
-        )
-        total = len(decodes) * pack + sum(
-            -(-(end - start) // pack) * pack for _, start, end in spans
-        )
+        # decode lanes and spans both pack DENSELY — the kernel routes per
+        # row, not per block — so every token costs exactly one flat slot
+        total = len(decodes) + sum(end - start for _, start, end in spans)
         if total > self.buckets[-1]:
+            self._unified_skip("bucket_overflow")
             return False
         bucket = self._bucket_len(total)
-        if pack > 1 and bucket % pack:
-            return False  # unpackable compile bucket (odd max_len tail)
+        if (
+            self.attention_impl.startswith("pallas")
+            and bucket % self._unified_tb
+        ):
+            # unpackable compile bucket (odd max_len tail): the kernel grid
+            # needs whole token blocks
+            self._unified_skip("unpackable_bucket")
+            return False
         unseeded = sum(
             1 for seq, start, _ in spans if start == seq.cached_tokens
         )
         if unseeded > self._unified_seed_slots:
+            self._unified_skip("seed_overflow")
             return False
 
         # per-window overlap gate, same rule as _overlap_ok: top_logprobs
@@ -2251,20 +2330,18 @@ class JaxLlmEngine:
         try:
             with self._xprof_span("dyn.unified"):
                 try:
-                    return self._run_unified(
-                        spans, decodes, bucket, overlap, pack
-                    )
+                    return self._run_unified(spans, decodes, bucket, overlap)
                 except Exception as exc:  # noqa: BLE001
                     if not self._attention_fallback(exc):
                         raise
                     # compile-class kernel failure: the jits were rebuilt on
                     # the XLA path; the in-flight window (old program)
-                    # already executed — retire it, then retry this window
-                    # (densely packed now — the fallback routes per token).
+                    # already executed — retire it, then retry this window.
                     # The retire can finish sequences (a stop detected one
                     # window late) and the first attempt can have failed a
                     # restore: re-filter so the retry never dispatches a
                     # freed lane's stale metadata.
+                    self._unified_skip("kernel_fallback")
                     self._sync_pipeline()
                     decodes = [
                         s for s in decodes if s.status == SeqStatus.RUNNING
@@ -2275,7 +2352,7 @@ class JaxLlmEngine:
                     ]
                     if not spans:
                         return False  # split path serves what remains
-                    return self._run_unified(spans, decodes, bucket, overlap, 1)
+                    return self._run_unified(spans, decodes, bucket, overlap)
         except Exception as exc:  # noqa: BLE001
             logger.exception("unified step failed")
             self._abandon_pipeline(prefills + decodes)
@@ -2290,7 +2367,6 @@ class JaxLlmEngine:
         decodes: list[Sequence],
         bucket: int,
         overlap: bool,
-        pack: int,
     ) -> bool:
         """Build the ragged batch, dispatch once, then either read back
         synchronously or put the window in flight (overlap).  A newly
@@ -2343,6 +2419,7 @@ class JaxLlmEngine:
                     seq, dev_ctx, 1, max_pos=self.max_len - 1
                 )
                 if slot is None:
+                    self._unified_skip("slot_oom")
                     self._sync_pipeline()
                     return False
                 slots[seq.seq_id] = slot
@@ -2367,16 +2444,11 @@ class JaxLlmEngine:
             if not decodes and not spans:
                 return True  # everything preempted: step consumed
 
-        num_tb = max(1, bucket // tb)
         token_ids = np.zeros((bucket,), np.int32)
         token_pos = np.full((bucket,), -1, np.int32)
         token_slot = np.full((bucket,), oob, np.int32)
         token_lane = np.full((bucket,), lanes, np.int32)
         use_fb = np.zeros((bucket,), bool)
-        tb_lane = np.zeros((num_tb,), np.int32)
-        lane_qstart = np.zeros((lanes,), np.int32)
-        lane_qlen = np.zeros((lanes,), np.int32)
-        lane_start = np.zeros((lanes,), np.int32)
         context_lens = np.zeros((lanes,), np.int32)
         sample_rows = np.zeros((lanes,), np.int32)
         sample_gate = np.zeros((lanes,), np.int32)
@@ -2410,16 +2482,11 @@ class JaxLlmEngine:
             token_pos[cursor] = pos
             token_slot[cursor] = slots[seq.seq_id]
             token_lane[cursor] = lane
-            if pack > 1:
-                tb_lane[cursor // tb] = lane
-            lane_qstart[lane] = cursor
-            lane_qlen[lane] = 1
-            lane_start[lane] = pos
             context_lens[lane] = dev_ctx
             sample_rows[lane] = cursor
             sample_gate[lane] = 1
             emit_seqs.append(seq)
-            cursor += pack
+            cursor += 1  # packed decode lanes: one flat slot per lane
         si = 0
         for seq, start, end in spans:
             self._maybe_record_queue_span(seq)
@@ -2437,12 +2504,6 @@ class JaxLlmEngine:
                 blocks[ppos // bs] * bs + ppos % bs
             )
             token_lane[cursor : cursor + span] = lane
-            npack = -(-span // pack)
-            if pack > 1:
-                tb_lane[cursor // tb : cursor // tb + npack] = lane
-            lane_qstart[lane] = cursor
-            lane_qlen[lane] = span
-            lane_start[lane] = start
             context_lens[lane] = end
             sample_rows[lane] = cursor + span - 1
             final = end >= n
@@ -2458,9 +2519,35 @@ class JaxLlmEngine:
                 seq.sampling_seeded = True
             if final:
                 emit_seqs.append(seq)
-            cursor += npack * pack
+            cursor += span
 
         tables = self._decode_tables(decodes + [s for s, _, _ in spans])
+        # packed-lane page worklist: resolve each token block's pages on the
+        # host (the kernel reads physical page ids straight from scalar
+        # prefetch — no per-block lane routing).  The worklist width is the
+        # engine-fixed self._unified_ps, so every window of this bucket
+        # shares ONE compiled program regardless of batch composition.
+        if self.attention_impl.startswith("pallas"):
+            from dynamo_tpu.ops.pallas import pack_page_meta
+
+            page_meta = pack_page_meta(
+                token_lane, token_pos, self._bt_host,
+                tb_tokens=tb, block_size=bs,
+                page_slots=self._unified_ps,
+                sliding_window=getattr(
+                    self.config.model, "sliding_window", None
+                ),
+            )
+        else:
+            # the XLA twin routes per token off token_lane/token_pos and
+            # never reads the worklist: ship minimal fixed-shape dummies
+            num_tb = max(1, bucket // tb)
+            page_meta = (
+                np.zeros((num_tb, 1), np.int32),
+                np.full((num_tb, 1), -1, np.int32),
+                np.zeros((num_tb, 1), np.int32),
+                np.zeros((num_tb,), np.int32),
+            )
         sampling_tail = self._device_sampling_tail(emit_seqs, lanes)
         if overlap and prev is not None:
             feedback_in = prev.feedback
@@ -2487,8 +2574,7 @@ class JaxLlmEngine:
             jnp.asarray(token_ids), feedback_in, jnp.asarray(use_fb),
             tables, jnp.asarray(context_lens), jnp.asarray(token_pos),
             jnp.asarray(token_slot), jnp.asarray(token_lane),
-            jnp.asarray(tb_lane), jnp.asarray(lane_qstart),
-            jnp.asarray(lane_qlen), jnp.asarray(lane_start),
+            *(jnp.asarray(a) for a in page_meta),
             jnp.asarray(sample_rows), jnp.asarray(sample_gate),
             *seed_args,
         )

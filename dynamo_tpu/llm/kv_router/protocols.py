@@ -70,6 +70,11 @@ class ForwardPassMetrics:
     # sync point the unified step exists to remove — flat while unified)
     decode_windows_unified_total: int = 0
     admission_drains_total: int = 0
+    # unified-batch fallbacks by reason slug ({reason: count} — why windows
+    # took the split path: init-time disables like "speculative"/"mesh" and
+    # per-step route checks like "guided"/"slot_oom"; empty while every
+    # window rides the unified step)
+    unified_fallbacks: dict = field(default_factory=dict)
     # utilization accounting (observability.perf): rolling rates + token
     # totals + wasted-work counters, and the opt-in engine phase timings
     # (DYN_ENGINE_PHASE_TIMING=1) as {phase: cumulative seconds}
@@ -139,6 +144,10 @@ class ForwardPassMetrics:
                 "decode_windows_unified_total", 0
             ),
             admission_drains_total=stats.get("admission_drains_total", 0),
+            unified_fallbacks={
+                str(reason): int(count)
+                for reason, count in (stats.get("unified_fallbacks") or {}).items()
+            },
             mfu_perc=stats.get("mfu_perc", 0.0),
             bandwidth_util_perc=stats.get("bandwidth_util_perc", 0.0),
             goodput_tokens_per_second=stats.get("goodput_tokens_per_second", 0.0),

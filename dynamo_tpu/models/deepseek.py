@@ -530,6 +530,58 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
     return mm(out.reshape(b, -1), w["wo"]), (k_layer, v_layer)
 
 
+def _mla_unified_attn(w, x, cfg: DeepseekConfig, positions, token_pos,
+                      token_lane, token_slot, k_layer, v_layer, block_tables,
+                      page_phys, page_lane, page_ord, page_count, cos, sin,
+                      attention: str = "jax", tb_tokens: int = 8):
+    """Absorbed-form ragged unified-batch MLA attention: the flat token
+    axis carries chunked-prefill spans + decode tokens, every token writes
+    its latent before anyone reads, scores stay in latent space per token.
+    ``attention="pallas"`` runs the packed-lane ragged MLA kernel; the XLA
+    twin (ops/attention.ragged_mla_paged_attention) is the fallback."""
+    t = x.shape[0]
+    H = cfg.num_heads
+    q = _project_q(w, x, cfg)
+    q_nope, q_rope = q[..., : cfg.qk_nope_head_dim], q[..., cfg.qk_nope_head_dim :]
+    q_rope = apply_rope(q_rope, positions, cos, sin)
+
+    c_kv, k_rope = _latent_kv(w, x, cfg)
+    k_rope = apply_rope(k_rope[:, None, :], positions, cos, sin)
+    k_layer, v_layer = write_decode_kv(
+        k_layer, v_layer, c_kv[:, None, :], k_rope, token_slot
+    )
+
+    w_uk = w["w_uk"].reshape(cfg.kv_lora_rank, H, cfg.qk_nope_head_dim)
+    w_uv = w["w_uv"].reshape(cfg.kv_lora_rank, H, cfg.v_head_dim)
+    q_lat = jnp.einsum(
+        "thn,rhn->thr", q_nope.astype(jnp.float32), w_uk.astype(jnp.float32)
+    )
+
+    num_blocks, block_size = k_layer.shape[0], k_layer.shape[1]
+    scale = float(cfg.attn_scale)
+    ck3 = k_layer.reshape(num_blocks, block_size, cfg.kv_lora_rank)
+    kr3 = v_layer.reshape(num_blocks, block_size, cfg.qk_rope_head_dim)
+
+    if attention in ("pallas", "pallas_interpret"):
+        from dynamo_tpu.ops.pallas import ragged_mla_attention
+
+        ctx = ragged_mla_attention(
+            q_lat, q_rope, ck3, kr3, token_lane, token_pos,
+            page_phys, page_lane, page_ord, page_count,
+            scale=scale, tb_tokens=tb_tokens,
+            interpret=attention == "pallas_interpret",
+        )
+    else:
+        from dynamo_tpu.ops.attention import ragged_mla_paged_attention
+
+        ctx = ragged_mla_paged_attention(
+            q_lat, q_rope, ck3, kr3, block_tables, token_lane, token_pos,
+            scale=scale,
+        )
+    out = jnp.einsum("thr,rhv->thv", ctx, w_uv.astype(jnp.float32)).astype(cfg.dtype)
+    return mm(out.reshape(t, -1), w["wo"]), (k_layer, v_layer)
+
+
 def _mla_window_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
                      block_tables, context_lens, flat_slots, cos, sin,
                      b: int, w_len: int, attention: str = "jax"):
@@ -730,6 +782,49 @@ def deepseek_forward_decode(
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)
     logits = _logits(params, cfg, x)
+    return logits.astype(jnp.float32), new_cache
+
+
+def deepseek_forward_unified(
+    params,
+    cfg: DeepseekConfig,
+    token_ids,      # [T] int32 — flat ragged token batch
+    kv_cache,
+    block_tables,   # [lanes, max_blocks] int32
+    context_lens,   # [lanes] int32 incl. each lane's span end
+    token_pos,      # [T] int32 absolute position (-1 = pad)
+    token_slot,     # [T] int32 flat cache slot (OOB = pad)
+    token_lane,     # [T] int32 owning lane (OOB = pad)
+    page_phys,      # [T // tb_tokens, PS] int32 (pack_page_meta)
+    page_lane,      # [T // tb_tokens, PS] int32 owning lane (-1 pad)
+    page_ord,       # [T // tb_tokens, PS] int32 page ordinal
+    page_count,     # [T // tb_tokens] int32 live worklist entries
+    sample_rows,    # [lanes] int32 flat index of span's LAST token
+    cos,
+    sin,
+    *,
+    attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
+    tb_tokens: int = 8,
+):
+    """Ragged unified-batch forward for the MLA family: mixed spans +
+    decode tokens in one launch against the latent cache (the llama
+    unified contract).  Every token writes its compressed latent + rope
+    key at its cache slot before attention reads, so span tokens see
+    their own in-window predecessors through the cache; the MoE stack
+    routes per token exactly as in the mixtral unified forward."""
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(token_pos, 0)
+
+    def attn(w, attn_in, k_layer, v_layer):
+        return _mla_unified_attn(
+            w, attn_in, cfg, positions, token_pos, token_lane, token_slot,
+            k_layer, v_layer, block_tables, page_phys, page_lane, page_ord,
+            page_count, cos, sin, attention=attention, tb_tokens=tb_tokens,
+        )
+
+    x, new_cache = _forward(params, cfg, x, kv_cache, attn)
+    rows = x[sample_rows]  # [lanes, h] — junk for hole lanes, caller-gated
+    logits = _logits(params, cfg, rows)
     return logits.astype(jnp.float32), new_cache
 
 

@@ -576,10 +576,10 @@ def llama_forward_unified(
     token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
     token_slot: jnp.ndarray,    # [T] int32 flat cache slot (OOB = pad)
     token_lane: jnp.ndarray,    # [T] int32 owning lane (OOB = pad)
-    tb_lane: jnp.ndarray,       # [T // tb_tokens] int32 lane per token block
-    lane_qstart: jnp.ndarray,   # [lanes] int32 flat index of span start
-    lane_qlen: jnp.ndarray,     # [lanes] int32 span length (0 = hole)
-    lane_start: jnp.ndarray,    # [lanes] int32 absolute span start position
+    page_phys: jnp.ndarray,     # [T // tb_tokens, PS] int32 (pack_page_meta)
+    page_lane: jnp.ndarray,     # [T // tb_tokens, PS] int32 owning lane (-1 pad)
+    page_ord: jnp.ndarray,      # [T // tb_tokens, PS] int32 page ordinal
+    page_count: jnp.ndarray,    # [T // tb_tokens] int32 live worklist entries
     sample_rows: jnp.ndarray,   # [lanes] int32 flat index of span's LAST token
     cos: jnp.ndarray,
     sin: jnp.ndarray,
@@ -608,8 +608,9 @@ def llama_forward_unified(
             )
 
             return ragged_kernel(
-                q, k_layer, v_layer, block_tables, context_lens, tb_lane,
-                lane_qstart, lane_qlen, lane_start, tb_tokens=tb_tokens,
+                q, k_layer, v_layer, token_lane, token_pos,
+                page_phys, page_lane, page_ord, page_count,
+                tb_tokens=tb_tokens,
                 interpret=attention == "pallas_interpret",
                 sliding_window=cfg.sliding_window,
             )

@@ -27,6 +27,7 @@ from dynamo_tpu.ops.attention import (
     paged_decode_attention,
     position_major_to_batch,
     prefill_attention_with_prefix,
+    ragged_paged_attention,
     window_attention,
     write_decode_kv,
     write_prefill_kv,
@@ -315,6 +316,90 @@ def mixtral_forward_decode(
         x @ params["embed"].T.astype(x.dtype)
         if cfg.tie_word_embeddings
         else mm(x, params["lm_head"])
+    )
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def mixtral_forward_unified(
+    params,
+    cfg: MixtralConfig,
+    token_ids,      # [T] int32 — flat ragged token batch
+    kv_cache,
+    block_tables,   # [lanes, max_blocks] int32
+    context_lens,   # [lanes] int32 incl. each lane's span end
+    token_pos,      # [T] int32 absolute position (-1 = pad)
+    token_slot,     # [T] int32 flat cache slot (OOB = pad)
+    token_lane,     # [T] int32 owning lane (OOB = pad)
+    page_phys,      # [T // tb_tokens, PS] int32 (pack_page_meta)
+    page_lane,      # [T // tb_tokens, PS] int32 owning lane (-1 pad)
+    page_ord,       # [T // tb_tokens, PS] int32 page ordinal
+    page_count,     # [T // tb_tokens] int32 live worklist entries
+    sample_rows,    # [lanes] int32 flat index of span's LAST token
+    cos,
+    sin,
+    *,
+    attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
+    tb_tokens: int = 8,
+):
+    """Ragged unified-batch forward for the sparse-MoE family: the llama
+    unified contract (mixed chunked-prefill spans + decode tokens, one
+    launch, per-token absolute positions) with the dense MLP swapped for
+    the top-k MoE FFN.  Expert routing is already per-token (ops/moe.py),
+    so it composes with the ragged layout unchanged — each token routes on
+    its own activations regardless of which lane owns it, and in the
+    no-drop regime capacity_factor is sized for, per-token expert outputs
+    are independent of batch composition (the split-vs-unified byte-parity
+    contract).  Pad rows route too and are discarded at the sample gather."""
+    t = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(cfg.dtype)
+    positions = jnp.maximum(token_pos, 0)
+
+    def layer(x, layer_in):
+        w, k_layer, v_layer = layer_in
+        state = {}
+
+        def attn(attn_in):
+            q = mm(attn_in, w["wq"]).reshape(t, cfg.num_heads, cfg.head_dim)
+            k = mm(attn_in, w["wk"]).reshape(t, cfg.num_kv_heads, cfg.head_dim)
+            v = mm(attn_in, w["wv"]).reshape(t, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
+                q = rms_norm(q, w["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, w["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q, positions, cos, sin)
+            k = apply_rope(k, positions, cos, sin)
+            # every token writes before anyone reads: span tokens see their
+            # own in-window predecessors through the cache
+            state["kv"] = write_decode_kv(k_layer, v_layer, k, v, token_slot)
+            if attention.startswith("pallas"):
+                from dynamo_tpu.ops.pallas import (
+                    ragged_paged_attention as ragged_kernel,
+                )
+
+                attn_out = ragged_kernel(
+                    q, state["kv"][0], state["kv"][1], token_lane, token_pos,
+                    page_phys, page_lane, page_ord, page_count,
+                    tb_tokens=tb_tokens,
+                    interpret=attention == "pallas_interpret",
+                )
+            else:
+                attn_out = ragged_paged_attention(
+                    q, state["kv"][0], state["kv"][1], block_tables,
+                    context_lens, token_lane, token_pos,
+                )
+            return mm(attn_out.reshape(t, -1), w["wo"])
+
+        x = _block(cfg, w, x, attn)
+        return x, state["kv"]
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer, x, (params["layers"], kv_cache["k"], kv_cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    rows = x[sample_rows]  # [lanes, h] — junk for hole lanes, caller-gated
+    logits = (
+        rows @ params["embed"].T.astype(rows.dtype)
+        if cfg.tie_word_embeddings
+        else mm(rows, params["lm_head"])
     )
     return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 

@@ -239,6 +239,7 @@ def _mixtral_family() -> ModelFamily:
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=mixtral.mixtral_forward_verify,
+        forward_unified=mixtral.mixtral_forward_unified,
     )
 
 
@@ -260,6 +261,7 @@ def _qwen3_moe_family() -> ModelFamily:
         load_weights=mixtral.load_hf_weights,
         quant_leaves=_PROJ_QUANT_LEAVES,
         forward_verify=mixtral.mixtral_forward_verify,
+        forward_unified=mixtral.mixtral_forward_unified,
     )
 
 
@@ -285,6 +287,7 @@ def _deepseek_family() -> ModelFamily:
             "ws_gate", "ws_up", "ws_down", "lm_head",
         ),
         forward_verify=deepseek.deepseek_forward_verify,
+        forward_unified=deepseek.deepseek_forward_unified,
     )
 
 

@@ -294,6 +294,70 @@ def ragged_paged_attention(
     return out.reshape(n_chunks * ch, h, d)[:t].astype(q.dtype)
 
 
+def ragged_mla_paged_attention(
+    q_lat: jnp.ndarray,         # [T, heads, R] absorbed latent queries (f32)
+    q_rope: jnp.ndarray,        # [T, heads, P] roped queries
+    ck_cache: jnp.ndarray,      # [num_blocks, block_size, R] latent (K AND V)
+    kr_cache: jnp.ndarray,      # [num_blocks, block_size, P] rope keys
+    block_tables: jnp.ndarray,  # [lanes, max_blocks] int32
+    token_lane: jnp.ndarray,    # [T] int32 owning lane per token (OOB = pad)
+    token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
+    *,
+    scale: float,
+    max_gather_tokens: int = 64,
+) -> jnp.ndarray:
+    """Ragged unified-batch MLA attention in latent space — pure-JAX twin
+    of the Pallas kernel (ops/pallas/mla_attention.py ragged_mla_attention).
+
+    Same contract as ragged_paged_attention but scores are the two-part
+    absorbed MLA form (q_lat·c_kv + q_rope·k_rope) and the context is
+    accumulated IN latent space [T, heads, R] (float32) for the caller to
+    decompress through w_uv.  Token chunking bounds the per-chunk gather
+    exactly like the GQA twin."""
+    t, h, r = q_lat.shape
+    p = q_rope.shape[-1]
+    block_size = ck_cache.shape[1]
+    lanes, max_blocks = block_tables.shape
+    length = max_blocks * block_size
+
+    ck = ck_cache[block_tables].reshape(lanes, length, r)
+    kr = kr_cache[block_tables].reshape(lanes, length, p)
+
+    def attend(qlc, qrc, lane_c, pos_c):
+        ck_t = ck[lane_c].astype(jnp.float32)  # [n, length, r]
+        kr_t = kr[lane_c].astype(jnp.float32)
+        logits = (
+            jnp.einsum("thr,tlr->thl", qlc.astype(jnp.float32), ck_t)
+            + jnp.einsum("thp,tlp->thl", qrc.astype(jnp.float32), kr_t)
+        ) * jnp.float32(scale)
+        kv_pos = jnp.arange(length)[None, :]
+        mask = kv_pos <= pos_c[:, None]  # causal; pads at -1 mask everything
+        logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+        weights = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("thl,tlr->thr", weights, ck_t)
+
+    lane = jnp.clip(token_lane, 0, lanes - 1)
+    if t <= max_gather_tokens:
+        return attend(q_lat, q_rope, lane, token_pos)
+    ch = max_gather_tokens
+    n_chunks = -(-t // ch)
+    pad = n_chunks * ch - t
+    qlp = jnp.pad(q_lat, ((0, pad), (0, 0), (0, 0)))
+    qrp = jnp.pad(q_rope, ((0, pad), (0, 0), (0, 0)))
+    lane_p = jnp.pad(lane, (0, pad))                       # lane 0, masked
+    pos_p = jnp.pad(token_pos, (0, pad), constant_values=-1)
+    out = jax.lax.map(
+        lambda a: attend(*a),
+        (
+            qlp.reshape(n_chunks, ch, h, r),
+            qrp.reshape(n_chunks, ch, h, p),
+            lane_p.reshape(n_chunks, ch),
+            pos_p.reshape(n_chunks, ch),
+        ),
+    )
+    return out.reshape(n_chunks * ch, h, r)[:t]
+
+
 def window_attention(
     attention: str,
     q: jnp.ndarray,

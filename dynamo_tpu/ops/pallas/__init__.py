@@ -14,13 +14,19 @@ from dynamo_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_window_attention_decode,
 )
-from dynamo_tpu.ops.pallas.ragged_attention import ragged_paged_attention
+from dynamo_tpu.ops.pallas.ragged_attention import (
+    pack_page_meta,
+    ragged_paged_attention,
+)
+from dynamo_tpu.ops.pallas.mla_attention import ragged_mla_attention
 from dynamo_tpu.ops.pallas.block_copy import gather_blocks, scatter_blocks
 
 __all__ = [
     "paged_attention_decode",
     "paged_window_attention_decode",
     "ragged_paged_attention",
+    "ragged_mla_attention",
+    "pack_page_meta",
     "gather_blocks",
     "scatter_blocks",
 ]

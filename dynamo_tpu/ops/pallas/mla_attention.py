@@ -267,3 +267,170 @@ def mla_paged_attention_decode(
         out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
         interpret=interpret,
     )(block_tables, context_lens, q_lat, q_rope, ck_cache, kr_cache)
+
+
+def _ragged_kernel(
+    token_lane_ref,     # [T] int32 — owning lane per token (OOB = pad)
+    token_pos_ref,      # [T] int32 — absolute position per token (-1 = pad)
+    page_phys_ref,      # [num_tb, PS] int32 — physical page per grid step
+    page_lane_ref,      # [num_tb, PS] int32 — lane owning that page
+    page_ord_ref,       # [num_tb, PS] int32 — page ordinal in its lane
+    page_count_ref,     # [num_tb] int32 — live worklist entries
+    q_lat_ref,          # [1, TB*H, R]  (token-major fold: row = tok*H + h)
+    q_rope_ref,         # [1, TB*H, P]
+    ck_page_ref,        # [1, bs, R]
+    kr_page_ref,        # [1, bs, P]
+    out_ref,            # [1, TB*H, R]
+    m_ref,              # [TB*H, 128] f32
+    l_ref,
+    acc_ref,            # [TB*H, R] f32
+    *,
+    block_size: int,
+    scale: float,
+    page_slots: int,
+    tb_tokens: int,
+    num_heads: int,
+):
+    """Ragged unified-batch MLA: the packed page-worklist loop of
+    ops/pallas/ragged_attention.py applied to the latent cache — two-part
+    scores, latent-space accumulation (decompression outside)."""
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+    tbh = tb_tokens * num_heads
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    page_lane = page_lane_ref[t, j]
+    page_start = page_ord_ref[t, j] * block_size
+
+    @pl.when(j < page_count_ref[t])
+    def _compute():
+        q_lat = q_lat_ref[0].astype(jnp.float32)    # [TB*H, R]
+        q_rope = q_rope_ref[0].astype(jnp.float32)  # [TB*H, P]
+        ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
+        kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
+        s = (
+            jax.lax.dot_general(
+                q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            + jax.lax.dot_general(
+                q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        ) * scale                                    # [TB*H, bs]
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
+        tok_of_row = row // num_heads
+        base = t * tb_tokens
+        q_pos = jnp.full((tbh, 1), -1, jnp.int32)
+        row_lane = jnp.full((tbh, 1), -1, jnp.int32)
+        for rr in range(tb_tokens):
+            q_pos = jnp.where(tok_of_row == rr, token_pos_ref[base + rr], q_pos)
+            row_lane = jnp.where(
+                tok_of_row == rr, token_lane_ref[base + rr], row_lane
+            )
+        mask = (row_lane == page_lane) & (pos <= q_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == page_slots - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, :1], 1e-20)
+        out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "tb_tokens", "interpret")
+)
+def ragged_mla_attention(
+    q_lat: jnp.ndarray,         # [T, H, R] flat ragged token batch
+    q_rope: jnp.ndarray,        # [T, H, P]
+    ck_cache: jnp.ndarray,      # [N, bs, R] latent cache (keys AND values)
+    kr_cache: jnp.ndarray,      # [N, bs, P] rope-key cache
+    token_lane: jnp.ndarray,    # [T] int32 owning lane (OOB = pad)
+    token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
+    page_phys: jnp.ndarray,     # [T // tb_tokens, PS] int32 (pack_page_meta)
+    page_lane: jnp.ndarray,     # [T // tb_tokens, PS] int32
+    page_ord: jnp.ndarray,      # [T // tb_tokens, PS] int32
+    page_count: jnp.ndarray,    # [T // tb_tokens] int32
+    *,
+    scale: float,
+    tb_tokens: int = 8,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Ragged unified-batch MLA paged attention with packed lanes: one
+    launch over mixed chunked-prefill spans + decode tokens against the
+    latent cache.  Returns the latent-space context [T, H, R] (float32);
+    metadata comes from ragged_attention.pack_page_meta over the latent
+    block tables."""
+    t_pad, h, r = q_lat.shape
+    p_dim = q_rope.shape[-1]
+    bs = ck_cache.shape[1]
+    if t_pad % tb_tokens:
+        raise ValueError(
+            f"flat token axis ({t_pad}) must pack whole token blocks of "
+            f"{tb_tokens}"
+        )
+    num_tb = t_pad // tb_tokens
+    page_slots = page_phys.shape[1]
+    tbh = tb_tokens * h
+
+    def kv_map(t, j, tl, tp, pp, pln, po, pc):
+        return (pp[t, j], 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(num_tb, page_slots),
+        in_specs=[
+            pl.BlockSpec((1, tbh, r), lambda t, j, *_: (t, 0, 0)),
+            pl.BlockSpec((1, tbh, p_dim), lambda t, j, *_: (t, 0, 0)),
+            pl.BlockSpec((1, bs, r), kv_map),
+            pl.BlockSpec((1, bs, p_dim), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, tbh, r), lambda t, j, *_: (t, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tbh, 128), jnp.float32),
+            pltpu.VMEM((tbh, 128), jnp.float32),
+            pltpu.VMEM((tbh, r), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        block_size=bs,
+        scale=scale,
+        page_slots=page_slots,
+        tb_tokens=tb_tokens,
+        num_heads=h,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_tb, tbh, r), jnp.float32),
+        interpret=interpret,
+    )(
+        token_lane, token_pos, page_phys, page_lane, page_ord, page_count,
+        q_lat.reshape(num_tb, tbh, r),
+        q_rope.reshape(num_tb, tbh, p_dim),
+        ck_cache, kr_cache,
+    )
+    return out.reshape(t_pad, h, r)

@@ -7,30 +7,45 @@ arxiv 2604.15464).  This is the kernel that lets the engine run mixed
 prefill+decode as ONE dispatch — no separate prefill program, no
 overlap-pipeline drain at sequence admission.
 
-Layout (follows the page-mapping idiom of ``paged_attention.py``):
+Layout (PACKED lanes — multiple sequences share one token block):
 
 - the flat token axis is cut into fixed-size TOKEN BLOCKS of ``tb_tokens``
-  rows; the host packs each sequence's query span into whole token blocks
-  (a span never shares a block with another sequence), so every grid step
-  serves exactly one lane — ``tb_lane[t]`` names it;
-- grid = (token blocks × KV pages): for token block ``t`` and page ``p``
-  the BlockSpec index_map reads the scalar-prefetched block table row of
-  ``tb_lane[t]``, so the page "gather" is pure DMA addressing;
-- per-lane row metadata rides in scalar prefetch: ``lane_qstart`` (flat
-  index of the span's first token), ``lane_qlen`` (span length, 0 = lane
-  hole), ``lane_start`` (absolute position of the span's first token) and
-  ``context_lens`` (absolute context INCLUDING the span's last token);
+  rows; the host packs spans AND single decode tokens densely, so one
+  block can carry up to ``tb_tokens`` different lanes (a 16-lane
+  decode-heavy window fills 2 blocks of 8 instead of burning 16
+  one-live-row blocks);
+- per-token routing rides in scalar prefetch: ``token_lane[i]`` names
+  token i's sequence lane and ``token_pos[i]`` its absolute position
+  (-1 = padding row, fully masked) — the same metadata the XLA twin
+  consumes, replacing the old one-lane-per-block ``tb_lane`` routing;
+- the KV side is a host-flattened page worklist per token block:
+  ``page_phys[t, j]`` is the PHYSICAL cache page the grid step (t, j)
+  DMAs (the BlockSpec index map reads it directly — no block-table
+  indirection in the kernel), ``page_lane[t, j]`` the lane that owns it,
+  ``page_ord[t, j]`` its ordinal in that lane's sequence (kv positions
+  start at ``ord * block_size``), and ``page_count[t]`` the number of
+  live entries.  Pad entries REPEAT the last live physical page so the
+  unchanged index map skips their DMA; their compute is gated off by
+  ``j < page_count[t]`` (repeating without the gate would double-count
+  that page in the softmax accumulator);
+- grid = (token blocks × page slots): page slots is the static width of
+  the worklist — a compile-bucket choice of the caller (the engine uses
+  one fixed width so there is exactly one unified program per token
+  bucket);
 - heads fold into the row axis like the window kernel (row = token*H + h)
   and GQA matching uses iota masks on the [TB*H, bs*KVH] score matrix;
 - softmax accumulates online flash-style in VMEM scratch across a token
-  block's pages; causality is per-row: token at absolute position q sees
-  cache positions <= q, which also masks every other lane's pages because
-  pages stream per-lane via the block table.
+  block's page slots; masking is per-row: a row participates in a page
+  step iff its token's lane owns the page and the page position is
+  causally visible (pos <= token_pos), which also confines every lane to
+  its own pages.
 
-Padding rows (decode blocks carry 1 live row, span tails round up, the
-token axis pads to a compile bucket with ``tb_lane = 0``) mask out through
-``lane_qstart``/``lane_qlen`` — their output rows are garbage the caller
-never reads.
+Padding rows (position -1 / out-of-range lane) match no page and no
+position — their l stays 0, the clamped denominator makes their output
+rows zero, and the caller never reads them.
+
+``pack_page_meta`` (plain numpy, host side) builds the page worklist from
+the per-token metadata + block tables; the engine and the tests share it.
 """
 
 from __future__ import annotations
@@ -39,19 +54,91 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
 
+def pack_page_meta(
+    token_lane,     # [T] int — owning lane per token (OOB / pos<0 = pad)
+    token_pos,      # [T] int — absolute position per token (-1 = pad)
+    block_tables,   # [lanes, max_blocks] int — logical->physical pages
+    *,
+    tb_tokens: int,
+    block_size: int,
+    page_slots: int | None = None,
+    sliding_window: int | None = None,
+):
+    """Host-side (numpy) page worklist for the packed ragged kernel.
+
+    For every token block: the lanes present in it (first-appearance
+    order), then for each lane every page holding kv positions its tokens
+    can see — causally up to ``max(token_pos) // block_size`` and, under a
+    sliding window, down from ``(min(token_pos) - W + 1) // block_size``.
+    Returns ``(page_phys, page_lane, page_ord, page_count)`` int32 arrays
+    of width ``page_slots`` (default: the tightest width that fits; the
+    engine passes its fixed compile-bucket width).  Pad entries repeat the
+    last live physical page so their DMA is skipped by the unchanged
+    BlockSpec index; blocks with no live tokens point at page 0 with
+    count 0."""
+    token_lane = np.asarray(token_lane)
+    token_pos = np.asarray(token_pos)
+    bt = np.asarray(block_tables)
+    lanes = bt.shape[0]
+    t_pad = token_lane.shape[0]
+    if t_pad % tb_tokens:
+        raise ValueError(
+            f"flat token axis ({t_pad}) must pack whole token blocks of "
+            f"{tb_tokens}"
+        )
+    num_tb = t_pad // tb_tokens
+    per_block: list[list[tuple[int, int, int]]] = []
+    for t in range(num_tb):
+        span: dict[int, tuple[int, int]] = {}
+        for i in range(t * tb_tokens, (t + 1) * tb_tokens):
+            lane, pos = int(token_lane[i]), int(token_pos[i])
+            if pos < 0 or not 0 <= lane < lanes:
+                continue
+            lo, hi = span.get(lane, (pos, pos))
+            span[lane] = (min(lo, pos), max(hi, pos))
+        entries: list[tuple[int, int, int]] = []
+        for lane, (lo, hi) in span.items():
+            first = 0
+            if sliding_window is not None:
+                first = max(0, lo - (sliding_window - 1)) // block_size
+            for ord_ in range(first, hi // block_size + 1):
+                entries.append((int(bt[lane, ord_]), lane, ord_))
+        per_block.append(entries)
+    need = max((len(e) for e in per_block), default=0)
+    ps = page_slots if page_slots is not None else max(1, need)
+    if need > ps:
+        raise ValueError(
+            f"page worklist needs {need} slots but page_slots={ps}"
+        )
+    page_phys = np.zeros((num_tb, ps), np.int32)
+    page_lane = np.full((num_tb, ps), -1, np.int32)
+    page_ord = np.zeros((num_tb, ps), np.int32)
+    page_count = np.zeros((num_tb,), np.int32)
+    for t, entries in enumerate(per_block):
+        page_count[t] = len(entries)
+        for j, (phys, lane, ord_) in enumerate(entries):
+            page_phys[t, j] = phys
+            page_lane[t, j] = lane
+            page_ord[t, j] = ord_
+        if entries:
+            page_phys[t, len(entries):] = entries[-1][0]
+    return page_phys, page_lane, page_ord, page_count
+
+
 def _ragged_kernel(
-    block_tables_ref,   # [lanes, maxb] int32
-    context_lens_ref,   # [lanes] int32 — INCLUDING each lane's span end
-    tb_lane_ref,        # [num_tb] int32 — lane served by each token block
-    lane_qstart_ref,    # [lanes] int32 — flat index of the span's first token
-    lane_qlen_ref,      # [lanes] int32 — span length (0 = hole)
-    lane_start_ref,     # [lanes] int32 — absolute position of the first token
+    token_lane_ref,     # [T] int32 — owning lane per token (OOB = pad)
+    token_pos_ref,      # [T] int32 — absolute position per token (-1 = pad)
+    page_phys_ref,      # [num_tb, PS] int32 — physical page per grid step
+    page_lane_ref,      # [num_tb, PS] int32 — lane owning that page
+    page_ord_ref,       # [num_tb, PS] int32 — page ordinal in its lane
+    page_count_ref,     # [num_tb] int32 — live worklist entries
     q_ref,              # [1, TB*H, D]   (token-major fold: row = tok*H + h)
     k_page_ref,         # [1, bs*KVH, D]
     v_page_ref,
@@ -64,37 +151,27 @@ def _ragged_kernel(
     num_kv_heads: int,
     groups: int,
     head_dim: int,
-    max_blocks: int,
+    page_slots: int,
     tb_tokens: int,
     sliding_window: int | None,
 ):
-    """Online-softmax page loop for one ragged token block."""
+    """Online-softmax page-worklist loop for one packed token block."""
     t = pl.program_id(0)
-    page = pl.program_id(1)
-    lane = tb_lane_ref[t]
-    ctx = context_lens_ref[lane]
-    qs = lane_qstart_ref[lane]
-    ql = lane_qlen_ref[lane]
-    sp = lane_start_ref[lane]
+    j = pl.program_id(1)
     rows = block_size * num_kv_heads
     h_all = num_kv_heads * groups
     tbh = tb_tokens * h_all
 
-    @pl.when(page == 0)
+    @pl.when(j == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    page_start = page * block_size
+    page_lane = page_lane_ref[t, j]
+    page_start = page_ord_ref[t, j] * block_size
 
-    active = page_start < ctx
-    if sliding_window is not None:
-        # pages entirely below the OLDEST query's window contribute nothing
-        # (lowest visible absolute position = lane_start - (W_s - 1))
-        active &= page_start + block_size > sp - (sliding_window - 1)
-
-    @pl.when(active)
+    @pl.when(j < page_count_ref[t])
     def _compute():
         q = q_ref[0].astype(jnp.float32)        # [TB*H, D]
         k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
@@ -110,12 +187,28 @@ def _ragged_kernel(
         kv_of_col = col % num_kv_heads
         row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
         kv_of_row = (row % h_all) // groups
-        # row r serves flat token t*TB + r//H; its offset inside the span
-        # places it at absolute position lane_start + offset
-        q_rel = t * tb_tokens + row // h_all - qs        # [TB*H, 1]
-        q_pos = sp + q_rel
-        row_ok = (q_rel >= 0) & (q_rel < ql)
-        mask = (kv_of_col == kv_of_row) & row_ok & (pos <= q_pos)
+        # per-row routing: row r serves flat token t*TB + r//H — its lane
+        # and absolute position come from the scalar-prefetched per-token
+        # metadata, folded in as a select chain over the block's tokens
+        # (scalar reads broadcast against the row iota; no vector gather)
+        tok_of_row = row // h_all
+        base = t * tb_tokens
+        q_pos = jnp.full((tbh, 1), -1, jnp.int32)
+        row_lane = jnp.full((tbh, 1), -1, jnp.int32)
+        for rr in range(tb_tokens):
+            q_pos = jnp.where(tok_of_row == rr, token_pos_ref[base + rr], q_pos)
+            row_lane = jnp.where(
+                tok_of_row == rr, token_lane_ref[base + rr], row_lane
+            )
+        # a row participates iff its token's lane owns this page and the
+        # page position is causally visible (pads sit at q_pos = -1 and
+        # match nothing; stale slots past a lane's context exceed every
+        # q_pos of that lane, so causality masks them too)
+        mask = (
+            (kv_of_col == kv_of_row)
+            & (row_lane == page_lane)
+            & (pos <= q_pos)
+        )
         if sliding_window is not None:
             mask = mask & (pos > q_pos - sliding_window)
         s = jnp.where(mask, s, NEG_INF)
@@ -135,7 +228,7 @@ def _ragged_kernel(
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(page == max_blocks - 1)
+    @pl.when(j == page_slots - 1)
     def _finish():
         denom = jnp.maximum(l_ref[:, :1], 1e-20)
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
@@ -148,23 +241,24 @@ def ragged_paged_attention(
     q: jnp.ndarray,             # [T, H, D] flat ragged token batch
     k_cache: jnp.ndarray,       # [N, bs, KVH, D]
     v_cache: jnp.ndarray,
-    block_tables: jnp.ndarray,  # [lanes, maxb] int32
-    context_lens: jnp.ndarray,  # [lanes] int32 incl. each span's last token
-    tb_lane: jnp.ndarray,       # [T // tb_tokens] int32
-    lane_qstart: jnp.ndarray,   # [lanes] int32
-    lane_qlen: jnp.ndarray,     # [lanes] int32 (0 = lane hole)
-    lane_start: jnp.ndarray,    # [lanes] int32
+    token_lane: jnp.ndarray,    # [T] int32 owning lane (OOB = pad)
+    token_pos: jnp.ndarray,     # [T] int32 absolute position (-1 = pad)
+    page_phys: jnp.ndarray,     # [T // tb_tokens, PS] int32 (pack_page_meta)
+    page_lane: jnp.ndarray,     # [T // tb_tokens, PS] int32
+    page_ord: jnp.ndarray,      # [T // tb_tokens, PS] int32
+    page_count: jnp.ndarray,    # [T // tb_tokens] int32
     *,
     tb_tokens: int = 8,
     interpret: bool = False,
     sliding_window: int | None = None,
 ) -> jnp.ndarray:
-    """Pallas ragged paged attention: causally-masked paged attention over
-    one mixed prefill+decode token batch in a single launch (pure-JAX twin:
-    ops/attention.py ragged_paged_attention)."""
+    """Pallas ragged paged attention with PACKED decode lanes: causally
+    masked paged attention over one mixed prefill+decode token batch in a
+    single launch, multiple lanes per token block (pure-JAX twin:
+    ops/attention.py ragged_paged_attention; host metadata builder:
+    pack_page_meta)."""
     t_pad, h, d = q.shape
     n, bs, kvh, _ = k_cache.shape
-    maxb = block_tables.shape[1]
     groups = h // kvh
     rows = bs * kvh
     if t_pad % tb_tokens:
@@ -173,20 +267,21 @@ def ragged_paged_attention(
             f"{tb_tokens}"
         )
     num_tb = t_pad // tb_tokens
+    page_slots = page_phys.shape[1]
     tbh = tb_tokens * h
 
-    def kv_map(t, p, bt, cl, tl, qs, ql, ls):
-        return (bt[tl[t], p], 0, 0)
+    def kv_map(t, j, tl, tp, pp, pln, po, pc):
+        return (pp[t, j], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
-        grid=(num_tb, maxb),
+        grid=(num_tb, page_slots),
         in_specs=[
-            pl.BlockSpec((1, tbh, d), lambda t, p, *_: (t, 0, 0)),
+            pl.BlockSpec((1, tbh, d), lambda t, j, *_: (t, 0, 0)),
             pl.BlockSpec((1, rows, d), kv_map),
             pl.BlockSpec((1, rows, d), kv_map),
         ],
-        out_specs=pl.BlockSpec((1, tbh, d), lambda t, p, *_: (t, 0, 0)),
+        out_specs=pl.BlockSpec((1, tbh, d), lambda t, j, *_: (t, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((tbh, 128), jnp.float32),
             pltpu.VMEM((tbh, 128), jnp.float32),
@@ -199,7 +294,7 @@ def ragged_paged_attention(
         num_kv_heads=kvh,
         groups=groups,
         head_dim=d,
-        max_blocks=maxb,
+        page_slots=page_slots,
         tb_tokens=tb_tokens,
         sliding_window=sliding_window,
     )
@@ -209,8 +304,7 @@ def ragged_paged_attention(
         out_shape=jax.ShapeDtypeStruct((num_tb, tbh, d), q.dtype),
         interpret=interpret,
     )(
-        block_tables, context_lens, tb_lane, lane_qstart, lane_qlen,
-        lane_start,
+        token_lane, token_pos, page_phys, page_lane, page_ord, page_count,
         q.reshape(num_tb, tbh, d),
         k_cache.reshape(n, rows, d),
         v_cache.reshape(n, rows, d),

@@ -206,8 +206,9 @@ K_DECODE_OVERLAP = register(
         "`0` disables the overlapped decode pipeline)", section=PERF)
 K_UNIFIED_BATCH = register(
     "DYN_UNIFIED_BATCH", type="bool", default=None,
-    doc="override `EngineConfig.unified_batch` (unset defers to config; "
-        "`1` enables the ragged unified-batch step)", section=PERF)
+    doc="override `EngineConfig.unified_batch` (unset defers to config, "
+        "which defaults ON for every family with a unified forward; `0` "
+        "forces the split prefill/decode step)", section=PERF)
 K_KERNEL_PERF = register(
     "DYN_KERNEL_PERF", type="str", default=None,
     doc="explicit path to a KERNEL_PERF.json kernel-choice table (default: "

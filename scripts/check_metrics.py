@@ -116,6 +116,7 @@ DISAGG_FAMILIES = (
 UNIFIED_FAMILIES = (
     "dyn_worker_unified_windows",
     "dyn_worker_admission_drains",
+    "dyn_worker_unified_fallbacks_total",
 )
 
 # planner autopilot state (dynamo_tpu/planner/state.py events mirrored by

@@ -27,10 +27,18 @@ admission — the sync point the unified step removes; must stay 0 in
 unified mode), unified-window count, and per-phase shares.  Exits nonzero
 when unified regresses steps/s below ``--mixed-min-speedup``.
 
+``--family`` picks the model family for the mixed A/B: ``llama`` (the
+``--model`` LlamaConfig geometry), ``moe`` (Mixtral tiny_moe routed
+experts) or ``mla`` (DeepSeek tiny_mla latent attention) — every family
+with a registered unified forward.  ``--decode-heavy`` switches the
+arrival pattern to one burst plus a mid-decode straggler: the window is
+decode lanes wall-to-wall, the regime the packed-lane kernel exists for.
+
 Usage: python scripts/profile_decode.py [--model llama32_1b|tiny]
            [--quant int8] [--isl 256] [--osl 64] [--batch 16]
            [--decode-steps 1] [--overlap 0|1] [--ab]
-           [--mixed] [--requests 12] [--arrival-ms 50] [--chunk 32]
+           [--mixed] [--family llama|moe|mla] [--decode-heavy]
+           [--requests 12] [--arrival-ms 50] [--chunk 32]
 """
 
 from __future__ import annotations
@@ -44,6 +52,24 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["DYN_ENGINE_PHASE_TIMING"] = "1"
+
+
+def _family_setup(args: argparse.Namespace):
+    """Resolve ``--family`` to (registry key, model config, model label)."""
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    family = getattr(args, "family", "llama") or "llama"
+    if family == "llama":
+        return "llama", getattr(LlamaConfig, args.model)(), args.model
+    if family == "moe":
+        from dynamo_tpu.models.mixtral import MixtralConfig
+
+        return "mixtral", MixtralConfig.tiny_moe(), "tiny_moe"
+    if family == "mla":
+        from dynamo_tpu.models.deepseek import DeepseekConfig
+
+        return "deepseek_v2", DeepseekConfig.tiny_mla(), "tiny_mla"
+    raise SystemExit(f"unknown --family {family!r} (llama|moe|mla)")
 
 
 def _decode_phase_shares(phase_ms: dict) -> dict:
@@ -218,11 +244,10 @@ async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
         StopConditions,
     )
     from dynamo_tpu.models.registry import get_family
-    from dynamo_tpu.models.llama import LlamaConfig
     from dynamo_tpu.runtime.engine import Context
 
-    cfg = getattr(LlamaConfig, args.model)()
-    family = get_family("llama")
+    fam_name, cfg, model_label = _family_setup(args)
+    family = get_family(fam_name)
     max_len = args.isl + args.osl + 16
     block_size = 16
     num_blocks = args.batch * ((max_len + block_size - 1) // block_size) + 8
@@ -240,6 +265,7 @@ async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
     engine = JaxLlmEngine(
         EngineConfig(
             model=cfg,
+            model_family=fam_name,
             num_blocks=num_blocks,
             block_size=block_size,
             max_batch_size=args.batch,
@@ -259,7 +285,7 @@ async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
     )
     engine.start()
     mode = "unified" if engine.unified_batch else "split"
-    print(f"profile: mixed engine up ({args.model}, {mode})", file=sys.stderr)
+    print(f"profile: mixed engine up ({model_label}, {mode})", file=sys.stderr)
     rng = np.random.default_rng(0)
 
     def make_request() -> dict:
@@ -290,9 +316,20 @@ async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
     engine.phase_stats.clear()
     t0 = time.monotonic()
     tasks = []
-    for _ in range(args.requests):
-        tasks.append(asyncio.ensure_future(drive(make_request())))
+    if getattr(args, "decode_heavy", False):
+        # decode-heavy packing scenario: admit everything in one burst so
+        # the steady-state window is decode lanes wall-to-wall (the regime
+        # the packed-lane kernel compresses from one block per lane to
+        # dense rows), then one straggler lands mid-decode to prove a
+        # chunk can still ride a packed decode window
+        for _ in range(args.requests - 1):
+            tasks.append(asyncio.ensure_future(drive(make_request())))
         await asyncio.sleep(args.arrival_ms / 1e3)
+        tasks.append(asyncio.ensure_future(drive(make_request())))
+    else:
+        for _ in range(args.requests):
+            tasks.append(asyncio.ensure_future(drive(make_request())))
+            await asyncio.sleep(args.arrival_ms / 1e3)
     counts = await asyncio.gather(*tasks)
     wall = time.monotonic() - t0
     stats = engine.stats()
@@ -305,8 +342,10 @@ async def run_mixed(args: argparse.Namespace, *, unified: bool) -> dict:
     return {
         "platform": dev.platform,
         "device_kind": dev.device_kind,
-        "model": args.model,
+        "model": model_label,
+        "family": getattr(args, "family", "llama") or "llama",
         "mode": mode,
+        "decode_heavy": bool(getattr(args, "decode_heavy", False)),
         "iterations": (
             stats["iterations_total"] - before["iterations_total"]
         ),
@@ -349,7 +388,9 @@ async def amain(args: argparse.Namespace) -> tuple[int, dict]:
         speedup = uni["steps_s"] / split["steps_s"] if split["steps_s"] else 0.0
         result = {
             "mixed": True,
-            "model": args.model,
+            "model": uni["model"],
+            "family": uni["family"],
+            "decode_heavy": uni["decode_heavy"],
             "batch": args.batch,
             "isl": args.isl,
             "osl": args.osl,
@@ -441,6 +482,15 @@ def main() -> int:
     parser.add_argument("--mixed-min-speedup", type=float, default=1.0,
                         help="minimum unified/split steps_s ratio for "
                              "--mixed to exit 0")
+    parser.add_argument("--family", default="llama",
+                        choices=("llama", "moe", "mla"),
+                        help="--mixed: model family (llama uses --model; "
+                             "moe/mla use the tiny Mixtral/DeepSeek "
+                             "geometries)")
+    parser.add_argument("--decode-heavy", action="store_true",
+                        help="--mixed: burst admission + one mid-decode "
+                             "straggler — windows are packed decode lanes "
+                             "nearly wall-to-wall")
     parser.add_argument("--requests", type=int, default=12,
                         help="--mixed: requests in the arrival stream")
     parser.add_argument("--arrival-ms", type=int, default=50,

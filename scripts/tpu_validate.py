@@ -320,6 +320,90 @@ def bench_block_copy(iters: int) -> list[dict]:
     return rows
 
 
+def bench_ragged_packed(iters: int) -> list[dict]:
+    """Packed decode lanes vs the padded per-lane-block layout, through the
+    SAME ragged kernel — the measurement behind the unified step's dense
+    packing.  A decode-heavy window of N single-token lanes used to burn N
+    mostly-empty token blocks (each lane padded to its own block); per-row
+    lane routing packs them into ceil(N/tb) blocks.  blocks_* and
+    block_reduction are host-side packing facts (hardware-independent —
+    the tier-1 regression diff gates on them); the timings are only
+    meaningful compiled on real hardware."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.ops.pallas import pack_page_meta, ragged_paged_attention
+
+    rows = []
+    tb = 8
+    # decode-heavy windows: every lane one token at the context tail
+    shapes = (
+        ((8, 32), (16, 32)) if INTERPRET else ((8, 1024), (16, 1024), (16, 3072))
+    )
+    qh, kvh, d = (4, 2, 128) if INTERPRET else (32, 8, 128)
+    bs = 8 if INTERPRET else 16
+    for lanes, ctx in shapes:
+        nblocks_seq = (ctx + bs - 1) // bs
+        pool = lanes * nblocks_seq + 8
+        rng = np.random.default_rng(0)
+        k = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jnp.bfloat16)
+        tables = np.asarray(
+            rng.permutation(pool)[: lanes * nblocks_seq].reshape(
+                lanes, nblocks_seq
+            ),
+            np.int32,
+        )
+
+        def layout(packed: bool):
+            # packed: lanes share token blocks densely; padded: each lane
+            # rounds up to its own whole block (the pre-packing layout)
+            t = -(-lanes // tb) * tb if packed else lanes * tb
+            token_lane = np.full((t,), lanes, np.int32)
+            token_pos = np.full((t,), -1, np.int32)
+            for lane in range(lanes):
+                row = lane if packed else lane * tb
+                token_lane[row] = lane
+                token_pos[row] = ctx - 1
+            meta = pack_page_meta(
+                token_lane, token_pos, tables, tb_tokens=tb, block_size=bs
+            )
+            q = jnp.asarray(
+                rng.standard_normal((t, qh, d)), jnp.bfloat16
+            )
+            args = (q, k, v, jnp.asarray(token_lane), jnp.asarray(token_pos),
+                    *(jnp.asarray(a) for a in meta))
+            return args, t // tb
+
+        fn = jax.jit(
+            lambda q, k, v, tl, tp, pp, pl, po, pc: ragged_paged_attention(
+                q, k, v, tl, tp, pp, pl, po, pc, tb_tokens=tb,
+                interpret=INTERPRET,
+            ).astype(q.dtype)
+        )
+        chain = lambda a, out: (out,) + a[1:]  # noqa: E731
+        args_packed, blocks_packed = layout(packed=True)
+        args_padded, blocks_padded = layout(packed=False)
+        us_packed = _time_us(fn, *args_packed, iters=iters, chain=chain)
+        us_padded = _time_us(fn, *args_padded, iters=iters, chain=chain)
+        rows.append(
+            {
+                "bench": "ragged_packed_decode",
+                "lanes": lanes,
+                "ctx": ctx,
+                "tb_tokens": tb,
+                "blocks_packed": blocks_packed,
+                "blocks_padded": blocks_padded,
+                "block_reduction": round(blocks_padded / blocks_packed, 2),
+                "packed_us": round(us_packed, 1),
+                "padded_us": round(us_padded, 1),
+                "packed_speedup": round(us_padded / us_packed, 3),
+            }
+        )
+    return rows
+
+
 def bench_calibration(iters: int) -> list[dict]:
     """Self-check rows proving the timing methodology: a dependent-chain
     matmul with known FLOPs and a dependent-chain stream with known bytes.
@@ -377,7 +461,8 @@ def run_bench(out_path: str | None) -> int:
         ),
         "rows": [],
     }
-    for fn in (bench_calibration, bench_attention, bench_block_copy):
+    for fn in (bench_calibration, bench_attention, bench_block_copy,
+               bench_ragged_packed):
         try:
             rows = fn(iters)
         except Exception as exc:  # noqa: BLE001 — independent benches

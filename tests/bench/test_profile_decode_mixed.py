@@ -22,16 +22,13 @@ def mixed_args(**overrides) -> SimpleNamespace:
         batch=4, decode_steps=1, overlap=None, ab=False,
         ab_min_speedup=0.0, mixed=True, mixed_min_speedup=0.0,
         requests=6, arrival_ms=30, chunk=16, out=None,
+        family="llama", decode_heavy=False,
     )
     defaults.update(overrides)
     return SimpleNamespace(**defaults)
 
 
-async def test_profile_decode_mixed_smoke(monkeypatch):
-    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
-    from profile_decode import amain
-
-    rc, result = await amain(mixed_args())
+def _assert_mixed_ok(rc, result):
     assert rc == 0
     assert result["mixed"] is True
     # both modes ran the arrival stream and the report carries the numbers
@@ -46,3 +43,53 @@ async def test_profile_decode_mixed_smoke(monkeypatch):
     # ...and new-sequence admission never drained its pipeline
     assert result["admission_drains_unified"] == 0
     assert result["unified_speedup_steps_s"] > 0.0
+
+
+async def test_profile_decode_mixed_smoke(monkeypatch):
+    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
+    from profile_decode import amain
+
+    rc, result = await amain(mixed_args())
+    _assert_mixed_ok(rc, result)
+    assert result["family"] == "llama"
+
+
+async def test_profile_decode_mixed_moe_family(monkeypatch):
+    """--family moe: the Mixtral routed-expert unified forward serves the
+    same continuous-arrival A/B end to end."""
+    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
+    from profile_decode import amain
+
+    rc, result = await amain(
+        mixed_args(family="moe", isl=16, osl=6, requests=4, batch=4)
+    )
+    _assert_mixed_ok(rc, result)
+    assert result["family"] == "moe"
+    assert result["model"] == "tiny_moe"
+
+
+async def test_profile_decode_mixed_mla_family(monkeypatch):
+    """--family mla: the DeepSeek latent-KV unified forward serves the
+    same continuous-arrival A/B end to end."""
+    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
+    from profile_decode import amain
+
+    rc, result = await amain(
+        mixed_args(family="mla", isl=16, osl=6, requests=4, batch=4)
+    )
+    _assert_mixed_ok(rc, result)
+    assert result["family"] == "mla"
+    assert result["model"] == "tiny_mla"
+
+
+async def test_profile_decode_mixed_decode_heavy(monkeypatch):
+    """--decode-heavy: burst admission packs the window with decode lanes;
+    the unified engine still serves ragged windows and never drains."""
+    monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
+    from profile_decode import amain
+
+    rc, result = await amain(
+        mixed_args(decode_heavy=True, osl=16, requests=4, batch=4)
+    )
+    _assert_mixed_ok(rc, result)
+    assert result["decode_heavy"] is True

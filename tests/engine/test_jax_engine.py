@@ -853,9 +853,11 @@ async def test_phase_timing_stats(monkeypatch):
     via stats(); off by default (no phase_ms key, no hot-loop tax)."""
     monkeypatch.setenv("DYN_ENGINE_PHASE_TIMING", "1")
     # the overlapped pipeline (default) has no synchronous decode.readback:
-    # the wait moves to decode.retire, which runs behind the next window
+    # the wait moves to decode.retire, which runs behind the next window.
+    # unified_batch=False: the prefill.* phases belong to the split path —
+    # a unified engine serves prefill inside the mixed decode window
     for overlap, readback_key in ((True, "decode.retire"), (False, "decode.readback")):
-        engine = make_engine(decode_overlap=overlap)
+        engine = make_engine(decode_overlap=overlap, unified_batch=False)
         try:
             prompt = list(range(3, 9))
             await collect(engine, request(prompt, max_tokens=4, ignore_eos=True))

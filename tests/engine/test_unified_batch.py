@@ -236,31 +236,151 @@ async def test_unified_disagg_prefill_falls_back():
     assert n == n_ref
 
 
+async def run_family_matrix(
+    family, cfg, reqs, *, overlap=True, stagger_s=0.0, **engine_kw
+):
+    """Drive the same requests through a split and a unified engine of a
+    non-llama family (shared params); return (split, unified, unified
+    stats)."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+    from dynamo_tpu.models.registry import get_family
+
+    params = get_family(family).init_params(cfg, jax.random.PRNGKey(0))
+    out, stats = [], []
+    for unified in (False, True):
+        defaults = dict(
+            model=cfg, model_family=family, num_blocks=64, block_size=4,
+            max_batch_size=4, prefill_buckets=(16, 32), max_model_len=64,
+            unified_batch=unified, decode_overlap=overlap,
+        )
+        defaults.update(engine_kw)
+        engine = JaxLlmEngine(EngineConfig(**defaults), params=params)
+        engine.start()
+        try:
+            tasks = []
+            for r in reqs:
+                tasks.append(asyncio.ensure_future(collect(engine, r)))
+                if stagger_s:
+                    await asyncio.sleep(stagger_s)
+            results = await asyncio.gather(*tasks)
+            stats.append(engine.stats())
+        finally:
+            engine.stop()
+        out.append(results)
+    return out[0], out[1], stats[1]
+
+
+async def test_unified_moe_family_parity():
+    """Mixtral routed experts through the unified forward: byte-identical
+    greedy streams split-vs-unified, chunked prefill and mid-window
+    admission included (token-level dispatch keeps per-token routing
+    independent of batch composition)."""
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    cfg = MixtralConfig.tiny_moe()
+    prompts = [list(range(3 + i, 13 + i)) for i in range(3)]
+    reqs = [request(p, max_tokens=6, ignore_eos=True) for p in prompts]
+    split, unified, stats = await run_family_matrix(
+        "mixtral", cfg, reqs, overlap=True, stagger_s=0.02,
+        prefill_chunk_tokens=8,
+    )
+    assert unified == split
+    assert stats["decode_windows_unified_total"] > 0
+    assert stats["admission_drains_total"] == 0
+
+
+async def test_unified_qwen3_moe_qk_norm_parity():
+    """The qk_norm branch (Qwen3-MoE: per-head RMSNorm pre-rope) holds the
+    same byte-parity contract through the shared MoE unified forward."""
+    from dataclasses import replace
+
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    cfg = replace(MixtralConfig.tiny_moe(), qk_norm=True)
+    prompts = [list(range(3, 13)), list(range(5, 15))]
+    reqs = [request(p, max_tokens=5, ignore_eos=True) for p in prompts]
+    split, unified, stats = await run_family_matrix(
+        "qwen3_moe", cfg, reqs, overlap=True, stagger_s=0.02,
+    )
+    assert unified == split
+    assert stats["decode_windows_unified_total"] > 0
+
+
+async def test_unified_mla_family_parity():
+    """DeepSeek MLA through the unified forward: the latent-KV ragged path
+    (absorbed decode over the packed c_kv/k_rope caches) emits byte-identical
+    greedy streams, chunked prefill and mid-window admission included."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+
+    cfg = DeepseekConfig.tiny_mla()
+    prompts = [list(range(3 + i, 13 + i)) for i in range(3)]
+    reqs = [request(p, max_tokens=6, ignore_eos=True) for p in prompts]
+    split, unified, stats = await run_family_matrix(
+        "deepseek_v2", cfg, reqs, overlap=True, stagger_s=0.02,
+        prefill_chunk_tokens=8,
+    )
+    assert unified == split
+    assert stats["decode_windows_unified_total"] > 0
+    assert stats["admission_drains_total"] == 0
+
+
+async def test_unified_moe_mla_seeded_parity():
+    """Seeded sampling (with penalties) stays byte-identical split-vs-unified
+    for the MoE and MLA families — the per-lane key fold rides context_lens
+    in both paths, exactly as it does for llama."""
+    from dynamo_tpu.models.deepseek import DeepseekConfig
+    from dynamo_tpu.models.mixtral import MixtralConfig
+
+    prompt = list(range(3, 20))
+    req = sampled_request(
+        prompt, max_tokens=8, temperature=8.0, seed=1234,
+        frequency_penalty=2.0,
+    )
+    for family, cfg in (
+        ("mixtral", MixtralConfig.tiny_moe()),
+        ("deepseek_v2", DeepseekConfig.tiny_mla()),
+    ):
+        split, unified, stats = await run_family_matrix(
+            family, cfg, [req], overlap=True, prefill_chunk_tokens=8,
+        )
+        assert unified == split
+        assert stats["decode_windows_unified_total"] > 0
+
+
 async def test_unified_knob_env_and_auto_disable(monkeypatch):
-    """DYN_UNIFIED_BATCH turns the path on; explicit config outranks the
-    env; geometries the ragged step cannot serve auto-disable loudly."""
+    """Unified batch is ON by default; DYN_UNIFIED_BATCH=0 and explicit
+    config turn it off; geometries the ragged step cannot serve
+    auto-disable loudly and count the reason in stats()."""
     engine = make_engine()
-    assert engine.unified_batch is False  # default off
+    assert engine.unified_batch is True  # default on
     engine.stop()
-    monkeypatch.setenv("DYN_UNIFIED_BATCH", "1")
+    monkeypatch.setenv("DYN_UNIFIED_BATCH", "0")
     engine = make_engine()
-    assert engine.unified_batch is True
+    assert engine.unified_batch is False
     engine.stop()
+    engine = make_engine(unified_batch=True)
+    assert engine.unified_batch is True  # explicit config outranks env
+    engine.stop()
+    monkeypatch.delenv("DYN_UNIFIED_BATCH")
     engine = make_engine(unified_batch=False)
     assert engine.unified_batch is False
     engine.stop()
-    monkeypatch.delenv("DYN_UNIFIED_BATCH")
     # speculative lanes keep their verify route
     engine = make_engine(unified_batch=True, speculative="ngram")
     assert engine.unified_batch is False
+    assert engine.stats()["unified_fallbacks"].get("speculative") == 1
     engine.stop()
     # fused multi-step windows cannot carry chunks
     engine = make_engine(unified_batch=True, decode_steps=4)
     assert engine.unified_batch is False
+    assert engine.stats()["unified_fallbacks"].get("multi_step_decode") == 1
     engine.stop()
     # narrowed KV dtype breaks split-vs-unified byte parity
     engine = make_engine(unified_batch=True, kv_cache_dtype="fp8")
     assert engine.unified_batch is False
+    assert engine.stats()["unified_fallbacks"].get("narrowed_kv_dtype") == 1
     engine.stop()
 
 

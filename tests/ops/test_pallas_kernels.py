@@ -256,65 +256,68 @@ def test_mla_window_attention_kernel_matches_reference():
 
 
 def ragged_meta(spans, lanes, tb=8, t_pad=None):
-    """Pack (lane, start_pos, q_len) spans into the ragged metadata the
-    unified kernel consumes: each span occupies whole token blocks, pads
-    carry lane 0 with fully-masked rows (the engine's packing)."""
-    total = sum(-(-l // tb) * tb for _, _, l in spans)
-    t_pad = t_pad or total
+    """Pack (lane, start_pos, q_len) spans DENSELY into the ragged
+    per-token metadata the unified kernel consumes: spans and decode
+    tokens share token blocks (packed lanes); only the flat axis tail
+    pads to whole blocks, with fully-masked rows."""
+    total = sum(l for _, _, l in spans)
+    t_pad = t_pad or -(-total // tb) * tb
     token_lane = np.full((t_pad,), lanes, np.int32)
     token_pos = np.full((t_pad,), -1, np.int32)
-    tb_lane = np.zeros((t_pad // tb,), np.int32)
-    qstart = np.zeros((lanes,), np.int32)
-    qlen = np.zeros((lanes,), np.int32)
-    lstart = np.zeros((lanes,), np.int32)
     ctx = np.zeros((lanes,), np.int32)
     cur = 0
     for lane, start, l in spans:
         token_lane[cur : cur + l] = lane
         token_pos[cur : cur + l] = np.arange(start, start + l)
-        ntb = -(-l // tb)
-        tb_lane[cur // tb : cur // tb + ntb] = lane
-        qstart[lane], qlen[lane], lstart[lane] = cur, l, start
         ctx[lane] = start + l
-        cur += ntb * tb
+        cur += l
     return (
-        jnp.asarray(token_lane), jnp.asarray(token_pos),
-        jnp.asarray(tb_lane), jnp.asarray(qstart), jnp.asarray(qlen),
-        jnp.asarray(lstart), jnp.asarray(ctx),
+        jnp.asarray(token_lane), jnp.asarray(token_pos), jnp.asarray(ctx)
     )
 
 
-def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None):
+def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None, sliding_window=None):
     """Kernel + pure-JAX twin over the shared test cache; returns
     (kernel_out, ref_out, token_pos host array, q)."""
+    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
+    from dynamo_tpu.ops.pallas import (
+        pack_page_meta,
+        ragged_paged_attention as ragged_kernel,
+    )
+
     rng = jax.random.PRNGKey(0)
     k_cache, v_cache, tables, _ = build_cache(rng)
-    token_lane, token_pos, tb_lane, qstart, qlen, lstart, ctx = ragged_meta(
-        spans, lanes, tb=tb, t_pad=t_pad
+    token_lane, token_pos, ctx = ragged_meta(spans, lanes, tb=tb, t_pad=t_pad)
+    page_meta = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=tb,
+        block_size=k_cache.shape[1], sliding_window=sliding_window,
     )
-    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
-    from dynamo_tpu.ops.pallas import ragged_paged_attention as ragged_kernel
-
     t = token_lane.shape[0]
     q = jax.random.normal(jax.random.fold_in(rng, q_key), (t, 4, 128), jnp.float32)
-    ref = ragged_ref(q, k_cache, v_cache, tables, ctx, token_lane, token_pos)
+    ref = ragged_ref(
+        q, k_cache, v_cache, tables, ctx, token_lane, token_pos,
+        sliding_window=sliding_window,
+    )
     out = ragged_kernel(
-        q, k_cache, v_cache, tables, ctx, tb_lane, qstart, qlen, lstart,
-        tb_tokens=tb, interpret=True,
+        q, k_cache, v_cache, token_lane, token_pos,
+        *(jnp.asarray(a) for a in page_meta),
+        tb_tokens=tb, interpret=True, sliding_window=sliding_window,
     )
     return np.asarray(out), np.asarray(ref), np.asarray(token_pos), q
 
 
 def test_ragged_attention_decode_only_matches_decode_kernel():
     """A decode-only ragged batch (one token per lane) must equal both the
-    pure-JAX twin and the plain paged decode path row-for-row."""
+    pure-JAX twin and the plain paged decode path row-for-row — and with
+    packed lanes all three decode tokens share ONE token block."""
     spans = [(0, 4, 1), (1, 16, 1), (2, 28, 1)]
     out, ref, token_pos, q = run_ragged(spans)
+    assert out.shape[0] == 8  # 3 lanes packed into a single 8-token block
     valid = token_pos >= 0
     np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
     rng = jax.random.PRNGKey(0)
     k_cache, v_cache, tables, _ = build_cache(rng)
-    rows = np.asarray([0, 8, 16])
+    rows = np.asarray([0, 1, 2])
     dec = paged_decode_attention(
         q[jnp.asarray(rows)], k_cache, v_cache, tables,
         jnp.asarray([5, 17, 29], jnp.int32),
@@ -340,13 +343,133 @@ def test_ragged_attention_mixed_and_single_token_tail():
 
 
 def test_ragged_attention_lane_holes_and_padding():
-    """Lane 1 is a hole (qlen 0) and the token axis pads past the spans:
-    every live row still matches, junk rows stay NaN-free."""
+    """Lane 1 is a hole (contributes no tokens) and the token axis pads
+    past the spans: every live row still matches, junk rows stay
+    NaN-free."""
     spans = [(0, 4, 1), (2, 20, 9)]
     out, ref, token_pos, _ = run_ragged(spans, t_pad=32)
     valid = token_pos >= 0
     np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
     assert np.isfinite(out).all()
+
+
+def test_ragged_attention_single_lane_degenerate():
+    """A single lane owning the whole window (the degenerate packing) is
+    just chunked prefill — packed metadata must not perturb it."""
+    out, ref, token_pos, _ = run_ragged([(1, 0, 17)])
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_attention_packed_block_reduction_16_lanes():
+    """The acceptance geometry: a 16-lane decode-heavy window.  Packed
+    lanes fit it in ceil(16/8) = 2 kernel token blocks — >= 4x fewer than
+    the one-lane-per-block layout's 16 — while every row still matches
+    the twin byte-for-row."""
+    from dynamo_tpu.ops.attention import (
+        ragged_paged_attention as ragged_ref,
+        write_prefill_kv,
+    )
+    from dynamo_tpu.ops.pallas import (
+        pack_page_meta,
+        ragged_paged_attention as ragged_kernel,
+    )
+
+    lanes, bs, kvh, d, maxb, tb = 16, 8, 2, 128, 4, 8
+    rng = jax.random.PRNGKey(3)
+    keys = jax.random.split(rng, 3)
+    k_cache = jnp.zeros((lanes * maxb, bs, kvh, d), jnp.float32)
+    v_cache = jnp.zeros((lanes * maxb, bs, kvh, d), jnp.float32)
+    tables = jnp.arange(lanes * maxb, dtype=jnp.int32).reshape(lanes, maxb)
+    ctx = [(5 + 3 * i) % (maxb * bs - 1) + 1 for i in range(lanes)]
+    for i in range(lanes):
+        k_seq = jax.random.normal(jax.random.fold_in(keys[0], i), (maxb * bs, kvh, d))
+        v_seq = jax.random.normal(jax.random.fold_in(keys[1], i), (maxb * bs, kvh, d))
+        k_cache, v_cache = write_prefill_kv(
+            k_cache, v_cache, k_seq, v_seq, tables[i], jnp.int32(ctx[i])
+        )
+    spans = [(i, ctx[i] - 1, 1) for i in range(lanes)]
+    token_lane, token_pos, ctx_a = ragged_meta(spans, lanes, tb=tb)
+    packed_blocks = token_lane.shape[0] // tb
+    padded_blocks = lanes  # one-lane-per-block: every decode lane = 1 block
+    assert packed_blocks * 4 <= padded_blocks
+    page_meta = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=tb, block_size=bs
+    )
+    q = jax.random.normal(keys[2], (token_lane.shape[0], 4, d), jnp.float32)
+    ref = ragged_ref(q, k_cache, v_cache, tables, ctx_a, token_lane, token_pos)
+    out = ragged_kernel(
+        q, k_cache, v_cache, token_lane, token_pos,
+        *(jnp.asarray(a) for a in page_meta),
+        tb_tokens=tb, interpret=True,
+    )
+    valid = np.asarray(token_pos) >= 0
+    np.testing.assert_allclose(
+        np.asarray(out)[valid], np.asarray(ref)[valid], rtol=2e-5, atol=2e-5
+    )
+
+
+def test_pack_page_meta_pads_repeat_last_page():
+    """Worklist pads repeat the last live physical page (the unchanged
+    BlockSpec index skips their DMA) and empty blocks count zero."""
+    from dynamo_tpu.ops.pallas import pack_page_meta
+
+    token_lane = np.asarray([0, 1, 3, 3, 3, 3, 3, 3], np.int32)
+    token_pos = np.asarray([9, 0, -1, -1, -1, -1, -1, -1], np.int32)
+    tables = np.asarray([[4, 5], [6, 7], [8, 9]], np.int32)
+    phys, lane, ord_, count = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=4, block_size=8,
+        page_slots=4,
+    )
+    # block 0: lane 0 needs pages 0..1 (pos 9), lane 1 page 0 — 3 live
+    assert count.tolist() == [3, 0]
+    assert phys[0].tolist() == [4, 5, 6, 6]   # pad repeats phys page 6
+    assert lane[0].tolist() == [0, 0, 1, -1]
+    assert ord_[0].tolist() == [0, 1, 0, 0]
+    assert phys[1].tolist() == [0, 0, 0, 0]   # empty block -> page 0, gated
+
+
+def test_ragged_mla_attention_matches_dense_reference():
+    """Packed-lane ragged MLA kernel vs a dense latent-space per-token
+    reference: mixed span + decode tokens against the latent cache, causal
+    per-row masks, pad rows finite."""
+    from dynamo_tpu.ops.pallas import pack_page_meta, ragged_mla_attention
+
+    rng = jax.random.PRNGKey(5)
+    h, r, p, bs, maxb, nblocks = 4, 32, 16, 8, 4, 16
+    keys = jax.random.split(rng, 4)
+    ck = jax.random.normal(keys[2], (nblocks, bs, r), jnp.float32)
+    kr = jax.random.normal(keys[3], (nblocks, bs, p), jnp.float32)
+    tables = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]], jnp.int32)
+    scale = 0.17
+    spans = [(0, 2, 3), (1, 16, 1), (2, 24, 5)]
+    token_lane, token_pos, _ = ragged_meta(spans, 3)
+    page_meta = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=8, block_size=bs
+    )
+    t = token_lane.shape[0]
+    q_lat = jax.random.normal(keys[0], (t, h, r), jnp.float32)
+    q_rope = jax.random.normal(keys[1], (t, h, p), jnp.float32)
+    out = np.asarray(ragged_mla_attention(
+        q_lat, q_rope, ck, kr, token_lane, token_pos,
+        *(jnp.asarray(a) for a in page_meta),
+        scale=scale, tb_tokens=8, interpret=True,
+    ))
+    assert np.isfinite(out).all()
+    length = maxb * bs
+    tl, tp = np.asarray(token_lane), np.asarray(token_pos)
+    tab = np.asarray(tables)
+    for i in range(t):
+        if tp[i] < 0:
+            continue
+        ck_g = np.asarray(ck)[tab[tl[i]]].reshape(length, r)
+        kr_g = np.asarray(kr)[tab[tl[i]]].reshape(length, p)
+        logits = (
+            np.asarray(q_lat)[i] @ ck_g.T + np.asarray(q_rope)[i] @ kr_g.T
+        ) * scale
+        logits = np.where(np.arange(length)[None, :] <= tp[i], logits, -1e30)
+        w = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+        np.testing.assert_allclose(out[i], w @ ck_g, rtol=2e-5, atol=2e-5)
 
 
 def test_ragged_attention_chunked_gather_matches_direct():
@@ -358,7 +481,7 @@ def test_ragged_attention_chunked_gather_matches_direct():
     spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
     rng = jax.random.PRNGKey(0)
     k_cache, v_cache, tables, _ = build_cache(rng)
-    token_lane, token_pos, _, _, _, _, ctx = ragged_meta(spans, 3)
+    token_lane, token_pos, ctx = ragged_meta(spans, 3)
     t = token_lane.shape[0]
     q = jax.random.normal(jax.random.fold_in(rng, 13), (t, 4, 128), jnp.float32)
     direct = ragged_ref(
@@ -375,26 +498,12 @@ def test_ragged_attention_chunked_gather_matches_direct():
 
 
 def test_ragged_attention_sliding_window_matches_fallback():
+    """Packed kernel with a sliding window must match the windowed XLA twin;
+    page pruning (pack_page_meta drops pages fully below the window) must
+    not change the result."""
     spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
-    rng = jax.random.PRNGKey(0)
-    k_cache, v_cache, tables, _ = build_cache(rng)
-    token_lane, token_pos, tb_lane, qstart, qlen, lstart, ctx = ragged_meta(
-        spans, 3
-    )
-    from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
-    from dynamo_tpu.ops.pallas import ragged_paged_attention as ragged_kernel
-
-    t = token_lane.shape[0]
-    q = jax.random.normal(jax.random.fold_in(rng, 11), (t, 4, 128), jnp.float32)
     for w in (4, 16):
-        ref = ragged_ref(
-            q, k_cache, v_cache, tables, ctx, token_lane, token_pos,
-            sliding_window=w,
-        )
-        out = ragged_kernel(
-            q, k_cache, v_cache, tables, ctx, tb_lane, qstart, qlen, lstart,
-            tb_tokens=8, interpret=True, sliding_window=w,
-        )
+        out, ref, token_pos, _ = run_ragged(spans, q_key=11, sliding_window=w)
         valid = np.asarray(token_pos) >= 0
         np.testing.assert_allclose(
             np.asarray(out)[valid], np.asarray(ref)[valid],
