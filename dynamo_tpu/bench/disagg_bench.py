@@ -369,9 +369,11 @@ async def run(args: argparse.Namespace) -> dict:
         result["streamed_ab"] = ab
 
         # -- routed fleet: 2 decode candidates, unequal overlap + links ---
-        # requests share a prefix the "near" (ici) candidate already holds;
-        # the "far" candidate sits behind dcn with a cold cache — the
-        # KV-locality/link-cost scorer should send the traffic near
+        # requests share a prefix the "near" candidate already holds; the
+        # "far" candidate sits on another slice — the KV-locality/link-cost
+        # scorer should send the traffic near.  Link classes are NOT
+        # hand-fed (DYN_TRANSFER_HOP stays unset): each worker publishes a
+        # TopologyCard and the watcher-discovered map feeds the cost model.
         from dynamo_tpu.llm.kv_router import (
             KvScheduler,
             RadixTree,
@@ -379,6 +381,7 @@ async def run(args: argparse.Namespace) -> dict:
             compute_block_hashes,
         )
         from dynamo_tpu.llm.kv_router.protocols import KvCacheEvent, RouterEvent
+        from dynamo_tpu.topology import TopologyWatcher, local_card
 
         decode2, _ = _build_engine(
             args.model, quant, args.kv_dtype, args.isl, args.osl, args.batch
@@ -393,9 +396,22 @@ async def run(args: argparse.Namespace) -> dict:
                 kind="stored", block_hashes=compute_block_hashes(shared, bs)
             ),
         ))
+        # discovery: the prefill source shares slice s0 with decode worker 1;
+        # decode worker 2 reports slice s1, so the map classifies the
+        # prefill→2 pair dcn and the scorer prices its transfers accordingly
+        for wid, role, slice_label in (
+            (17, "prefill", "s0"), (1, "decode", "s0"), (2, "decode", "s1"),
+        ):
+            card = local_card(wid, role=role, slice_label=slice_label)
+            await rt.plane.kv.put(card.key(), card.to_json())
+        topo_watch = TopologyWatcher(rt)
+        await topo_watch.start()
+        for _ in range(200):
+            if len(topo_watch.map.nodes) >= 3:
+                break
+            await asyncio.sleep(0.01)
         cost_model = TransferCostModel()
-        cost_model.update_link(1, hop="ici")
-        cost_model.update_link(2, hop="dcn")
+        cost_model.attach_topology(topo_watch.map)
         sched = KvScheduler()
         fleet_engines = {1: disagg, 2: disagg2}
         picks = {1: 0, 2: 0}
@@ -422,15 +438,22 @@ async def run(args: argparse.Namespace) -> dict:
         fleet_wall = time.monotonic() - t0
         result["fleet"] = {
             "decode_workers": 2,
-            "near": {"worker": 1, "hop": "ici",
+            "topology_discovered": topo_watch.map.informative(),
+            "near": {"worker": 1,
+                     "hop": topo_watch.map.inbound_hop(1),
+                     "bandwidth_bps": topo_watch.map.pair_bandwidth(17, 1),
                      "overlap_blocks": len(compute_block_hashes(shared, bs)),
                      "picks": picks[1]},
-            "far": {"worker": 2, "hop": "dcn", "overlap_blocks": 0,
+            "far": {"worker": 2,
+                    "hop": topo_watch.map.inbound_hop(2),
+                    "bandwidth_bps": topo_watch.map.pair_bandwidth(17, 2),
+                    "overlap_blocks": 0,
                     "picks": picks[2]},
             "preferred_is_near": picks[1] > picks[2],
             "wall_s": round(fleet_wall, 2),
             **ttft_stats(),
         }
+        await topo_watch.stop()
         dev = jax.devices()[0]
         result["platform"] = dev.platform
         result["device_kind"] = dev.device_kind

@@ -8,6 +8,7 @@ Usage:
   python -m dynamo_tpu.cli.dynctl list-instances [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl remove-model NAME [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl drain INSTANCE_ID [--timeout S] [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl topology [--json] [--control-plane H:P]
 """
 
 from __future__ import annotations
@@ -46,6 +47,52 @@ async def _amain(args) -> int:
                 if "/instances/" in e.key:
                     d = json.loads(e.value)
                     print(f"{d['namespace']}.{d['component']}.{d['endpoint']}\t{d['instance_id']:016x}")
+        elif args.cmd == "topology":
+            from dynamo_tpu.topology.card import CARDS_PREFIX, TopologyCard
+            from dynamo_tpu.topology.map import TopologyMap
+
+            topo = TopologyMap()
+            for e in await plane.kv.get_prefix(CARDS_PREFIX):
+                topo.upsert(TopologyCard.from_json(e.value))
+            if args.json:
+                print(json.dumps(topo.to_dict(), indent=2))
+            elif not topo.nodes:
+                print("(no topology cards published)")
+            else:
+                d = topo.to_dict()
+                print(f"{'WORKER':<18} {'ROLE':<8} {'SLICE':<10} {'HOST':<16} ADDRESS")
+                for wid, card in d["nodes"].items():
+                    print(
+                        f"{wid:<18} {card['role'] or '-':<8} "
+                        f"{card['slice_label'] or '-':<10} "
+                        f"{card['host'] or '-':<16} {card['transfer_address'] or '-'}"
+                    )
+                if d["links"]:
+                    print()
+                    print(
+                        f"{'A':<18} {'B':<18} {'HOP':<6} {'MEASURED':>12} "
+                        f"{'PRIOR':>12} {'RTT':>9} {'PROBES':>7}"
+                    )
+                    for link in d["links"]:
+                        measured = (
+                            f"{link['measured_bps'] / 1e9:.2f}GB/s"
+                            if link["measured_bps"] > 0 else "-"
+                        )
+                        rtt = (
+                            f"{link['rtt_s'] * 1e3:.2f}ms"
+                            if link["rtt_s"] > 0 else "-"
+                        )
+                        print(
+                            f"{link['a']:<18} {link['b']:<18} "
+                            f"{link['hop'] or '?':<6} {measured:>12} "
+                            f"{link['prior_bps'] / 1e9:>10.1f}GB/s "
+                            f"{rtt:>9} {link['probes_total']:>7}"
+                        )
+                print()
+                print(
+                    f"informative={d['informative']} "
+                    f"links={sum(1 for _ in d['links'])} age={d['age_s']:.1f}s"
+                )
         elif args.cmd == "remove-model":
             n = await plane.kv.delete_prefix(f"{MODELS_PREFIX}{args.name}/")
             print(f"removed {n} registration(s) for {args.name}")
@@ -104,6 +151,11 @@ def main() -> int:
     for name in ("list-models", "list-instances"):
         p = sub.add_parser(name)
         p.add_argument("--control-plane", default="127.0.0.1:2379")
+    topo = sub.add_parser(
+        "topology", help="dump the fleet topology map (nodes + classified links)"
+    )
+    topo.add_argument("--json", action="store_true", help="emit the map as JSON")
+    topo.add_argument("--control-plane", default="127.0.0.1:2379")
     rm = sub.add_parser("remove-model")
     rm.add_argument("name")
     rm.add_argument("--control-plane", default="127.0.0.1:2379")

@@ -320,6 +320,49 @@ class MetricsService:
             "latest decision",
             registry=self.registry,
         )
+        # fleet topology plane (topology/): map shape + link measurements,
+        # mirrored from the service's own TopologyWatcher (or an attached
+        # map).  Families always exist — zeros until cards are published.
+        self.topology_nodes = Gauge(
+            "dyn_topology_nodes",
+            "Workers with a published topology card",
+            registry=self.registry,
+        )
+        self.topology_links = Gauge(
+            "dyn_topology_links",
+            "Pairwise links in the fleet topology map by hop class",
+            ["hop"], registry=self.registry,
+        )
+        self.topology_probe_rtt = Gauge(
+            "dyn_topology_probe_rtt_seconds",
+            "Probe round-trip EWMA by hop class",
+            ["hop"], registry=self.registry,
+        )
+        self.topology_probe_bandwidth = Gauge(
+            "dyn_topology_probe_bandwidth_bps",
+            "Measured link bandwidth EWMA by hop class",
+            ["hop"], registry=self.registry,
+        )
+        self.topology_map_age = Gauge(
+            "dyn_topology_map_age_seconds",
+            "Seconds since the topology map last changed",
+            registry=self.registry,
+        )
+        self.topology_worker_info = Gauge(
+            "dyn_topology_worker_info",
+            "Per-worker placement facts (value always 1; slice and inbound "
+            "hop class ride as labels)",
+            ["worker", "slice", "hop"], registry=self.registry,
+        )
+        self._seen_topology_workers: set[tuple[str, str, str]] = set()
+        self._topology = None          # TopologyMap (attached or watched)
+        self._topology_watcher = None  # owned TopologyWatcher, when started
+        from dynamo_tpu.topology.metrics import HOP_CLASSES
+
+        for hop in HOP_CLASSES:
+            self.topology_links.labels(hop).set(0)
+            self.topology_probe_rtt.labels(hop).set(0)
+            self.topology_probe_bandwidth.labels(hop).set(0)
         self._planner_event: PlannerStateEvent | None = None
         self._planner_sub = None
         self._planner_task: asyncio.Task | None = None
@@ -327,8 +370,21 @@ class MetricsService:
         self._hit_task: asyncio.Task | None = None
         self._runner: web.AppRunner | None = None
 
+    def attach_topology(self, topo_map) -> None:
+        """Mirror an externally-owned TopologyMap (fleet/test harnesses)
+        instead of watching the control plane for cards ourselves."""
+        self._topology = topo_map
+
     async def start(self) -> None:
         await self.aggregator.start()
+        from dynamo_tpu.utils import knobs
+
+        if self._topology is None and knobs.get("DYN_TOPO"):
+            from dynamo_tpu.topology import TopologyWatcher
+
+            self._topology_watcher = TopologyWatcher(self.component.runtime)
+            await self._topology_watcher.start()
+            self._topology = self._topology_watcher.map
         bus = self.component.runtime.plane.bus
         self._hit_sub = await bus.subscribe(self.component.event_subject(KV_HIT_RATE_SUBJECT))
         self._hit_task = spawn_logged(self._hit_loop())
@@ -350,6 +406,9 @@ class MetricsService:
 
     async def stop(self) -> None:
         await self.aggregator.stop()
+        if self._topology_watcher is not None:
+            await self._topology_watcher.stop()
+            self._topology_watcher = None
         if self._hit_sub is not None:
             await self._hit_sub.unsubscribe()
         if self._hit_task is not None:
@@ -377,7 +436,38 @@ class MetricsService:
             except Exception:  # noqa: BLE001
                 continue
 
+    def _refresh_topology(self) -> None:
+        from dynamo_tpu.topology.metrics import HOP_CLASSES, hop_summaries
+
+        topo = self._topology
+        summaries = hop_summaries(topo)
+        self.topology_nodes.set(len(topo.nodes) if topo is not None else 0)
+        self.topology_map_age.set(topo.age_s() if topo is not None else 0.0)
+        for hop in HOP_CLASSES:
+            self.topology_links.labels(hop).set(summaries[hop]["links"])
+            self.topology_probe_rtt.labels(hop).set(summaries[hop]["rtt_s"])
+            self.topology_probe_bandwidth.labels(hop).set(summaries[hop]["bps"])
+        # per-worker placement info series (value 1, facts in the labels) —
+        # the dyn_top SLICE/HOP column reads these
+        current: set[tuple[str, str, str]] = set()
+        if topo is not None:
+            for wid, card in topo.nodes.items():
+                key = (
+                    f"{wid:x}",
+                    card.slice_label or "-",
+                    topo.inbound_hop(wid) or "-",
+                )
+                self.topology_worker_info.labels(*key).set(1)
+                current.add(key)
+        for key in self._seen_topology_workers - current:
+            try:
+                self.topology_worker_info.remove(*key)
+            except KeyError:
+                pass
+        self._seen_topology_workers = current
+
     def _refresh(self) -> None:
+        self._refresh_topology()
         ev = self._planner_event
         if ev is not None:
             self.planner_target.labels("prefill").set(ev.target_prefill)

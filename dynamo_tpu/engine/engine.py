@@ -2982,7 +2982,9 @@ class JaxLlmEngine:
         True when any block actually moved (the idle loop uses it to keep
         paging without sleeping; headroom-deferred work must NOT spin)."""
         pager = self.prefetch_pager
-        budget = pager.blocks_per_step * (pager.idle_boost if idle else 1)
+        # effective budget is link-priced: a tier behind ici/dcn gets a
+        # smaller per-step allowance (all-local topology = full budget)
+        budget = pager.effective_blocks_per_step() * (pager.idle_boost if idle else 1)
         progress = False
         moved = 0
         wall0 = time.time()

@@ -271,8 +271,12 @@ class DisaggDecodeEngine:
         self.transfer_server = KvTransferServer(self._on_transfer, host=transfer_host)
         # link characterization for the router's transfer-cost model: hop
         # class this decode worker sits behind relative to the prefill pool
-        # ("local"|"ici"|"dcn"; "" = unknown → the router keeps its prior)
-        self.transfer_hop = knobs.get("DYN_TRANSFER_HOP")
+        # ("local"|"ici"|"dcn"; "" = unknown → the router keeps its prior).
+        # DYN_TRANSFER_HOP is an explicit OVERRIDE; unset, the hop comes from
+        # the discovered topology map (attach_topology) when one is wired.
+        self._hop_override = knobs.get("DYN_TRANSFER_HOP")
+        self._topology = None          # TopologyMap, when attached
+        self._topo_self_id: int | None = None
         self._bytes_per_block: int | None = None  # lazy, for the transfer guard
         # observability
         self.remote_prefills = 0
@@ -296,6 +300,22 @@ class DisaggDecodeEngine:
 
     async def stop(self) -> None:
         await self.transfer_server.stop()
+
+    def attach_topology(self, topo_map, *, self_worker_id: int) -> None:
+        """Derive this worker's inbound hop class from a discovered
+        TopologyMap (consulted only while informative — a single-host
+        all-local map leaves ``transfer_hop`` empty, exactly as before)."""
+        self._topology = topo_map
+        self._topo_self_id = self_worker_id
+
+    @property
+    def transfer_hop(self) -> str:
+        if self._hop_override:
+            return self._hop_override
+        topo = self._topology
+        if topo is not None and self._topo_self_id is not None and topo.informative():
+            return topo.inbound_hop(self._topo_self_id)
+        return ""
 
     def _release_landing(self, seq_id: str, block_ids: list[int]) -> None:
         """Release a sequence's landing blocks — DEFERRED while any streamed
@@ -496,9 +516,28 @@ class DisaggDecodeEngine:
 
     def _est_transfer_seconds(self, n_tokens: int) -> float:
         """Estimated inbound KV transfer time for a prompt, from measured
-        bandwidth (0.0 while unmeasured — never gate on a guess)."""
+        bandwidth.  Unmeasured, an informative topology map supplies the
+        discovered link's bandwidth (prior or probed) so the transfer guard
+        can act before the first real shipment; with neither, 0.0 — never
+        gate on a guess."""
         secs = self.kv_transfer_seconds_total
-        if secs <= 0 or self.kv_transfer_bytes_total <= 0:
+        bps = self.kv_transfer_bytes_total / secs if secs > 0 else 0.0
+        if bps <= 0:
+            topo = self._topology
+            if (
+                topo is not None and self._topo_self_id is not None
+                and topo.informative()
+            ):
+                sources = [
+                    c.worker_id for c in topo.nodes.values()
+                    if c.role == "prefill" and c.worker_id != self._topo_self_id
+                ]
+                if sources:
+                    bps = max(
+                        topo.pair_bandwidth(src, self._topo_self_id)
+                        for src in sources
+                    )
+        if bps <= 0:
             return 0.0
         if self._bytes_per_block is None:
             import jax
@@ -508,7 +547,7 @@ class DisaggDecodeEngine:
                 for leaf in jax.tree.leaves(self.engine.cache)
             )
         blocks = self.engine.allocator.blocks_needed(n_tokens)
-        return blocks * self._bytes_per_block / (self.kv_transfer_bytes_total / secs)
+        return blocks * self._bytes_per_block / bps
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
         pre = PreprocessedRequest.from_wire(request.data)
@@ -698,6 +737,7 @@ class PrefillWorker:
         self.queue = queue
         self.client = KvTransferClient()
         self._task: asyncio.Task | None = None
+        self._prober = None  # TopologyProber, when a map is attached
         self.prefills_done = 0
         self.stale_dropped = 0
         # streamed multi-part transfer: ship completed chunks while later
@@ -712,14 +752,32 @@ class PrefillWorker:
         # prefill instead of silently dropping all disagg traffic
         self.clock_skew_margin_s = knobs.get("DYN_DISAGG_CLOCK_SKEW_S")
 
+    def attach_topology(self, topo_map, *, self_worker_id: int) -> None:
+        """Run the bounded topology prober off this pump's own transfer
+        client: active RTT/bandwidth probes of decode peers plus the
+        client's passive per-destination send EWMAs (every real transfer
+        is a measurement) fold into the attached TopologyMap."""
+        from dynamo_tpu.topology import TopologyProber
+
+        self._prober = TopologyProber(
+            topo_map, self_worker_id=self_worker_id, client=self.client
+        )
+        if self._task is not None:
+            spawn_logged(self._prober.start(), name="topology-prober-start")
+
     def start(self) -> None:
         if self._task is None:
             self._task = spawn_logged(self._loop())
+            if self._prober is not None:
+                spawn_logged(self._prober.start(), name="topology-prober-start")
 
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        if self._prober is not None:
+            await self._prober.stop()
+            self._prober = None
         await self.client.close()
 
     async def _loop(self) -> None:

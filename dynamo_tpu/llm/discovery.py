@@ -114,8 +114,24 @@ class ModelWatcher:
         self._backing: dict[str, set[str]] = {}
         self._entries: dict[str, ModelEntry] = {}  # entry key -> entry
         self._pipelines: dict[str, dict] = {}  # model name -> {"router": ..., "kv": ...}
+        # fleet topology plane: one card watcher shared by every KV router
+        # this frontend builds (DYN_TOPO; started alongside model discovery)
+        self._topology_watcher = None
+
+    @property
+    def topology(self):
+        """The live TopologyMap, or None when the plane is off."""
+        return (
+            self._topology_watcher.map
+            if self._topology_watcher is not None else None
+        )
 
     async def start(self) -> None:
+        if knobs.get("DYN_TOPO"):
+            from dynamo_tpu.topology import TopologyWatcher
+
+            self._topology_watcher = TopologyWatcher(self.runtime)
+            await self._topology_watcher.start()
         self._watch = self.runtime.plane.kv.watch_prefix(MODELS_PREFIX)
         self._task = spawn_logged(self._loop())
 
@@ -124,6 +140,9 @@ class ModelWatcher:
             self._watch.cancel()
         if self._task is not None:
             self._task.cancel()
+        if self._topology_watcher is not None:
+            await self._topology_watcher.stop()
+            self._topology_watcher = None
         for state in self._pipelines.values():
             kv_router = state.get("kv")
             if kv_router is not None:
@@ -215,6 +234,8 @@ class ModelWatcher:
         kv_router = None
         if self.router_mode == RouterMode.KV:
             kv_router = KvRouter(endpoint.component, block_size=mdc.kv_block_size)
+            if self._topology_watcher is not None:
+                kv_router.attach_topology(self._topology_watcher.map)
             await kv_router.start()
             engine: object = KvPushRouter(push_router, kv_router)
         else:

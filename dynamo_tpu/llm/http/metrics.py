@@ -110,6 +110,9 @@ class FrontendMetrics:
         self.registry = registry or CollectorRegistry()
         self.slo = slo or SloTracker()
         self.exemplars = ExemplarStore()
+        # fleet TopologyMap (attach_topology): rendered as dyn_topology_*
+        # families; None still declares the families with zero samples
+        self.topology = None
         self.requests_total = Counter(
             f"{PREFIX}_http_service_requests_total",
             "Total HTTP LLM requests",
@@ -173,14 +176,21 @@ class FrontendMetrics:
         status["exemplars"] = self.exemplars.snapshot()
         return status
 
+    def attach_topology(self, topo_map) -> None:
+        self.topology = topo_map
+
     def render(self) -> bytes:
         # one scrape surface: per-model serving metrics plus the process-
         # wide resilience counters (retries, sheds, control-plane
-        # reconnects), the SLO burn-rate families, and bucket exemplars
+        # reconnects), the SLO burn-rate families, topology-map gauges, and
+        # bucket exemplars
+        from dynamo_tpu.topology import metrics as topology_metrics
+
         return (
             generate_latest(self.registry)
             + robustness_counters.render()
             + self.slo.render()
+            + topology_metrics.render(self.topology)
             + self.exemplars.render()
         )
 

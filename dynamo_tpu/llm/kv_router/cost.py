@@ -48,11 +48,51 @@ class LinkEstimate:
 
 
 class TransferCostModel:
-    """Per-worker link estimates + normalized transfer-cost scoring."""
+    """Per-worker link estimates + normalized transfer-cost scoring.
+
+    Link state layers, per candidate, cheapest-information-first:
+
+    1. explicit per-worker estimates (`DYN_TRANSFER_HOP` override published
+       through metrics, observed transfers) — exactly the pre-topology model;
+    2. an attached :class:`TopologyMap` (discovery + probing), consulted only
+       while it is *informative* (at least one non-local pair) — a
+       single-host all-local map changes nothing;
+    3. the ``DEFAULT_HOP`` worst-case prior.
+    """
 
     def __init__(self, *, ewma_alpha: float = 0.25) -> None:
         self._links: dict[int, LinkEstimate] = {}
         self._ewma_alpha = ewma_alpha
+        self._topology = None          # TopologyMap, when attached
+        self._self_worker_id: int | None = None
+
+    def attach_topology(self, topo_map, *, self_worker_id: int | None = None) -> None:
+        """Resolve unknown links from a discovered TopologyMap.
+
+        ``self_worker_id`` names the node transfers originate from (a decode
+        engine scoring its own inbound link); routers scoring many
+        candidates leave it unset and each candidate is priced by its best
+        link from the fleet's prefill nodes.
+        """
+        self._topology = topo_map
+        self._self_worker_id = self_worker_id
+
+    def _topology_bandwidth(self, worker_id: int) -> float | None:
+        topo = self._topology
+        if topo is None or not topo.informative():
+            return None
+        if worker_id not in topo.nodes:
+            return None
+        if self._self_worker_id is not None:
+            return topo.pair_bandwidth(self._self_worker_id, worker_id)
+        sources = [
+            c.worker_id for c in topo.nodes.values()
+            if c.role == "prefill" and c.worker_id != worker_id
+        ] or [wid for wid in topo.nodes if wid != worker_id]
+        if not sources:
+            return None
+        # a candidate is as near as its best prefill source
+        return max(topo.pair_bandwidth(src, worker_id) for src in sources)
 
     # -- link state --------------------------------------------------------
     def update_link(
@@ -92,16 +132,21 @@ class TransferCostModel:
 
     def known(self) -> bool:
         """True once ANY worker has link information — before that, costs
-        would be uniform noise and selection stays overlap/load-only."""
-        return any(
-            link.hop or link.measured_bps > 0 for link in self._links.values()
-        )
+        would be uniform noise and selection stays overlap/load-only.  An
+        attached topology map counts only while informative: an all-local
+        map leaves selection exactly overlap/load-only."""
+        if any(link.hop or link.measured_bps > 0 for link in self._links.values()):
+            return True
+        return self._topology is not None and self._topology.informative()
 
     def bandwidth_bps(self, worker_id: int) -> float:
         link = self._links.get(worker_id)
-        if link is None:
-            return HOP_BANDWIDTH_BPS[DEFAULT_HOP]
-        return link.bandwidth_bps()
+        if link is not None and (link.hop or link.measured_bps > 0):
+            return link.bandwidth_bps()
+        topo_bps = self._topology_bandwidth(worker_id)
+        if topo_bps is not None:
+            return topo_bps
+        return HOP_BANDWIDTH_BPS[DEFAULT_HOP]
 
     def estimate_seconds(self, worker_id: int, transfer_bytes: int) -> float:
         return transfer_bytes / self.bandwidth_bps(worker_id)

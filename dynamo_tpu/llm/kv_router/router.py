@@ -49,6 +49,7 @@ class KvRouter:
         # load metrics (transfer_hop + measured inbound bandwidth); until any
         # link is characterized, scheduling stays overlap/load-only
         self.cost_model = TransferCostModel()
+        self.topology = None  # TopologyMap, via attach_topology()
         self._subs = []
         self._tasks: list[asyncio.Task] = []
         # predictive prefetch (prefetch/forwarder.py): hints forwarded to
@@ -101,6 +102,13 @@ class KvRouter:
                 self.cost_model.update_from_metrics(metrics)
             except Exception:  # noqa: BLE001
                 logger.exception("bad load metrics")
+
+    def attach_topology(self, topo_map) -> None:
+        """Let the cost model resolve unknown links from the discovered
+        fleet TopologyMap (no-op for selection until the map is
+        informative — an all-local map changes nothing)."""
+        self.topology = topo_map
+        self.cost_model.attach_topology(topo_map)
 
     def remove_worker(self, worker_id: int) -> None:
         self.indexer.remove_worker(worker_id)

@@ -46,6 +46,11 @@ class MockerConfig:
     # disagg pool membership reported through stats()/ForwardPassMetrics
     # ("prefill"/"decode", "" = serves both)
     role: str = ""
+    # emulated inbound KV-transfer latency (seconds at speedup=1) added per
+    # prefill — how multi-slice soaks make a worker behind a DCN hop pay
+    # for the prefix bytes shipped to it (scenarios/fleet.py sets it from
+    # FleetSpec.link_delay_s by the worker's link class)
+    transfer_delay_s: float = 0.0
     # rolling window (wall seconds) for the goodput/prefill-rate/MFU stats
     util_window_s: float = 2.0
 
@@ -210,6 +215,7 @@ class MockerEngine:
                 cost += (
                     cfg.prefill_linear_s * new
                     + cfg.prefill_quadratic_s * (cached + new) * new
+                    + cfg.transfer_delay_s
                 )
             decodes = [s for s in self.scheduler.running if s.status == SeqStatus.RUNNING]
             if decodes:

@@ -55,6 +55,11 @@ def resolve_dtype(name: str) -> np.dtype:
 # TCP entirely and hand device arrays straight to the sink
 LOCAL_SERVERS: dict[str, "KvTransferServer"] = {}
 
+# topology-prober payloads carry this seq-id prefix: servers ack them (so the
+# sender times a real staging+frame+ack exchange) but never deliver them to
+# the engine sink — probing must be invisible to decode state
+PROBE_SEQ_PREFIX = "__dyn_topo_probe__/"
+
 
 @dataclass
 class KvTransferPayload:
@@ -114,6 +119,8 @@ class KvTransferServer:
     async def deliver_local(self, payload: KvTransferPayload) -> None:
         """Same-process fast path: blocks arrive as device arrays and skip
         the codec entirely (the ICI-class transfer)."""
+        if payload.seq_id.startswith(PROBE_SEQ_PREFIX):
+            return
         await self.sink(payload)
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -146,7 +153,8 @@ class KvTransferServer:
                     last=bool(h.get("last", True)),
                     block_start=int(h.get("block_start", 0)),
                 )
-                await self.sink(payload)
+                if not payload.seq_id.startswith(PROBE_SEQ_PREFIX):
+                    await self.sink(payload)
                 writer.write(encode_frame(TwoPartMessage(header={"ok": True, "seq_id": h["seq_id"]})))
                 await writer.drain()
         except (ConnectionError, asyncio.IncompleteReadError):

@@ -68,6 +68,11 @@ class WorkloadSample:
     prefill_occupancy: float = 0.0
     decode_occupancy: float = 0.0
     avg_mfu: float = 0.0
+    # topology: the set of slice labels each pool's replicas live on (from
+    # the fleet TopologyMap).  Empty = unknown — the slice-aware rebalance
+    # guard stays inert, preserving the pre-topology planner exactly.
+    prefill_slices: tuple = ()
+    decode_slices: tuple = ()
 
 
 def burn_rates_from_slo(status: dict | None) -> dict[str, float]:
@@ -98,6 +103,7 @@ def sample_from_endpoints(
     itl_s: float = 0.0,
     roles: dict[int, str] | None = None,
     slo_status: dict | None = None,
+    slices: dict[int, str] | None = None,
 ) -> WorkloadSample:
     """Build a WorkloadSample from a live fleet snapshot
     (llm/kv_router/metrics_aggregator.ProcessedEndpoints): per-worker
@@ -111,9 +117,14 @@ def sample_from_endpoints(
     for both pools.
 
     ``slo_status`` is the frontend ``/slo`` JSON; when given, the worst
-    window per objective becomes the sample's burn-rate inputs."""
+    window per objective becomes the sample's burn-rate inputs.
+
+    ``slices`` maps worker_id → discovered slice label (fleet TopologyMap);
+    the per-pool slice sets feed the planner's cross-slice rebalance guard.
+    """
     worker_map = dict(getattr(endpoints, "workers", {}))
     roles = roles or {}
+    slices = slices or {}
 
     def _role(wid, m) -> str:
         return roles.get(wid) or str(getattr(m, "role", "") or "")
@@ -124,6 +135,12 @@ def sample_from_endpoints(
     decode_pool = [
         m for wid, m in worker_map.items() if _role(wid, m) in ("", "decode")
     ]
+
+    def _pool_slices(role: str) -> tuple:
+        return tuple(sorted({
+            slices[wid] for wid, m in worker_map.items()
+            if wid in slices and slices[wid] and _role(wid, m) in ("", role)
+        }))
 
     def _occ(pool) -> float:
         return (
@@ -156,6 +173,8 @@ def sample_from_endpoints(
         ttft_burn_rate=burn.get("ttft", 0.0),
         itl_burn_rate=burn.get("itl", 0.0),
         error_burn_rate=burn.get("error_rate", burn.get("error", 0.0)),
+        prefill_slices=_pool_slices("prefill"),
+        decode_slices=_pool_slices("decode"),
     )
 
 
@@ -190,6 +209,10 @@ class PlannerConfig:
     # rebalance_occupancy, own objective not burning) to the burning pool
     rebalance: bool = True
     rebalance_occupancy: float = 0.5
+    # pool-per-slice awareness: when the two pools' discovered slice sets
+    # are disjoint, a rebalance would move a replica across DCN and split a
+    # hot prefill↔decode pair — refuse the move (demand scaling unaffected)
+    rebalance_slice_aware: bool = True
 
 
 @dataclass
@@ -231,6 +254,8 @@ class Planner:
         self._burn: dict[str, float] = {"ttft": 0.0, "itl": 0.0, "error": 0.0}
         self._prefill_occ = 0.0
         self._decode_occ = 0.0
+        self._prefill_slices: tuple = ()
+        self._decode_slices: tuple = ()
         self._cooldown_until = float("-inf")
         self.last_decision: PlannerDecision | None = None
         self._task: asyncio.Task | None = None
@@ -274,6 +299,8 @@ class Planner:
         }
         self._prefill_occ = sample.prefill_occupancy or sample.avg_occupancy
         self._decode_occ = sample.decode_occupancy or sample.avg_occupancy
+        self._prefill_slices = tuple(sample.prefill_slices)
+        self._decode_slices = tuple(sample.decode_slices)
         # real utilization (when the sample carries it): EWMA of measured
         # per-replica throughput.  Only samples with actual flow update it —
         # an idle interval says nothing about capacity.
@@ -386,7 +413,17 @@ class Planner:
                     <= cfg.max_total_chips
                 )
 
-            if (
+            # slice guard: with both pools' placements known and sharing no
+            # slice, the moved replica would land a DCN hop away from every
+            # partner — the transfer bill eats what the rebalance buys
+            cross_slice = (
+                cfg.rebalance_slice_aware
+                and self._prefill_slices and self._decode_slices
+                and not set(self._prefill_slices) & set(self._decode_slices)
+            )
+            if cross_slice and (prefill_starved or decode_starved):
+                reasons.append("rebalance_blocked_cross_slice")
+            elif (
                 prefill_starved and not decode_starved
                 and num_decode > cfg.min_decode
                 and self._decode_occ < cfg.rebalance_occupancy

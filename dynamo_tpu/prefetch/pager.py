@@ -38,6 +38,17 @@ logger = get_logger("prefetch.pager")
 # as already-judged (they count as misses when forgotten unconsumed)
 MAX_TRACKED_BLOCKS = 65536
 
+# link-class pricing for tier page-ins (topology plane): a page-in whose
+# backing tier sits behind a slower hop gets a smaller per-step budget —
+# the device loop must not stall serving while blocks crawl over DCN.
+# Fractions of the configured blocks_per_step; "" / "local" = full budget.
+LINK_BUDGET_FRACTION = {
+    "": 1.0,
+    "local": 1.0,
+    "ici": 0.5,
+    "dcn": 0.25,
+}
+
 
 @dataclass(order=True)
 class _Job:
@@ -59,6 +70,9 @@ class PrefetchPager:
         self.ttl_s = ttl_s
         self.blocks_per_step = blocks_per_step
         self.idle_boost = idle_boost
+        # hop class of the link behind the offload tier (set_link_hop):
+        # scales the effective per-step page-in budget by LINK_BUDGET_FRACTION
+        self.link_hop = ""
         self._clock = clock
         self._lock = threading.Lock()
         self._queue: list[_Job] = []
@@ -78,6 +92,19 @@ class PrefetchPager:
         self.blocks_restored_total = 0   # host tier → HBM pre-restores
         self.blocks_onboarded_total = 0  # disk/remote → host promotions
         self.deferred_total = 0          # jobs postponed for HBM headroom
+
+    # -- link pricing (topology plane) ----------------------------------------
+    def set_link_hop(self, hop: str) -> None:
+        """Price tier page-ins by the hop class behind the offload tier
+        (from the discovered TopologyMap).  Unknown classes price like
+        ``dcn`` — assume the worst about an unclassified link."""
+        self.link_hop = hop or ""
+
+    def effective_blocks_per_step(self) -> int:
+        fraction = LINK_BUDGET_FRACTION.get(
+            self.link_hop, LINK_BUDGET_FRACTION["dcn"]
+        )
+        return max(1, int(self.blocks_per_step * fraction))
 
     # -- queue (any thread) --------------------------------------------------
     def submit(self, block_hashes: list[int], *, source: str = "arrival") -> bool:
@@ -187,4 +214,5 @@ class PrefetchPager:
                 "prefetch_blocks_onboarded_total": self.blocks_onboarded_total,
                 "prefetch_deferred_total": self.deferred_total,
                 "prefetch_queue_depth": len(self._queue),
+                "prefetch_blocks_per_step_effective": self.effective_blocks_per_step(),
             }

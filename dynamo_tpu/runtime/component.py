@@ -134,8 +134,16 @@ class Endpoint:
         instance_id: int | None = None,
         lease_ttl: float = 3.0,
         stats_handler=None,
+        topo_role: str = "",
+        topo_transfer_address: str = "",
+        topo_slice: str | None = None,
     ) -> "EndpointService":
-        """Register an instance and start serving requests pushed to it."""
+        """Register an instance and start serving requests pushed to it.
+
+        ``topo_*`` feed the instance's TopologyCard (fleet topology plane):
+        role (``prefill``/``decode``), the KV-transfer data-plane address,
+        and an explicit slice label for emulated multi-slice fleets.
+        """
         from dynamo_tpu.runtime.ingress import EndpointService
 
         inst_id = instance_id if instance_id is not None else secrets.randbits(63)
@@ -148,7 +156,11 @@ class Endpoint:
                 self.component.namespace.name, self.component.name, self.name, inst_id
             ),
         )
-        service = EndpointService(self.runtime, instance, engine, stats_handler=stats_handler)
+        service = EndpointService(
+            self.runtime, instance, engine, stats_handler=stats_handler,
+            topo_role=topo_role, topo_transfer_address=topo_transfer_address,
+            topo_slice=topo_slice,
+        )
         await service.start(lease_ttl=lease_ttl)
         return service
 

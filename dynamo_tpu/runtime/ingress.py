@@ -44,11 +44,19 @@ class EndpointService:
         engine: AsyncEngine,
         *,
         stats_handler=None,
+        topo_role: str = "",
+        topo_transfer_address: str = "",
+        topo_slice: str | None = None,
     ):
         self.runtime = runtime
         self.instance = instance
         self.engine = engine
         self.stats_handler = stats_handler
+        # topology plane: placement facts for this instance's TopologyCard
+        # (published lease-scoped in start() when DYN_TOPO is on)
+        self.topo_role = topo_role
+        self.topo_transfer_address = topo_transfer_address
+        self.topo_slice = topo_slice
         self._lease = None
         self._sub = None
         self._stats_sub = None
@@ -82,6 +90,15 @@ class EndpointService:
         self.runtime.register_keepalive(self._lease)
         # register *after* subscribing so no request can race the subscription
         await plane.kv.put(instance_key(self.instance), self.instance.to_json(), self._lease.id)
+        if knobs.get("DYN_TOPO"):
+            from dynamo_tpu.topology import local_card, publish_card
+
+            await publish_card(self, local_card(
+                self.instance.instance_id,
+                transfer_address=self.topo_transfer_address,
+                role=self.topo_role,
+                slice_label=self.topo_slice,
+            ))
         logger.info("serving %s (instance %x)", self.instance.subject, self.instance.instance_id)
 
     async def shutdown(self, *, drain_timeout: float | None = None) -> None:

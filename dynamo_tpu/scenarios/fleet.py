@@ -44,6 +44,7 @@ class _Worker:
     service: object
     kv_pub: KvEventPublisher
     metrics_pub: WorkerMetricsPublisher
+    slice_label: str = ""
 
     @property
     def worker_id(self) -> int:
@@ -70,6 +71,15 @@ class SoakFleet:
     _frontend_runner: web.AppRunner | None = None
     _scale_lock: asyncio.Lock = field(default_factory=asyncio.Lock)
     scale_log: list = field(default_factory=list)  # executed scale ops
+    # multi-slice emulation (FleetSpec.slices): per-worker selection counts
+    # from the router's hit-rate events + the discovered TopologyMap
+    topo_watch: object = None
+    near_slice: str = ""
+    selection_counts: dict = field(default_factory=dict)  # worker_id → picks
+    _spawned: dict = field(default_factory=dict)   # pool → spawn counter
+    _slice_by_worker: dict = field(default_factory=dict)  # survives retirement
+    _hit_sub: object = None
+    _hit_task: object = None
 
     # -- bring-up / teardown -------------------------------------------------
     async def start(self) -> None:
@@ -80,6 +90,11 @@ class SoakFleet:
         )
         self.comp = self.rt.namespace("soak").component("backend")
         self.ep = self.comp.endpoint("generate")
+        if fl.slices:
+            # NEAR = the prefill pool's slice: decode selection is judged by
+            # how far the prefix blocks must travel from where prefill ran
+            labels = fl.slices.get("prefill") or next(iter(fl.slices.values()))
+            self.near_slice = labels[0]
         for pool, n in fl.pools.items():
             self._pools[pool] = []
             for _ in range(n):
@@ -95,8 +110,13 @@ class SoakFleet:
             self.dispatcher = self.push
         await self.push.client.wait_for_instances(self.worker_count(), timeout=10)
 
+        if fl.slices:
+            await self._start_topology()
+
         # real metrics service (scrapeable by dyn_top / check_metrics)
         self.metrics_service = MetricsService(self.comp, host="127.0.0.1", port=0)
+        if self.topo_watch is not None:
+            self.metrics_service.attach_topology(self.topo_watch.map)
         await self.metrics_service.start()
         self.worker_url = f"http://127.0.0.1:{self.metrics_service.port}"
 
@@ -116,6 +136,12 @@ class SoakFleet:
             await self._frontend_runner.cleanup()
         if self.metrics_service is not None:
             await self.metrics_service.stop()
+        if self._hit_task is not None:
+            self._hit_task.cancel()
+        if self._hit_sub is not None:
+            await self._hit_sub.unsubscribe()
+        if self.topo_watch is not None:
+            await self.topo_watch.stop()
         if self.kv_router is not None:
             await self.kv_router.stop()
         for pool in list(self._pools):
@@ -124,6 +150,62 @@ class SoakFleet:
             self._pools[pool] = []
         if self.rt is not None:
             await self.rt.close()
+
+    # -- topology plane (FleetSpec.slices) -----------------------------------
+    async def _start_topology(self) -> None:
+        """Discover the emulated multi-slice fleet and wire its consumers:
+        the KV router prices candidates by discovered link class, and the
+        router's per-request hit-rate events feed the near-slice selection
+        ledger the ``min_near_slice_fraction`` assertion reads."""
+        from dynamo_tpu.llm.kv_router.protocols import (
+            KV_HIT_RATE_SUBJECT,
+            KvHitRateEvent,
+        )
+        from dynamo_tpu.topology import TopologyWatcher, local_card
+        from dynamo_tpu.utils.tasks import spawn_logged
+
+        self.topo_watch = TopologyWatcher(self.rt)
+        await self.topo_watch.start()
+        await self._await_nodes()
+        if len(self.topo_watch.map.nodes) < self.worker_count():
+            # DYN_TOPO is off, so the workers didn't self-publish — the spec
+            # asked for slices explicitly, so publish their cards here
+            for pool, ws in self._pools.items():
+                for w in ws:
+                    card = local_card(
+                        w.worker_id, role=pool,
+                        slice_label=w.slice_label or None,
+                    )
+                    await self.rt.plane.kv.put(
+                        card.key(), card.to_json(), w.service._lease.id
+                    )
+            await self._await_nodes()
+        if self.kv_router is not None:
+            self.kv_router.attach_topology(self.topo_watch.map)
+        self._hit_sub = await self.rt.plane.bus.subscribe(
+            self.comp.event_subject(KV_HIT_RATE_SUBJECT)
+        )
+
+        async def _count() -> None:
+            async for msg in self._hit_sub:
+                try:
+                    ev = KvHitRateEvent.from_json(msg.payload)
+                except Exception:  # noqa: BLE001
+                    continue
+                self.selection_counts[ev.worker_id] = (
+                    self.selection_counts.get(ev.worker_id, 0) + 1
+                )
+
+        self._hit_task = spawn_logged(_count())
+
+    async def _await_nodes(self) -> None:
+        for _ in range(200):
+            if len(self.topo_watch.map.nodes) >= self.worker_count():
+                return
+            await asyncio.sleep(0.01)
+
+    def slice_of(self, worker_id: int) -> str:
+        return self._slice_by_worker.get(worker_id, "")
 
     # -- frontend surface ----------------------------------------------------
     async def _handle_slo(self, request: web.Request) -> web.Response:
@@ -147,8 +229,26 @@ class SoakFleet:
         )
 
     async def _spawn(self, pool: str) -> _Worker:
-        engine = MockerEngine(self._mocker_config(pool))
-        service = await self.ep.serve(engine, stats_handler=engine.stats)
+        fl = self.spec.fleet
+        cfg = self._mocker_config(pool)
+        slice_label = ""
+        labels = fl.slices.get(pool) or []
+        if labels:
+            slice_label = labels[self._spawned.get(pool, 0) % len(labels)]
+            # mocker-side per-pair latency: a worker off the prefill slice
+            # pays the DCN-class transfer bill on every prefill
+            far = bool(self.near_slice) and slice_label != self.near_slice
+            hop = "dcn" if far else "local"
+            cfg.transfer_delay_s = float(
+                fl.link_delay_s.get(hop, cfg.transfer_delay_s)
+            )
+        self._spawned[pool] = self._spawned.get(pool, 0) + 1
+        engine = MockerEngine(cfg)
+        service = await self.ep.serve(
+            engine, stats_handler=engine.stats,
+            topo_role=pool, topo_slice=slice_label or None,
+        )
+        self._slice_by_worker[service.instance.instance_id] = slice_label
         kv_pub = KvEventPublisher(self.comp, worker_id=service.instance.instance_id)
         kv_pub.start()
         engine._event_sink = kv_pub.sink
@@ -158,7 +258,7 @@ class SoakFleet:
         )
         metrics_pub.start()
         engine.start()
-        return _Worker(pool, engine, service, kv_pub, metrics_pub)
+        return _Worker(pool, engine, service, kv_pub, metrics_pub, slice_label)
 
     async def _retire(self, worker: _Worker) -> None:
         # graceful scale-down IS the drain state machine: admissions stop,

@@ -323,6 +323,7 @@ class ScenarioRunner:
         faults_before = counters.get("dyn_faults_injected_total")
         armed: list = []
         ticks_before = len(self.ticks)
+        selections_before = dict(self.fleet.selection_counts)
 
         work = [
             asyncio.ensure_future(self._run_arrival(stats, phase_t0, a, rng))
@@ -398,6 +399,39 @@ class ScenarioRunner:
                 f"completed {stats.completed} below floor {a.min_completed}"
             )
 
+        # topology-aware routing: where did this phase's selections land?
+        topology_view = None
+        if spec.fleet.slices:
+            by_slice: dict[str, int] = {}
+            for wid, count in self.fleet.selection_counts.items():
+                delta = count - selections_before.get(wid, 0)
+                if delta > 0:
+                    label = self.fleet.slice_of(wid) or "-"
+                    by_slice[label] = by_slice.get(label, 0) + delta
+            total_sel = sum(by_slice.values())
+            near = by_slice.get(self.fleet.near_slice, 0)
+            near_fraction = near / total_sel if total_sel else 0.0
+            topology_view = {
+                "near_slice": self.fleet.near_slice,
+                "selections_by_slice": by_slice,
+                "near_fraction": round(near_fraction, 4),
+            }
+            if a.min_near_slice_fraction:
+                if not total_sel:
+                    failures.append(
+                        "min_near_slice_fraction set but no routed selections "
+                        "observed (policy must be kv)"
+                    )
+                elif near_fraction < a.min_near_slice_fraction:
+                    failures.append(
+                        f"near-slice fraction {near_fraction:.2f} below floor "
+                        f"{a.min_near_slice_fraction} ({by_slice})"
+                    )
+        elif a.min_near_slice_fraction:
+            failures.append(
+                "min_near_slice_fraction set but fleet.slices is empty"
+            )
+
         ms = lambda x: None if x is None else round(x * 1000.0, 2)  # noqa: E731
         return {
             "name": phase.name,
@@ -432,6 +466,7 @@ class ScenarioRunner:
                 "fired": dict(FAULTS.fired),
             },
             "worker_kills": killed,
+            "topology": topology_view,
             "resumes": {
                 "attempts": counters.get("dyn_resume_attempts_total"),
                 "succeeded": counters.get("dyn_resume_success_total"),
@@ -503,6 +538,10 @@ class ScenarioRunner:
             "speedup": spec.speedup,
             "policy": spec.fleet.policy,
             "pools": dict(spec.fleet.pools),
+            "topology": (
+                None if self.fleet.topo_watch is None
+                else self.fleet.topo_watch.map.to_dict()
+            ),
             "wall_s": round(time.monotonic() - wall_start, 2),
             "sim_s": round(self.sim_now(), 2),
             "phases": phases,

@@ -125,6 +125,11 @@ class PhaseAssertions:
     min_goodput_tok_s: float = 0.0   # mean fleet goodput over phase ticks
     min_mfu: float = 0.0             # mean fleet MFU over phase ticks
     min_completed: int = 0
+    # topology-aware routing (fleet.slices): floor on the fraction of this
+    # phase's KV-router selections that landed on a worker in the NEAR
+    # slice (the prefill pool's slice) — the multi-slice soak's proof that
+    # discovered link classes steer decode selection
+    min_near_slice_fraction: float = 0.0
 
 
 @dataclass
@@ -171,12 +176,26 @@ class FleetSpec:
     max_batch_size: int = 8
     metrics_period_s: float = 0.25   # simulated seconds
     mocker: dict = field(default_factory=dict)   # MockerConfig overrides
+    # emulated multi-slice placement: pool → list of slice labels assigned
+    # round-robin to that pool's workers (published as TopologyCards, so the
+    # fleet's KV router discovers the link classes).  Empty = single slice
+    # (the topology plane sees an all-local map and changes nothing).
+    slices: dict = field(default_factory=dict)
+    # mocker-side per-pair latency: hop class → extra simulated seconds each
+    # prefill pays on a worker behind that link (the KV-transfer bill a far
+    # slice really pays; see MockerConfig.transfer_delay_s)
+    link_delay_s: dict = field(default_factory=dict)
 
     def validate(self) -> None:
         if self.policy not in ("kv", "random"):
             raise ValueError(f"fleet policy must be kv|random, got {self.policy!r}")
         if not self.pools or any(n < 0 for n in self.pools.values()):
             raise ValueError("fleet pools must map name → replicas >= 0")
+        if any(not labels for labels in self.slices.values()):
+            raise ValueError("fleet slices must map pool → non-empty label list")
+        bad = set(self.link_delay_s) - {"local", "ici", "dcn"}
+        if bad:
+            raise ValueError(f"link_delay_s keys must be hop classes, got {sorted(bad)}")
 
 
 @dataclass

@@ -272,5 +272,8 @@ async def serve_frontend(
         prefetch_hinter=hinter,
     )
     await watcher.start()
+    # same live map on the scrape surface: dyn_topology_* next to dyn_llm_*
+    if watcher.topology is not None:
+        service.metrics.attach_topology(watcher.topology)
     await service.start()
     return service, watcher

@@ -1,0 +1,45 @@
+"""Fleet topology plane: discovery, link probing, and network-aware placement.
+
+Three layers (see docs/topology.md):
+
+- ``card``: each worker publishes a :class:`TopologyCard` (host fingerprint,
+  JAX slice/process identity, data-plane address) through the control plane,
+  lease-scoped like model registration so churn is visible as watch DELETEs.
+- ``map``: :class:`TopologyMap` aggregates cards into nodes + pairwise links
+  classified ``local``/``ici``/``dcn``; :class:`TopologyWatcher` keeps a map
+  live off a ``watch_prefix`` the same way ``ModelWatcher`` tracks models.
+- ``prober``: :class:`TopologyProber` measures pairwise RTT/bandwidth over the
+  existing KV-transfer transport and folds results — plus ``KvTransferClient``
+  per-destination send EWMAs — into the map, so priors decay into measurements.
+
+Consumers (TransferCostModel, disagg router, planner rebalance, prefetch
+pager) only act on a map that is *informative* — a single-host fleet discovers
+an all-``local`` map and behaves byte-identically to a fleet with no topology
+plane at all.
+"""
+
+from dynamo_tpu.topology.card import (
+    CARDS_PREFIX,
+    TopologyCard,
+    local_card,
+    publish_card,
+)
+from dynamo_tpu.topology.map import (
+    TopologyLink,
+    TopologyMap,
+    TopologyWatcher,
+    classify_link,
+)
+from dynamo_tpu.topology.prober import TopologyProber
+
+__all__ = [
+    "CARDS_PREFIX",
+    "TopologyCard",
+    "TopologyLink",
+    "TopologyMap",
+    "TopologyProber",
+    "TopologyWatcher",
+    "classify_link",
+    "local_card",
+    "publish_card",
+]

@@ -245,8 +245,9 @@ K_KV_STREAM = register(
     doc="streamed multi-part disagg KV transfer; `0` = single-shot", section=PERF)
 K_TRANSFER_HOP = register(
     "DYN_TRANSFER_HOP", type="str", default="",
-    doc="decode worker's hop class (`local`|`ici`|`dcn`) published to the "
-        "router's transfer-cost model", section=PERF)
+    doc="explicit override of the worker's *discovered* hop class "
+        "(`local`|`ici`|`dcn`) published to the router's transfer-cost "
+        "model (unset: the topology plane's classification wins)", section=PERF)
 K_DISAGG_PREFILL_TIMEOUT_S = register(
     "DYN_DISAGG_PREFILL_TIMEOUT_S", type="float", default=300.0,
     doc="decode-side wait for the KV stream before falling back to local "
@@ -255,6 +256,27 @@ K_DISAGG_CLOCK_SKEW_S = register(
     "DYN_DISAGG_CLOCK_SKEW_S", type="float", default=30.0,
     doc="tolerated cross-host clock skew when judging queued-prefill "
         "staleness", section=PERF)
+
+# -- fleet topology plane (docs/performance.md) ------------------------------
+K_TOPO = register(
+    "DYN_TOPO", type="bool", default=True,
+    doc="master topology-plane gate: card publication, map watching, and "
+        "probing; `0` restores the env-knob-only link model", section=PERF)
+K_TOPO_SLICE = register(
+    "DYN_TOPO_SLICE", type="str", default="",
+    doc="explicit slice label for this worker's TopologyCard (overrides "
+        "JAX `slice_index` detection; used to emulate multi-slice fleets)",
+    section=PERF)
+K_TOPO_PROBE_PERIOD_S = register(
+    "DYN_TOPO_PROBE_PERIOD_S", type="float", default=10.0,
+    doc="seconds between topology probe ticks (0 disables active probing; "
+        "passive KvTransferClient EWMAs still feed the map)", section=PERF)
+K_TOPO_PROBE_BYTES = register(
+    "DYN_TOPO_PROBE_BYTES", type="int", default=65536,
+    doc="payload size of one topology bandwidth probe", section=PERF)
+K_TOPO_PROBE_MAX_PER_TICK = register(
+    "DYN_TOPO_PROBE_MAX_PER_TICK", type="int", default=4,
+    doc="max peers probed per tick (round-robin across the fleet)", section=PERF)
 
 # -- robustness / routing (docs/robustness.md) -------------------------------
 K_FAULTS = register(

@@ -57,6 +57,17 @@ SLO_FAMILIES = (
     "dyn_slo_threshold_seconds",
 )
 
+# fleet topology plane (dynamo_tpu/topology/): map shape + link measurements,
+# rendered on BOTH surfaces (frontend text helper + metrics-service registry).
+# Always declared — zeros until topology cards are published.
+TOPOLOGY_FAMILIES = (
+    "dyn_topology_nodes",
+    "dyn_topology_links",
+    "dyn_topology_probe_rtt_seconds",
+    "dyn_topology_probe_bandwidth_bps",
+    "dyn_topology_map_age_seconds",
+)
+
 # frontend registry (dynamo_tpu/llm/http/metrics.py) + resilience counters
 FRONTEND_FAMILIES = (
     "dyn_llm_http_service_requests_total",
@@ -66,7 +77,7 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + SLO_FAMILIES
+) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + SLO_FAMILIES + TOPOLOGY_FAMILIES
 
 # utilization accounting (dynamo_tpu/observability/perf.py → engine stats →
 # ForwardPassMetrics → metrics service)
@@ -141,7 +152,10 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES + TOPOLOGY_FAMILIES + (
+    # worker-surface-only: per-worker placement facts for dyn_top
+    "dyn_topology_worker_info",
+)
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)
 _TYPE_RE = re.compile(r"^# TYPE (\S+)", re.MULTILINE)

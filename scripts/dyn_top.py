@@ -71,6 +71,10 @@ PLANNER_FIELDS = {
     "dyn_planner_observed_capacity_tok_s": "observed_capacity_tok_s",
 }
 
+# topology-plane placement info (value always 1; the facts ride as labels):
+# slice label + inbound hop class per worker → the SLICE/HOP column
+TOPOLOGY_INFO_FAMILY = "dyn_topology_worker_info"
+
 
 def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
     """Minimal text-exposition parser: (family, labels, value) samples."""
@@ -131,6 +135,11 @@ def collect_snapshot(
                 )[pkey] = value
                 continue
             if "worker" not in labels:
+                continue
+            if name == TOPOLOGY_INFO_FAMILY:
+                row = workers.setdefault(labels["worker"], {})
+                row["slice"] = labels.get("slice", "-")
+                row["hop"] = labels.get("hop", "-")
                 continue
             tier_key = TIER_FIELDS.get(name)
             if tier_key is not None and "tier" in labels:
@@ -218,15 +227,20 @@ def render_table(snap: dict) -> str:
         lines.append(f"  workers: unreachable ({snap['workers_error']})")
     if workers:
         lines.append(
-            f"  {'WORKER':<10} {'MFU':>7} {'BW':>7} {'GOODPUT/s':>10} "
+            f"  {'WORKER':<10} {'SLICE/HOP':>10} {'MFU':>7} {'BW':>7} "
+            f"{'GOODPUT/s':>10} "
             f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} "
             f"{'WASTED':>8} {'PF-HIT':>7} {'UNI':>6} {'DRAIN':>6} "
             f"{'XFER-HID':>8}"
         )
         for wid in sorted(workers):
             r = workers[wid]
+            placement = (
+                f"{r.get('slice', '-')}/{r.get('hop', '-')}"
+                if ("slice" in r or "hop" in r) else "-"
+            )
             lines.append(
-                f"  {wid:<10} {_pct(r.get('mfu_perc')):>7} "
+                f"  {wid:<10} {placement:>10} {_pct(r.get('mfu_perc')):>7} "
                 f"{_pct(r.get('bandwidth_util_perc')):>7} "
                 f"{_num(r.get('goodput_tokens_per_second'), 10)} "
                 f"{_pct(r.get('kv_usage_perc')):>7} "
@@ -255,7 +269,7 @@ def render_table(snap: dict) -> str:
         fleet = snap.get("fleet") or {}
         if fleet:
             lines.append(
-                f"  {'FLEET':<10} {_pct(fleet.get('mfu_perc_avg')):>7} {'':>7} "
+                f"  {'FLEET':<10} {'':>10} {_pct(fleet.get('mfu_perc_avg')):>7} {'':>7} "
                 f"{_num(fleet.get('goodput_tokens_per_second'), 10)} "
                 f"{_pct(fleet.get('kv_usage_perc_avg')):>7} {'':>7} "
                 f"{_num(fleet.get('running'), 5)} {_num(fleet.get('waiting'), 5)}"

@@ -1,0 +1,86 @@
+"""TransferCostModel ⨯ TopologyMap: pair resolution layering — explicit
+per-worker link reports (the DYN_TRANSFER_HOP override path, delivered via
+worker metrics) beat the discovered map, the map beats the worst-case prior,
+and an uninformative map changes nothing."""
+
+from types import SimpleNamespace
+
+from dynamo_tpu.llm.kv_router.cost import HOP_BANDWIDTH_BPS, TransferCostModel
+from dynamo_tpu.topology import TopologyMap
+from dynamo_tpu.topology.card import TopologyCard
+
+
+def two_slice_map():
+    """prefill(17)@s0, decode(1)@s0 near, decode(2)@s1 far — all one
+    process (same host+pid), like an emulated fleet: the same-slice pair
+    classifies local, the cross-slice pair dcn."""
+    m = TopologyMap()
+    m.upsert(TopologyCard(
+        worker_id=17, host="h0", pid=1, slice_label="s0", role="prefill"))
+    m.upsert(TopologyCard(
+        worker_id=1, host="h0", pid=1, slice_label="s0", role="decode"))
+    m.upsert(TopologyCard(
+        worker_id=2, host="h0", pid=1, slice_label="s1", role="decode"))
+    return m
+
+
+def test_pair_resolution_from_discovered_map():
+    model = TransferCostModel()
+    # before attach: nothing known, worst-case prior everywhere
+    assert not model.known()
+    assert model.bandwidth_bps(1) == HOP_BANDWIDTH_BPS["dcn"]
+
+    model.attach_topology(two_slice_map())
+    assert model.known()
+    # near decode is priced by its best prefill source (same slice → local)
+    assert model.bandwidth_bps(1) == HOP_BANDWIDTH_BPS["local"]
+    # far decode sits behind the cross-slice dcn hop
+    assert model.bandwidth_bps(2) == HOP_BANDWIDTH_BPS["dcn"]
+
+    # equal missing blocks → the far worker carries the full relative cost
+    costs = model.costs([1, 2], {1: 4, 2: 4})
+    assert costs[2] == 1.0
+    assert costs[1] < 0.05
+
+
+def test_map_measurement_refines_pair():
+    m = two_slice_map()
+    m.observe(17, 2, bandwidth_bps=50e9)
+    model = TransferCostModel()
+    model.attach_topology(m)
+    assert model.bandwidth_bps(2) == 50e9
+
+
+def test_explicit_link_report_beats_map():
+    model = TransferCostModel()
+    model.attach_topology(two_slice_map())
+    # the worker self-reports DYN_TRANSFER_HOP=ici through its load metrics
+    model.update_from_metrics(SimpleNamespace(
+        worker_id=2, transfer_hop="ici", kv_transfer_bandwidth_bps=0.0,
+    ))
+    assert model.bandwidth_bps(2) == HOP_BANDWIDTH_BPS["ici"]
+    # the other worker still resolves from the map
+    assert model.bandwidth_bps(1) == HOP_BANDWIDTH_BPS["local"]
+
+
+def test_transfer_hop_env_override_beats_discovery(monkeypatch):
+    from dynamo_tpu.llm.disagg import DisaggDecodeEngine
+
+    m = two_slice_map()
+
+    monkeypatch.delenv("DYN_TRANSFER_HOP", raising=False)
+    engine = DisaggDecodeEngine(None, None, None, None)
+    engine.attach_topology(m, self_worker_id=2)
+    assert engine.transfer_hop == "dcn"  # discovered inbound hop
+
+    monkeypatch.setenv("DYN_TRANSFER_HOP", "ici")
+    engine = DisaggDecodeEngine(None, None, None, None)
+    engine.attach_topology(m, self_worker_id=2)
+    assert engine.transfer_hop == "ici"  # explicit override wins
+
+
+def test_self_worker_resolution_uses_own_pair():
+    model = TransferCostModel()
+    model.attach_topology(two_slice_map(), self_worker_id=17)
+    assert model.bandwidth_bps(1) == HOP_BANDWIDTH_BPS["local"]
+    assert model.bandwidth_bps(2) == HOP_BANDWIDTH_BPS["dcn"]

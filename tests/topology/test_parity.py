@@ -1,0 +1,135 @@
+"""Single-host degeneration parity: a one-host fleet discovers an
+all-``local`` map, every consumer treats it as absent, and request output is
+byte-identical with the topology plane on vs off."""
+
+import asyncio
+import json
+
+from dynamo_tpu.llm.kv_router.cost import HOP_BANDWIDTH_BPS, TransferCostModel
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.llm.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest, StopConditions
+from dynamo_tpu.runtime import Context, DistributedRuntime
+from dynamo_tpu.runtime.client import PushRouter, RouterMode
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.topology import TopologyMap, TopologyWatcher
+from dynamo_tpu.topology.card import TopologyCard
+from dynamo_tpu.utils.config import RuntimeConfig
+
+
+def local_pair_map():
+    m = TopologyMap()
+    m.upsert(TopologyCard(worker_id=1, host="h0", pid=9))
+    m.upsert(TopologyCard(worker_id=2, host="h0", pid=9))
+    return m
+
+
+def test_all_local_map_is_inert():
+    m = local_pair_map()
+    assert not m.informative()
+    model = TransferCostModel()
+    model.attach_topology(m)
+    # the cost model refuses to wake up: selection stays overlap/load-only
+    assert not model.known()
+    assert model.bandwidth_bps(1) == HOP_BANDWIDTH_BPS["dcn"]
+    assert model.bandwidth_bps(2) == HOP_BANDWIDTH_BPS["dcn"]
+
+
+def test_all_local_map_leaves_disagg_hop_empty():
+    from dynamo_tpu.llm.disagg import DisaggDecodeEngine
+
+    engine = DisaggDecodeEngine(None, None, None, None)
+    engine.attach_topology(local_pair_map(), self_worker_id=2)
+    assert engine.transfer_hop == ""
+
+
+async def _serve_and_collect(name: str, topo_on: bool) -> bytes:
+    """One single-host KV-routed mocker fleet; returns the exact wire bytes
+    of a fixed request sequence."""
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane=f"memory://{name}")
+    )
+    comp = rt.namespace("ns").component("backend")
+    ep = comp.endpoint("generate")
+    workers = []
+    try:
+        for wid in (1, 2):
+            engine = MockerEngine(MockerConfig(speedup=500.0))
+            service = await ep.serve(
+                engine, stats_handler=engine.stats,
+                instance_id=wid, topo_role="decode",
+            )
+            kv_pub = KvEventPublisher(comp, worker_id=wid)
+            kv_pub.start()
+            engine._event_sink = kv_pub.sink
+            engine.start()
+            workers.append((engine, service, kv_pub))
+
+        push = await PushRouter.from_endpoint(ep, mode=RouterMode.RANDOM)
+        await push.client.wait_for_instances(2, timeout=5)
+        kv_router = KvRouter(comp, block_size=16, enable_prefetch=False)
+        topo = None
+        if topo_on:
+            # the frontend wiring (ModelWatcher): watcher + attach
+            topo = TopologyWatcher(rt)
+            await topo.start()
+            for _ in range(200):
+                if len(topo.map.nodes) == 2:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(topo.map.nodes) == 2, "workers never published cards"
+            assert not topo.map.informative()  # one host → all local
+            kv_router.attach_topology(topo.map)
+        await kv_router.start()
+        dispatcher = KvPushRouter(push, kv_router)
+
+        outs = []
+        for i in range(4):
+            wire = PreprocessedRequest(
+                token_ids=[(i * 3 + j) % 50 for j in range(24)],
+                stop=StopConditions(max_tokens=6, ignore_eos=True),
+                eos_token_ids=[],
+            ).to_wire()
+            stream = await dispatcher.generate(Context(dict(wire)))
+            outs.append([item async for item in stream])
+
+        await kv_router.stop()
+        if topo is not None:
+            await topo.stop()
+        return json.dumps(outs, sort_keys=True).encode()
+    finally:
+        for engine, service, kv_pub in workers:
+            await service.shutdown(drain_timeout=1)
+            await kv_pub.stop()
+            engine.stop()
+        await rt.close()
+
+
+async def test_single_host_output_byte_identical_plane_on_off(monkeypatch):
+    monkeypatch.setenv("DYN_TOPO", "1")
+    with_plane = await _serve_and_collect("topo-on", topo_on=True)
+    monkeypatch.setenv("DYN_TOPO", "0")
+    without_plane = await _serve_and_collect("topo-off", topo_on=False)
+    assert with_plane == without_plane
+
+
+async def test_plane_off_publishes_no_cards(monkeypatch):
+    monkeypatch.setenv("DYN_TOPO", "0")
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://topo-gate")
+    )
+    try:
+        ep = rt.namespace("ns").component("backend").endpoint("generate")
+        engine = MockerEngine(MockerConfig(speedup=500.0))
+        service = await ep.serve(engine, stats_handler=engine.stats)
+        from dynamo_tpu.topology import CARDS_PREFIX
+
+        entries = await rt.plane.kv.get_prefix(CARDS_PREFIX)
+        assert not entries
+        await service.shutdown(drain_timeout=1)
+        engine.stop()
+    finally:
+        await rt.close()

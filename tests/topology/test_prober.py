@@ -1,0 +1,128 @@
+"""TopologyProber: active probes over the real KV-transfer transport fold
+RTT/bandwidth EWMAs into the map, probe payloads never reach the engine
+sink, and passive KvTransferClient per-destination EWMAs merge in."""
+
+from dynamo_tpu.parallel.kv_transfer import KvTransferClient, KvTransferServer
+from dynamo_tpu.topology import TopologyMap, TopologyProber
+from dynamo_tpu.topology.card import TopologyCard
+
+
+def _map_with(*cards):
+    m = TopologyMap()
+    for c in cards:
+        m.upsert(c)
+    return m
+
+
+async def test_probe_once_measures_over_real_transport():
+    delivered = []
+
+    async def sink(payload):
+        delivered.append(payload)
+
+    server = KvTransferServer(sink)
+    await server.start()
+    try:
+        m = _map_with(
+            TopologyCard(worker_id=1, host="h0", pid=1, role="prefill"),
+            TopologyCard(
+                worker_id=2, host="h0", pid=1, role="decode",
+                transfer_address=server.address,
+            ),
+        )
+        client = KvTransferClient()
+        prober = TopologyProber(
+            m, self_worker_id=1, client=client,
+            period_s=999.0, probe_bytes=4096, max_per_tick=4,
+        )
+        done = await prober.probe_once()
+        assert done == 1
+        assert prober.probes_sent == 1
+
+        link = m.link(1, 2)
+        assert link.probes_total == 1
+        assert link.rtt_s > 0
+        assert link.measured_bps > 0
+        # probe payloads are invisible to decode state: acked, not delivered
+        assert delivered == []
+    finally:
+        await server.stop()
+
+
+async def test_probe_failure_is_counted_not_raised():
+    m = _map_with(
+        TopologyCard(worker_id=1),
+        TopologyCard(worker_id=2, transfer_address="127.0.0.1:1"),  # dead port
+    )
+    prober = TopologyProber(
+        m, self_worker_id=1, period_s=999.0, probe_bytes=16, max_per_tick=4,
+    )
+    done = await prober.probe_once()
+    assert done == 0
+    assert prober.probe_failures == 1
+    link = m.link(1, 2)
+    assert link is None or link.measured_bps == 0
+
+
+async def test_merge_client_ewmas_decays_prior_into_measurement():
+    m = _map_with(
+        TopologyCard(worker_id=1, slice_label="s0", role="prefill"),
+        TopologyCard(
+            worker_id=2, slice_label="s1", role="decode",
+            transfer_address="10.0.0.2:7000",
+        ),
+    )
+    # dcn prior before any measurement
+    assert m.pair_bandwidth(1, 2) == 10e9
+
+    client = KvTransferClient()
+    client.bandwidth_bps["10.0.0.2:7000"] = 2e9
+    client.bandwidth_bps["unknown:1"] = 9e9  # no card → ignored
+    prober = TopologyProber(
+        m, self_worker_id=1, client=client,
+        period_s=999.0, probe_bytes=16, max_per_tick=4,
+    )
+    assert prober.merge_client_ewmas() == 1
+    # measurement replaces the prior outright on first observation
+    assert m.pair_bandwidth(1, 2) == 2e9
+
+    # a second, different EWMA folds in (alpha=0.25 by default)
+    client.bandwidth_bps["10.0.0.2:7000"] = 4e9
+    prober.merge_client_ewmas()
+    assert m.pair_bandwidth(1, 2) == 0.75 * 2e9 + 0.25 * 4e9
+
+
+async def test_prefill_pump_hosts_the_prober():
+    from dynamo_tpu.llm.disagg import PrefillWorker
+
+    m = _map_with(
+        TopologyCard(worker_id=1, role="prefill"),
+        TopologyCard(
+            worker_id=2, role="decode", transfer_address="10.0.0.2:7000"
+        ),
+    )
+    pump = PrefillWorker(None, None, None)
+    pump.attach_topology(m, self_worker_id=1)
+    # the prober rides the pump's own client: every real KV send is a
+    # passive bandwidth measurement for the map
+    assert pump._prober.client is pump.client
+    pump.client.bandwidth_bps["10.0.0.2:7000"] = 3e9
+    assert pump._prober.merge_client_ewmas() == 1
+    assert m.pair_bandwidth(1, 2) == 3e9
+    await pump.stop()
+    assert pump._prober is None
+
+
+async def test_merge_skips_self_and_nonpositive():
+    m = _map_with(
+        TopologyCard(worker_id=1, transfer_address="10.0.0.1:7000"),
+        TopologyCard(worker_id=2, transfer_address="10.0.0.2:7000"),
+    )
+    client = KvTransferClient()
+    client.bandwidth_bps["10.0.0.1:7000"] = 5e9   # self → skipped
+    client.bandwidth_bps["10.0.0.2:7000"] = 0.0   # unmeasured → skipped
+    prober = TopologyProber(
+        m, self_worker_id=1, client=client,
+        period_s=999.0, probe_bytes=16, max_per_tick=4,
+    )
+    assert prober.merge_client_ewmas() == 0
