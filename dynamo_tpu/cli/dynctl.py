@@ -8,6 +8,7 @@ Usage:
   python -m dynamo_tpu.cli.dynctl list-instances [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl remove-model NAME [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl drain INSTANCE_ID [--timeout S] [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl migrate REQUEST_ID DST [--reason R] [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl topology [--json] [--control-plane H:P]
 """
 
@@ -137,6 +138,43 @@ async def _amain(args) -> int:
                 f"duration={result.get('duration_s')}s deregistered={gone}"
             )
             return 0 if result.get("ok") and gone else 1
+        elif args.cmd == "migrate":
+            from dynamo_tpu.runtime.migration import MIGRATE_SUBJECT
+
+            op = {
+                "op": "migrate",
+                "request_id": args.request_id,
+                "dst": args.dst,
+                "reason": args.reason,
+            }
+            try:
+                # only the dispatcher that owns the request replies; the
+                # flip itself is bounded by DYN_MIGRATE_FLIP_TIMEOUT_S on
+                # the owning side, so pad generously here
+                reply = await plane.bus.request(
+                    MIGRATE_SUBJECT, json.dumps(op).encode(), timeout=args.timeout
+                )
+            except (asyncio.TimeoutError, RuntimeError) as exc:
+                # a remote control plane wraps the bus timeout in the RPC
+                # error channel (RuntimeError), the in-memory one raises it
+                if isinstance(exc, RuntimeError) and "Timeout" not in repr(exc):
+                    raise
+                print(
+                    f"no dispatcher owns request {args.request_id!r} "
+                    "(wrong id, already finished, or DYN_MIGRATE=0)"
+                )
+                return 1
+            result = json.loads(reply.decode())
+            if result.get("ok"):
+                print(
+                    f"migrated {result['request_id']}: "
+                    f"{result['src']} -> {result['dst']} "
+                    f"(hop={result.get('hop') or '?'} "
+                    f"hidden={result.get('hidden_s')}s)"
+                )
+                return 0
+            print(f"migrate failed: {result.get('error')}")
+            return 1
     finally:
         await plane.close()
     return 0
@@ -166,6 +204,22 @@ def main() -> int:
     drain.add_argument("--timeout", type=float, default=None,
                        help="drain budget in seconds (default DYN_DRAIN_TIMEOUT_S)")
     drain.add_argument("--control-plane", default="127.0.0.1:2379")
+    mig = sub.add_parser(
+        "migrate", help="move one live decode session to another worker"
+    )
+    mig.add_argument("request_id",
+                     help="id of the in-flight session: the request/trace id "
+                          "(x-request-id header, frontend logs) or the "
+                          "dispatcher's internal session id")
+    mig.add_argument("dst", nargs="?", default=None,
+                     help="destination instance id (hex, prefix ok); omit to "
+                          "let the coordinator pick the cheapest-hop worker")
+    mig.add_argument("--reason", default="manual",
+                     help="migration reason; anything but 'manual' also "
+                          "authorizes a DCN-hop destination")
+    mig.add_argument("--timeout", type=float, default=30.0,
+                     help="seconds to wait for the owning dispatcher's reply")
+    mig.add_argument("--control-plane", default="127.0.0.1:2379")
     args = parser.parse_args()
     return asyncio.run(_amain(args))
 

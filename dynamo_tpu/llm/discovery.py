@@ -147,6 +147,9 @@ class ModelWatcher:
             kv_router = state.get("kv")
             if kv_router is not None:
                 await kv_router.stop()
+            router = state.get("router")
+            if router is not None and router.migrations is not None:
+                await router.migrations.stop()
 
     async def _loop(self) -> None:
         try:
@@ -208,6 +211,10 @@ class ModelWatcher:
         state = self._pipelines.pop(entry.name, None)
         if state is not None and state.get("kv") is not None:
             await state["kv"].stop()
+        if state is not None and state.get("router") is not None:
+            router = state["router"]
+            if router.migrations is not None:
+                await router.migrations.stop()
         if self.prefetch_hinter is not None:
             self.prefetch_hinter.remove_model(entry.name)
         self.manager.remove_model(entry.name)
@@ -230,6 +237,13 @@ class ModelWatcher:
         ns = self.runtime.namespace(entry.namespace)
         endpoint = ns.component(entry.component).endpoint(entry.endpoint)
         push_router = await PushRouter.from_endpoint(endpoint, self.router_mode)
+        if push_router.migrations is not None:
+            # live-migration control verb (dynctl migrate) + topology-priced
+            # destination picking; the lambda keeps reading the watcher's
+            # map as probes refine it
+            if self._topology_watcher is not None:
+                push_router.migrations.attach_topology(lambda: self.topology)
+            await push_router.migrations.serve_ctl(self.runtime.plane.bus)
 
         kv_router = None
         if self.router_mode == RouterMode.KV:

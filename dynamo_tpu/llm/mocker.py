@@ -234,7 +234,11 @@ class MockerEngine:
                 self._emit_next(seq)
             decode_before = self._tokens_emitted_total
             for seq in decodes:
-                if seq.status == SeqStatus.FINISHED:
+                # FINISHED (cancelled mid-sleep) or PREEMPTED (victimized by
+                # an EARLIER seq's ensure_slot in this very loop — its blocks
+                # are gone, touching the allocator would KeyError): skip; a
+                # preempted seq is already queued for recompute.
+                if seq.status != SeqStatus.RUNNING:
                     continue
                 slot = self.scheduler.ensure_slot(seq)
                 if slot is None:

@@ -83,6 +83,74 @@ class KvTransferPayload:
     part_index: int = 0
     last: bool = True
     block_start: int = 0
+    # layer-wise granularity: a part may carry only layers
+    # [layer_start, layer_start + layer_count) of its blocks' leading (layer)
+    # axis, so the first layers of a block can leave before the block
+    # finishes all layers.  layer_count == -1 means "all layers" — every
+    # legacy frame is the all-layers degenerate case.
+    layer_start: int = 0
+    layer_count: int = -1
+
+
+def split_layerwise(
+    payload: KvTransferPayload, layers_per_part: int
+) -> list[KvTransferPayload]:
+    """Slice one payload into layer-range parts along the blocks' leading
+    (layer) axis.  The final part inherits ``last`` and the first_token*
+    fields; intermediates are ordinary non-final stream parts.  A payload
+    whose arrays have fewer layers than ``layers_per_part`` round-trips as
+    a single part."""
+    if layers_per_part <= 0 or not payload.blocks:
+        return [payload]
+    n_layers = min(a.shape[0] for a in payload.blocks.values())
+    if n_layers <= layers_per_part:
+        return [payload]
+    parts: list[KvTransferPayload] = []
+    for start in range(0, n_layers, layers_per_part):
+        count = min(layers_per_part, n_layers - start)
+        final = start + count >= n_layers
+        parts.append(KvTransferPayload(
+            seq_id=payload.seq_id,
+            first_token=payload.first_token if final else -1,
+            block_ids=list(payload.block_ids),
+            blocks={n: a[start:start + count] for n, a in payload.blocks.items()},
+            first_token_logprob=payload.first_token_logprob if final else None,
+            first_token_top_logprobs=(
+                payload.first_token_top_logprobs if final else None
+            ),
+            part_index=payload.part_index + len(parts),
+            last=payload.last and final,
+            block_start=payload.block_start,
+            layer_start=start,
+            layer_count=count,
+        ))
+    return parts
+
+
+def assemble_layers(parts: list[KvTransferPayload]) -> KvTransferPayload:
+    """Stitch layer-range parts of one block range back into a full-depth
+    payload (receiver-side twin of :func:`split_layerwise`; tolerates
+    duplicates and arbitrary arrival order)."""
+    if len(parts) == 1 and parts[0].layer_count < 0:
+        return parts[0]
+    by_start = {p.layer_start: p for p in parts}
+    ordered = [by_start[k] for k in sorted(by_start)]
+    final = max(parts, key=lambda p: p.layer_start)
+    blocks = {
+        name: np.concatenate([p.blocks[name] for p in ordered], axis=0)
+        for name in ordered[0].blocks
+    }
+    return KvTransferPayload(
+        seq_id=final.seq_id,
+        first_token=final.first_token,
+        block_ids=list(final.block_ids),
+        blocks=blocks,
+        first_token_logprob=final.first_token_logprob,
+        first_token_top_logprobs=final.first_token_top_logprobs,
+        part_index=final.part_index,
+        last=final.last,
+        block_start=final.block_start,
+    )
 
 
 class KvTransferServer:
@@ -148,10 +216,14 @@ class KvTransferServer:
                     block_ids=list(h["block_ids"]),
                     blocks=blocks,
                     # mixed-version compat: a pre-streaming sender omits the
-                    # part fields — decode as a one-part stream
+                    # part fields — decode as a one-part stream; a
+                    # pre-layerwise sender omits the layer fields — decode
+                    # as an all-layers part
                     part_index=int(h.get("part_index", 0)),
                     last=bool(h.get("last", True)),
                     block_start=int(h.get("block_start", 0)),
+                    layer_start=int(h.get("layer_start", 0)),
+                    layer_count=int(h.get("layer_count", -1)),
                 )
                 if not payload.seq_id.startswith(PROBE_SEQ_PREFIX):
                     await self.sink(payload)
@@ -252,6 +324,8 @@ class KvTransferClient:
                 "part_index": payload.part_index,
                 "last": payload.last,
                 "block_start": payload.block_start,
+                "layer_start": payload.layer_start,
+                "layer_count": payload.layer_count,
                 "parts": [
                     {"name": n, "dtype": a.dtype.name, "shape": list(a.shape)}
                     for n, a in zip(names, arrays)

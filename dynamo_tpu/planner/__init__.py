@@ -13,6 +13,7 @@ budget, and state.PlannerStatePublisher mirrors every executed decision to
 the metrics service's dyn_planner_* gauges.
 """
 
+from dynamo_tpu.planner.defrag import DefragConfig, Defragmenter
 from dynamo_tpu.planner.load_predictor import (
     ConstantPredictor,
     EwmaPredictor,
@@ -36,6 +37,8 @@ from dynamo_tpu.planner.state import (
 
 __all__ = [
     "ConstantPredictor",
+    "DefragConfig",
+    "Defragmenter",
     "EwmaPredictor",
     "LinearTrendPredictor",
     "make_predictor",

@@ -36,13 +36,18 @@ HELP = {
     "dyn_drain_started_total": "Worker graceful drains initiated (dynctl drain / SIGTERM / scale-down)",
     "dyn_drain_completed_total": "Worker graceful drains that emptied within the budget",
     "dyn_drain_handoff_total": "In-flight requests handed off (resume-redispatch) by a draining worker",
+    "dyn_migration_started_total": "Live session migrations that passed validation and began the handoff",
+    "dyn_migration_committed_total": "Live session migrations whose stream flip committed on the destination",
+    "dyn_migration_aborted_total": "Migrations aborted cleanly back to the still-decoding source",
+    "dyn_migration_failed_total": "Migrate requests rejected before any handoff started (unknown session, bad destination, unpriced DCN hop)",
+    "dyn_migration_hidden_seconds": "Wall seconds of source decode overlapped with migration handoffs (latency hidden from clients)",
 }
 
 _lock = threading.Lock()
 _counters: dict[str, int] = {}
 
 
-def incr(name: str, by: int = 1) -> int:
+def incr(name: str, by: float = 1) -> float:
     with _lock:
         _counters[name] = _counters.get(name, 0) + by
         return _counters[name]
@@ -71,7 +76,10 @@ def render() -> bytes:
     present so scrape checks can assert on them before the first event)."""
     lines = []
     for name, value in sorted(snapshot().items()):
+        # accumulated-seconds families (e.g. dyn_migration_hidden_seconds)
+        # render as gauges: the counter type reserves the _total suffix
+        mtype = "counter" if name.endswith("_total") else "gauge"
         lines.append(f"# HELP {name} {HELP.get(name, 'Resilience counter')}")
-        lines.append(f"# TYPE {name} counter")
+        lines.append(f"# TYPE {name} {mtype}")
         lines.append(f"{name} {value}")
     return ("\n".join(lines) + "\n").encode()

@@ -49,6 +49,8 @@ WORKER_GENERATE = "worker.generate"  # ingress handing a request to its engine
 ENGINE_STEP = "engine.step"          # engine device-loop iteration
 PREFILL_DEQUEUE = "prefill.dequeue"  # disagg prefill worker queue pop
 KV_TRANSFER = "kv.transfer"          # disagg KV block shipment
+MIGRATE_HANDOFF = "migrate.handoff"  # migration snapshot/KV-stream/pre-admit
+MIGRATE_FLIP = "migrate.flip"        # migration stream flip about to commit
 
 EXCEPTIONS: dict[str, type[BaseException]] = {
     "ConnectionError": ConnectionError,

@@ -26,6 +26,7 @@ from dynamo_tpu.runtime.component import Endpoint, Instance, instances_prefix
 from dynamo_tpu.runtime.dataplane import PendingStream
 from dynamo_tpu.runtime.controlplane.interface import WatchEventType
 from dynamo_tpu.runtime.engine import Context, EngineContext, ResponseStream
+from dynamo_tpu.runtime.migration import MigrationCoordinator
 from dynamo_tpu.runtime.resume import GenerationJournal, dedupe_stream
 from dynamo_tpu.utils.logging import get_logger
 from dynamo_tpu.utils.tasks import spawn_logged
@@ -60,6 +61,11 @@ class Client:
         self._watch = None
         self._watch_task: asyncio.Task | None = None
         self._changed = asyncio.Event()
+        # instance-removal hooks (sync callables, instance_id arg): the
+        # migration coordinator uses the DELETE event — fired the moment a
+        # drain deletes its instance key — to move survivors off the worker
+        # while its natural-completion window is still open
+        self.on_instance_removed: list = []
 
     async def start(self) -> None:
         if self._static:
@@ -88,6 +94,11 @@ class Client:
                     self._instances[inst.instance_id] = inst
                 else:
                     self._instances.pop(inst.instance_id, None)
+                    for hook in list(self.on_instance_removed):
+                        try:
+                            hook(inst.instance_id)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("instance-removed hook failed")
                 self._changed.set()
                 self._changed = asyncio.Event()
         except ConnectionError as exc:
@@ -171,6 +182,14 @@ class PushRouter:
         # re-pay the connect timeout on every other request)
         self.dark_ttl_s = knobs.get("DYN_DARK_WORKER_TTL_S")
         self._dark: dict[int, float] = {}  # instance_id -> retry-after monotonic
+        # live-session migration (dynctl migrate / drain handoff / planner
+        # defrag): journaled streams register with the coordinator so their
+        # decode can be flipped to another worker mid-stream, exactly-once
+        self.migrations: MigrationCoordinator | None = (
+            MigrationCoordinator(self) if knobs.get("DYN_MIGRATE") else None
+        )
+        if self.migrations is not None:
+            self.migrations.attach_client(client)
 
     @classmethod
     async def from_endpoint(
@@ -290,104 +309,168 @@ class PushRouter:
         retries = 0
         resumes = 0
         resume_counted = False
-        while True:
-            streamed_any = False
-            try:
-                async for item in pending:
-                    streamed_any = True
-                    if journal is not None:
-                        journal.record(item)
-                    if (
-                        resumes and not resume_counted
-                        and isinstance(item, dict)
-                        and isinstance(item.get("data"), dict)
-                        and item["data"].get("finish_reason")
-                    ):
-                        # success is counted at the FINISH item, not at
-                        # generator exhaustion: consumers stop pulling once
-                        # they see the finish, so a post-loop increment may
-                        # never run
+        # ``pending`` is what we iterate (possibly a dedupe wrapper);
+        # ``raw`` is the underlying transport stream of the active hop —
+        # the thing a migration flip must kill to release the source
+        raw = pending
+        handle = None
+        if journal is not None and self.migrations is not None:
+            handle = self.migrations.register(
+                request.ctx.id, journal, request.ctx, inst_id
+            )
+        try:
+            while True:
+                streamed_any = False
+                it = pending.__aiter__()
+                try:
+                    while True:
+                        if handle is not None and handle.flip_pending():
+                            if journal.finished:
+                                # the finish item already reached the client;
+                                # there is nothing left to move
+                                handle.abort_flip("finished")
+                            else:
+                                # COMMIT — synchronous (no await between the
+                                # pending check and ``done.set()``), so the
+                                # coordinator's flip timeout can never observe
+                                # a half-applied swap.  An item boundary IS a
+                                # journal window boundary: the source decoded
+                                # ``delta`` tokens past the snapshot, all
+                                # delivered, and the destination regenerates
+                                # exactly that window for the cursor to drop.
+                                flip = handle.flip
+                                delta = journal.total_recorded - flip.snap_total
+                                old_raw, raw = raw, flip.dst_raw
+                                inst_id = flip.dst_inst_id
+                                handle.inst_id = inst_id
+                                pending = dedupe_stream(
+                                    raw, flip.payload_accepted + delta,
+                                    ack_skip=delta,
+                                )
+                                it = pending.__aiter__()
+                                handle.flip = None
+                                flip.outcome = "committed"
+                                flip.done.set()
+                                # release the source: a data-plane control
+                                # frame killing the worker-side context of
+                                # the OLD hop only — the request context
+                                # (and this stream) are untouched
+                                spawn_logged(old_raw.send_control("kill"))
+                        try:
+                            item = await it.__anext__()
+                        except StopAsyncIteration:
+                            break
+                        streamed_any = True
+                        if journal is not None:
+                            journal.record(item)
+                        if (
+                            isinstance(item, dict)
+                            and isinstance(item.get("data"), dict)
+                            and item["data"].get("finish_reason")
+                        ):
+                            # success is counted at the FINISH item, not at
+                            # generator exhaustion: consumers stop pulling
+                            # once they see the finish, so a post-loop
+                            # increment may never run.  The journal releases
+                            # its retained tokens here for the same reason.
+                            if journal is not None:
+                                journal.finish()
+                            if resumes and not resume_counted:
+                                resume_counted = True
+                                counters.incr("dyn_resume_success_total")
+                        yield item
+                    if resumes and not resume_counted:
                         resume_counted = True
                         counters.incr("dyn_resume_success_total")
-                    yield item
-                if resumes and not resume_counted:
-                    resume_counted = True
-                    counters.incr("dyn_resume_success_total")
-                return
-            except Exception as exc:  # noqa: BLE001 — retry decision below
-                if request.ctx.is_killed or not _is_transient_stream_error(exc):
-                    raise
-                accepted = journal.accepted if journal is not None else []
-                if not streamed_any and not accepted:
-                    # pre-first-token: safe plain re-dispatch
-                    if retries >= retry_max:
+                    return
+                except Exception as exc:  # noqa: BLE001 — retry decision below
+                    if handle is not None:
+                        # a flip prepared against the now-broken hop is void;
+                        # the coordinator kills its pre-admitted destination
+                        # and the ordinary resume machinery takes over —
+                        # migration is never less safe than not migrating
+                        handle.abort_flip()
+                    if request.ctx.is_killed or not _is_transient_stream_error(exc):
                         raise
-                    retries += 1
-                    counters.incr("dyn_retries_total")
+                    accepted = journal.accepted if journal is not None else []
+                    if not streamed_any and not accepted:
+                        # pre-first-token: safe plain re-dispatch
+                        if retries >= retry_max:
+                            raise
+                        retries += 1
+                        counters.incr("dyn_retries_total")
+                        tried.add(inst_id)
+                        self.quarantine(inst_id)
+                        logger.warning(
+                            "stream from instance %x failed pre-first-token (%s); "
+                            "re-dispatching (retry %d/%d)",
+                            inst_id, exc, retries, retry_max,
+                        )
+                        span = get_recorder().start(
+                            "dispatch.retry", getattr(request.ctx, "trace", None),
+                            component="frontend",
+                            attrs={
+                                "failed_instance": f"{inst_id:x}",
+                                "attempt": retries,
+                                "error": repr(exc),
+                            },
+                        )
+                        try:
+                            pending, inst_id = await self._rendezvous(request, None, tried)
+                        except BaseException as redispatch_exc:
+                            if span is not None:
+                                span.end(status="error", error=repr(redispatch_exc))
+                            # surface the original stream failure; the re-dispatch
+                            # failure (usually "no instances left") rides as cause
+                            raise exc from redispatch_exc
+                        if span is not None:
+                            span.end(instance=f"{inst_id:x}")
+                        raw = pending
+                        if handle is not None:
+                            handle.inst_id = inst_id
+                        continue
+                    # mid-stream: resume from the journal (or truncate honestly)
+                    if journal is None or resumes >= resume_max:
+                        raise
+                    resumes += 1
+                    journal.resumes = resumes
+                    counters.incr("dyn_resume_attempts_total")
                     tried.add(inst_id)
                     self.quarantine(inst_id)
                     logger.warning(
-                        "stream from instance %x failed pre-first-token (%s); "
-                        "re-dispatching (retry %d/%d)",
-                        inst_id, exc, retries, retry_max,
+                        "stream from instance %x failed after %d accepted "
+                        "token(s) (%s); resuming (resume %d/%d)",
+                        inst_id, len(accepted), exc, resumes, resume_max,
                     )
                     span = get_recorder().start(
-                        "dispatch.retry", getattr(request.ctx, "trace", None),
+                        "dispatch.resume", getattr(request.ctx, "trace", None),
                         component="frontend",
                         attrs={
                             "failed_instance": f"{inst_id:x}",
-                            "attempt": retries,
+                            "accepted_tokens": len(accepted),
+                            "attempt": resumes,
                             "error": repr(exc),
                         },
                     )
+                    # un-pinned re-dispatch of the ORIGINAL request + cursor; a
+                    # resume-aware engine continues (and acks), everything else
+                    # replays — riding the prefix cache — and the dedupe cursor
+                    # drops the replayed prefix
+                    resumed = Context(journal.resume_request(), request.ctx)
                     try:
-                        pending, inst_id = await self._rendezvous(request, None, tried)
+                        raw, inst_id = await self._rendezvous(resumed, None, tried)
                     except BaseException as redispatch_exc:
                         if span is not None:
                             span.end(status="error", error=repr(redispatch_exc))
-                        # surface the original stream failure; the re-dispatch
-                        # failure (usually "no instances left") rides as cause
                         raise exc from redispatch_exc
                     if span is not None:
                         span.end(instance=f"{inst_id:x}")
-                    continue
-                # mid-stream: resume from the journal (or truncate honestly)
-                if journal is None or resumes >= resume_max:
-                    raise
-                resumes += 1
-                journal.resumes = resumes
-                counters.incr("dyn_resume_attempts_total")
-                tried.add(inst_id)
-                self.quarantine(inst_id)
-                logger.warning(
-                    "stream from instance %x failed after %d accepted "
-                    "token(s) (%s); resuming (resume %d/%d)",
-                    inst_id, len(accepted), exc, resumes, resume_max,
-                )
-                span = get_recorder().start(
-                    "dispatch.resume", getattr(request.ctx, "trace", None),
-                    component="frontend",
-                    attrs={
-                        "failed_instance": f"{inst_id:x}",
-                        "accepted_tokens": len(accepted),
-                        "attempt": resumes,
-                        "error": repr(exc),
-                    },
-                )
-                # un-pinned re-dispatch of the ORIGINAL request + cursor; a
-                # resume-aware engine continues (and acks), everything else
-                # replays — riding the prefix cache — and the dedupe cursor
-                # drops the replayed prefix
-                resumed = Context(journal.resume_request(), request.ctx)
-                try:
-                    raw, inst_id = await self._rendezvous(resumed, None, tried)
-                except BaseException as redispatch_exc:
-                    if span is not None:
-                        span.end(status="error", error=repr(redispatch_exc))
-                    raise exc from redispatch_exc
-                if span is not None:
-                    span.end(instance=f"{inst_id:x}")
-                pending = dedupe_stream(raw, len(accepted))
+                    if handle is not None:
+                        handle.inst_id = inst_id
+                    pending = dedupe_stream(raw, len(accepted))
+        finally:
+            if handle is not None:
+                self.migrations.unregister(handle)
 
     async def _rendezvous(
         self, request: Context[dict], instance_id: int | None, tried: set[int]

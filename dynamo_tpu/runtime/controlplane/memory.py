@@ -75,13 +75,23 @@ class MemoryKV(KeyValueStore):
         lease, _ = entry
         lease._revoked.set()
         for key in self._lease_keys.pop(lease_id, set()):
-            old = self._data.pop(key, None)
-            if old is not None:
+            old = self._data.get(key)
+            # only reap keys this lease still owns: a reconnect re-grant
+            # re-puts the key under its NEW lease id, and the old lease
+            # expiring afterwards must not take the live key with it
+            if old is not None and old.lease_id == lease_id:
+                del self._data[key]
                 self._notify(WatchEvent(WatchEventType.DELETE, old))
 
     # -- KeyValueStore -----------------------------------------------------
     async def put(self, key: str, value: bytes, lease_id: int = 0) -> int:
         self._revision += 1
+        prev = self._data.get(key)
+        if prev is not None and prev.lease_id and prev.lease_id != lease_id:
+            # re-put under a different (or no) lease transfers ownership;
+            # leaving the key in the old lease's set would let that lease's
+            # expiry delete a key it no longer owns
+            self._lease_keys[prev.lease_id].discard(key)
         entry = KVEntry(key=key, value=value, revision=self._revision, lease_id=lease_id)
         self._data[key] = entry
         if lease_id:

@@ -36,6 +36,8 @@ import hashlib
 import json
 from typing import Any, AsyncIterator
 
+from dynamo_tpu.utils import knobs
+
 # Annotation event a resume-aware engine emits as its first item to signal
 # "I continued from your accepted tokens — nothing to dedupe".
 RESUME_ACK_EVENT = "dyn.resume.ack"
@@ -80,6 +82,41 @@ class GenerationJournal:
         ) and _is_deterministic(sampling)
         self.accepted: list[int] = []
         self.resumes = 0
+        # memory bound: accepted tokens beyond this fold into the base
+        # prompt, so a very long stream's journal stays O(cap), not O(osl)
+        self.max_items = knobs.get("DYN_RESUME_JOURNAL_MAX_ITEMS") or 0
+        self.folded = 0
+        self.finished = False
+
+    @property
+    def total_recorded(self) -> int:
+        """Tokens recorded over the request's whole lifetime — fold-invariant,
+        so migration snapshots can be diffed across a fold boundary."""
+        return self.folded + len(self.accepted)
+
+    def _fold(self, count: int) -> None:
+        """Move the ``count`` oldest accepted tokens into the base prompt.
+
+        A resume built afterwards replays/continues from the grown prompt
+        with a correspondingly smaller accepted tail and max_tokens budget —
+        semantically identical, just with the cursor's oldest prefix baked
+        into ``token_ids``.  The captured request is never mutated in place;
+        the journal swaps in a rewritten copy."""
+        if count <= 0 or not self.resumable:
+            return
+        prefix, self.accepted = self.accepted[:count], self.accepted[count:]
+        wire = dict(self.request)
+        wire["token_ids"] = list(wire.get("token_ids") or []) + prefix
+        stop = dict(wire.get("stop") or {})
+        max_tokens = stop.get("max_tokens")
+        if max_tokens is not None:
+            stop["max_tokens"] = max(int(max_tokens) - len(prefix), 1)
+            wire["stop"] = stop
+        self.request = wire
+        self.folded += len(prefix)
+        self.prompt_hash = hashlib.sha256(
+            json.dumps(list(wire["token_ids"])).encode()
+        ).hexdigest()
 
     def record(self, item: dict) -> None:
         """Note a wire item the caller is about to see (post-dedupe)."""
@@ -88,6 +125,15 @@ class GenerationJournal:
         data = item.get("data")
         if isinstance(data, dict):
             self.accepted.extend(data.get("token_ids") or [])
+            if self.max_items > 0 and len(self.accepted) > self.max_items:
+                self._fold(len(self.accepted) - self.max_items)
+
+    def finish(self) -> None:
+        """The stream delivered its finish item: release the retained tokens
+        now instead of waiting for the request object graph to die."""
+        self.finished = True
+        self.folded = self.total_recorded
+        self.accepted = []
 
     def resume_payload(self) -> dict:
         # penalty counts / stop-sequence progress are a pure function of the
@@ -145,14 +191,19 @@ def ack_item(accepted_count: int) -> dict:
 
 
 async def dedupe_stream(
-    stream: AsyncIterator[dict], skip: int
+    stream: AsyncIterator[dict], skip: int, *, ack_skip: int = 0
 ) -> AsyncIterator[dict]:
     """Exactly-once cursor over a resumed stream.
 
     Replay mode: drop the first ``skip`` generated tokens (count-based — a
     new token that happens to equal an old one must NOT be dropped, so no
     content matching).  Continuation mode: the first item is a
-    ``dyn.resume.ack`` annotation — swallow it and dedupe nothing.  A
+    ``dyn.resume.ack`` annotation — swallow it, then drop ``ack_skip``
+    tokens.  A plain resume leaves ``ack_skip`` at 0 (the continuation
+    starts exactly at the cursor); a live-migration handoff passes the
+    tokens the *source kept decoding* between the journal snapshot shipped
+    to the destination and the flip commit — the destination regenerates
+    that window, and dropping it is what makes the flip exactly-once.  A
     finish_reason landing inside the dropped prefix is preserved on an
     empty-token item so the stream still terminates cleanly.
     """
@@ -162,7 +213,7 @@ async def dedupe_stream(
         if first:
             first = False
             if isinstance(item, dict) and item.get("event") == RESUME_ACK_EVENT:
-                remaining = 0
+                remaining = ack_skip
                 continue
         if remaining > 0 and isinstance(item, dict):
             data = item.get("data")

@@ -36,6 +36,8 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.observability.slo import SloConfig, SloObjective, SloTracker
 from dynamo_tpu.planner import (
+    DefragConfig,
+    Defragmenter,
     PerfProfile,
     Planner,
     PlannerConfig,
@@ -104,6 +106,10 @@ class _PhaseStats:
         self.retries = 0
         self.abandoned = 0
         self.by_kind: dict[str, int] = {}
+        # verify_outputs bookkeeping: completed requests whose streamed
+        # tokens matched / diverged from the deterministic greedy reference
+        self.verified = 0
+        self.corrupt = 0
 
     def burn(self, spec: ScenarioSpec) -> dict[str, float]:
         s = spec.slo
@@ -142,6 +148,9 @@ class ScenarioRunner:
         self._window_ttfts: list[float] = []
         self._window_itls: list[float] = []
         self._next_plan_t = 0.0
+        # planner-driven defragmentation (autopilot.defrag)
+        self.defrag: Defragmenter | None = None
+        self._next_defrag_t = 0.0
 
     # -- simulated clock -----------------------------------------------------
     def sim_now(self) -> float:
@@ -174,6 +183,7 @@ class ScenarioRunner:
             t0 = self.sim_now()
             ttft = None
             last_emit = None
+            got: list[int] = []
             try:
                 stream = await self.fleet.dispatcher.generate(Context(dict(wire)))
                 async for item in stream:
@@ -192,9 +202,25 @@ class ScenarioRunner:
                         self._window_itls.append(itl)
                         self.slo.observe_latency("itl", itl, now=now)
                     last_emit = now
+                    got.extend(ann.data.token_ids)
                     if history is not None:
                         history.extend(ann.data.token_ids)
                 stats.completed += 1
+                if spec.verify_outputs:
+                    # the mocker's greedy chain is fully determined by the
+                    # prompt's last token — so the reference an unmigrated
+                    # run would stream is computable without running it, and
+                    # any resume/migration replay or drop shows up here
+                    last = tokens[-1] if tokens else -1
+                    expected = [(last + 1 + i) % 1000 for i in range(osl)]
+                    if got == expected:
+                        stats.verified += 1
+                    else:
+                        stats.corrupt += 1
+                        logger.warning(
+                            "output diverged from greedy reference "
+                            "(kind=%s len=%d want=%d)", kind, len(got), osl,
+                        )
                 self.slo.observe_outcome("error_rate", True, now=self.sim_now())
                 return True
             except asyncio.CancelledError:
@@ -250,6 +276,44 @@ class ScenarioRunner:
         logger.info("phase %s: %s worker %s in pool %s",
                     phase.name, ev.mode, wid, ev.pool)
 
+    async def _migrate_later(self, phase: Phase, ev, phase_t0: float,
+                             migrated: list) -> None:
+        """MigrationEvent: live-migrate up to ``count`` in-flight sessions,
+        each to the coordinator's cheapest-hop pick.  Refusals (session
+        finished between listing and migrating, no destination) are recorded
+        and skipped — the event keeps walking the registry until it commits
+        ``count`` moves or runs out of sessions."""
+        await self._sim_sleep_until(phase_t0 + ev.at_s)
+        coord = getattr(self.fleet.push, "migrations", None)
+        if coord is None:
+            migrated.append({"t": round(self.sim_now(), 3),
+                             "error": "migration disabled (DYN_MIGRATE=0)"})
+            return
+        committed = 0
+        for rid in sorted(coord.sessions()):
+            if committed >= ev.count:
+                break
+            res = await coord.migrate(rid, None, reason=ev.reason)
+            migrated.append({
+                "t": round(self.sim_now(), 3), "request": rid,
+                "ok": bool(res.get("ok")), "src": res.get("src"),
+                "dst": res.get("dst"), "hop": res.get("hop"),
+                "error": res.get("error"),
+            })
+            if res.get("ok"):
+                committed += 1
+        logger.info("phase %s: migration event committed %d/%d",
+                    phase.name, committed, ev.count)
+
+    # -- defrag ---------------------------------------------------------------
+    def _occupancy(self) -> dict[int, float]:
+        """Per-worker KV occupancy from the live metrics aggregator."""
+        snap = self.fleet.metrics_service.aggregator.snapshot()
+        return {
+            wid: float(getattr(m, "gpu_cache_usage_perc", 0.0))
+            for wid, m in snap.workers.items()
+        }
+
     # -- autopilot -----------------------------------------------------------
     async def _autopilot_step(self, phase_name: str) -> None:
         ap = self.spec.autopilot
@@ -301,6 +365,14 @@ class ScenarioRunner:
         snap = await asyncio.to_thread(self._capture_top)
         fleet = snap.get("fleet") or {}
         now = self.sim_now()
+        # cross-worker KV-occupancy dispersion: the defrag loop's input and
+        # the migration bench's before/after measurement
+        occ = self._occupancy()
+        mean_occ = sum(occ.values()) / len(occ) if occ else 0.0
+        var = (
+            sum((v - mean_occ) ** 2 for v in occ.values()) / len(occ)
+            if occ else 0.0
+        )
         self.ticks.append({
             "t": round(now, 3),
             "phase": phase_name,
@@ -310,6 +382,9 @@ class ScenarioRunner:
             "waiting": fleet.get("waiting", 0),
             "running": fleet.get("running", 0),
             "worst_burn": round(self.slo.worst_burn_rate(now), 3),
+            "kv_occ_mean": round(mean_occ, 4),
+            "kv_occ_var": round(var, 6),
+            "kv_occ_spread": round(Defragmenter.spread(occ), 4),
             "planner": snap.get("planner"),
         })
 
@@ -333,12 +408,22 @@ class ScenarioRunner:
             for s in plan.sessions
         ]
         killed: list = []
+        migrated: list = []
+        mig_before = {
+            k: counters.get(f"dyn_migration_{k}_total")
+            for k in ("started", "committed", "aborted", "failed")
+        }
         chaos = [
             asyncio.ensure_future(self._arm_later(phase, ev, phase_t0, armed))
             for ev in phase.faults
         ] + [
             asyncio.ensure_future(self._kill_later(phase, ev, phase_t0, killed))
             for ev in phase.worker_kills
+        ] + [
+            asyncio.ensure_future(
+                self._migrate_later(phase, ev, phase_t0, migrated)
+            )
+            for ev in phase.migrations
         ]
 
         # tick/autopilot loop for the phase duration
@@ -350,6 +435,9 @@ class ScenarioRunner:
             if spec.autopilot.enabled and now >= self._next_plan_t:
                 self._next_plan_t = now + spec.autopilot.interval_s
                 await self._autopilot_step(phase.name)
+            if self.defrag is not None and now >= self._next_defrag_t:
+                self._next_defrag_t = now + spec.autopilot.interval_s
+                await self.defrag.step(self._occupancy(), now=now)
             if not mid_captured and now - phase_t0 >= phase.duration_s / 2:
                 mid_captured = True
                 snap = await asyncio.to_thread(self._capture_top)
@@ -397,6 +485,27 @@ class ScenarioRunner:
         if a.min_completed and stats.completed < a.min_completed:
             failures.append(
                 f"completed {stats.completed} below floor {a.min_completed}"
+            )
+        mig_counts = {
+            k: counters.get(f"dyn_migration_{k}_total") - v
+            for k, v in mig_before.items()
+        }
+        if (
+            a.min_migrations_committed
+            and mig_counts["committed"] < a.min_migrations_committed
+        ):
+            failures.append(
+                f"migrations committed {mig_counts['committed']} below floor "
+                f"{a.min_migrations_committed}"
+            )
+        if a.max_failed >= 0 and stats.failed > a.max_failed:
+            failures.append(
+                f"failed requests {stats.failed} exceed ceiling {a.max_failed}"
+            )
+        if spec.verify_outputs and stats.corrupt:
+            failures.append(
+                f"{stats.corrupt} completed request(s) streamed tokens "
+                "diverging from the greedy reference"
             )
 
         # topology-aware routing: where did this phase's selections land?
@@ -466,6 +575,11 @@ class ScenarioRunner:
                 "fired": dict(FAULTS.fired),
             },
             "worker_kills": killed,
+            "migrations": {"events": migrated, **mig_counts},
+            "outputs": (
+                {"verified": stats.verified, "corrupt": stats.corrupt}
+                if spec.verify_outputs else None
+            ),
             "topology": topology_view,
             "resumes": {
                 "attempts": counters.get("dyn_resume_attempts_total"),
@@ -513,11 +627,32 @@ class ScenarioRunner:
                     self.fleet.comp, clock=self.sim_now
                 )
                 self.planner.state_publisher = self.state_pub
+            if spec.autopilot.defrag:
+                coord = getattr(self.fleet.push, "migrations", None)
+                if coord is None:
+                    logger.warning(
+                        "autopilot.defrag set but live migration is disabled "
+                        "(DYN_MIGRATE=0); defrag loop stays off"
+                    )
+                else:
+                    ap = spec.autopilot
+                    self.defrag = Defragmenter(
+                        coord,
+                        DefragConfig(
+                            enabled=True,
+                            occupancy_spread=ap.defrag_spread,
+                            min_occupancy=ap.defrag_min_occupancy,
+                            max_per_step=ap.defrag_max_per_step,
+                            cooldown_s=ap.defrag_cooldown_s,
+                        ),
+                        clock=self.sim_now,
+                    )
 
             # re-zero the simulated clock: fleet bring-up wall time must not
             # eat into phase 1's simulated window
             self._t0_wall = time.monotonic()
             self._next_plan_t = spec.autopilot.interval_s
+            self._next_defrag_t = spec.autopilot.interval_s
 
             for phase in spec.phases:
                 logger.info("phase %s starting at sim t=%.1fs",
@@ -550,6 +685,14 @@ class ScenarioRunner:
                 "decisions": self.decisions,
                 "steering_decisions": len(steered),
                 "scale_events": list(self.fleet.scale_log),
+            },
+            "migrations": {
+                "committed": counters.get("dyn_migration_committed_total"),
+                "aborted": counters.get("dyn_migration_aborted_total"),
+                "failed": counters.get("dyn_migration_failed_total"),
+                "defrag_moves": (
+                    [] if self.defrag is None else list(self.defrag.moves)
+                ),
             },
             "slo": self.slo.status(self.sim_now()),
             "ticks": self.ticks,

@@ -115,6 +115,23 @@ class WorkerKillEvent:
 
 
 @dataclass
+class MigrationEvent:
+    """Migrate up to ``count`` live decode sessions at a phase-relative
+    simulated time — the runner walks the dispatcher's migration registry
+    and asks the coordinator to move each one to its cheapest-hop healthy
+    destination (runtime/migration.py).  ``reason`` other than "manual"
+    also authorizes DCN-hop destinations, mirroring ``dynctl migrate``."""
+
+    at_s: float = 0.0
+    count: int = 1
+    reason: str = "manual"
+
+    def validate(self) -> None:
+        if self.count <= 0:
+            raise ValueError("migration event needs count > 0")
+
+
+@dataclass
 class PhaseAssertions:
     """What must hold when the phase drains.  Burn-rate ceilings are
     evaluated on PHASE-LOCAL counts ((bad/total)/budget over exactly the
@@ -130,6 +147,12 @@ class PhaseAssertions:
     # slice (the prefill pool's slice) — the multi-slice soak's proof that
     # discovered link classes steer decode selection
     min_near_slice_fraction: float = 0.0
+    # live migration: floor on sessions COMMITTED to a new worker during
+    # this phase (migration events, drain integration, or planner defrag)
+    min_migrations_committed: int = 0
+    # ceiling on client-visible failed requests; -1 disables the check
+    # (0 demands the migration soak's hard "zero failed requests")
+    max_failed: int = -1
 
 
 @dataclass
@@ -139,6 +162,7 @@ class Phase:
     traffic: TrafficShape = field(default_factory=TrafficShape)
     faults: list = field(default_factory=list)        # [FaultEvent]
     worker_kills: list = field(default_factory=list)  # [WorkerKillEvent]
+    migrations: list = field(default_factory=list)    # [MigrationEvent]
     assertions: PhaseAssertions = field(default_factory=PhaseAssertions)
 
     def validate(self) -> None:
@@ -148,6 +172,8 @@ class Phase:
         for ev in self.faults:
             ev.validate()
         for ev in self.worker_kills:
+            ev.validate()
+        for ev in self.migrations:
             ev.validate()
 
 
@@ -228,6 +254,15 @@ class AutopilotSpec:
     # acceptance: the soak summary fails unless at least one executed
     # decision was burn/SLA-driven (reason beyond plain "load")
     expect_decision: bool = False
+    # planner-driven defragmentation (planner/defrag.py): stepped on the
+    # autopilot interval against per-worker KV occupancy, it migrates live
+    # sessions off hot workers through the dispatcher's migration
+    # coordinator (bounded rate, cooldown, never cross-slice)
+    defrag: bool = False
+    defrag_spread: float = 0.25
+    defrag_min_occupancy: float = 0.5
+    defrag_max_per_step: int = 1
+    defrag_cooldown_s: float = 8.0
 
 
 @dataclass
@@ -238,6 +273,10 @@ class ScenarioSpec:
     tick_s: float = 1.0              # sampling cadence, simulated seconds
     drain_s: float = 10.0            # post-phase drain budget, simulated
     retry_max: int = 2               # runner-side pre-first-token retries
+    # check every completed request's streamed tokens against the mocker's
+    # deterministic chain — the migration soak's "byte-identical output vs
+    # an unmigrated greedy reference" proof (any corruption fails the phase)
+    verify_outputs: bool = False
     slo: SloSpec = field(default_factory=SloSpec)
     fleet: FleetSpec = field(default_factory=FleetSpec)
     autopilot: AutopilotSpec = field(default_factory=AutopilotSpec)
@@ -283,6 +322,9 @@ class ScenarioSpec:
                     "faults": lambda fs: [_build(FaultEvent, f) for f in fs],
                     "worker_kills": lambda ks: [
                         _build(WorkerKillEvent, k) for k in ks
+                    ],
+                    "migrations": lambda ms: [
+                        _build(MigrationEvent, m) for m in ms
                     ],
                     "assertions": lambda a: _build(PhaseAssertions, a),
                 },

@@ -313,6 +313,20 @@ K_RESUME = register(
     "DYN_RESUME", type="bool", default=True,
     doc="mid-stream resume: re-dispatch a failed stream with a `resume_from` "
         "journal instead of truncating (`0` restores truncation)", section=ROBUST)
+K_RESUME_JOURNAL_MAX_ITEMS = register(
+    "DYN_RESUME_JOURNAL_MAX_ITEMS", type="int", default=4096,
+    doc="max accepted tokens a GenerationJournal retains per request; older "
+        "tokens fold into the journal's base prompt so memory stays bounded "
+        "on long streams (0 = unbounded)", section=ROBUST)
+K_MIGRATE = register(
+    "DYN_MIGRATE", type="bool", default=True,
+    doc="live session migration: the dispatcher may move an in-flight decode "
+        "to another worker (dynctl migrate / drain handoff / planner defrag); "
+        "`0` disables the coordinator entirely", section=ROBUST)
+K_MIGRATE_FLIP_TIMEOUT_S = register(
+    "DYN_MIGRATE_FLIP_TIMEOUT_S", type="float", default=10.0,
+    doc="max seconds a migration waits for the consumer loop to commit the "
+        "stream flip before aborting back to the source", section=ROBUST)
 K_DRAIN_TIMEOUT_S = register(
     "DYN_DRAIN_TIMEOUT_S", type="float", default=30.0,
     doc="graceful drain budget: admissions stop immediately, in-flight work "

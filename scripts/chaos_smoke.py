@@ -16,7 +16,13 @@ server, two echo workers, HTTP frontend with tight admission control — then:
 4. kills a worker stream **mid-decode** (``dp.send:nth=4``) and asserts the
    dispatcher's generation journal resumed it on the peer with zero
    client-visible failures (``dyn_resume_success_total >= 1``);
-5. gracefully drains one worker and asserts it deregistered (instance gone
+5. live-migrates a mid-decode stream to the peer worker and asserts the
+   client saw a byte-identical stream (``dyn_migration_committed_total >=
+   1``), then injects a destination death mid-handoff
+   (``migrate.handoff:once``) and asserts the migration aborted back to
+   the source with the stream still completing byte-identically
+   (``dyn_migration_aborted_total`` moved, exactly-once either way);
+6. gracefully drains one worker and asserts it deregistered (instance gone
    from the control-plane view) while the survivor keeps serving 200s.
 
 Exit code 0 = recovered; 1 = a request failed or a recovery counter stayed
@@ -82,6 +88,7 @@ async def amain(
     requests: int | None = None, burst: int | None = None,
     schedule: str | None = None,
 ) -> int:
+    import json as _json
     import os
 
     import httpx
@@ -221,11 +228,115 @@ async def amain(
                 f"{counters.get('dyn_resume_success_total')})",
             )
 
-            # phase 4 — graceful drain: one worker empties and deregisters;
+            # phase 5 — live migration: move a mid-decode stream to the
+            # peer worker (client must see a byte-identical stream), then
+            # kill the destination mid-handoff and assert the migration
+            # aborts cleanly back to the source (exactly-once either way)
+            FAULTS.reset()
+            for w in workers:
+                # echo streams are instant by default; pace them so a
+                # stream is still live long enough to migrate mid-decode
+                w.engine.token_delay_s = 0.03
+            pipelines = getattr(watcher, "_pipelines", {})
+            mig = next(
+                (
+                    p["router"].migrations
+                    for p in pipelines.values()
+                    if p.get("router") is not None
+                    and p["router"].migrations is not None
+                ),
+                None,
+            )
+            check(mig is not None, "migration coordinator on the frontend router")
+            if mig is not None:
+                long_prompt = "migrate " * 120
+
+                async def _stream_chat() -> tuple[int, str]:
+                    text: list[str] = []
+                    async with client.stream(
+                        "POST", "/v1/chat/completions",
+                        json={
+                            "model": "tiny",
+                            "messages": [
+                                {"role": "user", "content": long_prompt}
+                            ],
+                            "max_tokens": 64, "stream": True,
+                        },
+                        timeout=60,
+                    ) as r:
+                        status = r.status_code
+                        async for line in r.aiter_lines():
+                            if not line.startswith("data:") or line.endswith(
+                                "[DONE]"
+                            ):
+                                continue
+                            chunk = _json.loads(line[5:])
+                            for c in chunk.get("choices", []):
+                                text.append(
+                                    (c.get("delta") or {}).get("content") or ""
+                                )
+                    return status, "".join(text)
+
+                async def _migrate_first_session() -> dict | None:
+                    for _ in range(300):
+                        sessions = mig.sessions()
+                        if sessions:
+                            rid = sorted(sessions)[0]
+                            return await mig.migrate(rid, reason="manual")
+                        await asyncio.sleep(0.01)
+                    return None
+
+                # unmigrated run fixes the exactly-once reference text
+                status, reference = await _stream_chat()
+                check(
+                    status == 200 and bool(reference),
+                    "migration baseline stream ok",
+                )
+
+                task = asyncio.ensure_future(_stream_chat())
+                result = await _migrate_first_session()
+                status, text = await task
+                check(
+                    bool(result and result.get("ok")),
+                    f"live migration committed: {result}",
+                )
+                check(
+                    status == 200 and text == reference,
+                    "migrated stream byte-identical to the unmigrated baseline",
+                )
+                check(
+                    counters.get("dyn_migration_committed_total") >= 1,
+                    f"dyn_migration_committed_total="
+                    f"{counters.get('dyn_migration_committed_total')}",
+                )
+
+                # destination death mid-handoff: abort, finish on the source
+                FAULTS.arm("migrate.handoff:once")
+                aborts_before = counters.get("dyn_migration_aborted_total")
+                task = asyncio.ensure_future(_stream_chat())
+                result = await _migrate_first_session()
+                status, text = await task
+                check(
+                    bool(result) and not result.get("ok"),
+                    f"fault-injected migration aborted: {result}",
+                )
+                check(
+                    counters.get("dyn_migration_aborted_total")
+                    >= aborts_before + 1,
+                    f"dyn_migration_aborted_total="
+                    f"{counters.get('dyn_migration_aborted_total')}",
+                )
+                check(
+                    status == 200 and text == reference,
+                    "aborted-migration stream completed on the source, "
+                    "byte-identical",
+                )
+            for w in workers:
+                w.engine.token_delay_s = 0.0
+
+            # phase 6 — graceful drain: one worker empties and deregisters;
             # the survivor keeps serving with zero 5xx
             FAULTS.reset()
-            import json as _json
-
             from dynamo_tpu.runtime.component import ROOT_PATH
 
             drained = workers[-1]

@@ -48,6 +48,16 @@ RESUME_DRAIN_FAMILIES = (
     "dyn_drain_handoff_total",
 )
 
+# live session migration (dynamo_tpu/runtime/migration.py), counted in the
+# same robustness registry and rendered on both surfaces
+MIGRATION_FAMILIES = (
+    "dyn_migration_started_total",
+    "dyn_migration_committed_total",
+    "dyn_migration_aborted_total",
+    "dyn_migration_failed_total",
+    "dyn_migration_hidden_seconds",
+)
+
 # SLO burn-rate families (dynamo_tpu/observability/slo.py), appended to the
 # frontend exposition next to the resilience counters
 SLO_FAMILIES = (
@@ -77,7 +87,7 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + SLO_FAMILIES + TOPOLOGY_FAMILIES
+) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + SLO_FAMILIES + TOPOLOGY_FAMILIES
 
 # utilization accounting (dynamo_tpu/observability/perf.py → engine stats →
 # ForwardPassMetrics → metrics service)
@@ -152,7 +162,7 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES + TOPOLOGY_FAMILIES + (
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES + TOPOLOGY_FAMILIES + (
     # worker-surface-only: per-worker placement facts for dyn_top
     "dyn_topology_worker_info",
 )

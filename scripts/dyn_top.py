@@ -190,6 +190,16 @@ def collect_snapshot(
             front["shed_total"] = sum(
                 v for n, _l, v in samples if n == "dyn_shed_total"
             )
+            # live-migration activity rides the frontend's counter surface
+            # (the coordinator lives in the frontend's push router)
+            front["migrations_committed"] = sum(
+                v for n, _l, v in samples
+                if n == "dyn_migration_committed_total"
+            )
+            front["migrations_aborted"] = sum(
+                v for n, _l, v in samples
+                if n == "dyn_migration_aborted_total"
+            )
         except (OSError, urllib.error.URLError) as exc:
             front["error"] = str(exc)
         try:
@@ -296,7 +306,12 @@ def render_table(snap: dict) -> str:
             lines.append(
                 f"  frontend: inflight={front.get('inflight', 0):g} "
                 f"requests={front.get('requests_total', 0):g} "
-                f"shed={front.get('shed_total', 0):g}"
+                f"shed={front.get('shed_total', 0):g} "
+                f"mig={front.get('migrations_committed', 0):g}"
+                + (
+                    f" (aborted {front['migrations_aborted']:g})"
+                    if front.get("migrations_aborted") else ""
+                )
             )
         if front.get("slo_error"):
             lines.append(f"  slo: unreachable ({front['slo_error']})")

@@ -11,6 +11,7 @@ sys.path.insert(0, str(Path(__file__).parent.parent.parent / "scripts"))
 from dyn_top import collect_snapshot, main, parse_prometheus, render_table  # noqa: E402
 
 from dynamo_tpu.components.metrics_service import MetricsService
+from dynamo_tpu.robustness import counters
 from dynamo_tpu.llm.http.service import HttpService
 from dynamo_tpu.llm.kv_router.publisher import WorkerMetricsPublisher
 from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
@@ -57,6 +58,7 @@ def test_parse_prometheus_lines():
 
 async def test_dyn_top_once_json_against_in_process_fleet(capsys):
     MemoryControlPlane.reset_named()
+    counters.reset()
     rt = await DistributedRuntime.create(
         RuntimeConfig(control_plane="memory://dyn-top")
     )
@@ -73,6 +75,8 @@ async def test_dyn_top_once_json_against_in_process_fleet(capsys):
         g.token_observed()
         g.mark_ok()
         g.done()
+        counters.incr("dyn_migration_committed_total", 2)
+        counters.incr("dyn_migration_aborted_total")
         await asyncio.sleep(0.1)
 
         frontend_url = f"http://127.0.0.1:{frontend.port}"
@@ -99,6 +103,9 @@ async def test_dyn_top_once_json_against_in_process_fleet(capsys):
         assert snap["fleet"]["workers"] == 1
         assert snap["fleet"]["goodput_tokens_per_second"] == 123.5
         assert snap["frontend"]["requests_total"] == 1.0
+        # migration counters ride the frontend counter surface
+        assert snap["frontend"]["migrations_committed"] == 2.0
+        assert snap["frontend"]["migrations_aborted"] == 1.0
         assert set(snap["frontend"]["slo"]["objectives"]) == {
             "ttft", "itl", "error_rate"
         }
@@ -107,6 +114,7 @@ async def test_dyn_top_once_json_against_in_process_fleet(capsys):
         assert "WORKER" in table and "ab" in table and "SLO burn" in table
         assert "PF-HIT" in table and "tiers: g2 10/32 (pin 2)" in table
     finally:
+        counters.reset()
         await pub.stop()
         await metrics_svc.stop()
         await frontend.stop()

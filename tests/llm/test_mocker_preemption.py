@@ -1,0 +1,63 @@
+"""Regression: block-pool exhaustion mid-decode must preempt-and-recompute,
+never kill the engine loop.
+
+The historical bug: ``MockerEngine._loop`` iterated a snapshot of decoding
+sequences, and sequence A's ``ensure_slot`` could preempt victim B (youngest)
+*inside that same iteration*.  B's blocks were released and its allocator
+entry dropped, but B was still later in the snapshot — its own ``ensure_slot``
+then raised ``KeyError(B)`` and crashed the loop, stalling every request on
+the worker.  The loop now skips non-RUNNING sequences; a preempted sequence
+recomputes and still delivers the exact greedy token chain.
+"""
+
+import asyncio
+
+from dynamo_tpu.llm.mocker import MockerConfig, MockerEngine
+from dynamo_tpu.llm.protocols.common import (
+    Annotated,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+
+
+async def _drive(engine: MockerEngine, token_ids: list[int], osl: int) -> list[int]:
+    request = PreprocessedRequest(
+        token_ids=token_ids,
+        sampling=SamplingOptions(use_greedy=True),
+        stop=StopConditions(max_tokens=osl, ignore_eos=True),
+    ).to_wire()
+    got: list[int] = []
+    stream = await engine.generate(Context(request))
+    async for item in stream:
+        ann = Annotated.from_wire(item, LLMEngineOutput.from_wire)
+        if ann.data is not None and ann.data.token_ids:
+            got.extend(ann.data.token_ids)
+    return got
+
+
+async def test_pool_exhaustion_preempts_without_killing_the_loop():
+    # 6 blocks * 16 = 96 token slots; two 20+60 requests need 5 blocks each,
+    # so decode MUST exhaust the pool and preempt the younger sequence while
+    # both are in the same decode snapshot.
+    engine = MockerEngine(
+        MockerConfig(num_blocks=6, block_size=16, max_batch_size=4, speedup=2000.0)
+    )
+    engine.start()
+    osl = 60
+    prompts = [list(range(100, 120)), list(range(200, 220))]
+    try:
+        outs = await asyncio.wait_for(
+            asyncio.gather(*[_drive(engine, p, osl) for p in prompts]),
+            timeout=30.0,
+        )
+    finally:
+        engine.stop()
+
+    assert engine.scheduler.preemptions_total >= 1, "scenario never preempted"
+    # the engine loop survived AND recompute preserved the exact greedy chain
+    for prompt, got in zip(prompts, outs):
+        expected = [(prompt[-1] + 1 + i) % 1000 for i in range(osl)]
+        assert got == expected

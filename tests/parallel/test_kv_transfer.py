@@ -13,6 +13,8 @@ from dynamo_tpu.parallel.kv_transfer import (
     KvTransferClient,
     KvTransferPayload,
     KvTransferServer,
+    assemble_layers,
+    split_layerwise,
 )
 from dynamo_tpu.runtime.codec import TwoPartMessage, encode_frame, read_two_part
 
@@ -97,6 +99,74 @@ async def test_multipart_fields_roundtrip_over_tcp():
         assert [p.last for p in received] == [False, False, True]
         assert [p.block_start for p in received] == [0, 2, 4]
         assert [p.first_token for p in received] == [-1, -1, 42]
+    finally:
+        await client.close()
+        await server.stop()
+
+
+def test_split_layerwise_roundtrips_through_assemble():
+    """Layer-range parts cover the leading axis exactly once, only the
+    final part carries the sampled token, and reassembly — in any arrival
+    order, with a duplicated part — reproduces the original arrays."""
+    p = payload(3)
+    n_layers = min(a.shape[0] for a in p.blocks.values())
+    parts = split_layerwise(p, 1)
+    assert len(parts) == n_layers
+    assert [q.layer_start for q in parts] == list(range(n_layers))
+    assert all(q.layer_count == 1 for q in parts)
+    # only the closing part is final: it alone carries first_token/last
+    assert [q.first_token for q in parts] == [-1] * (n_layers - 1) + [p.first_token]
+    assert [q.last for q in parts] == [False] * (n_layers - 1) + [True]
+    assert [q.part_index for q in parts] == list(range(n_layers))
+    # reassemble out of order, with one part duplicated
+    shuffled = [parts[-1], parts[0], parts[0]] + parts[1:]
+    got = assemble_layers(shuffled)
+    assert got.first_token == p.first_token
+    assert got.block_ids == p.block_ids
+    assert got.first_token_logprob == p.first_token_logprob
+    for name, arr in p.blocks.items():
+        np.testing.assert_array_equal(got.blocks[name], arr)
+
+
+def test_split_layerwise_degenerate_cases_pass_through():
+    p = payload(4)
+    # layers_per_part >= n_layers, or granularity off: the payload itself
+    assert split_layerwise(p, 0) == [p]
+    assert split_layerwise(p, 99)[0] is p
+    # a legacy all-layers frame reassembles to itself
+    assert assemble_layers([p]) is p
+
+
+async def test_layerwise_parts_roundtrip_over_tcp():
+    """layer_start/layer_count survive the codec; a legacy frame (no layer
+    fields staged) decodes as the all-layers degenerate case."""
+    received: list[KvTransferPayload] = []
+
+    async def sink(p: KvTransferPayload) -> None:
+        received.append(p)
+
+    server = KvTransferServer(sink)
+    await server.start()
+    from dynamo_tpu.parallel import kv_transfer as mod
+
+    mod.LOCAL_SERVERS.pop(server.address, None)
+    client = KvTransferClient()
+    try:
+        original = payload(5)
+        for part in split_layerwise(original, 1):
+            await client.send(server.address, part)
+        assert [p.layer_start for p in received] == [0, 1]
+        assert all(p.layer_count == 1 for p in received)
+        got = assemble_layers(received)
+        for name, arr in original.blocks.items():
+            np.testing.assert_array_equal(
+                got.blocks[name], np.ascontiguousarray(arr)
+            )
+        # legacy frame: default fields decode to all-layers
+        received.clear()
+        await client.send(server.address, payload(6))
+        assert received[0].layer_start == 0
+        assert received[0].layer_count == -1
     finally:
         await client.close()
         await server.stop()

@@ -82,6 +82,34 @@ async def test_lease_and_keys_survive_server_restart():
         await server.stop()
 
 
+async def test_regranted_lease_key_survives_old_lease_expiry():
+    """Reconnect to the SAME server (connection blip, state kept): the
+    resync re-grants a NEW lease and re-puts the key under it, but the OLD
+    lease still exists server-side and expires one TTL later.  Its expiry
+    must not reap the key the new lease now owns — historically it did
+    (put() left the key in the old lease's key set), so every worker
+    deregistered ~TTL after any control-plane reconnect."""
+    port = free_port()
+    server = ControlPlaneServer(port=port)
+    await server.start()
+    plane = RemoteControlPlane("127.0.0.1", port)
+    await plane.connect()
+    try:
+        lease = await plane.kv.grant_lease(0.5)
+        await plane.kv.put("inst/worker-1", b"alive", lease_id=lease.id)
+
+        FAULTS.arm("cp.recv:once")  # blip the connection; server state kept
+        await wait_for(lambda: plane.reconnects_total >= 1, what="reconnect")
+        # outlive the ORIGINAL lease's TTL by a few reap cycles
+        await asyncio.sleep(1.5)
+        entry = await plane.kv.get("inst/worker-1")
+        assert entry is not None, "old lease's expiry reaped the re-put key"
+        assert not lease.revoked
+    finally:
+        await plane.close()
+        await server.stop()
+
+
 async def test_watch_resyncs_with_synthetic_deletes_after_restart():
     """A consumer's Watch handle survives a restart: keys that vanished
     with the server's state come through as synthetic DELETEs (carrying

@@ -1,0 +1,505 @@
+"""Live session migration: the handoff-boundary dedupe arithmetic and the
+journal memory bound in isolation, the coordinator's refusal/pricing policy
+against a stub router, then end-to-end — a routed fleet where a live decode
+is migrated (once, twice, and under a destination-death fault) with
+exactly-once delivery and byte-identical output."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import httpx
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.controlplane.memory import MemoryControlPlane
+from dynamo_tpu.runtime.migration import MigrationCoordinator
+from dynamo_tpu.runtime.resume import (
+    GenerationJournal,
+    ack_item,
+    dedupe_stream,
+)
+from dynamo_tpu.serve import serve_frontend, serve_worker
+from dynamo_tpu.topology.card import TopologyCard
+from dynamo_tpu.topology.map import TopologyMap
+from dynamo_tpu.utils.config import RuntimeConfig
+
+MODEL_DIR = str(Path(__file__).parent.parent / "data" / "tiny-chat-model")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+def wire(sampling=None, token_ids=(1, 2, 3), max_tokens=64):
+    return {
+        "token_ids": list(token_ids),
+        "sampling": dict(sampling or {"use_greedy": True}),
+        "stop": {"max_tokens": max_tokens},
+    }
+
+
+async def _drain(gen):
+    return [item async for item in gen]
+
+
+async def _stream(items):
+    for item in items:
+        yield item
+
+
+# -- journal memory bound (DYN_RESUME_JOURNAL_MAX_ITEMS) --------------------
+
+def test_journal_folds_oldest_tokens_past_the_cap(monkeypatch):
+    monkeypatch.setenv("DYN_RESUME_JOURNAL_MAX_ITEMS", "4")
+    journal = GenerationJournal(wire(max_tokens=64))
+    for t in range(100, 110):
+        journal.record({"data": {"token_ids": [t]}})
+    # retained tail is capped; the oldest prefix folded into the prompt
+    assert len(journal.accepted) == 4
+    assert journal.accepted == [106, 107, 108, 109]
+    assert journal.folded == 6
+    assert journal.total_recorded == 10
+    resumed = journal.resume_request()
+    assert resumed["token_ids"] == [1, 2, 3, 100, 101, 102, 103, 104, 105]
+    assert resumed["resume_from"]["accepted"] == [106, 107, 108, 109]
+    # max_tokens budget shrinks with the folded prefix
+    assert resumed["stop"]["max_tokens"] == 64 - 6
+    # hash follows the grown prompt, so replay validation still works
+    assert resumed["resume_from"]["prompt_hash"] == GenerationJournal(
+        wire(token_ids=[1, 2, 3, 100, 101, 102, 103, 104, 105])
+    ).prompt_hash
+
+
+def test_journal_fold_never_collapses_max_tokens_to_zero(monkeypatch):
+    monkeypatch.setenv("DYN_RESUME_JOURNAL_MAX_ITEMS", "2")
+    journal = GenerationJournal(wire(max_tokens=3))
+    for t in range(8):
+        journal.record({"data": {"token_ids": [t]}})
+    assert journal.request["stop"]["max_tokens"] == 1
+
+
+def test_journal_unbounded_when_knob_is_zero(monkeypatch):
+    monkeypatch.setenv("DYN_RESUME_JOURNAL_MAX_ITEMS", "0")
+    journal = GenerationJournal(wire())
+    for t in range(5000):
+        journal.record({"data": {"token_ids": [t]}})
+    assert len(journal.accepted) == 5000 and journal.folded == 0
+
+
+def test_journal_finish_releases_retained_tokens():
+    journal = GenerationJournal(wire())
+    journal.record({"data": {"token_ids": [10, 11, 12]}})
+    journal.finish()
+    assert journal.finished
+    assert journal.accepted == []
+    assert journal.total_recorded == 3  # fold-invariant survives release
+
+
+# -- dedupe at the handoff boundary -----------------------------------------
+#
+# Migration flip arithmetic: the snapshot shipped ``payload_accepted``
+# tokens; the source decoded ``delta`` more before the flip committed.
+# Continuation engines ack and re-emit only the delta window; replay
+# engines re-emit everything.  Both must land exactly-once.
+
+async def test_handoff_dedupe_drops_the_duplicate_window_replay():
+    # replay-mode destination: payload_accepted=3, delta=2 → skip 5
+    items = [{"data": {"token_ids": [10, 11, 12]}},   # snapshot prefix
+             {"data": {"token_ids": [13, 14]}},        # delta window (dup)
+             {"data": {"token_ids": [15]}},            # fresh
+             {"data": {"token_ids": [16], "finish_reason": "length"}}]
+    out = await _drain(dedupe_stream(_stream(items), 3 + 2, ack_skip=2))
+    assert out == [{"data": {"token_ids": [15]}},
+                   {"data": {"token_ids": [16], "finish_reason": "length"}}]
+
+
+async def test_handoff_dedupe_ack_mode_drops_only_the_delta_window():
+    # continuation-mode destination: ack, then it regenerates the 2-token
+    # delta window the source already delivered — exactly those drop
+    items = [ack_item(3),
+             {"data": {"token_ids": [13]}}, {"data": {"token_ids": [14]}},
+             {"data": {"token_ids": [15]}}]
+    out = await _drain(dedupe_stream(_stream(items), 3 + 2, ack_skip=2))
+    assert out == [{"data": {"token_ids": [15]}}]
+
+
+async def test_handoff_dedupe_cursor_exactly_at_a_finish_item():
+    # the delta window IS the end of the stream: the duplicate finish item
+    # must still terminate the stream (empty-token finish), never vanish
+    items = [ack_item(3),
+             {"data": {"token_ids": [13, 14], "finish_reason": "stop"}}]
+    out = await _drain(dedupe_stream(_stream(items), 3 + 2, ack_skip=2))
+    assert out == [{"data": {"token_ids": [], "finish_reason": "stop"}}]
+
+
+async def test_handoff_dedupe_parity_across_two_consecutive_migrations():
+    """Seeded-sampling parity: migrate the same session twice and the
+    delivered token sequence equals the never-migrated reference chain."""
+    journal = GenerationJournal(wire({"seed": 7}, max_tokens=12))
+    reference = list(range(100, 112))  # the deterministic seeded chain
+    delivered = []
+
+    def deliver(item):
+        journal.record(item)
+        delivered.extend(item["data"]["token_ids"])
+
+    # hop 1 (source) delivers 4 tokens
+    for t in reference[:4]:
+        deliver({"data": {"token_ids": [t]}})
+    # migration 1 snapshots at 3, source decodes 1 more before the flip
+    snap1, payload1 = 3, 3
+    delta1 = journal.total_recorded - snap1
+    assert (payload1 + delta1, delta1) == (4, 1)
+    # destination regenerates from the snapshot (seeded → same chain)
+    dst1 = [ack_item(payload1)] + [
+        {"data": {"token_ids": [t]}} for t in reference[snap1:8]
+    ]
+    async for item in dedupe_stream(_stream(dst1), payload1 + delta1,
+                                    ack_skip=delta1):
+        deliver(item)
+    assert delivered == reference[:8]
+    # migration 2 of the SAME session: snapshot at 6, delta 2
+    snap2, payload2 = 6, 6
+    delta2 = journal.total_recorded - snap2
+    assert delta2 == 2
+    dst2 = [ack_item(payload2)] + [
+        {"data": {"token_ids": [t],
+                  "finish_reason": "length" if t == reference[-1] else None}}
+        for t in reference[snap2:]
+    ]
+    async for item in dedupe_stream(_stream(dst2), payload2 + delta2,
+                                    ack_skip=delta2):
+        deliver(item)
+    assert delivered == reference  # exactly-once: no dup, no gap
+
+
+# -- coordinator policy (stub router) ---------------------------------------
+
+class _StubClient:
+    def __init__(self, ids):
+        self.instance_ids = list(ids)
+        self.on_instance_removed = []
+
+
+class _StubRouter:
+    def __init__(self, ids):
+        self.client = _StubClient(ids)
+
+    def healthy_ids(self, exclude=None):
+        return [w for w in self.client.instance_ids if w not in (exclude or set())]
+
+
+def _topo(slices):
+    topo = TopologyMap()
+    for wid, label in slices.items():
+        topo.upsert(TopologyCard(worker_id=wid, host=f"h{label}",
+                                 slice_label=label))
+    return topo
+
+
+async def test_migrate_refusals_count_failed_and_never_start():
+    coord = MigrationCoordinator(_StubRouter([1, 2]))
+    journal = GenerationJournal(wire())
+    handle = coord.register("req-1", journal, object(), 1)
+
+    res = await coord.migrate("nope")
+    assert not res["ok"] and "unknown" in res["error"]
+    res = await coord.migrate("req-1", 1)
+    assert not res["ok"] and "already decoding" in res["error"]
+    res = await coord.migrate("req-1", 99)
+    assert not res["ok"] and "not a registered" in res["error"]
+    journal.finish()
+    res = await coord.migrate("req-1", 2)
+    assert not res["ok"] and "finished" in res["error"]
+    assert counters.get("dyn_migration_failed_total") == 4
+    assert counters.get("dyn_migration_started_total") == 0
+    coord.unregister(handle)
+    assert coord.sessions() == {}
+
+
+async def test_migrate_refuses_unpriced_dcn_hops():
+    coord = MigrationCoordinator(_StubRouter([1, 2]))
+    coord.attach_topology(_topo({1: "s0", 2: "s1"}))  # cross-slice = dcn
+    coord.register("req-1", GenerationJournal(wire()), object(), 1)
+    res = await coord.migrate("req-1", 2)  # default reason = manual
+    assert not res["ok"] and "DCN" in res["error"]
+    assert counters.get("dyn_migration_failed_total") == 1
+    assert counters.get("dyn_migration_started_total") == 0
+
+
+def test_resolve_accepts_session_id_and_unique_trace_id():
+    """Operators know the request/trace id (x-request-id), not the
+    dispatcher's internal session id — resolve() accepts either, and an
+    ambiguous trace id (n>1 fan-out shares one trace) matches nothing."""
+    coord = MigrationCoordinator(_StubRouter([1, 2]))
+
+    class _Trace:
+        trace_id = "trace-1"
+
+    class _Ctx:
+        trace = _Trace()
+
+    h = coord.register("internal-1", GenerationJournal(wire()), _Ctx(), 1)
+    assert coord.resolve("internal-1") is h
+    assert coord.resolve("trace-1") is h
+    assert coord.resolve("missing") is None
+    coord.register("internal-2", GenerationJournal(wire()), _Ctx(), 1)
+    assert coord.resolve("trace-1") is None  # ambiguous → no match
+
+
+def test_pick_destination_prefers_near_slice_targets():
+    coord = MigrationCoordinator(_StubRouter([1, 2, 3]))
+    coord.attach_topology(_topo({1: "s0", 2: "s1", 3: "s0"}))
+    # 3 shares the source's slice (ici); 2 is across DCN
+    assert coord.pick_destination(1) == 3
+    # with the near candidate gone, DCN is only allowed when priced in
+    coord.router.client.instance_ids = [1, 2]
+    assert coord.pick_destination(1) is None
+    assert coord.pick_destination(1, allow_dcn=True) == 2
+
+
+def test_pick_destination_without_topology_uses_any_healthy_peer():
+    coord = MigrationCoordinator(_StubRouter([5, 6]))
+    assert coord.pick_destination(5) == 6
+    assert coord.pick_destination(6) == 5
+    coord.router.client.instance_ids = [5]
+    assert coord.pick_destination(5) is None
+
+
+# -- end-to-end: routed fleet, live stream migrated -------------------------
+
+async def make_stack(n_workers: int, token_delay_s: float = 0.02):
+    MemoryControlPlane.reset_named()
+    rt = await DistributedRuntime.create(
+        RuntimeConfig(control_plane="memory://migrate-e2e")
+    )
+    workers = []
+    for _ in range(n_workers):
+        w = await serve_worker(rt, MODEL_DIR, model_name="tiny", engine_kind="echo")
+        # slow the echo cadence so a migration can land mid-decode
+        w.engine.token_delay_s = token_delay_s
+        workers.append(w)
+    service, watcher = await serve_frontend(rt, host="127.0.0.1", port=0)
+    return rt, workers, service, watcher
+
+
+async def teardown(rt, workers, service, watcher):
+    await watcher.stop()
+    await service.stop()
+    for w in workers:
+        await w.shutdown()
+    await rt.close()
+
+
+async def wait_for_model(client, name="tiny", timeout=10.0):
+    for _ in range(int(timeout / 0.1)):
+        r = await client.get("/v1/models")
+        if name in [m["id"] for m in r.json().get("data", [])]:
+            return
+        await asyncio.sleep(0.1)
+    raise TimeoutError(f"model {name} never appeared")
+
+
+PROMPT = "one two three four five six seven eight nine ten"
+
+
+async def _stream_text(client, request_id: str | None = None) -> tuple[str, list]:
+    from dynamo_tpu.llm.protocols.sse import SseDecoder
+
+    decoder = SseDecoder()
+    text, errors = [], []
+    async with client.stream(
+        "POST",
+        "/v1/chat/completions",
+        json={
+            "model": "tiny",
+            "messages": [{"role": "user", "content": PROMPT}],
+            "stream": True,
+        },
+        headers={"x-request-id": request_id} if request_id else None,
+        timeout=30,
+    ) as r:
+        assert r.status_code == 200
+        async for chunk in r.aiter_bytes():
+            for ev in decoder.feed(chunk):
+                if not ev["data"] or ev["data"] == "[DONE]":
+                    continue
+                payload = json.loads(ev["data"])
+                if "error" in payload:
+                    errors.append(payload)
+                for choice in payload.get("choices", []):
+                    text.append(choice.get("delta", {}).get("content") or "")
+    return "".join(text), errors
+
+
+async def _wait_for_session(coord, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        sessions = coord.sessions()
+        if sessions:
+            return next(iter(sessions))
+        await asyncio.sleep(0.005)
+    raise TimeoutError("no live session registered with the coordinator")
+
+
+async def test_live_stream_migrates_mid_decode_byte_identical():
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            baseline, errors = await _stream_text(client)
+            assert baseline and not errors
+
+            coord = watcher._pipelines["tiny"]["router"].migrations
+            assert coord is not None
+            counters.reset()
+            task = asyncio.ensure_future(_stream_text(client))
+            rid = await _wait_for_session(coord)
+            await asyncio.sleep(0.05)  # let a few tokens reach the client
+            result = await coord.migrate(rid)
+            assert result["ok"], result
+            migrated, errors = await task
+            assert not errors
+            assert migrated == baseline
+            assert counters.get("dyn_migration_started_total") == 1
+            assert counters.get("dyn_migration_committed_total") == 1
+            assert counters.get("dyn_migration_aborted_total") == 0
+            assert counters.get("dyn_migration_hidden_seconds") > 0
+            # the session really moved: no resume/retry machinery fired
+            assert counters.get("dyn_resume_attempts_total") == 0
+            assert counters.get("dyn_retries_total") == 0
+            # and the counters reach the scrape surface
+            m = await client.get("/metrics")
+            assert "dyn_migration_committed_total 1" in m.text
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_migrate_by_operator_visible_request_id():
+    """dynctl-style UX: migrate names the x-request-id (trace id), which
+    differs from the dispatcher's internal session id.  The whole handoff
+    — including the mid-handoff liveness re-check, which must key on the
+    handle's OWN id — commits under the trace-id alias."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            baseline, errors = await _stream_text(client)
+            assert baseline and not errors
+
+            coord = watcher._pipelines["tiny"]["router"].migrations
+            counters.reset()
+            trace_id = "cafe0123456789abcafe0123456789ab"
+            task = asyncio.ensure_future(_stream_text(client, trace_id))
+            rid = await _wait_for_session(coord)
+            assert rid != trace_id  # internal id, not the operator's
+            await asyncio.sleep(0.05)
+            result = await coord.migrate(trace_id)
+            assert result["ok"], result
+            migrated, errors = await task
+            assert not errors
+            assert migrated == baseline
+            assert counters.get("dyn_migration_committed_total") == 1
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_two_consecutive_migrations_of_the_same_session():
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            baseline, errors = await _stream_text(client)
+            assert baseline and not errors
+
+            coord = watcher._pipelines["tiny"]["router"].migrations
+            counters.reset()
+            task = asyncio.ensure_future(_stream_text(client))
+            rid = await _wait_for_session(coord)
+            await asyncio.sleep(0.04)
+            first = await coord.migrate(rid)
+            assert first["ok"], first
+            await asyncio.sleep(0.04)
+            second = await coord.migrate(rid)  # back to the original worker
+            migrated, errors = await task
+            assert not errors
+            assert migrated == baseline
+            if second["ok"]:
+                assert counters.get("dyn_migration_committed_total") == 2
+            else:
+                # the stream finished before the second handoff — still a
+                # clean refusal/abort, never a corrupted stream
+                assert counters.get("dyn_migration_committed_total") == 1
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_destination_death_mid_migration_completes_on_source():
+    """The migrate.handoff fault kills the handoff before pre-admission:
+    the session must finish on the source with zero duplicate or lost
+    tokens, counted as a clean abort."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            baseline, errors = await _stream_text(client)
+            assert baseline and not errors
+
+            coord = watcher._pipelines["tiny"]["router"].migrations
+            counters.reset()
+            FAULTS.arm("migrate.handoff:once")
+            task = asyncio.ensure_future(_stream_text(client))
+            rid = await _wait_for_session(coord)
+            await asyncio.sleep(0.05)
+            result = await coord.migrate(rid)
+            assert not result["ok"] and result.get("aborted")
+            migrated, errors = await task
+            assert not errors
+            assert migrated == baseline  # exactly-once on the source
+            assert counters.get("dyn_migration_started_total") == 1
+            assert counters.get("dyn_migration_aborted_total") == 1
+            assert counters.get("dyn_migration_committed_total") == 0
+    finally:
+        await teardown(rt, workers, service, watcher)
+
+
+async def test_flip_fault_aborts_after_preadmission():
+    """The migrate.flip fault fires AFTER the destination pre-admitted:
+    the pre-admitted stream must be discarded (killed) and the session
+    still completes on the source."""
+    rt, workers, service, watcher = await make_stack(2)
+    try:
+        async with httpx.AsyncClient(
+            base_url=f"http://127.0.0.1:{service.port}"
+        ) as client:
+            await wait_for_model(client)
+            coord = watcher._pipelines["tiny"]["router"].migrations
+            counters.reset()
+            FAULTS.arm("migrate.flip:once")
+            task = asyncio.ensure_future(_stream_text(client))
+            rid = await _wait_for_session(coord)
+            await asyncio.sleep(0.05)
+            result = await coord.migrate(rid)
+            assert not result["ok"] and result.get("aborted")
+            migrated, errors = await task
+            assert not errors and migrated
+            assert counters.get("dyn_migration_aborted_total") == 1
+            assert counters.get("dyn_migration_committed_total") == 0
+    finally:
+        await teardown(rt, workers, service, watcher)

@@ -1,0 +1,99 @@
+"""Migration soak: the shipped ``migration`` spec validates, and a
+scaled-down routed-fleet soak live-migrates sessions mid-decode three ways —
+explicit migration events, graceful-drain integration, and the planner's
+defrag loop — with ZERO failed requests and every completed stream
+byte-identical to the unmigrated greedy reference (``verify_outputs``)."""
+
+import pytest
+
+from dynamo_tpu.robustness import counters
+from dynamo_tpu.robustness.faults import FAULTS
+from dynamo_tpu.scenarios.runner import run_scenario
+from dynamo_tpu.scenarios.spec import (
+    MigrationEvent,
+    ScenarioSpec,
+    builtin_spec_path,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    FAULTS.reset()
+    yield
+    counters.reset()
+    FAULTS.reset()
+
+
+def test_shipped_migration_spec_loads_and_round_trips():
+    spec = ScenarioSpec.load(builtin_spec_path("migration"))
+    assert [p.name for p in spec.phases] == [
+        "live_migrate", "drain_under_load", "defrag"
+    ]
+    assert spec.verify_outputs
+    assert spec.fleet.policy == "kv"
+    assert spec.autopilot.defrag
+    # "zero failed requests" is spelled as a hard in-spec ceiling everywhere
+    assert all(p.assertions.max_failed == 0 for p in spec.phases)
+    assert spec.phases[0].migrations and spec.phases[0].migrations[0].count == 2
+    assert spec.phases[1].worker_kills[0].mode == "drain"
+    assert all(
+        p.assertions.min_migrations_committed >= 1 for p in spec.phases
+    )
+    again = ScenarioSpec.from_dict(spec.to_dict())
+    assert again.to_dict() == spec.to_dict()
+
+
+def test_migration_event_validation():
+    with pytest.raises(ValueError, match="count"):
+        MigrationEvent(at_s=1.0, count=0).validate()
+    data = {
+        "name": "t",
+        "phases": [{
+            "name": "p1", "duration_s": 5.0,
+            "migrations": [{"at_s": 1.0, "cout": 1}],
+        }],
+    }
+    with pytest.raises(ValueError, match="unknown spec keys"):
+        ScenarioSpec.from_dict(data)
+
+
+async def test_migration_soak_zero_loss_and_byte_identical_outputs():
+    spec = ScenarioSpec.load(builtin_spec_path("migration"))
+    # scaled-down for the tier-1 gate: same phases, same assertions, less
+    # simulated time (the shipped durations feed scripts/migration_bench.py)
+    spec.speedup = 12.0
+    for phase, duration, floor in zip(spec.phases, (8.0, 8.0, 10.0), (24, 24, 12)):
+        phase.duration_s = duration
+        phase.assertions.min_completed = floor
+    artifact = await run_scenario(spec.validate(), name="migration-soak-test")
+    assert artifact["passed"], [
+        (p["name"], p["assertions"]["failures"]) for p in artifact["phases"]
+    ]
+    by_name = {p["name"]: p for p in artifact["phases"]}
+
+    # explicit migration events committed, under live load, zero failures
+    live = by_name["live_migrate"]
+    assert live["migrations"]["committed"] >= 2
+    assert live["requests"]["failed"] == 0
+    assert live["outputs"]["corrupt"] == 0
+
+    # the drain migrated its survivors instead of cancelling them
+    drain = by_name["drain_under_load"]
+    assert drain["worker_kills"] and drain["worker_kills"][0]["mode"] == "drain"
+    assert drain["migrations"]["committed"] >= 1
+    assert drain["requests"]["failed"] == 0
+
+    # the defrag loop moved at least one session off a hot worker
+    defrag = by_name["defrag"]
+    assert defrag["migrations"]["committed"] >= 1
+    assert artifact["migrations"]["defrag_moves"], "defrag never moved a session"
+    assert defrag["requests"]["failed"] == 0
+
+    # global: every completed request verified byte-identical
+    assert all(
+        p["outputs"]["corrupt"] == 0 for p in artifact["phases"]
+    )
+    assert artifact["migrations"]["committed"] >= 4
+    # occupancy dispersion is in the tick series for the bench to read
+    assert all("kv_occ_var" in t for t in artifact["ticks"])
