@@ -54,7 +54,10 @@ def parse_args(argv=None) -> argparse.Namespace:
     run.add_argument("--warmup", action="store_true",
                      help="pre-compile every serving program before registering")
     run.add_argument("--compilation-cache", default=None, metavar="DIR",
-                     help="persistent JAX compilation cache directory; with "
+                     help="persistent JAX compilation cache directory "
+                          "(default: DYN_COMPILE_CACHE_DIR, else "
+                          "~/.cache/dynamo_tpu/jax_cache; set "
+                          "DYN_COMPILE_CACHE_DIR='' to disable); with "
                           "--warmup the serving programs also AOT-compile "
                           "in parallel (cold restarts reuse the cache)")
     run.add_argument("--speculative", choices=["ngram"], default=None,
@@ -100,6 +103,15 @@ async def _run(args) -> int:
         import jax
 
         jax.config.update("jax_compilation_cache_dir", args.compilation_cache)
+    else:
+        # default-on persistence: the engine would resolve this itself at
+        # init, but doing it here covers out=echo/mocker spawns too and
+        # logs the resolved dir once at startup
+        from dynamo_tpu.engine.engine import _ensure_compile_cache
+
+        resolved = _ensure_compile_cache()
+        if resolved:
+            logger.info("persistent compile cache: %s", resolved)
     control_plane = args.control_plane or "memory"
     runtime = await DistributedRuntime.create(
         RuntimeConfig(control_plane=control_plane, namespace=args.namespace)

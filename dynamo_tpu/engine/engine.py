@@ -74,7 +74,52 @@ def _round_chunk_tokens(chunk_tokens: int, block_size: int) -> int:
     return max(1, (chunk_tokens + block_size - 1) // block_size) * block_size
 
 
-def _measured_attention_preference(device_kind: str | None = None) -> str | None:
+def _kernel_perf_path() -> str:
+    """DYN_KERNEL_PERF override or the repo-root KERNEL_PERF.json."""
+    import os
+
+    return knobs.get("DYN_KERNEL_PERF") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "KERNEL_PERF.json",
+    )
+
+
+def _ensure_compile_cache() -> str | None:
+    """Default-on persistent compile cache.
+
+    An explicitly configured ``jax_compilation_cache_dir`` always wins.
+    Otherwise ``DYN_COMPILE_CACHE_DIR`` decides: a path points the cache
+    there, ``""`` (empty string) opts out, and unset defaults to
+    ``~/.cache/dynamo_tpu/jax_cache`` so AOT-compiled serving programs
+    survive worker restarts without any flag.  Returns the active cache
+    dir, or None when persistence is disabled.
+    """
+    import os
+
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        return current
+    configured = knobs.get("DYN_COMPILE_CACHE_DIR")
+    if configured == "":
+        return None  # explicit opt-out
+    path = configured or os.path.join(
+        os.path.expanduser("~"), ".cache", "dynamo_tpu", "jax_cache"
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as err:  # unwritable HOME etc. — persistence is optional
+        logger.info("persistent compile cache unavailable at %s: %s", path, err)
+        return None
+    return path
+
+
+def _measured_attention_preference(
+    device_kind: str | None = None,
+    *,
+    batch: int | None = None,
+    ctx: int | None = None,
+) -> str | None:
     """Consult a measured kernel-perf table (scripts/tpu_validate.py --bench
     → KERNEL_PERF.json at the repo root, or DYN_KERNEL_PERF=path).
 
@@ -82,20 +127,20 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
     platform exists (interpret-mode tables are ignored: Mosaic interpret
     timings say nothing about hardware; tables from a DIFFERENT TPU
     generation are ignored too when ``device_kind`` is known), else None so
-    the caller keeps the static heuristic.  Decision: median pallas-vs-XLA
-    speedup across the measured paged-attention decode shapes.  The table
-    is purely advisory — any malformed content degrades to None, never to
-    a startup crash.
+    the caller keeps the static heuristic.  Decision: PER-SHAPE when the
+    caller passes its decode geometry — the measured paged-attention row
+    nearest to (batch, ctx) in log space decides, so a batch-16 engine
+    routes to the XLA twin when the batch-16 rows show Pallas losing even
+    though batch-64 rows show it winning — else the median speedup across
+    all measured shapes.  The table is purely advisory — any malformed
+    content degrades to None, never to a startup crash.
     """
     import json
-    import os
+    import math as _math
     import statistics
 
     explicit = knobs.get("DYN_KERNEL_PERF")
-    path = explicit or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-        "KERNEL_PERF.json",
-    )
+    path = _kernel_perf_path()
     def skip(why: str) -> None:
         # the operator EXPLICITLY pointed here — silently reverting to the
         # static heuristic would look exactly like measured selection
@@ -125,14 +170,36 @@ def _measured_attention_preference(device_kind: str | None = None) -> str | None
                 table.get("device_kind"), device_kind,
             )
             return None
-        speedups = [
-            float(r["pallas_speedup"])
-            for r in table.get("rows", [])
+        rows = [
+            r for r in table.get("rows", [])
             if r.get("bench") == "paged_attention_decode"
             and "pallas_speedup" in r
         ]
-        if not speedups:
+        if not rows:
             return skip("no paged_attention_decode rows")
+        if batch is not None:
+            shaped = [r for r in rows if "batch" in r and "ctx" in r]
+            if shaped:
+                def dist(r):
+                    d = abs(_math.log2(max(int(r["batch"]), 1) / max(batch, 1)))
+                    if ctx is not None:
+                        d += 0.5 * abs(
+                            _math.log2(max(int(r["ctx"]), 1) / max(ctx, 1))
+                        )
+                    return d
+                nearest = min(shaped, key=dist)
+                choice = (
+                    "pallas" if float(nearest["pallas_speedup"]) >= 1.0
+                    else "jax"
+                )
+                logger.info(
+                    "attention_impl=auto: nearest measured shape "
+                    "batch=%s ctx=%s speedup=%.3f -> %s",
+                    nearest.get("batch"), nearest.get("ctx"),
+                    float(nearest["pallas_speedup"]), choice,
+                )
+                return choice
+        speedups = [float(r["pallas_speedup"]) for r in rows]
     except (OSError, ValueError, TypeError, AttributeError, KeyError) as err:
         return skip(f"unusable: {err}")
     return "pallas" if statistics.median(speedups) >= 1.0 else "jax"
@@ -323,6 +390,7 @@ class JaxLlmEngine:
     ):
         self.config = config
         cfg = config.model
+        _ensure_compile_cache()
         self.family = get_family(config.model_family)
         self.max_len = config.resolved_max_len()
         self.max_blocks_per_seq = (self.max_len + config.block_size - 1) // config.block_size
@@ -466,7 +534,9 @@ class JaxLlmEngine:
                     kind = jax.devices()[0].device_kind
                 except Exception:  # noqa: BLE001
                     kind = None
-                measured = _measured_attention_preference(kind)
+                measured = _measured_attention_preference(
+                    kind, batch=config.max_batch_size, ctx=self.max_len,
+                )
                 self.attention_impl = measured or "pallas"
                 if measured:
                     logger.info(
@@ -679,35 +749,105 @@ class JaxLlmEngine:
                 resolved = resolve_kv_cache_dtype(config.kv_cache_dtype)
                 if resolved is not None and jnp.dtype(resolved) != jnp.dtype(
                     cfg.dtype
-                ):
-                    # split prefill attends full-precision activations while
-                    # the unified step reads its own freshly-written cache:
-                    # a narrowed cache dtype would break the byte-identical
-                    # output parity contract between the two paths
+                ) and not jnp.issubdtype(jnp.dtype(resolved), jnp.floating):
+                    # float narrowings (fp8/bf16/f16) flow through unified:
+                    # every ragged kernel and XLA twin upcasts cache reads
+                    # to f32 and write_decode_kv casts on write.  The
+                    # parity contract with the split path is tolerance-
+                    # level there (split prefill attends full-precision
+                    # activations, unified reads its freshly-written
+                    # quantized cache) — tests/engine/test_quantized_unified
+                    # pins it.  Non-float cache dtypes have no kernel read
+                    # path: keep them on the split step, reason-slugged.
                     reason = (
-                        f"kv_cache_dtype {config.kv_cache_dtype!r} narrows "
-                        "the cache below the activation dtype"
+                        f"kv_cache_dtype {config.kv_cache_dtype!r} has no "
+                        "unified kernel read path"
                     )
-                    slug = "narrowed_kv_dtype"
+                    slug = "unsupported_kv_dtype"
             if reason is not None:
                 self._unified_skip(slug, reason)
                 unified = False
         self.unified_batch = unified
         self._unified_windows = 0     # mixed windows served by one dispatch
         self._admission_drains = 0    # pipeline drains forced by admission
-        # ragged token-block granularity: the flat token axis pads to whole
-        # kernel blocks of this many tokens; lanes PACK within a block (per
-        # -row routing), so this is launch-grid granularity only — gcd keeps
-        # every compile bucket — powers of two plus block-rounded chunk
-        # windows — block-packable
+        # ragged kernel tunables (token-block size, page-worklist width,
+        # pages per grid step), precedence: explicit knob > tuned
+        # KERNEL_PERF.json row (ops/autotune.py) > heuristic default.
+        # tb: the flat token axis pads to whole kernel blocks of this many
+        # tokens; lanes PACK within a block (per-row routing), so this is
+        # launch-grid granularity only.  ps: static worklist width — ONE
+        # shape per token bucket, so compiles (and AOT warming) never churn
+        # on batch composition; the full width (tb * max_blocks_per_seq)
+        # always fits, a tuned tighter width falls back to it through the
+        # overflow repack ladder in _run_unified.
         import math as _math
 
-        self._unified_tb = _math.gcd(config.block_size, 8) or 1
-        # fixed per-engine worklist width for the packed kernel: a token
-        # block holds at most tb lanes, each owning at most
-        # max_blocks_per_seq pages — ONE static shape per token bucket, so
-        # compiles (and AOT warming) never churn on batch composition
-        self._unified_ps = self._unified_tb * self.max_blocks_per_seq
+        tb_default = _math.gcd(config.block_size, 8) or 1
+        tuned = self._resolve_tuned_kernel_config(cfg)
+        knob_tb = knobs.get("DYN_AUTOTUNE_TB")
+        knob_ps = knobs.get("DYN_AUTOTUNE_PAGE_SLOTS")
+        knob_pps = knobs.get("DYN_AUTOTUNE_PAGES_PER_STEP")
+        # a tb that cannot pack every unified bucket would split-fallback
+        # every window: validate tuned/knob choices against the prospective
+        # bucket set (chunk + mixed buckets are added below, after this)
+        prospective = set(self.buckets)
+        if (
+            config.prefill_chunk_tokens is not None
+            and self.family.forward_prefill_with_prefix is not None
+        ):
+            ct = _round_chunk_tokens(
+                config.prefill_chunk_tokens, config.block_size
+            )
+            if ct < self.max_len:
+                prospective.add(ct)
+                mixed_b = -(-(ct + config.max_batch_size) // 8) * 8
+                if mixed_b < self.max_len:
+                    prospective.add(mixed_b)
+        tb = int(knob_tb or (tuned or {}).get("tb_tokens") or tb_default)
+        if tb != tb_default and any(b % tb for b in prospective):
+            logger.warning(
+                "kernel tb_tokens=%d does not divide unified buckets %s; "
+                "using heuristic default %d",
+                tb, sorted(prospective), tb_default,
+            )
+            tb = tb_default
+        tuned_fits = tuned is not None and int(tuned["tb_tokens"]) == tb
+        pps = int(
+            knob_pps
+            or ((tuned or {}).get("pages_per_step") if tuned_fits else 0)
+            or 1
+        )
+        ps_full = tb * self.max_blocks_per_seq
+        pps = max(1, min(pps, ps_full))
+        ps = int(
+            knob_ps
+            or ((tuned or {}).get("page_slots") if tuned_fits else 0)
+            or ps_full
+        )
+        # kernel contract: page_slots is a positive multiple of
+        # pages_per_step; the overflow ladder's full width too
+        ps = -(-max(pps, min(ps, ps_full)) // pps) * pps
+        self._unified_tb = tb
+        self._unified_ps = ps
+        self._unified_pps = pps
+        self._unified_ps_full = -(-ps_full // pps) * pps
+        self._unified_ps_overflows = 0  # windows repacked at full width
+        if knob_tb or knob_ps or knob_pps:
+            source = "knob"
+        elif tuned_fits:
+            source = "tuned"
+        else:
+            source = "default"
+        self._kernel_config = {
+            "tb_tokens": tb, "page_slots": ps, "pages_per_step": pps,
+            "source": source,
+            "geometry": getattr(self, "_kernel_geometry", None),
+        }
+        if source != "default":
+            logger.info(
+                "unified kernel config (%s): tb_tokens=%d page_slots=%d "
+                "pages_per_step=%d", source, tb, ps, pps,
+            )
         self._fb_zero = None          # resident all-zero feedback tokens
         self._seed_none = None        # resident no-op seed scatter args
         # Per-lane block-table host rows, rewritten only for lanes whose
@@ -924,6 +1064,50 @@ class JaxLlmEngine:
         if is_quantized(raw_params):
             return raw_params
         return quantize_params(raw_params, self.family.quant_leaves)
+
+    def _resolve_tuned_kernel_config(self, cfg) -> dict | None:
+        """Look up the autotuned ragged-kernel row for this engine's
+        (geometry, device_kind, kv dtype) in the kernel-perf table
+        (DYN_KERNEL_PERF or repo-root KERNEL_PERF.json).  Advisory like the
+        attention-impl lookup: anything malformed degrades to None (the
+        heuristic defaults), never to a startup crash.  DYN_AUTOTUNE=0
+        disables the lookup entirely."""
+        self._kernel_geometry = None
+        if knobs.get("DYN_AUTOTUNE") is False:
+            return None
+        try:
+            from dynamo_tpu.ops import autotune as _autotune
+
+            heads = int(getattr(cfg, "num_heads", 0) or 1)
+            geom = _autotune.Geometry(
+                num_heads=heads,
+                num_kv_heads=int(getattr(cfg, "num_kv_heads", 0) or heads),
+                head_dim=int(
+                    getattr(cfg, "head_dim", 0)
+                    or getattr(cfg, "kv_lora_rank", 0)
+                    or 128
+                ),
+                block_size=self.config.block_size,
+                lanes=self.config.max_batch_size,
+                max_blocks_per_seq=self.max_blocks_per_seq,
+            )
+            kv_dtype = resolve_kv_cache_dtype(self.config.kv_cache_dtype)
+            if kv_dtype is None:
+                kv_dtype = jnp.dtype(cfg.dtype)
+            try:
+                kind = jax.devices()[0].device_kind
+            except Exception:  # noqa: BLE001
+                kind = None
+            self._kernel_geometry = geom.key
+            return _autotune.resolve(
+                _autotune.load_table(_kernel_perf_path()),
+                geometry_key=geom.key,
+                device_kind=kind,
+                dtype=str(jnp.dtype(kv_dtype)),
+            )
+        except Exception as err:  # noqa: BLE001
+            logger.warning("autotune table resolution failed: %s", err)
+            return None
 
     # -- guided decoding ---------------------------------------------------
     def enable_guided_json(self, tokenizer) -> None:
@@ -1292,6 +1476,7 @@ class JaxLlmEngine:
                 token_pos, token_slot, token_lane, page_phys, page_lane,
                 page_ord, page_count, sample_rows, cos, sin,
                 attention=self.attention_impl, tb_tokens=tb,
+                pages_per_step=self._unified_pps,
             )  # [lanes, vocab]
             prompt_counts = prompt_counts.at[seed_lanes].set(
                 seed_prompt, mode="drop"
@@ -1855,9 +2040,10 @@ class JaxLlmEngine:
         during compilation).
 
         The compiled results reach the real dispatch path through JAX's
-        persistent compilation cache — callers must have
-        ``jax_compilation_cache_dir`` configured (bench.py does); without
-        it this wastes work and returns without compiling.  An aval
+        persistent compilation cache — ``_ensure_compile_cache()`` points
+        it at DYN_COMPILE_CACHE_DIR (default ~/.cache/dynamo_tpu/jax_cache)
+        at engine init, so this only skips when the operator opted out
+        (DYN_COMPILE_CACHE_DIR="") or the dir was unwritable.  An aval
         mismatch would silently compile a useless twin program, so
         tests/engine/test_aot_precompile.py asserts the real serving path
         produces ZERO new cache entries after this ran.
@@ -1869,7 +2055,10 @@ class JaxLlmEngine:
         if self.mesh is not None:
             return 0
         if not jax.config.jax_compilation_cache_dir:
-            logger.warning("aot_precompile: no jax_compilation_cache_dir; skipping")
+            logger.info(
+                "aot_precompile: persistent compile cache disabled "
+                '(DYN_COMPILE_CACHE_DIR=""); compiles stay in-process'
+            )
             return 0
 
         sds = jax.ShapeDtypeStruct
@@ -2070,6 +2259,11 @@ class JaxLlmEngine:
             # reason-slug → count of windows (or the engine init) that fell
             # back from the unified step; each reason also logged once
             "unified_fallbacks": dict(self._unified_fallbacks),
+            # resolved ragged-kernel tunables (source: knob / tuned / default)
+            "kernel_config": dict(self._kernel_config),
+            # windows whose page worklist outgrew the tuned page_slots and
+            # repacked at the untuned full-size grid (autotune too tight)
+            "unified_ps_overflows_total": self._unified_ps_overflows,
             "decode_steps_total": self._decode_steps_total,
             "guided_requests_total": self._guided_requests,
             "guided_completions_total": self._guided_completions,
@@ -2530,14 +2724,27 @@ class JaxLlmEngine:
         if self.attention_impl.startswith("pallas"):
             from dynamo_tpu.ops.pallas import pack_page_meta
 
-            page_meta = pack_page_meta(
-                token_lane, token_pos, self._bt_host,
-                tb_tokens=tb, block_size=bs,
-                page_slots=self._unified_ps,
-                sliding_window=getattr(
-                    self.config.model, "sliding_window", None
-                ),
-            )
+            sw = getattr(self.config.model, "sliding_window", None)
+            try:
+                page_meta = pack_page_meta(
+                    token_lane, token_pos, self._bt_host,
+                    tb_tokens=tb, block_size=bs,
+                    page_slots=self._unified_ps,
+                    sliding_window=sw,
+                )
+            except ValueError:
+                if self._unified_ps_full <= self._unified_ps:
+                    raise
+                # tuned page_slots too tight for this window's worklist:
+                # repack at the untuned full-size rung (at most one extra
+                # compiled program per bucket) instead of failing the window
+                self._unified_ps_overflows += 1
+                page_meta = pack_page_meta(
+                    token_lane, token_pos, self._bt_host,
+                    tb_tokens=tb, block_size=bs,
+                    page_slots=self._unified_ps_full,
+                    sliding_window=sw,
+                )
         else:
             # the XLA twin routes per token off token_lane/token_pos and
             # never reads the worklist: ship minimal fixed-shape dummies

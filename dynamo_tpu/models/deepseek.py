@@ -533,7 +533,8 @@ def _mla_decode_attn(w, x, cfg: DeepseekConfig, positions, k_layer, v_layer,
 def _mla_unified_attn(w, x, cfg: DeepseekConfig, positions, token_pos,
                       token_lane, token_slot, k_layer, v_layer, block_tables,
                       page_phys, page_lane, page_ord, page_count, cos, sin,
-                      attention: str = "jax", tb_tokens: int = 8):
+                      attention: str = "jax", tb_tokens: int = 8,
+                      pages_per_step: int = 1):
     """Absorbed-form ragged unified-batch MLA attention: the flat token
     axis carries chunked-prefill spans + decode tokens, every token writes
     its latent before anyone reads, scores stay in latent space per token.
@@ -568,7 +569,7 @@ def _mla_unified_attn(w, x, cfg: DeepseekConfig, positions, token_pos,
         ctx = ragged_mla_attention(
             q_lat, q_rope, ck3, kr3, token_lane, token_pos,
             page_phys, page_lane, page_ord, page_count,
-            scale=scale, tb_tokens=tb_tokens,
+            scale=scale, tb_tokens=tb_tokens, pages_per_step=pages_per_step,
             interpret=attention == "pallas_interpret",
         )
     else:
@@ -805,6 +806,7 @@ def deepseek_forward_unified(
     *,
     attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
     tb_tokens: int = 8,
+    pages_per_step: int = 1,
 ):
     """Ragged unified-batch forward for the MLA family: mixed spans +
     decode tokens in one launch against the latent cache (the llama
@@ -820,6 +822,7 @@ def deepseek_forward_unified(
             w, attn_in, cfg, positions, token_pos, token_lane, token_slot,
             k_layer, v_layer, block_tables, page_phys, page_lane, page_ord,
             page_count, cos, sin, attention=attention, tb_tokens=tb_tokens,
+            pages_per_step=pages_per_step,
         )
 
     x, new_cache = _forward(params, cfg, x, kv_cache, attn)

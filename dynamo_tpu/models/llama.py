@@ -586,6 +586,7 @@ def llama_forward_unified(
     *,
     attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
     tb_tokens: int = 8,
+    pages_per_step: int = 1,
 ) -> tuple[jnp.ndarray, dict]:
     """Ragged unified-batch forward: one launch computes chunked-prefill
     spans AND decode tokens from different sequences, each token at its own
@@ -611,6 +612,7 @@ def llama_forward_unified(
                 q, k_layer, v_layer, token_lane, token_pos,
                 page_phys, page_lane, page_ord, page_count,
                 tb_tokens=tb_tokens,
+                pages_per_step=pages_per_step,
                 interpret=attention == "pallas_interpret",
                 sliding_window=cfg.sliding_window,
             )

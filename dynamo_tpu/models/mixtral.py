@@ -340,6 +340,7 @@ def mixtral_forward_unified(
     *,
     attention: str = "jax",     # "jax" | "pallas" | "pallas_interpret"
     tb_tokens: int = 8,
+    pages_per_step: int = 1,
 ):
     """Ragged unified-batch forward for the sparse-MoE family: the llama
     unified contract (mixed chunked-prefill spans + decode tokens, one
@@ -379,6 +380,7 @@ def mixtral_forward_unified(
                     q, state["kv"][0], state["kv"][1], token_lane, token_pos,
                     page_phys, page_lane, page_ord, page_count,
                     tb_tokens=tb_tokens,
+                    pages_per_step=pages_per_step,
                     interpret=attention == "pallas_interpret",
                 )
             else:

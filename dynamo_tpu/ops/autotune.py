@@ -1,0 +1,341 @@
+"""Kernel autotuner for the ragged packed attention family.
+
+The unified-batch kernels expose a small tunable space that the engine
+historically filled with heuristics:
+
+- ``tb_tokens`` — token-block size of the packed ragged kernel (was
+  ``gcd(block_size, 8)``);
+- ``page_slots`` — static width of the per-token-block page worklist
+  (was ``tb_tokens * max_blocks_per_seq``, hugely oversized for decode-
+  heavy windows: every step past ``page_count`` is a dead pipeline tick);
+- ``pages_per_step`` — KV pages DMA'd per grid step (ragged kernels) /
+  pages per compute block (``paged_attention`` / ``mla_attention``).
+
+This module sweeps that space per **(model geometry, device_kind,
+dtype)** key.  On CPU the sweep is scored by a deterministic cost model
+over the REAL host packer (``pack_page_meta`` builds the worklists for a
+synthetic decode-heavy + mixed-chunk workload, so packing waste and
+feasibility are exact); on TPU ``scripts/tpu_validate.py --bench`` passes
+a wall-clock ``runner`` and the winner is measured, not modeled.  Winners
+persist as provenance-stamped rows in ``KERNEL_PERF.json`` (same table
+the calibration benches write); the engine resolves them at init with the
+precedence **explicit knob > tuned row > heuristic default**.
+
+Row schema (version 1)::
+
+    {"bench": "autotune_ragged", "geometry": "h4kv2d64-bs4-l4-mb16",
+     "device_kind": "any" | "<jax device_kind>", "dtype": "float32",
+     "source": "cost_model" | "measured", "version": 1,
+     "tb_tokens": 4, "page_slots": 16, "pages_per_step": 2,
+     "cost": 123.4, "swept": 18}
+
+``source="cost_model"`` rows are hardware-independent layout choices and
+are stamped ``device_kind="any"``; ``source="measured"`` rows are only
+trusted for the device kind that produced them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+
+import numpy as np
+
+RAGGED_BENCH = "autotune_ragged"
+SCHEMA_VERSION = 1
+
+# cost-model coefficients (arbitrary units; only ratios matter).  DMA is
+# the dominant real cost of decode attention, per-step overhead is the
+# pipeline bubble each grid step pays, MAC covers the masked score/row
+# waste that grows with tb_tokens, SELECT the per-token routing chain,
+# PAD the dead pipeline tick a deduped pad slot still occupies.
+_C_DMA = 1.0        # per KV byte streamed
+_C_STEP = 4096.0    # per grid step
+_C_MAC = 0.002      # per masked MAC in the score matrix
+_C_SELECT = 64.0    # per select in the routing chain, per live page
+_C_PAD = 256.0      # per dead (pad) worklist slot
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """The shape key the tuned parameters depend on: attention geometry,
+    cache page size, and the engine's packing envelope (decode lanes and
+    worst-case pages per lane)."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int
+    lanes: int               # max_batch_size — decode lanes per window
+    max_blocks_per_seq: int
+
+    @property
+    def key(self) -> str:
+        return (
+            f"h{self.num_heads}kv{self.num_kv_heads}d{self.head_dim}"
+            f"-bs{self.block_size}-l{self.lanes}-mb{self.max_blocks_per_seq}"
+        )
+
+
+def _synthetic_workloads(geom: Geometry, tb: int):
+    """Deterministic (token_lane, token_pos, block_tables) workloads the
+    cost model scores: a full decode window with every lane mid-stream,
+    and a mixed window (decode lanes + one chunked-prefill span).  Both
+    are derived purely from the geometry — no RNG, no wall clock."""
+    lanes = geom.lanes
+    bs = geom.block_size
+    mid = max(bs, (geom.max_blocks_per_seq * bs) // 2)
+    bt = np.arange(
+        lanes * geom.max_blocks_per_seq, dtype=np.int32
+    ).reshape(lanes, geom.max_blocks_per_seq)
+
+    def pad_to(arr, fill):
+        t_pad = -(-len(arr) // tb) * tb
+        out = np.full(t_pad, fill, np.int32)
+        out[: len(arr)] = arr
+        return out
+
+    # decode-heavy: one token per lane, staggered contexts around mid
+    d_lane = np.arange(lanes, dtype=np.int32)
+    d_pos = np.array([mid - 1 + (i % bs) for i in range(lanes)], np.int32)
+    decode = (pad_to(d_lane, lanes), pad_to(d_pos, -1), bt)
+
+    # mixed: a 2-page prefill chunk on lane 0 + the other lanes decoding
+    chunk = 2 * bs
+    m_lane = np.concatenate([
+        np.zeros(chunk, np.int32), np.arange(1, lanes, dtype=np.int32)
+    ])
+    m_pos = np.concatenate([
+        np.arange(chunk, dtype=np.int32),
+        np.array([mid - 1 + (i % bs) for i in range(1, lanes)], np.int32),
+    ])
+    mixed = (pad_to(m_lane, lanes), pad_to(m_pos, -1), bt)
+    return (decode, mixed)
+
+
+def _pack_stats(geom: Geometry, tb: int):
+    """Run the real host packer over the synthetic workloads; return
+    (need, per-workload [num_tb, live_pages] pairs).  ``need`` is the
+    tightest page_slots width that fits every workload."""
+    from dynamo_tpu.ops.pallas.ragged_attention import pack_page_meta
+
+    need = 1
+    stats = []
+    for token_lane, token_pos, bt in _synthetic_workloads(geom, tb):
+        page_phys, _, _, page_count = pack_page_meta(
+            token_lane, token_pos, bt,
+            tb_tokens=tb, block_size=geom.block_size,
+        )
+        need = max(need, page_phys.shape[1])
+        stats.append((page_phys.shape[0], int(page_count.sum())))
+    return need, stats
+
+
+def cost_model(geom: Geometry, tb: int, ps: int, pps: int,
+               dtype_bytes: int = 4) -> float | None:
+    """Deterministic score (lower is better) for one candidate; None when
+    the candidate cannot hold the synthetic workloads (the engine would
+    hit the overflow-repack ladder on typical traffic)."""
+    need, stats = _pack_stats(geom, tb)
+    if ps < need or ps % pps:
+        return None
+    page_bytes = (
+        2 * geom.block_size * geom.num_kv_heads * geom.head_dim * dtype_bytes
+    )
+    tbh = tb * geom.num_heads
+    score_cols = geom.block_size * geom.num_kv_heads
+    cost = 0.0
+    for num_tb, live in stats:
+        steps = num_tb * (ps // pps)
+        cost += _C_STEP * steps
+        cost += _C_DMA * live * page_bytes
+        cost += _C_MAC * live * tbh * score_cols
+        cost += _C_SELECT * live * tb
+        cost += _C_PAD * (num_tb * ps - live)
+    return cost
+
+
+def candidate_grid(geom: Geometry, buckets: tuple[int, ...] = ()) -> list[dict]:
+    """The swept (tb_tokens, page_slots, pages_per_step) candidates.
+    ``buckets`` (the engine's unified token buckets) constrain tb_tokens:
+    a tb that does not divide every bucket would force the split
+    fallback, so it is never a valid winner."""
+    default_tb = math.gcd(geom.block_size, 8) or 1
+    tbs = sorted({
+        t for t in (1, 2, 4, 8, 16, default_tb)
+        if t <= max(geom.lanes, default_tb)
+        and all(b % t == 0 for b in buckets)
+    })
+    out = []
+    for tb in tbs:
+        need, _ = _pack_stats(geom, tb)
+        full = tb * geom.max_blocks_per_seq
+        for pps in (1, 2, 4, 8):
+            # round the tight width up to a pps multiple; also sweep a
+            # 2x-slack width and the legacy full width
+            tight = -(-need // pps) * pps
+            for ps in sorted({tight, min(full, 2 * tight), full}):
+                if ps < need or ps % pps:
+                    continue
+                out.append(
+                    {"tb_tokens": tb, "page_slots": ps, "pages_per_step": pps}
+                )
+    # dedup, preserving order
+    seen = set()
+    uniq = []
+    for c in out:
+        k = (c["tb_tokens"], c["page_slots"], c["pages_per_step"])
+        if k not in seen:
+            seen.add(k)
+            uniq.append(c)
+    return uniq
+
+
+def sweep(
+    geom: Geometry,
+    *,
+    dtype: str = "float32",
+    buckets: tuple[int, ...] = (),
+    runner=None,
+    device_kind: str | None = None,
+) -> dict:
+    """Score every candidate and return the winner row (plus the swept
+    grid under ``"grid"`` for bench reporting).  ``runner`` is an optional
+    ``callable(candidate) -> wall_us | None`` — when present the sweep is
+    *measured* and stamped with the real device kind; otherwise the
+    deterministic cost model scores it (``device_kind="any"``)."""
+    dtype_bytes = max(1, np.dtype(dtype).itemsize)
+    grid = candidate_grid(geom, buckets)
+    if not grid:
+        raise ValueError(f"no feasible candidates for {geom.key}")
+    scored = []
+    for cand in grid:
+        if runner is not None:
+            cost = runner(dict(cand))
+        else:
+            cost = cost_model(
+                geom, cand["tb_tokens"], cand["page_slots"],
+                cand["pages_per_step"], dtype_bytes,
+            )
+        if cost is None:
+            continue
+        scored.append((float(cost), cand))
+    if not scored:
+        raise ValueError(f"no candidate survived the sweep for {geom.key}")
+    scored.sort(key=lambda it: (it[0], sorted(it[1].items())))
+    best_cost, best = scored[0]
+    row = {
+        "bench": RAGGED_BENCH,
+        "geometry": geom.key,
+        "device_kind": device_kind if runner is not None else "any",
+        "dtype": str(dtype),
+        "source": "measured" if runner is not None else "cost_model",
+        "version": SCHEMA_VERSION,
+        **best,
+        "cost": round(best_cost, 3),
+        "swept": len(grid),
+    }
+    row["grid"] = [
+        {**cand, "cost": round(cost, 3)} for cost, cand in scored
+    ]
+    return row
+
+
+# ------------------------------------------------------------ persistence
+
+
+def _row_key(row: dict) -> tuple:
+    return (
+        row.get("bench"), row.get("geometry"), row.get("device_kind"),
+        row.get("dtype"), row.get("source"), row.get("version"),
+    )
+
+
+def load_table(path) -> dict:
+    """Read a KERNEL_PERF-format table ({header..., "rows": [...]}) or
+    return an empty shell when the file does not exist / fails to parse."""
+    try:
+        with open(path) as fh:
+            table = json.load(fh)
+    except (OSError, ValueError):
+        return {"rows": []}
+    if not isinstance(table, dict):
+        return {"rows": []}
+    table.setdefault("rows", [])
+    return table
+
+
+def tune(
+    path,
+    geom: Geometry,
+    *,
+    dtype: str = "float32",
+    buckets: tuple[int, ...] = (),
+    runner=None,
+    device_kind: str | None = None,
+) -> tuple[dict, bool]:
+    """Sweep-or-load: return ``(row, cached)``.  An existing row for the
+    same (bench, geometry, device_kind, dtype, source, version) key is a
+    cache hit — the file is not touched and no sweep runs.  Otherwise the
+    winner is upserted into ``path`` (header and unrelated rows are
+    preserved)."""
+    source = "measured" if runner is not None else "cost_model"
+    kind = device_kind if runner is not None else "any"
+    probe = {
+        "bench": RAGGED_BENCH, "geometry": geom.key, "device_kind": kind,
+        "dtype": str(dtype), "source": source, "version": SCHEMA_VERSION,
+    }
+    table = load_table(path)
+    for row in table["rows"]:
+        if _row_key(row) == _row_key(probe):
+            return row, True
+    row = sweep(
+        geom, dtype=dtype, buckets=buckets, runner=runner,
+        device_kind=device_kind,
+    )
+    row = {k: v for k, v in row.items() if k != "grid"}
+    table["rows"] = [
+        r for r in table["rows"] if _row_key(r) != _row_key(row)
+    ] + [row]
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(table, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return row, False
+
+
+def resolve(
+    table: dict,
+    *,
+    geometry_key: str,
+    device_kind: str | None,
+    dtype: str,
+    bench: str = RAGGED_BENCH,
+) -> dict | None:
+    """Pick the tuned row for a geometry: a measured row for this exact
+    device kind wins over the hardware-independent cost-model row; rows
+    for other devices, dtypes, or schema versions never match."""
+    rows = [
+        r for r in table.get("rows", ())
+        if r.get("bench") == bench
+        and r.get("geometry") == geometry_key
+        and r.get("dtype") == str(dtype)
+        and r.get("version") == SCHEMA_VERSION
+        and all(k in r for k in ("tb_tokens", "page_slots", "pages_per_step"))
+    ]
+    measured = [
+        r for r in rows
+        if r.get("source") == "measured"
+        and device_kind is not None
+        and r.get("device_kind") == device_kind
+    ]
+    if measured:
+        return measured[0]
+    modeled = [
+        r for r in rows
+        if r.get("source") == "cost_model" and r.get("device_kind") == "any"
+    ]
+    return modeled[0] if modeled else None

@@ -31,67 +31,74 @@ def _kernel(
     # inputs
     q_lat_ref,          # [1, H, R]
     q_rope_ref,         # [1, H, P]
-    ck_page_ref,        # [1, bs, R]   latents (keys AND values)
-    kr_page_ref,        # [1, bs, P]   rope keys
-    # output
-    out_ref,            # [1, H, R]    latent-space context
-    # scratch
-    m_ref,              # [H, 128] f32 running max
-    l_ref,              # [H, 128] f32 running denom
-    acc_ref,            # [H, R]  f32 running numerator
-    *,
+    *refs,              # pps × (ck_page [1, bs, R], kr_page [1, bs, P]),
+                        # out [1, H, R], then m/l/acc scratch
     block_size: int,
     scale: float,
     max_blocks: int,
+    pages_per_step: int,
 ):
+    pps = pages_per_step
+    kv_refs = refs[: 2 * pps]
+    out_ref = refs[2 * pps]
+    m_ref, l_ref, acc_ref = refs[2 * pps + 1:]
     seq = pl.program_id(0)
-    page = pl.program_id(1)
+    step = pl.program_id(1)
     ctx = context_lens_ref[seq]
 
-    @pl.when(page == 0)
+    @pl.when(step == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    page_start = page * block_size
+    for i in range(pps):
+        page = step * pps + i
+        page_start = page * block_size
+        ck_page_ref = kv_refs[2 * i]
+        kr_page_ref = kv_refs[2 * i + 1]
 
-    @pl.when(page_start < ctx)
-    def _compute():
-        q_lat = q_lat_ref[0].astype(jnp.float32)    # [H, R]
-        q_rope = q_rope_ref[0].astype(jnp.float32)  # [H, P]
-        ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
-        kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
-        # [H, bs] two-part scores, both contractions on the MXU
-        s = (
-            jax.lax.dot_general(
-                q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+        @pl.when(page_start < ctx)
+        def _compute(
+            ck_page_ref=ck_page_ref, kr_page_ref=kr_page_ref,
+            page_start=page_start,
+        ):
+            q_lat = q_lat_ref[0].astype(jnp.float32)    # [H, R]
+            q_rope = q_rope_ref[0].astype(jnp.float32)  # [H, P]
+            ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
+            kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
+            # [H, bs] two-part scores, both contractions on the MXU
+            s = (
+                jax.lax.dot_general(
+                    q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                + jax.lax.dot_general(
+                    q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            ) * scale
+            pos = page_start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_size), 1
+            )
+            s = jnp.where(pos < ctx, s, NEG_INF)
+
+            m_prev = m_ref[:, :1]                       # [H, 1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)                      # [H, bs]
+            l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            # [H, R] context in latent space: values ARE the latents
+            pv = jax.lax.dot_general(
+                p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            + jax.lax.dot_general(
-                q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        ) * scale
-        pos = page_start + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-        m_prev = m_ref[:, :1]                           # [H, 1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                          # [H, bs]
-        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        # [H, R] context in latent space: values ARE the latents
-        pv = jax.lax.dot_general(
-            p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(page == max_blocks - 1)
+    @pl.when(step == -(-max_blocks // pps) - 1)
     def _finish():
         denom = jnp.maximum(l_ref[:, :1], 1e-20)
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
@@ -224,7 +231,9 @@ def mla_paged_window_attention_decode(
     return out.reshape(b, w, h, r)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "pages_per_step")
+)
 def mla_paged_attention_decode(
     q_lat: jnp.ndarray,         # [B, H, R] f32/bf16
     q_rope: jnp.ndarray,        # [B, H, P]
@@ -235,21 +244,39 @@ def mla_paged_attention_decode(
     *,
     scale: float,
     interpret: bool = False,
+    pages_per_step: int = 1,
 ) -> jnp.ndarray:
-    """Returns the latent-space context [B, H, R] (float32)."""
+    """Returns the latent-space context [B, H, R] (float32).
+    ``pages_per_step`` widens each grid step to DMA that many block-table
+    pages (autotuned; past-the-end indices clamp to the last block)."""
     b, h, r = q_lat.shape
     p_dim = q_rope.shape[-1]
     bs = ck_cache.shape[1]
     maxb = block_tables.shape[1]
+    pps = pages_per_step
+    if pps < 1:
+        raise ValueError(f"pages_per_step must be >= 1, got {pps}")
+    pps = min(pps, maxb)
 
+    def kv_map_at(i):
+        def kv_map(s, p, bt, cl):
+            return (bt[s, jnp.minimum(p * pps + i, maxb - 1)], 0, 0)
+        return kv_map
+
+    kv_specs = []
+    for i in range(pps):
+        m = kv_map_at(i)
+        kv_specs += [
+            pl.BlockSpec((1, bs, r), m),
+            pl.BlockSpec((1, bs, p_dim), m),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, maxb),
+        grid=(b, -(-maxb // pps)),
         in_specs=[
             pl.BlockSpec((1, h, r), lambda s, p, bt, cl: (s, 0, 0)),
             pl.BlockSpec((1, h, p_dim), lambda s, p, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, bs, r), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
-            pl.BlockSpec((1, bs, p_dim), lambda s, p, bt, cl: (bt[s, p], 0, 0)),
+            *kv_specs,
         ],
         out_specs=pl.BlockSpec((1, h, r), lambda s, p, bt, cl: (s, 0, 0)),
         scratch_shapes=[
@@ -259,14 +286,18 @@ def mla_paged_attention_decode(
         ],
     )
     kernel = functools.partial(
-        _kernel, block_size=bs, scale=scale, max_blocks=maxb
+        _kernel, block_size=bs, scale=scale, max_blocks=maxb,
+        pages_per_step=pps,
     )
+    kv_args = []
+    for _ in range(pps):
+        kv_args += [ck_cache, kr_cache]
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, r), jnp.float32),
         interpret=interpret,
-    )(block_tables, context_lens, q_lat, q_rope, ck_cache, kr_cache)
+    )(block_tables, context_lens, q_lat, q_rope, *kv_args)
 
 
 def _ragged_kernel(
@@ -278,22 +309,24 @@ def _ragged_kernel(
     page_count_ref,     # [num_tb] int32 — live worklist entries
     q_lat_ref,          # [1, TB*H, R]  (token-major fold: row = tok*H + h)
     q_rope_ref,         # [1, TB*H, P]
-    ck_page_ref,        # [1, bs, R]
-    kr_page_ref,        # [1, bs, P]
-    out_ref,            # [1, TB*H, R]
-    m_ref,              # [TB*H, 128] f32
-    l_ref,
-    acc_ref,            # [TB*H, R] f32
-    *,
+    *refs,              # pps × (ck_page [1, bs, R], kr_page [1, bs, P]),
+                        # out [1, TB*H, R], then m/l/acc scratch
     block_size: int,
     scale: float,
     page_slots: int,
     tb_tokens: int,
     num_heads: int,
+    pages_per_step: int,
 ):
     """Ragged unified-batch MLA: the packed page-worklist loop of
     ops/pallas/ragged_attention.py applied to the latent cache — two-part
-    scores, latent-space accumulation (decompression outside)."""
+    scores, latent-space accumulation (decompression outside).  Each grid
+    step folds ``pages_per_step`` consecutive worklist slots into the
+    running softmax (one input stream per slot)."""
+    pps = pages_per_step
+    kv_refs = refs[: 2 * pps]
+    out_ref = refs[2 * pps]
+    m_ref, l_ref, acc_ref = refs[2 * pps + 1:]
     t = pl.program_id(0)
     j = pl.program_id(1)
     tbh = tb_tokens * num_heads
@@ -304,63 +337,73 @@ def _ragged_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    page_lane = page_lane_ref[t, j]
-    page_start = page_ord_ref[t, j] * block_size
+    for i in range(pps):
+        slot = j * pps + i
+        page_lane = page_lane_ref[t, slot]
+        page_start = page_ord_ref[t, slot] * block_size
+        ck_page_ref = kv_refs[2 * i]
+        kr_page_ref = kv_refs[2 * i + 1]
 
-    @pl.when(j < page_count_ref[t])
-    def _compute():
-        q_lat = q_lat_ref[0].astype(jnp.float32)    # [TB*H, R]
-        q_rope = q_rope_ref[0].astype(jnp.float32)  # [TB*H, P]
-        ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
-        kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
-        s = (
-            jax.lax.dot_general(
-                q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+        @pl.when(slot < page_count_ref[t])
+        def _compute(
+            ck_page_ref=ck_page_ref, kr_page_ref=kr_page_ref,
+            page_lane=page_lane, page_start=page_start,
+        ):
+            q_lat = q_lat_ref[0].astype(jnp.float32)    # [TB*H, R]
+            q_rope = q_rope_ref[0].astype(jnp.float32)  # [TB*H, P]
+            ck = ck_page_ref[0].astype(jnp.float32)     # [bs, R]
+            kr = kr_page_ref[0].astype(jnp.float32)     # [bs, P]
+            s = (
+                jax.lax.dot_general(
+                    q_lat, ck, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                + jax.lax.dot_general(
+                    q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            ) * scale                                    # [TB*H, bs]
+            pos = page_start + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_size), 1
+            )
+            row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
+            tok_of_row = row // num_heads
+            base = t * tb_tokens
+            q_pos = jnp.full((tbh, 1), -1, jnp.int32)
+            row_lane = jnp.full((tbh, 1), -1, jnp.int32)
+            for rr in range(tb_tokens):
+                q_pos = jnp.where(
+                    tok_of_row == rr, token_pos_ref[base + rr], q_pos
+                )
+                row_lane = jnp.where(
+                    tok_of_row == rr, token_lane_ref[base + rr], row_lane
+                )
+            mask = (row_lane == page_lane) & (pos <= q_pos)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_ref[:, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            + jax.lax.dot_general(
-                q_rope, kr, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        ) * scale                                    # [TB*H, bs]
-        pos = page_start + jax.lax.broadcasted_iota(
-            jnp.int32, (1, block_size), 1
-        )
-        row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
-        tok_of_row = row // num_heads
-        base = t * tb_tokens
-        q_pos = jnp.full((tbh, 1), -1, jnp.int32)
-        row_lane = jnp.full((tbh, 1), -1, jnp.int32)
-        for rr in range(tb_tokens):
-            q_pos = jnp.where(tok_of_row == rr, token_pos_ref[base + rr], q_pos)
-            row_lane = jnp.where(
-                tok_of_row == rr, token_lane_ref[base + rr], row_lane
-            )
-        mask = (row_lane == page_lane) & (pos <= q_pos)
-        s = jnp.where(mask, s, NEG_INF)
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-        m_prev = m_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, ck, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-
-    @pl.when(j == page_slots - 1)
+    @pl.when(j == page_slots // pps - 1)
     def _finish():
         denom = jnp.maximum(l_ref[:, :1], 1e-20)
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "tb_tokens", "interpret")
+    jax.jit,
+    static_argnames=("scale", "tb_tokens", "pages_per_step", "interpret"),
 )
 def ragged_mla_attention(
     q_lat: jnp.ndarray,         # [T, H, R] flat ragged token batch
@@ -376,13 +419,15 @@ def ragged_mla_attention(
     *,
     scale: float,
     tb_tokens: int = 8,
+    pages_per_step: int = 1,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Ragged unified-batch MLA paged attention with packed lanes: one
     launch over mixed chunked-prefill spans + decode tokens against the
     latent cache.  Returns the latent-space context [T, H, R] (float32);
     metadata comes from ragged_attention.pack_page_meta over the latent
-    block tables."""
+    block tables.  ``pages_per_step`` widens each grid step to DMA that
+    many worklist pages (autotuned; ``page_slots`` must divide evenly)."""
     t_pad, h, r = q_lat.shape
     p_dim = q_rope.shape[-1]
     bs = ck_cache.shape[1]
@@ -393,19 +438,33 @@ def ragged_mla_attention(
         )
     num_tb = t_pad // tb_tokens
     page_slots = page_phys.shape[1]
+    pps = pages_per_step
+    if pps < 1 or page_slots % pps:
+        raise ValueError(
+            f"page_slots ({page_slots}) must be a positive multiple of "
+            f"pages_per_step ({pps})"
+        )
     tbh = tb_tokens * h
 
-    def kv_map(t, j, tl, tp, pp, pln, po, pc):
-        return (pp[t, j], 0, 0)
+    def kv_map_at(i):
+        def kv_map(t, j, tl, tp, pp, pln, po, pc):
+            return (pp[t, j * pps + i], 0, 0)
+        return kv_map
 
+    kv_specs = []
+    for i in range(pps):
+        m = kv_map_at(i)
+        kv_specs += [
+            pl.BlockSpec((1, bs, r), m),
+            pl.BlockSpec((1, bs, p_dim), m),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
-        grid=(num_tb, page_slots),
+        grid=(num_tb, page_slots // pps),
         in_specs=[
             pl.BlockSpec((1, tbh, r), lambda t, j, *_: (t, 0, 0)),
             pl.BlockSpec((1, tbh, p_dim), lambda t, j, *_: (t, 0, 0)),
-            pl.BlockSpec((1, bs, r), kv_map),
-            pl.BlockSpec((1, bs, p_dim), kv_map),
+            *kv_specs,
         ],
         out_specs=pl.BlockSpec((1, tbh, r), lambda t, j, *_: (t, 0, 0)),
         scratch_shapes=[
@@ -421,7 +480,11 @@ def ragged_mla_attention(
         page_slots=page_slots,
         tb_tokens=tb_tokens,
         num_heads=h,
+        pages_per_step=pps,
     )
+    kv_args = []
+    for _ in range(pps):
+        kv_args += [ck_cache, kr_cache]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -431,6 +494,6 @@ def ragged_mla_attention(
         token_lane, token_pos, page_phys, page_lane, page_ord, page_count,
         q_lat.reshape(num_tb, tbh, r),
         q_rope.reshape(num_tb, tbh, p_dim),
-        ck_cache, kr_cache,
+        *kv_args,
     )
     return out.reshape(t_pad, h, r)

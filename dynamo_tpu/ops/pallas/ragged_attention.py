@@ -28,10 +28,12 @@ Layout (PACKED lanes — multiple sequences share one token block):
   unchanged index map skips their DMA; their compute is gated off by
   ``j < page_count[t]`` (repeating without the gate would double-count
   that page in the softmax accumulator);
-- grid = (token blocks × page slots): page slots is the static width of
-  the worklist — a compile-bucket choice of the caller (the engine uses
-  one fixed width so there is exactly one unified program per token
-  bucket);
+- grid = (token blocks × page slots / pages_per_step): page slots is the
+  static width of the worklist — a compile-bucket choice of the caller
+  (the engine uses one fixed width so there is exactly one unified
+  program per token bucket); ``pages_per_step`` folds that many
+  consecutive worklist slots into one grid step (each slot gets its own
+  input stream + index map, so the DMAs still address single pages);
 - heads fold into the row axis like the window kernel (row = token*H + h)
   and GQA matching uses iota masks on the [TB*H, bs*KVH] score matrix;
 - softmax accumulates online flash-style in VMEM scratch across a token
@@ -140,22 +142,28 @@ def _ragged_kernel(
     page_ord_ref,       # [num_tb, PS] int32 — page ordinal in its lane
     page_count_ref,     # [num_tb] int32 — live worklist entries
     q_ref,              # [1, TB*H, D]   (token-major fold: row = tok*H + h)
-    k_page_ref,         # [1, bs*KVH, D]
-    v_page_ref,
-    out_ref,            # [1, TB*H, D]
-    m_ref,              # [TB*H, 128] f32
-    l_ref,
-    acc_ref,            # [TB*H, D] f32
-    *,
+    *refs,              # pps × (k_page [1, bs*KVH, D], v_page), out, scratch
     block_size: int,
     num_kv_heads: int,
     groups: int,
     head_dim: int,
     page_slots: int,
     tb_tokens: int,
+    pages_per_step: int,
     sliding_window: int | None,
 ):
-    """Online-softmax page-worklist loop for one packed token block."""
+    """Online-softmax page-worklist loop for one packed token block.
+
+    Each grid step owns ``pages_per_step`` consecutive worklist slots: the
+    same cache array is passed once per slot with its own BlockSpec index
+    map (index maps address exactly one block, so batching arbitrary
+    physical pages into one DMA is impossible — multiple inputs is the
+    Pallas way to widen a step), and the kernel folds the slots into the
+    running softmax sequentially."""
+    pps = pages_per_step
+    kv_refs = refs[: 2 * pps]
+    out_ref = refs[2 * pps]
+    m_ref, l_ref, acc_ref = refs[2 * pps + 1:]
     t = pl.program_id(0)
     j = pl.program_id(1)
     rows = block_size * num_kv_heads
@@ -168,74 +176,88 @@ def _ragged_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    page_lane = page_lane_ref[t, j]
-    page_start = page_ord_ref[t, j] * block_size
+    for i in range(pps):
+        slot = j * pps + i
+        page_lane = page_lane_ref[t, slot]
+        page_start = page_ord_ref[t, slot] * block_size
+        k_page_ref = kv_refs[2 * i]
+        v_page_ref = kv_refs[2 * i + 1]
 
-    @pl.when(j < page_count_ref[t])
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)        # [TB*H, D]
-        k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
-        v = v_page_ref[0].astype(jnp.float32)
-        scale = 1.0 / (head_dim ** 0.5)
-        s = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                        # [TB*H, bs*KVH]
-        col = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
-        pos = page_start + col // num_kv_heads
-        kv_of_col = col % num_kv_heads
-        row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
-        kv_of_row = (row % h_all) // groups
-        # per-row routing: row r serves flat token t*TB + r//H — its lane
-        # and absolute position come from the scalar-prefetched per-token
-        # metadata, folded in as a select chain over the block's tokens
-        # (scalar reads broadcast against the row iota; no vector gather)
-        tok_of_row = row // h_all
-        base = t * tb_tokens
-        q_pos = jnp.full((tbh, 1), -1, jnp.int32)
-        row_lane = jnp.full((tbh, 1), -1, jnp.int32)
-        for rr in range(tb_tokens):
-            q_pos = jnp.where(tok_of_row == rr, token_pos_ref[base + rr], q_pos)
-            row_lane = jnp.where(
-                tok_of_row == rr, token_lane_ref[base + rr], row_lane
+        @pl.when(slot < page_count_ref[t])
+        def _compute(
+            k_page_ref=k_page_ref, v_page_ref=v_page_ref,
+            page_lane=page_lane, page_start=page_start,
+        ):
+            q = q_ref[0].astype(jnp.float32)        # [TB*H, D]
+            k = k_page_ref[0].astype(jnp.float32)   # [bs*KVH, D]
+            v = v_page_ref[0].astype(jnp.float32)
+            scale = 1.0 / (head_dim ** 0.5)
+            s = jax.lax.dot_general(
+                q, k,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                    # [TB*H, bs*KVH]
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, rows), 1)
+            pos = page_start + col // num_kv_heads
+            kv_of_col = col % num_kv_heads
+            row = jax.lax.broadcasted_iota(jnp.int32, (tbh, 1), 0)
+            kv_of_row = (row % h_all) // groups
+            # per-row routing: row r serves flat token t*TB + r//H — its
+            # lane and absolute position come from the scalar-prefetched
+            # per-token metadata, folded in as a select chain over the
+            # block's tokens (scalar reads broadcast against the row iota;
+            # no vector gather)
+            tok_of_row = row // h_all
+            base = t * tb_tokens
+            q_pos = jnp.full((tbh, 1), -1, jnp.int32)
+            row_lane = jnp.full((tbh, 1), -1, jnp.int32)
+            for rr in range(tb_tokens):
+                q_pos = jnp.where(
+                    tok_of_row == rr, token_pos_ref[base + rr], q_pos
+                )
+                row_lane = jnp.where(
+                    tok_of_row == rr, token_lane_ref[base + rr], row_lane
+                )
+            # a row participates iff its token's lane owns this page and
+            # the page position is causally visible (pads sit at
+            # q_pos = -1 and match nothing; stale slots past a lane's
+            # context exceed every q_pos of that lane, so causality masks
+            # them too)
+            mask = (
+                (kv_of_col == kv_of_row)
+                & (row_lane == page_lane)
+                & (pos <= q_pos)
             )
-        # a row participates iff its token's lane owns this page and the
-        # page position is causally visible (pads sit at q_pos = -1 and
-        # match nothing; stale slots past a lane's context exceed every
-        # q_pos of that lane, so causality masks them too)
-        mask = (
-            (kv_of_col == kv_of_row)
-            & (row_lane == page_lane)
-            & (pos <= q_pos)
-        )
-        if sliding_window is not None:
-            mask = mask & (pos > q_pos - sliding_window)
-        s = jnp.where(mask, s, NEG_INF)
+            if sliding_window is not None:
+                mask = mask & (pos > q_pos - sliding_window)
+            s = jnp.where(mask, s, NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
-        l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p, v,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            m_prev = m_ref[:, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+            l_new = l_ref[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, v,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[...] = acc_ref[...] * alpha + pv
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(j == page_slots - 1)
+    @pl.when(j == page_slots // pps - 1)
     def _finish():
         denom = jnp.maximum(l_ref[:, :1], 1e-20)
         out_ref[0] = (acc_ref[...] / denom).astype(out_ref.dtype)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tb_tokens", "interpret", "sliding_window")
+    jax.jit,
+    static_argnames=(
+        "tb_tokens", "pages_per_step", "interpret", "sliding_window"
+    ),
 )
 def ragged_paged_attention(
     q: jnp.ndarray,             # [T, H, D] flat ragged token batch
@@ -249,6 +271,7 @@ def ragged_paged_attention(
     page_count: jnp.ndarray,    # [T // tb_tokens] int32
     *,
     tb_tokens: int = 8,
+    pages_per_step: int = 1,
     interpret: bool = False,
     sliding_window: int | None = None,
 ) -> jnp.ndarray:
@@ -256,7 +279,8 @@ def ragged_paged_attention(
     masked paged attention over one mixed prefill+decode token batch in a
     single launch, multiple lanes per token block (pure-JAX twin:
     ops/attention.py ragged_paged_attention; host metadata builder:
-    pack_page_meta)."""
+    pack_page_meta).  ``pages_per_step`` widens each grid step to DMA that
+    many worklist pages (autotuned; ``page_slots`` must divide evenly)."""
     t_pad, h, d = q.shape
     n, bs, kvh, _ = k_cache.shape
     groups = h // kvh
@@ -268,18 +292,32 @@ def ragged_paged_attention(
         )
     num_tb = t_pad // tb_tokens
     page_slots = page_phys.shape[1]
+    pps = pages_per_step
+    if pps < 1 or page_slots % pps:
+        raise ValueError(
+            f"page_slots ({page_slots}) must be a positive multiple of "
+            f"pages_per_step ({pps})"
+        )
     tbh = tb_tokens * h
 
-    def kv_map(t, j, tl, tp, pp, pln, po, pc):
-        return (pp[t, j], 0, 0)
+    def kv_map_at(i):
+        def kv_map(t, j, tl, tp, pp, pln, po, pc):
+            return (pp[t, j * pps + i], 0, 0)
+        return kv_map
 
+    kv_specs = []
+    for i in range(pps):
+        m = kv_map_at(i)
+        kv_specs += [
+            pl.BlockSpec((1, rows, d), m),
+            pl.BlockSpec((1, rows, d), m),
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
-        grid=(num_tb, page_slots),
+        grid=(num_tb, page_slots // pps),
         in_specs=[
             pl.BlockSpec((1, tbh, d), lambda t, j, *_: (t, 0, 0)),
-            pl.BlockSpec((1, rows, d), kv_map),
-            pl.BlockSpec((1, rows, d), kv_map),
+            *kv_specs,
         ],
         out_specs=pl.BlockSpec((1, tbh, d), lambda t, j, *_: (t, 0, 0)),
         scratch_shapes=[
@@ -296,8 +334,14 @@ def ragged_paged_attention(
         head_dim=d,
         page_slots=page_slots,
         tb_tokens=tb_tokens,
+        pages_per_step=pps,
         sliding_window=sliding_window,
     )
+    k_flat = k_cache.reshape(n, rows, d)
+    v_flat = v_cache.reshape(n, rows, d)
+    kv_args = []
+    for _ in range(pps):
+        kv_args += [k_flat, v_flat]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -306,7 +350,6 @@ def ragged_paged_attention(
     )(
         token_lane, token_pos, page_phys, page_lane, page_ord, page_count,
         q.reshape(num_tb, tbh, d),
-        k_cache.reshape(n, rows, d),
-        v_cache.reshape(n, rows, d),
+        *kv_args,
     )
     return out.reshape(t_pad, h, d)

@@ -213,6 +213,31 @@ K_KERNEL_PERF = register(
     "DYN_KERNEL_PERF", type="str", default=None,
     doc="explicit path to a KERNEL_PERF.json kernel-choice table (default: "
         "the repo-root artifact, purely advisory)", section=PERF)
+K_COMPILE_CACHE_DIR = register(
+    "DYN_COMPILE_CACHE_DIR", type="str", default=None,
+    doc="persistent JAX compile cache dir (unset: "
+        "`~/.cache/dynamo_tpu/jax_cache`; empty string disables; an "
+        "explicitly set `jax_compilation_cache_dir` always wins)",
+    section=PERF)
+K_AUTOTUNE = register(
+    "DYN_AUTOTUNE", type="bool", default=True,
+    doc="consult KERNEL_PERF.json autotune rows for ragged-kernel tunables "
+        "at engine init; `0` keeps the static heuristic defaults",
+    section=PERF)
+K_AUTOTUNE_TB = register(
+    "DYN_AUTOTUNE_TB", type="int", default=None,
+    doc="force the ragged kernel's token-block size (overrides tuned rows; "
+        "must divide every serving bucket or it falls back with a warning)",
+    section=PERF)
+K_AUTOTUNE_PAGE_SLOTS = register(
+    "DYN_AUTOTUNE_PAGE_SLOTS", type="int", default=None,
+    doc="force the packed page-worklist width (overflowing windows repack "
+        "at the full-size rung and count in "
+        "`stats()[\"unified_ps_overflows_total\"]`)", section=PERF)
+K_AUTOTUNE_PAGES_PER_STEP = register(
+    "DYN_AUTOTUNE_PAGES_PER_STEP", type="int", default=None,
+    doc="force KV pages fetched per ragged/paged grid step (must divide "
+        "page_slots)", section=PERF)
 
 # -- predictive prefetch (docs/performance.md) -------------------------------
 K_PREFETCH = register(

@@ -11,7 +11,11 @@ starts:
                  success proves nothing about lowering)
 3. kernel perf — scripts/tpu_validate.py --bench → KERNEL_PERF.json with
                  platform=tpu, activating attention_impl="auto"'s measured
-                 selection (engine/engine.py)
+                 per-shape selection (engine/engine.py) AND the autotune
+                 stage: wall-clock sweep of the ragged kernel's
+                 (tb_tokens, page_slots, pages_per_step) grid whose
+                 measured winners the engine resolves at init
+                 (ops/autotune.py)
 4. decode prof — scripts/profile_decode.py → PROFILE_DECODE.json, the
                  steady-state hot-loop phase split (schedule/upload/
                  dispatch/readback/post) that located the cross-backend

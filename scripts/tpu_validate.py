@@ -404,6 +404,106 @@ def bench_ragged_packed(iters: int) -> list[dict]:
     return rows
 
 
+# the standard autotuned geometries: the tiny tier-1 test shape and the
+# llama3-8b serving shape.  The cost-model rows for these are COMMITTED in
+# KERNEL_PERF.json (tests/bench/test_kernel_perf_ragged.py ratchets them),
+# and --out rewrites the whole table, so the bench must regenerate them.
+AUTOTUNE_GEOMETRIES = (
+    # (num_heads, num_kv_heads, head_dim, block_size, lanes,
+    #  max_blocks_per_seq, dtypes, buckets)
+    (4, 2, 16, 4, 4, 32, ("float32",), (16, 32, 64, 128)),
+    (32, 8, 128, 16, 16, 256, ("float32", "bfloat16", "float8_e4m3fn"),
+     (32, 64, 128, 256, 512, 1024, 2048, 4096)),
+)
+
+
+def bench_autotune(iters: int) -> list[dict]:
+    """Ragged-kernel tunable sweep (ops/autotune.py): tb_tokens x
+    page_slots x pages_per_step per geometry.  Off-TPU the deterministic
+    cost model scores the grid (hardware-independent rows, device_kind=
+    "any"); on real hardware each candidate is additionally WALL-CLOCK
+    timed over a synthetic decode-heavy window and the measured winner is
+    stamped with this chip's device_kind.  The swept grid prints to
+    stdout per candidate; only winner rows enter the table."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.ops import autotune
+    from dynamo_tpu.ops.pallas import pack_page_meta, ragged_paged_attention
+
+    dev = jax.devices()[0]
+    rows = []
+    for h, kvh, d, bs, lanes, mb, dtypes, buckets in AUTOTUNE_GEOMETRIES:
+        geom = autotune.Geometry(
+            num_heads=h, num_kv_heads=kvh, head_dim=d, block_size=bs,
+            lanes=lanes, max_blocks_per_seq=mb,
+        )
+        for dtype in dtypes:
+            # hardware-independent cost-model winner (always emitted: the
+            # committed rows the tier-1 ratchet diffs must survive --out)
+            modeled = autotune.sweep(geom, dtype=dtype, buckets=buckets)
+            for cand in modeled.pop("grid"):
+                print(json.dumps({"bench": "autotune_grid",
+                                  "geometry": geom.key, "dtype": dtype,
+                                  "source": "cost_model", **cand}))
+            rows.append(modeled)
+        if INTERPRET:
+            continue  # interpret wall clocks say nothing about hardware
+
+        # measured sweep at the serving dtype: time the compiled kernel on
+        # this chip over the decode-heavy synthetic window
+        jdt = jnp.bfloat16
+        rng = np.random.default_rng(0)
+        pool = lanes * mb + 8
+        k = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jdt)
+        v = jnp.asarray(rng.standard_normal((pool, bs, kvh, d)), jdt)
+
+        def runner(cand):
+            tb = cand["tb_tokens"]
+            ps = cand["page_slots"]
+            pps = cand["pages_per_step"]
+            token_lane, token_pos, bt = autotune._synthetic_workloads(
+                geom, tb
+            )[0]
+            try:
+                meta = pack_page_meta(
+                    token_lane, token_pos, bt, tb_tokens=tb,
+                    block_size=bs, page_slots=ps,
+                )
+            except ValueError:
+                return None  # candidate cannot hold the workload
+            q = jnp.asarray(
+                rng.standard_normal((token_lane.shape[0], h, d)), jdt
+            )
+            fn = jax.jit(
+                lambda q, k, v, tl, tp, pp, pl, po, pc: ragged_paged_attention(
+                    q, k, v, tl, tp, pp, pl, po, pc, tb_tokens=tb,
+                    pages_per_step=pps, interpret=INTERPRET,
+                ).astype(q.dtype)
+            )
+            chain = lambda a, out: (out,) + a[1:]  # noqa: E731
+            us = _time_us(
+                fn, q, k, v,
+                jnp.asarray(token_lane), jnp.asarray(token_pos),
+                *(jnp.asarray(a) for a in meta),
+                iters=iters, chain=chain,
+            )
+            print(json.dumps({"bench": "autotune_grid",
+                              "geometry": geom.key, "dtype": "bfloat16",
+                              "source": "measured", **cand,
+                              "us": round(us, 1)}))
+            return us
+
+        measured = autotune.sweep(
+            geom, dtype="bfloat16", buckets=buckets, runner=runner,
+            device_kind=dev.device_kind,
+        )
+        measured.pop("grid")
+        rows.append(measured)
+    return rows
+
+
 def bench_calibration(iters: int) -> list[dict]:
     """Self-check rows proving the timing methodology: a dependent-chain
     matmul with known FLOPs and a dependent-chain stream with known bytes.
@@ -457,12 +557,18 @@ def run_bench(out_path: str | None) -> int:
         "note": (
             "interpret-mode timings: NOT hardware-representative; the "
             "engine ignores this table" if INTERPRET else
-            "compiled on real hardware; attention_impl=auto consults this"
+            "compiled on real hardware; attention_impl=auto consults this. "
+            "autotune_ragged rows (ops/autotune.py schema v1) carry the "
+            "tuned ragged-kernel configs keyed (geometry, device_kind, "
+            "dtype): cost_model rows are chip-blind (device_kind=any), "
+            "measured rows bind only on their exact device_kind; engine "
+            "precedence is explicit DYN_AUTOTUNE_* knob > tuned row > "
+            "heuristic default"
         ),
         "rows": [],
     }
     for fn in (bench_calibration, bench_attention, bench_block_copy,
-               bench_ragged_packed):
+               bench_ragged_packed, bench_autotune):
         try:
             rows = fn(iters)
         except Exception as exc:  # noqa: BLE001 — independent benches

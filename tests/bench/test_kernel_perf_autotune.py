@@ -1,0 +1,300 @@
+"""Kernel autotuner (ops/autotune.py): deterministic CPU sweeps, row
+provenance, persistence cache hits, and the engine's resolution chain
+(explicit knob > tuned KERNEL_PERF.json row > heuristic default).
+
+Everything here is tier-1: the cost model runs the REAL host packer over
+synthetic workloads — no wall clock, no RNG — so the same geometry always
+produces the same winner on any box.
+"""
+
+import json
+
+import jax
+import pytest
+
+from dynamo_tpu.ops import autotune
+
+
+TINY = autotune.Geometry(
+    num_heads=4, num_kv_heads=2, head_dim=16,
+    block_size=4, lanes=4, max_blocks_per_seq=32,
+)
+
+
+def test_sweep_winner_is_deterministic_and_feasible():
+    a = autotune.sweep(TINY, buckets=(16, 32, 64))
+    b = autotune.sweep(TINY, buckets=(16, 32, 64))
+    grid_a = a.pop("grid")
+    b.pop("grid")
+    assert a == b
+    # provenance: a CPU sweep is a hardware-independent cost-model row
+    assert a["bench"] == autotune.RAGGED_BENCH
+    assert a["source"] == "cost_model"
+    assert a["device_kind"] == "any"
+    assert a["dtype"] == "float32"
+    assert a["version"] == autotune.SCHEMA_VERSION
+    assert a["geometry"] == TINY.key == "h4kv2d16-bs4-l4-mb32"
+    assert a["swept"] == len(grid_a) >= 8
+    # the winner must be feasible: page_slots fits the synthetic
+    # workloads and is a pages_per_step multiple
+    assert a["page_slots"] % a["pages_per_step"] == 0
+    need, _ = autotune._pack_stats(TINY, a["tb_tokens"])
+    assert a["page_slots"] >= need
+    # every bucket stays packable at the tuned tb
+    assert all(b_ % a["tb_tokens"] == 0 for b_ in (16, 32, 64))
+    # the tuned width beats the legacy full width in the model: the sweep
+    # exists to stop paying dead pad ticks
+    full = a["tb_tokens"] * TINY.max_blocks_per_seq
+    assert a["page_slots"] <= full
+
+
+def test_cost_model_orders_tight_over_oversized():
+    """An oversized worklist pays _C_PAD per dead slot: for the same
+    (tb, pps) the tight width must never score worse."""
+    tb = 4
+    need, _ = autotune._pack_stats(TINY, tb)
+    tight = autotune.cost_model(TINY, tb, need, 1)
+    full = autotune.cost_model(TINY, tb, tb * TINY.max_blocks_per_seq, 1)
+    assert tight is not None and full is not None
+    assert tight < full
+    # infeasible candidates report None, not a bogus score
+    assert autotune.cost_model(TINY, tb, max(1, need - 1), 1) is None
+
+
+def test_tune_persists_and_rerun_is_cache_hit(tmp_path):
+    path = tmp_path / "KERNEL_PERF.json"
+    row, cached = autotune.tune(path, TINY, buckets=(16, 32))
+    assert cached is False
+    table = json.loads(path.read_text())
+    assert [r["geometry"] for r in table["rows"]] == [TINY.key]
+    # the persisted row carries full provenance but not the swept grid
+    assert "grid" not in table["rows"][0]
+    for key in ("bench", "geometry", "device_kind", "dtype", "source",
+                "version", "tb_tokens", "page_slots", "pages_per_step",
+                "cost", "swept"):
+        assert key in table["rows"][0], key
+    before = path.read_text()
+    row2, cached2 = autotune.tune(path, TINY, buckets=(16, 32))
+    assert cached2 is True
+    assert row2 == row
+    assert path.read_text() == before  # no-op: file untouched
+    # header and unrelated rows survive an upsert
+    table["platform"] = "tpu"
+    table["rows"].append({"bench": "calib_matmul", "tflops": 1.0})
+    path.write_text(json.dumps(table))
+    other = autotune.Geometry(
+        num_heads=8, num_kv_heads=8, head_dim=64,
+        block_size=8, lanes=8, max_blocks_per_seq=16,
+    )
+    autotune.tune(path, other, buckets=(32,))
+    table2 = json.loads(path.read_text())
+    assert table2["platform"] == "tpu"
+    benches = [r["bench"] for r in table2["rows"]]
+    assert benches.count("calib_matmul") == 1
+    assert benches.count(autotune.RAGGED_BENCH) == 2
+
+
+def test_measured_rows_outrank_cost_model_rows():
+    modeled = {
+        "bench": autotune.RAGGED_BENCH, "geometry": TINY.key,
+        "device_kind": "any", "dtype": "float32", "source": "cost_model",
+        "version": 1, "tb_tokens": 4, "page_slots": 8, "pages_per_step": 1,
+    }
+    measured = dict(modeled, device_kind="TPU v5 lite", source="measured",
+                    page_slots=16, pages_per_step=4)
+    table = {"rows": [modeled, measured]}
+    # exact-kind measured row wins
+    got = autotune.resolve(
+        table, geometry_key=TINY.key, device_kind="TPU v5 lite",
+        dtype="float32",
+    )
+    assert got is measured
+    # a different chip falls back to the hardware-independent row
+    got = autotune.resolve(
+        table, geometry_key=TINY.key, device_kind="TPU v6e", dtype="float32",
+    )
+    assert got is modeled
+    # dtype and geometry are part of the key
+    assert autotune.resolve(
+        table, geometry_key=TINY.key, device_kind=None, dtype="bfloat16",
+    ) is None
+    assert autotune.resolve(
+        table, geometry_key="h1kv1d8-bs4-l2-mb4", device_kind=None,
+        dtype="float32",
+    ) is None
+
+
+def test_measured_runner_stamps_device_kind():
+    calls = []
+
+    def runner(cand):
+        calls.append(cand)
+        # pretend pps=2 candidates are fastest on this "hardware"
+        return 10.0 if cand["pages_per_step"] == 2 else 100.0
+
+    row = autotune.sweep(
+        TINY, buckets=(16, 32), runner=runner, device_kind="TPU v5 lite",
+    )
+    assert row["source"] == "measured"
+    assert row["device_kind"] == "TPU v5 lite"
+    assert row["pages_per_step"] == 2
+    assert len(calls) == row["swept"]
+
+
+# ---------------------------------------------------------------- engine
+
+
+def _engine(tmp_path, monkeypatch, table_rows=None, **overrides):
+    from tests.engine.test_jax_engine import make_engine
+
+    if table_rows is not None:
+        path = tmp_path / "perf.json"
+        path.write_text(json.dumps({"rows": table_rows}))
+        monkeypatch.setenv("DYN_KERNEL_PERF", str(path))
+    return make_engine(**overrides)
+
+
+def _tuned_row(**kw):
+    row = {
+        "bench": autotune.RAGGED_BENCH, "geometry": TINY.key,
+        "device_kind": "any", "dtype": "float32", "source": "cost_model",
+        "version": 1, "tb_tokens": 4, "page_slots": 8, "pages_per_step": 2,
+    }
+    row.update(kw)
+    return row
+
+
+def test_engine_resolves_tuned_row(tmp_path, monkeypatch):
+    """The tiny test engine (geometry == TINY) must pick its tunables from
+    a matching autotune row and report the provenance in stats()."""
+    engine = _engine(
+        tmp_path, monkeypatch, table_rows=[_tuned_row()],
+        num_blocks=64, block_size=4, max_batch_size=4, max_model_len=128,
+    )
+    try:
+        kc = engine.stats()["kernel_config"]
+        assert kc["source"] == "tuned"
+        assert kc["geometry"] == TINY.key
+        assert (kc["tb_tokens"], kc["page_slots"], kc["pages_per_step"]) == (4, 8, 2)
+        assert engine._unified_tb == 4
+        assert engine._unified_ps == 8
+        assert engine._unified_pps == 2
+        # the overflow rung stays the full width, pps-aligned
+        assert engine._unified_ps_full == 4 * 32
+    finally:
+        engine.stop()
+
+
+def test_engine_default_without_rows(tmp_path, monkeypatch):
+    engine = _engine(
+        tmp_path, monkeypatch, table_rows=[],
+        num_blocks=64, block_size=4, max_batch_size=4, max_model_len=128,
+    )
+    try:
+        kc = engine.stats()["kernel_config"]
+        assert kc["source"] == "default"
+        assert kc["tb_tokens"] == 4          # gcd(block_size=4, 8)
+        assert kc["page_slots"] == 4 * 32    # legacy full width
+        assert kc["pages_per_step"] == 1
+        assert engine.stats()["unified_ps_overflows_total"] == 0
+    finally:
+        engine.stop()
+
+
+def test_engine_knob_outranks_tuned_row(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_AUTOTUNE_PAGE_SLOTS", "24")
+    monkeypatch.setenv("DYN_AUTOTUNE_PAGES_PER_STEP", "4")
+    engine = _engine(
+        tmp_path, monkeypatch, table_rows=[_tuned_row()],
+        num_blocks=64, block_size=4, max_batch_size=4, max_model_len=128,
+    )
+    try:
+        kc = engine.stats()["kernel_config"]
+        assert kc["source"] == "knob"
+        assert kc["page_slots"] == 24
+        assert kc["pages_per_step"] == 4
+    finally:
+        engine.stop()
+
+
+def test_engine_autotune_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("DYN_AUTOTUNE", "0")
+    engine = _engine(
+        tmp_path, monkeypatch, table_rows=[_tuned_row()],
+        num_blocks=64, block_size=4, max_batch_size=4, max_model_len=128,
+    )
+    try:
+        assert engine.stats()["kernel_config"]["source"] == "default"
+    finally:
+        engine.stop()
+
+
+def test_engine_rejects_tuned_tb_that_breaks_buckets(tmp_path, monkeypatch):
+    """A tuned tb that cannot pack every unified bucket must fall back to
+    the heuristic default (warn, not wedge every window into the split
+    path) — and the tuned ps/pps are dropped with it (they were chosen
+    FOR that tb)."""
+    engine = _engine(
+        tmp_path, monkeypatch,
+        table_rows=[_tuned_row(tb_tokens=16, page_slots=32)],
+        num_blocks=64, block_size=4, max_batch_size=4, max_model_len=128,
+        prefill_buckets=(24, 48),
+    )
+    try:
+        kc = engine.stats()["kernel_config"]
+        assert kc["source"] == "default"
+        assert kc["tb_tokens"] == 4
+        assert kc["pages_per_step"] == 1
+    finally:
+        engine.stop()
+
+
+# ------------------------------------------- per-shape attention_impl=auto
+
+
+def _shape_table(tmp_path, monkeypatch, rows, **header):
+    from dynamo_tpu.engine.engine import _measured_attention_preference
+
+    table = {"platform": "tpu", "interpret": False, **header, "rows": rows}
+    path = tmp_path / "perf.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setenv("DYN_KERNEL_PERF", str(path))
+    return _measured_attention_preference
+
+
+def test_attention_auto_per_shape_routing(tmp_path, monkeypatch):
+    """attention_impl=auto honors the measured row NEAREST to this
+    engine's (batch, ctx): batch-16 engines route to the XLA twin where
+    batch-16 rows show Pallas losing, while batch-64 engines still get
+    the kernel — same table, different shapes."""
+    rows = [
+        {"bench": "paged_attention_decode", "batch": 16, "ctx": 1024,
+         "pallas_speedup": 0.81},
+        {"bench": "paged_attention_decode", "batch": 32, "ctx": 2048,
+         "pallas_speedup": 0.82},
+        {"bench": "paged_attention_decode", "batch": 64, "ctx": 1024,
+         "pallas_speedup": 1.41},
+    ]
+    pref = _shape_table(tmp_path, monkeypatch, rows)
+    assert pref(batch=16, ctx=1024) == "jax"
+    assert pref(batch=32, ctx=2048) == "jax"
+    assert pref(batch=64, ctx=1024) == "pallas"
+    # shapes off the measured grid snap to the nearest row in log space
+    assert pref(batch=48, ctx=1024) == "pallas"   # log-nearer 64 than 32
+    assert pref(batch=8, ctx=512) == "jax"
+    # no shape → median over all rows (legacy whole-table decision)
+    assert pref() == "jax"
+
+
+def test_attention_auto_table_gates_still_hold(tmp_path, monkeypatch):
+    rows = [{"bench": "paged_attention_decode", "batch": 16, "ctx": 1024,
+             "pallas_speedup": 0.5}]
+    # interpret-mode tables say nothing about hardware
+    pref = _shape_table(tmp_path, monkeypatch, rows, interpret=True)
+    assert pref(batch=16, ctx=1024) is None
+    # a table from a different chip generation is ignored when kind known
+    pref = _shape_table(
+        tmp_path, monkeypatch, rows, device_kind="TPU v4",
+    )
+    assert pref("TPU v5 lite", batch=16, ctx=1024) is None
+    assert pref("TPU v4", batch=16, ctx=1024) == "jax"

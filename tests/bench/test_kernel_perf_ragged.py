@@ -77,3 +77,37 @@ def test_bench_path_reproduces_rows_in_interpret_mode():
         assert r["blocks_packed"] == -(-r["lanes"] // r["tb_tokens"])
         assert r["blocks_padded"] == r["lanes"]
         assert r["packed_us"] > 0 and r["padded_us"] > 0
+
+
+def test_artifact_autotune_rows_match_cost_model():
+    """Ratchet for tuned rows: every committed ``autotune_ragged``
+    cost-model row must be exactly what ops/autotune.py's deterministic
+    sweep produces for its geometry today — a cost-model or packer change
+    that moves a winner must ship a regenerated KERNEL_PERF.json."""
+    import re
+
+    from dynamo_tpu.ops import autotune
+
+    rows = [
+        r for r in json.loads(ARTIFACT.read_text())["rows"]
+        if r.get("bench") == autotune.RAGGED_BENCH
+        and r.get("source") == "cost_model"
+    ]
+    assert rows, "KERNEL_PERF.json lost its autotune_ragged rows"
+    # the committed set must cover the tiny tier-1 geometry AND a
+    # headline serving geometry
+    keys = {r["geometry"] for r in rows}
+    assert "h4kv2d16-bs4-l4-mb32" in keys
+    assert any(k.startswith("h32") for k in keys)
+    pat = re.compile(r"h(\d+)kv(\d+)d(\d+)-bs(\d+)-l(\d+)-mb(\d+)")
+    for r in rows:
+        assert r["device_kind"] == "any", r       # cost model is chip-blind
+        assert r["version"] == autotune.SCHEMA_VERSION, r
+        h, kvh, d, bs, lanes, mb = map(int, pat.fullmatch(r["geometry"]).groups())
+        geom = autotune.Geometry(
+            num_heads=h, num_kv_heads=kvh, head_dim=d,
+            block_size=bs, lanes=lanes, max_blocks_per_seq=mb,
+        )
+        fresh = autotune.sweep(geom, dtype=r["dtype"])
+        for key in ("tb_tokens", "page_slots", "pages_per_step", "cost"):
+            assert fresh[key] == r[key], (key, fresh[key], r)

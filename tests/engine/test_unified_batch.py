@@ -377,10 +377,18 @@ async def test_unified_knob_env_and_auto_disable(monkeypatch):
     assert engine.unified_batch is False
     assert engine.stats()["unified_fallbacks"].get("multi_step_decode") == 1
     engine.stop()
-    # narrowed KV dtype breaks split-vs-unified byte parity
+    # narrowed FLOAT KV dtypes (fp8/bf16) flow through unified: kernels and
+    # twins upcast reads, write_decode_kv casts on write
     engine = make_engine(unified_batch=True, kv_cache_dtype="fp8")
+    assert engine.unified_batch is True
+    assert not engine.stats()["unified_fallbacks"]
+    engine.stop()
+    # non-float narrowings have no unified kernel read path
+    import jax.numpy as jnp
+
+    engine = make_engine(unified_batch=True, kv_cache_dtype=jnp.int8)
     assert engine.unified_batch is False
-    assert engine.stats()["unified_fallbacks"].get("narrowed_kv_dtype") == 1
+    assert engine.stats()["unified_fallbacks"].get("unsupported_kv_dtype") == 1
     engine.stop()
 
 

@@ -276,7 +276,8 @@ def ragged_meta(spans, lanes, tb=8, t_pad=None):
     )
 
 
-def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None, sliding_window=None):
+def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None, sliding_window=None,
+               page_slots=None, pages_per_step=1, cache_dtype=None):
     """Kernel + pure-JAX twin over the shared test cache; returns
     (kernel_out, ref_out, token_pos host array, q)."""
     from dynamo_tpu.ops.attention import ragged_paged_attention as ragged_ref
@@ -287,10 +288,14 @@ def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None, sliding_window=None):
 
     rng = jax.random.PRNGKey(0)
     k_cache, v_cache, tables, _ = build_cache(rng)
+    if cache_dtype is not None:
+        k_cache = k_cache.astype(cache_dtype)
+        v_cache = v_cache.astype(cache_dtype)
     token_lane, token_pos, ctx = ragged_meta(spans, lanes, tb=tb, t_pad=t_pad)
     page_meta = pack_page_meta(
         token_lane, token_pos, tables, tb_tokens=tb,
         block_size=k_cache.shape[1], sliding_window=sliding_window,
+        page_slots=page_slots,
     )
     t = token_lane.shape[0]
     q = jax.random.normal(jax.random.fold_in(rng, q_key), (t, 4, 128), jnp.float32)
@@ -302,6 +307,7 @@ def run_ragged(spans, q_key=9, lanes=3, tb=8, t_pad=None, sliding_window=None):
         q, k_cache, v_cache, token_lane, token_pos,
         *(jnp.asarray(a) for a in page_meta),
         tb_tokens=tb, interpret=True, sliding_window=sliding_window,
+        pages_per_step=pages_per_step,
     )
     return np.asarray(out), np.asarray(ref), np.asarray(token_pos), q
 
@@ -533,3 +539,144 @@ def test_paged_attention_sliding_window_matches_fallback():
     full = np.asarray(paged_decode_attention(q, k, v, tables, ctx))
     win = np.asarray(paged_decode_attention(q, k, v, tables, ctx, sliding_window=4))
     assert not np.allclose(full, win)
+
+
+def test_ragged_attention_pages_per_step_parity():
+    """Multi-page DMA batching (pages_per_step > 1) is a pure grid
+    relayout: every pps that divides the worklist width must reproduce the
+    pps=1 result byte-for-byte, and the twin within tolerance."""
+    spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
+    base, ref, token_pos, _ = run_ragged(spans, page_slots=8, pages_per_step=1)
+    valid = token_pos >= 0
+    np.testing.assert_allclose(base[valid], ref[valid], rtol=2e-5, atol=2e-5)
+    for pps in (2, 8):
+        out, _, _, _ = run_ragged(spans, page_slots=8, pages_per_step=pps)
+        np.testing.assert_array_equal(out[valid], base[valid])
+    # non-divisible pps is a static-shape error, not silent corruption
+    with pytest.raises(ValueError, match="pages_per_step"):
+        run_ragged(spans, page_slots=12, pages_per_step=8)
+
+
+def test_paged_attention_pages_per_step_parity():
+    """Decode kernel: clamped multi-page grid steps match pps=1 exactly,
+    including pps values that do not divide (or exceed) max_blocks."""
+    rng = jax.random.PRNGKey(0)
+    k_cache, v_cache, tables, ctx = build_cache(rng)
+    q = jax.random.normal(jax.random.fold_in(rng, 7), (3, 4, 128), jnp.float32)
+    base = np.asarray(paged_attention_decode(
+        q, k_cache, v_cache, tables, ctx, interpret=True
+    ))
+    for pps in (3, 8):
+        out = np.asarray(paged_attention_decode(
+            q, k_cache, v_cache, tables, ctx, interpret=True,
+            pages_per_step=pps,
+        ))
+        np.testing.assert_array_equal(out, base)
+
+
+def test_mla_attention_pages_per_step_parity():
+    """MLA decode + ragged MLA kernels under pages_per_step match their
+    pps=1 results exactly."""
+    from dynamo_tpu.ops.attention import ragged_mla_paged_attention
+    from dynamo_tpu.ops.pallas import pack_page_meta, ragged_mla_attention
+    from dynamo_tpu.ops.pallas.mla_attention import mla_paged_attention_decode
+
+    rng = np.random.default_rng(5)
+    nb, bs, R, P, h, maxb = 12, 8, 128, 64, 4, 4
+    ck = jnp.asarray(rng.standard_normal((nb, bs, R)), jnp.float32)
+    kr = jnp.asarray(rng.standard_normal((nb, bs, P)), jnp.float32)
+    tables = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]], jnp.int32)
+    ctx = jnp.asarray([5, 17, 29], jnp.int32)
+    scale = 1.0 / np.sqrt(R + P)
+    q_lat = jnp.asarray(rng.standard_normal((3, h, R)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((3, h, P)), jnp.float32)
+    base = np.asarray(mla_paged_attention_decode(
+        q_lat, q_rope, ck, kr, tables, ctx, scale=scale, interpret=True
+    ))
+    for pps in (2, 3):
+        out = np.asarray(mla_paged_attention_decode(
+            q_lat, q_rope, ck, kr, tables, ctx, scale=scale, interpret=True,
+            pages_per_step=pps,
+        ))
+        np.testing.assert_array_equal(out, base)
+
+    # ragged MLA: mixed chunk + decode spans
+    lanes, tb = 3, 8
+    token_lane, token_pos, _ = ragged_meta(
+        [(0, 4, 1), (1, 8, 9), (2, 28, 1)], lanes, tb=tb
+    )
+    meta = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=tb, block_size=bs,
+        page_slots=8,
+    )
+    t = token_lane.shape[0]
+    ql = jnp.asarray(rng.standard_normal((t, h, R)), jnp.float32)
+    qr = jnp.asarray(rng.standard_normal((t, h, P)), jnp.float32)
+    rbase = np.asarray(ragged_mla_attention(
+        ql, qr, ck, kr, token_lane, token_pos,
+        *(jnp.asarray(a) for a in meta),
+        scale=scale, tb_tokens=tb, interpret=True,
+    ))
+    valid = np.asarray(token_pos) >= 0
+    rref = np.asarray(ragged_mla_paged_attention(
+        ql, qr, ck, kr, tables, token_lane, token_pos, scale=scale,
+    ))
+    np.testing.assert_allclose(rbase[valid], rref[valid], rtol=2e-5, atol=2e-5)
+    for pps in (2, 8):
+        rout = np.asarray(ragged_mla_attention(
+            ql, qr, ck, kr, token_lane, token_pos,
+            *(jnp.asarray(a) for a in meta),
+            scale=scale, tb_tokens=tb, interpret=True, pages_per_step=pps,
+        ))
+        np.testing.assert_array_equal(rout[valid], rbase[valid])
+
+
+def test_ragged_attention_fp8_cache():
+    """fp8 KV read inside the packed ragged kernel: the kernel upcasts
+    page reads to f32, so it must agree with the XLA twin reading the SAME
+    fp8 cache (tight tolerance — identical quantized inputs), and sit
+    within quantization error of the f32 result."""
+    fp8 = jnp.float8_e4m3fn
+    spans = [(0, 4, 1), (1, 8, 9), (2, 28, 1)]
+    out8, ref8, token_pos, _ = run_ragged(spans, cache_dtype=fp8)
+    valid = token_pos >= 0
+    np.testing.assert_allclose(out8[valid], ref8[valid], rtol=2e-5, atol=2e-5)
+    out32, _, _, _ = run_ragged(spans)
+    rel = np.linalg.norm(out8[valid] - out32[valid]) / max(
+        np.linalg.norm(out32[valid]), 1e-9
+    )
+    assert 0 < rel < 0.12, rel  # quantized but sane
+
+
+def test_ragged_mla_attention_fp8_cache():
+    """fp8 latent+rope cache through the ragged MLA kernel vs its twin."""
+    from dynamo_tpu.ops.attention import ragged_mla_paged_attention
+    from dynamo_tpu.ops.pallas import pack_page_meta, ragged_mla_attention
+
+    fp8 = jnp.float8_e4m3fn
+    rng = np.random.default_rng(6)
+    nb, bs, R, P, h = 12, 8, 128, 64, 4
+    ck = jnp.asarray(rng.standard_normal((nb, bs, R)), jnp.float32).astype(fp8)
+    kr = jnp.asarray(rng.standard_normal((nb, bs, P)), jnp.float32).astype(fp8)
+    tables = jnp.asarray([[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]], jnp.int32)
+    lanes, tb = 3, 8
+    token_lane, token_pos, _ = ragged_meta(
+        [(0, 4, 1), (1, 8, 9), (2, 28, 1)], lanes, tb=tb
+    )
+    meta = pack_page_meta(
+        token_lane, token_pos, tables, tb_tokens=tb, block_size=bs
+    )
+    t = token_lane.shape[0]
+    scale = 1.0 / np.sqrt(R + P)
+    ql = jnp.asarray(rng.standard_normal((t, h, R)), jnp.float32)
+    qr = jnp.asarray(rng.standard_normal((t, h, P)), jnp.float32)
+    out = np.asarray(ragged_mla_attention(
+        ql, qr, ck, kr, token_lane, token_pos,
+        *(jnp.asarray(a) for a in meta),
+        scale=scale, tb_tokens=tb, interpret=True,
+    ))
+    ref = np.asarray(ragged_mla_paged_attention(
+        ql, qr, ck, kr, tables, token_lane, token_pos, scale=scale,
+    ))
+    valid = np.asarray(token_pos) >= 0
+    np.testing.assert_allclose(out[valid], ref[valid], rtol=2e-5, atol=2e-5)
