@@ -10,6 +10,7 @@ Usage:
   python -m dynamo_tpu.cli.dynctl drain INSTANCE_ID [--timeout S] [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl migrate REQUEST_ID DST [--reason R] [--control-plane H:P]
   python -m dynamo_tpu.cli.dynctl topology [--json] [--control-plane H:P]
+  python -m dynamo_tpu.cli.dynctl flight dump [INSTANCE_ID] [--control-plane H:P]
 """
 
 from __future__ import annotations
@@ -138,6 +139,58 @@ async def _amain(args) -> int:
                 f"duration={result.get('duration_s')}s deregistered={gone}"
             )
             return 0 if result.get("ok") and gone else 1
+        elif args.cmd == "flight":
+            from dynamo_tpu.runtime.component import ctl_subject
+
+            # resolve instances: an explicit id (hex prefix ok) or, with no
+            # argument, every registered instance gets a dump request
+            needle = (args.instance or "").lower()
+            if needle.startswith("0x"):
+                needle = needle[2:]
+            matches = []
+            for e in await plane.kv.get_prefix(ROOT_PATH):
+                if "/instances/" not in e.key:
+                    continue
+                d = json.loads(e.value)
+                hex16 = f"{d['instance_id']:016x}"
+                if (not needle or needle in (hex16, f"{d['instance_id']:x}")
+                        or hex16.startswith(needle)):
+                    matches.append(d)
+            if not matches:
+                print(
+                    f"no instance matches {args.instance!r}" if args.instance
+                    else "(no instances registered)"
+                )
+                return 1
+            if args.instance and len(matches) > 1:
+                print(f"ambiguous instance id {args.instance!r} ({len(matches)} matches)")
+                return 1
+            failed = False
+            for inst in matches:
+                try:
+                    reply = await plane.bus.request(
+                        ctl_subject(inst["subject"]),
+                        json.dumps({"op": "flight_dump"}).encode(),
+                        timeout=args.timeout,
+                    )
+                    result = json.loads(reply.decode())
+                except (asyncio.TimeoutError, RuntimeError):
+                    print(f"{inst['subject']}: no reply (worker gone?)")
+                    failed = True
+                    continue
+                if not result.get("ok"):
+                    print(f"{inst['subject']}: {result.get('error', 'dump failed')}")
+                    failed = True
+                    continue
+                paths = result.get("paths") or []
+                if not result.get("enabled", True):
+                    print(f"{inst['subject']}: flight recorder disabled (DYN_FLIGHT=0)")
+                elif not paths:
+                    print(f"{inst['subject']}: no recorders live (nothing dumped)")
+                else:
+                    for path in paths:
+                        print(f"{inst['subject']}: {path}")
+            return 1 if failed else 0
         elif args.cmd == "migrate":
             from dynamo_tpu.runtime.migration import MIGRATE_SUBJECT
 
@@ -220,6 +273,17 @@ def main() -> int:
     mig.add_argument("--timeout", type=float, default=30.0,
                      help="seconds to wait for the owning dispatcher's reply")
     mig.add_argument("--control-plane", default="127.0.0.1:2379")
+    fl = sub.add_parser(
+        "flight", help="perf flight recorder operations (dump)"
+    )
+    fl.add_argument("action", choices=["dump"],
+                    help="dump: write every live recorder's ring to JSONL")
+    fl.add_argument("instance", nargs="?", default=None,
+                    help="instance id (hex, prefix ok); omit to dump every "
+                         "registered instance")
+    fl.add_argument("--timeout", type=float, default=10.0,
+                    help="seconds to wait for each worker's reply")
+    fl.add_argument("--control-plane", default="127.0.0.1:2379")
     args = parser.parse_args()
     return asyncio.run(_amain(args))
 

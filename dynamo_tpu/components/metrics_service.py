@@ -266,6 +266,39 @@ class MetricsService:
             "Hot shared prefixes pinned tier-resident",
             ["worker", "tier"], registry=self.registry,
         )
+        # perf flight recorder (observability/flight.py via engine stats):
+        # ring bookkeeping per worker — mirrored remote counters, so gauges
+        # with the canonical *_total names (same rationale as above).  The
+        # last-dump reason rides as a label on a value-1 info series
+        # (dyn_topology_worker_info precedent) — the dyn_top FLIGHT column
+        # reads it.
+        self.flight_records = Gauge(
+            "dyn_flight_records_total",
+            "Flight-recorder records captured (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.flight_dropped = Gauge(
+            "dyn_flight_dropped_total",
+            "Flight-recorder records evicted over the byte budget (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.flight_dumps = Gauge(
+            "dyn_flight_dumps_total",
+            "Flight-recorder JSONL dumps written (cumulative)",
+            ["worker"], registry=self.registry,
+        )
+        self.flight_buffer = Gauge(
+            "dyn_flight_buffer_bytes",
+            "Flight-recorder ring occupancy in bytes",
+            ["worker"], registry=self.registry,
+        )
+        self.flight_last_dump = Gauge(
+            "dyn_flight_last_dump_info",
+            "Per-worker last flight-dump trigger (value always 1; the "
+            "reason rides as a label; absent until something dumped)",
+            ["worker", "reason"], registry=self.registry,
+        )
+        self._seen_flight_dumps: set[tuple[str, str]] = set()
         self._worker_gauges = (
             self.kv_active, self.kv_total, self.cache_usage, self.waiting,
             self.running, self.batch_occupancy, self.preemptions,
@@ -281,6 +314,8 @@ class MetricsService:
             self.disagg_transfer_seconds, self.disagg_transfer_hidden,
             self.disagg_transfer_parts, self.disagg_hidden_ratio,
             self.disagg_bandwidth,
+            self.flight_records, self.flight_dropped, self.flight_dumps,
+            self.flight_buffer,
         )
         self._seen_workers: set[str] = set()
         self._seen_phases: set[tuple[str, str]] = set()
@@ -503,6 +538,13 @@ class MetricsService:
                 except KeyError:
                     pass
                 self._seen_fallback_reasons.discard((label, reason))
+        for label, reason in list(self._seen_flight_dumps):
+            if label not in live:
+                try:
+                    self.flight_last_dump.remove(label, reason)
+                except KeyError:
+                    pass
+                self._seen_flight_dumps.discard((label, reason))
         for label, tier in list(self._seen_tiers):
             if label not in live:
                 for g in (
@@ -581,6 +623,23 @@ class MetricsService:
                 m.disagg_transfer_hidden_ratio
             )
             self.disagg_bandwidth.labels(label).set(m.kv_transfer_bandwidth_bps)
+            self.flight_records.labels(label).set(m.flight_records_total)
+            self.flight_dropped.labels(label).set(m.flight_dropped_total)
+            self.flight_dumps.labels(label).set(m.flight_dumps_total)
+            self.flight_buffer.labels(label).set(m.flight_buffer_bytes)
+            reason_now = m.flight_last_dump_reason or ""
+            if reason_now:
+                self.flight_last_dump.labels(label, reason_now).set(1)
+                self._seen_flight_dumps.add((label, reason_now))
+            # only the LATEST dump reason may stand per worker — a newer
+            # trigger replaces the old series instead of accumulating
+            for seen_label, reason in list(self._seen_flight_dumps):
+                if seen_label == label and reason != reason_now:
+                    try:
+                        self.flight_last_dump.remove(label, reason)
+                    except KeyError:
+                        pass
+                    self._seen_flight_dumps.discard((label, reason))
             for tier, row in (m.offload_tiers or {}).items():
                 self.offload_blocks.labels(label, tier).set(row.get("blocks", 0))
                 self.offload_blocks_used.labels(label, tier).set(row.get("used", 0))

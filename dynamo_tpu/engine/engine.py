@@ -49,7 +49,7 @@ from dynamo_tpu.llm.protocols.common import (
 )
 from dynamo_tpu.models.llama import LlamaConfig
 from dynamo_tpu.models.registry import get_family
-from dynamo_tpu.observability import StepTelemetry, get_recorder
+from dynamo_tpu.observability import FlightRecorder, StepTelemetry, get_recorder
 from dynamo_tpu.observability.perf import UtilizationTracker, model_cost
 from dynamo_tpu.robustness.faults import ENGINE_STEP, FAULTS
 from dynamo_tpu.ops.sampling import (
@@ -669,6 +669,12 @@ class JaxLlmEngine:
                 cfg, quantize=config.quantize, kv_cache_dtype=config.kv_cache_dtype
             )
         )
+        # Perf flight recorder (observability/flight.py): bounded ring of
+        # per-step telemetry + discrete events, dumped to JSONL on demand
+        # (dynctl flight dump) or automatically on burn breach / crash /
+        # drain.  DYN_FLIGHT=0 makes every hook below a no-op.
+        self.flight = FlightRecorder(source="engine")
+        self._flight_preemptions = 0    # last preemption total seen, for deltas
         self._tokens_emitted = 0        # tokens that reached a caller's stream
         self._step_prefill_tokens = 0   # per-iteration scratch, reset each step
         self._step_decode_tokens = 0
@@ -2272,6 +2278,9 @@ class JaxLlmEngine:
             # utilization accounting (observability/perf.py): rolling MFU /
             # bandwidth-utilization / goodput + cumulative token totals
             **self.utilization.stats(),
+            # flight-recorder summary (ring occupancy + dump bookkeeping),
+            # mirrored as dyn_flight_* worker gauges by the metrics service
+            **self.flight.stats(),
         }
         # emitted count from the engine's own synchronous counter: the
         # tracker's copy updates at end-of-iteration, and a caller that just
@@ -2358,9 +2367,37 @@ class JaxLlmEngine:
                     weight_streams=self._step_weight_streams,
                     emitted_tokens=self._tokens_emitted - emitted_before,
                 )
-            except Exception:  # noqa: BLE001 — scheduler-level bug: keep the
-                # thread alive (callers would hang forever), don't hot-spin
+                if self.flight.enabled:
+                    preempted = self.scheduler.preemptions_total
+                    if preempted > self._flight_preemptions:
+                        self.flight.record_event(
+                            "preemption",
+                            count=preempted - self._flight_preemptions,
+                            total=preempted,
+                        )
+                        self._flight_preemptions = preempted
+                    rates = self.utilization.rates()
+                    self.flight.record_step(
+                        iteration=self._iterations,
+                        num_running=self.scheduler.num_running,
+                        num_waiting=self.scheduler.num_waiting,
+                        kv_usage=self.allocator.usage,
+                        prefill_tokens=self._step_prefill_tokens,
+                        decode_tokens=self._step_decode_tokens,
+                        emitted_tokens=self._tokens_emitted - emitted_before,
+                        step_duration_s=step_duration_s,
+                        mfu=rates["mfu_perc"],
+                        goodput_tok_s=rates["goodput_tokens_per_second"],
+                    )
+            except Exception as exc:  # noqa: BLE001 — scheduler-level bug:
+                # keep the thread alive (callers would hang forever), don't
+                # hot-spin
                 logger.exception("engine step failed")
+                if self.flight.enabled:
+                    self.flight.record_event(
+                        "step_error", error=f"{type(exc).__name__}: {exc}"
+                    )
+                    self.flight.maybe_dump("step_error")
                 time.sleep(0.1)
         # shutdown with a window in flight: retire it so already-computed
         # tokens reach their streams instead of vanishing with the thread
@@ -2438,6 +2475,8 @@ class JaxLlmEngine:
         self._unified_fallbacks[reason] = (
             self._unified_fallbacks.get(reason, 0) + 1
         )
+        if self.flight.enabled:
+            self.flight.record_event("unified_fallback", reason=reason)
         if reason not in self._unified_fallback_logged:
             self._unified_fallback_logged.add(reason)
             logger.info(

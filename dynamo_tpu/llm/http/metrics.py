@@ -182,8 +182,9 @@ class FrontendMetrics:
     def render(self) -> bytes:
         # one scrape surface: per-model serving metrics plus the process-
         # wide resilience counters (retries, sheds, control-plane
-        # reconnects), the SLO burn-rate families, topology-map gauges, and
-        # bucket exemplars
+        # reconnects), the SLO burn-rate families, topology-map gauges,
+        # flight-recorder summary, and bucket exemplars
+        from dynamo_tpu.observability import flight
         from dynamo_tpu.topology import metrics as topology_metrics
 
         return (
@@ -191,6 +192,7 @@ class FrontendMetrics:
             + robustness_counters.render()
             + self.slo.render()
             + topology_metrics.render(self.topology)
+            + flight.render()
             + self.exemplars.render()
         )
 
@@ -263,3 +265,9 @@ class InflightGuard:
         # error-rate SLO: only SERVER failures burn budget — client-caused
         # outcomes (client_error, cancelled) must not trip the shed hook
         m.slo.observe_outcome("error_rate", self.status != "error")
+        # flight-recorder burn trigger: a worst-window burn rate above
+        # DYN_FLIGHT_BURN auto-dumps every live recorder (rate-limited
+        # inside — this runs per finished request)
+        from dynamo_tpu.observability import flight
+
+        flight.check_burn(m.slo)

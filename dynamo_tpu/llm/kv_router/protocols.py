@@ -113,6 +113,13 @@ class ForwardPassMetrics:
     disagg_transfer_hidden_ratio: float = 0.0
     transfer_hop: str = ""
     kv_transfer_bandwidth_bps: float = 0.0
+    # perf flight recorder (observability.flight): ring bookkeeping + the
+    # last dump's trigger reason ("" until something dumped)
+    flight_records_total: int = 0
+    flight_dropped_total: int = 0
+    flight_dumps_total: int = 0
+    flight_buffer_bytes: int = 0
+    flight_last_dump_reason: str = ""
 
     def to_json(self) -> bytes:
         return json.dumps(asdict(self)).encode()
@@ -202,6 +209,13 @@ class ForwardPassMetrics:
             ),
             transfer_hop=str(stats.get("transfer_hop", "") or ""),
             kv_transfer_bandwidth_bps=stats.get("kv_transfer_bandwidth_bps", 0.0),
+            flight_records_total=stats.get("flight_records_total", 0),
+            flight_dropped_total=stats.get("flight_dropped_total", 0),
+            flight_dumps_total=stats.get("flight_dumps_total", 0),
+            flight_buffer_bytes=stats.get("flight_buffer_bytes", 0),
+            flight_last_dump_reason=str(
+                stats.get("flight_last_dump_reason", "") or ""
+            ),
         )
 
 

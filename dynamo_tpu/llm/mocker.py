@@ -20,6 +20,7 @@ from typing import AsyncIterator, Callable
 
 from dynamo_tpu.engine.kv_manager import BlockAllocator, KvEvent
 from dynamo_tpu.engine.scheduler import Scheduler
+from dynamo_tpu.observability.flight import FlightRecorder
 from dynamo_tpu.engine.sequence import Sequence, SeqStatus
 from dynamo_tpu.llm.protocols.common import (
     Annotated,
@@ -82,6 +83,10 @@ class MockerEngine:
         self._tokens_emitted_total = 0
         self._prefill_tokens_total = 0
         self._decode_tokens_total = 0
+        # perf flight recorder: same ring + dump triggers as the real engine
+        # so soak fleets produce replayable load traces (DYN_FLIGHT=0 = off)
+        self.flight = FlightRecorder(source="mocker")
+        self._flight_preemptions = 0
 
     def _sink(self, event: KvEvent) -> None:
         if self._event_sink is not None:
@@ -143,6 +148,7 @@ class MockerEngine:
             "tokens_emitted_total": self._tokens_emitted_total,
             "prefill_tokens_total": self._prefill_tokens_total,
             "decode_tokens_total": self._decode_tokens_total,
+            **self.flight.stats(),
         }
 
     async def generate(self, request: Context[dict]) -> ResponseStream[dict]:
@@ -253,6 +259,28 @@ class MockerEngine:
                 prefill_tokens,
                 cost,
             ))
+            if self.flight.enabled:
+                preempted = self.scheduler.preemptions_total
+                if preempted > self._flight_preemptions:
+                    self.flight.record_event(
+                        "preemption",
+                        count=preempted - self._flight_preemptions,
+                        total=preempted,
+                    )
+                    self._flight_preemptions = preempted
+                goodput, prefill_rate, mfu = self._util_rates()
+                self.flight.record_step(
+                    iteration=self._iterations,
+                    num_running=self.scheduler.num_running,
+                    num_waiting=self.scheduler.num_waiting,
+                    kv_usage=self.allocator.usage,
+                    prefill_tokens=prefill_tokens,
+                    decode_tokens=self._tokens_emitted_total - decode_before,
+                    emitted_tokens=self._tokens_emitted_total - emitted_before,
+                    step_duration_s=cost / cfg.speedup,
+                    mfu=mfu,
+                    goodput_tok_s=goodput,
+                )
 
     def _emit_next(self, seq: Sequence) -> None:
         # deterministic "generation": next token = (last + 1) mod 1000

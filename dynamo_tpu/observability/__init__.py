@@ -21,6 +21,7 @@ One request, one ``trace_id``, visible in every layer it touches:
 See docs/observability.md for the metric families, env vars, and formats.
 """
 
+from dynamo_tpu.observability.flight import FlightRecorder, flight_dir, latest_dump, load_dump
 from dynamo_tpu.observability.perf import ModelCost, UtilizationTracker, model_cost
 from dynamo_tpu.observability.recorder import (
     Span,
@@ -33,6 +34,7 @@ from dynamo_tpu.observability.step_metrics import StepTelemetry
 from dynamo_tpu.observability.trace import TraceContext, new_span_id, new_trace_id
 
 __all__ = [
+    "FlightRecorder",
     "ModelCost",
     "SloConfig",
     "SloObjective",
@@ -42,7 +44,10 @@ __all__ = [
     "StepTelemetry",
     "TraceContext",
     "UtilizationTracker",
+    "flight_dir",
     "get_recorder",
+    "latest_dump",
+    "load_dump",
     "model_cost",
     "new_span_id",
     "new_trace_id",

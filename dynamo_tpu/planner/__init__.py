@@ -19,6 +19,7 @@ from dynamo_tpu.planner.load_predictor import (
     EwmaPredictor,
     LinearTrendPredictor,
     make_predictor,
+    replay_trace,
 )
 from dynamo_tpu.planner.perf_interpolation import PerfProfile, ProfilePoint
 from dynamo_tpu.planner.planner import (
@@ -42,6 +43,7 @@ __all__ = [
     "EwmaPredictor",
     "LinearTrendPredictor",
     "make_predictor",
+    "replay_trace",
     "PerfProfile",
     "ProfilePoint",
     "Planner",

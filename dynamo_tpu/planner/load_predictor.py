@@ -2,11 +2,18 @@
 — constant / ARIMA / Prophet).  Here: constant, EWMA, linear-trend, an
 AR(p)-with-differencing forecaster fitted by least squares (the ARIMA(p,d,0)
 role), and a seasonal trend decomposition (the Prophet role) — numpy-only,
-no pandas/pmdarima/Prophet runtime."""
+no pandas/pmdarima/Prophet runtime.
+
+Every predictor also answers ``predict_ahead(steps)`` — the ``steps``-tick
+forecast the planner needs to act BEFORE a load crest instead of reacting
+at it — and ``replay_trace()`` fits a predictor offline from a flight
+recorder dump (observability/flight.py), so a soak's telemetry closes the
+loop back into planning."""
 
 from __future__ import annotations
 
 from collections import deque
+from pathlib import Path
 
 import numpy as np
 
@@ -22,6 +29,9 @@ class ConstantPredictor:
 
     def predict(self) -> float:
         return self._last
+
+    def predict_ahead(self, steps: int = 1) -> float:
+        return self.predict()
 
 
 class EwmaPredictor:
@@ -40,6 +50,10 @@ class EwmaPredictor:
     def predict(self) -> float:
         return self._value or 0.0
 
+    def predict_ahead(self, steps: int = 1) -> float:
+        # the EWMA level is a flat forecast at any horizon
+        return self.predict()
+
 
 class LinearTrendPredictor:
     """Least-squares line over a sliding window, extrapolated one step."""
@@ -51,6 +65,9 @@ class LinearTrendPredictor:
         self._obs.append(value)
 
     def predict(self) -> float:
+        return self.predict_ahead(1)
+
+    def predict_ahead(self, steps: int = 1) -> float:
         n = len(self._obs)
         if n == 0:
             return 0.0
@@ -62,7 +79,7 @@ class LinearTrendPredictor:
         cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._obs))
         var = sum((x - mean_x) ** 2 for x in xs)
         slope = cov / var if var else 0.0
-        return max(0.0, mean_y + slope * (n - mean_x))
+        return max(0.0, mean_y + slope * (n - 1 + steps - mean_x))
 
 
 class ArPredictor:
@@ -110,6 +127,21 @@ class ArPredictor:
             tail = np.diff(tail) if tail.size > 1 else tail
         return float(max(0.0, forecast))
 
+    def predict_ahead(self, steps: int = 1) -> float:
+        # roll the one-step forecast forward, feeding each prediction back
+        # as an observation (the standard iterated AR multi-step forecast);
+        # the window is restored afterwards, so this is side-effect free
+        saved = list(self._obs)
+        try:
+            value = self.predict()
+            for _ in range(int(steps) - 1):
+                self._obs.append(value)
+                value = self.predict()
+            return value
+        finally:
+            self._obs.clear()
+            self._obs.extend(saved)
+
 
 class SeasonalPredictor:
     """Seasonal-trend decomposition forecast (the Prophet role): a linear
@@ -130,6 +162,9 @@ class SeasonalPredictor:
         self._t += 1
 
     def predict(self) -> float:
+        return self.predict_ahead(1)
+
+    def predict_ahead(self, steps: int = 1) -> float:
         y = np.asarray(self._obs, np.float64)
         n = y.size
         if n == 0:
@@ -150,9 +185,9 @@ class SeasonalPredictor:
             X[:, 2 + ph] = phases == ph
         coef, *_ = np.linalg.lstsq(X, y, rcond=None)
         x_next = np.zeros(m + 1)
-        x_next[0] = n
+        x_next[0] = n - 1 + steps
         x_next[1] = 1.0
-        next_phase = self._t % m
+        next_phase = (self._t - 1 + steps) % m
         if next_phase < m - 1:
             x_next[2 + next_phase] = 1.0
         return float(max(0.0, coef @ x_next))
@@ -168,3 +203,56 @@ def make_predictor(kind: str = "constant", **kwargs):
         "seasonal": SeasonalPredictor,
         "prophet": SeasonalPredictor,
     }[kind](**kwargs)
+
+
+def replay_trace(
+    source,
+    *,
+    kind: str = "seasonal",
+    field: str = "num_running",
+    bucket_s: float = 1.0,
+    agg: str = "mean",
+    **kwargs,
+):
+    """Fit a predictor offline from a flight-recorder trace.
+
+    ``source`` is a flight dump path (observability/flight.py JSONL) or an
+    iterable of already-loaded record dicts.  The trace's ``step`` records
+    are bucketed into a regular ``bucket_s`` series on the recorder's
+    monotonic clock — ``field`` per bucket, aggregated by ``agg``
+    ("mean" for level signals like num_running, "sum" for rate signals
+    like decode_tokens) — and replayed through ``make_predictor(kind)``.
+    Gaps hold the last level under "mean" and read zero under "sum".
+
+    Returns the fitted predictor, ready for ``predict_ahead()``."""
+    if isinstance(source, (str, Path)):
+        from dynamo_tpu.observability.flight import load_dump
+
+        _header, records = load_dump(source)
+    else:
+        records = list(source)
+    if agg not in ("mean", "sum"):
+        raise ValueError(f"agg must be mean|sum, got {agg!r}")
+    steps = [
+        r for r in records
+        if r.get("kind") == "step" and field in r and "t" in r
+    ]
+    if not steps:
+        raise ValueError(f"no step records carrying {field!r} in the trace")
+    if bucket_s <= 0:
+        raise ValueError("bucket_s must be > 0")
+    t0 = min(float(r["t"]) for r in steps)
+    buckets: dict[int, list[float]] = {}
+    for r in steps:
+        idx = int((float(r["t"]) - t0) / bucket_s)
+        buckets.setdefault(idx, []).append(float(r[field]))
+    predictor = make_predictor(kind, **kwargs)
+    level = 0.0
+    for i in range(max(buckets) + 1):
+        vals = buckets.get(i)
+        if vals:
+            level = sum(vals) if agg == "sum" else sum(vals) / len(vals)
+        elif agg == "sum":
+            level = 0.0
+        predictor.observe(level)
+    return predictor

@@ -187,6 +187,16 @@ class FaultRegistry:
         counters.incr("dyn_faults_injected_total")
         detail = "".join(f" {k}={v}" for k, v in attrs.items())
         logger.warning("injected fault at %s (#%d)%s", point, self.fired[point], detail)
+        try:
+            # flight recorder: injected faults are exactly the discrete
+            # events a post-mortem wants time-aligned with step telemetry.
+            # Lazy import (faults sits below observability in the graph).
+            from dynamo_tpu.observability import flight
+
+            for rec in flight.recorders():
+                rec.record_event("fault", point=point, fire=self.fired[point])
+        except Exception:  # noqa: BLE001 — never mask the injected fault
+            pass
         raise fire.exc_type(f"injected fault at {point} (#{self.fired[point]})")
 
 

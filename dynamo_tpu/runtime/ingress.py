@@ -16,6 +16,7 @@ import time
 import msgpack
 
 from dynamo_tpu.observability import get_recorder
+from dynamo_tpu.observability import flight as flight_obs
 from dynamo_tpu.observability.trace import read_trace
 from dynamo_tpu.robustness import counters
 from dynamo_tpu.robustness.faults import FAULTS, WORKER_GENERATE
@@ -234,6 +235,12 @@ class EndpointService:
         deadline = t0 + timeout_s
         self._draining = True
         counters.incr("dyn_drain_started_total")
+        # flight recorder: record the drain and snapshot the ring NOW —
+        # the worker is about to empty and the pre-drain window is the
+        # evidence an operator wants
+        flight_obs.dump_all_on_drain(
+            instance=f"{self.instance.instance_id:x}", in_flight=self._in_flight
+        )
         span = get_recorder().start(
             "engine.drain", None, component="worker",
             attrs={"subject": self.instance.subject,
@@ -302,6 +309,22 @@ class EndpointService:
                 op = json.loads(msg.payload.decode())
             except Exception:  # noqa: BLE001
                 logger.warning("malformed ctl message on %s", self.instance.subject)
+                continue
+            if op.get("op") == "flight_dump":
+                # on-demand flight dump (dynctl flight dump): write every
+                # live recorder's ring and reply with the paths
+                paths = flight_obs.dump_all("manual")
+                if msg.reply_to:
+                    await self.runtime.plane.bus.publish(
+                        msg.reply_to,
+                        json.dumps({
+                            "op": "flight_dump",
+                            "ok": True,
+                            "instance_id": f"{self.instance.instance_id:x}",
+                            "enabled": flight_obs.flight_enabled(),
+                            "paths": [str(p) for p in paths],
+                        }).encode(),
+                    )
                 continue
             if op.get("op") != "drain":
                 if msg.reply_to:

@@ -73,6 +73,18 @@ MIGRATE_SUBJECT = "_dyn.ctl.migrate"
 _HOP_COST = {"local": 0, "ici": 1, "": 2, "unknown": 2, "dcn": 3}
 
 
+def _flight_event(status: str, **fields) -> None:
+    """Mirror a migration state transition into every live flight recorder
+    (best-effort — the handoff must never fail on observability)."""
+    try:
+        from dynamo_tpu.observability import flight
+
+        for rec in flight.recorders():
+            rec.record_event("migration", status=status, **fields)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 class _PendingFlip:
     """A prepared destination stream waiting for the consumer loop to swap
     it in at the next item boundary.  ``outcome`` transitions exactly once
@@ -255,6 +267,8 @@ class MigrationCoordinator:
         handle.busy = True
         t0 = time.monotonic()
         counters.incr("dyn_migration_started_total")
+        _flight_event("started", request=request_id, src=f"{src:x}", dst=f"{dst:x}",
+                      reason=reason)
         span = get_recorder().start(
             "migrate", getattr(handle.ctx, "trace", None), component="frontend",
             attrs={"request": request_id, "src": f"{src:x}", "dst": f"{dst:x}",
@@ -307,6 +321,7 @@ class MigrationCoordinator:
                 # frame), the client-visible source stream is untouched
                 await dst_raw.send_control("kill")
             counters.incr("dyn_migration_aborted_total")
+            _flight_event("aborted", request=request_id, error=repr(exc))
             if span is not None:
                 span.end(status="error", error=repr(exc))
             logger.warning(
@@ -322,6 +337,7 @@ class MigrationCoordinator:
             handle.busy = False
         hidden = time.monotonic() - t0
         counters.incr("dyn_migration_committed_total")
+        _flight_event("committed", request=request_id, hidden_s=round(hidden, 4))
         counters.incr("dyn_migration_hidden_seconds", hidden)
         if span is not None:
             span.end(hidden_s=round(hidden, 4))

@@ -40,7 +40,7 @@ logger = get_logger("scenarios.fleet")
 @dataclass
 class _Worker:
     pool: str
-    engine: MockerEngine
+    engine: object                  # MockerEngine or JaxLlmEngine
     service: object
     kv_pub: KvEventPublisher
     metrics_pub: WorkerMetricsPublisher
@@ -77,6 +77,9 @@ class SoakFleet:
     near_slice: str = ""
     selection_counts: dict = field(default_factory=dict)  # worker_id → picks
     _spawned: dict = field(default_factory=dict)   # pool → spawn counter
+    # jax engine mode: one host param init shared by every worker (engines
+    # never mutate params, and N random inits would dominate bring-up)
+    _params_cache: dict = field(default_factory=dict)
     _slice_by_worker: dict = field(default_factory=dict)  # survives retirement
     _hit_sub: object = None
     _hit_task: object = None
@@ -228,22 +231,59 @@ class SoakFleet:
             **overrides,
         )
 
+    def _jax_engine(self):
+        """A real JaxLlmEngine worker (FleetSpec.engine='jax'): the actual
+        model/scheduler/allocator hot path behind the same endpoint surface
+        the mocker serves, so one scenario spec drives either."""
+        import jax as _jax
+
+        from dynamo_tpu.engine import EngineConfig, JaxLlmEngine
+        from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+        fl = self.spec.fleet
+        mcfg = LlamaConfig.tiny()
+        if "params" not in self._params_cache:
+            self._params_cache["params"] = init_params(
+                mcfg, _jax.random.PRNGKey(0)
+            )
+        # bucket ladder sized to the context window (routed_fleet idiom):
+        # every serving program is warmed before traffic, so fewer buckets
+        # = faster bring-up, and the top bucket covers max_model_len
+        buckets = tuple(
+            b for b in (128, 256, 512, 1024, 2048) if b < fl.max_model_len
+        ) + (fl.max_model_len,)
+        return JaxLlmEngine(
+            EngineConfig(
+                model=mcfg,
+                num_blocks=fl.num_blocks,
+                block_size=fl.block_size,
+                max_batch_size=fl.max_batch_size,
+                prefill_buckets=buckets,
+                max_model_len=fl.max_model_len,
+            ),
+            params=self._params_cache["params"],
+        )
+
     async def _spawn(self, pool: str) -> _Worker:
         fl = self.spec.fleet
-        cfg = self._mocker_config(pool)
         slice_label = ""
         labels = fl.slices.get(pool) or []
         if labels:
             slice_label = labels[self._spawned.get(pool, 0) % len(labels)]
-            # mocker-side per-pair latency: a worker off the prefill slice
-            # pays the DCN-class transfer bill on every prefill
-            far = bool(self.near_slice) and slice_label != self.near_slice
-            hop = "dcn" if far else "local"
-            cfg.transfer_delay_s = float(
-                fl.link_delay_s.get(hop, cfg.transfer_delay_s)
-            )
         self._spawned[pool] = self._spawned.get(pool, 0) + 1
-        engine = MockerEngine(cfg)
+        if fl.engine == "jax":
+            engine = self._jax_engine()
+        else:
+            cfg = self._mocker_config(pool)
+            if slice_label:
+                # mocker-side per-pair latency: a worker off the prefill
+                # slice pays the DCN-class transfer bill on every prefill
+                far = bool(self.near_slice) and slice_label != self.near_slice
+                hop = "dcn" if far else "local"
+                cfg.transfer_delay_s = float(
+                    fl.link_delay_s.get(hop, cfg.transfer_delay_s)
+                )
+            engine = MockerEngine(cfg)
         service = await self.ep.serve(
             engine, stats_handler=engine.stats,
             topo_role=pool, topo_slice=slice_label or None,
@@ -258,6 +298,11 @@ class SoakFleet:
         )
         metrics_pub.start()
         engine.start()
+        if fl.engine == "jax":
+            # compile every serving program before traffic: lazy compiles
+            # mid-phase would dominate TTFT and fail the SLO assertions
+            # for reasons that have nothing to do with the system under test
+            await engine.warmup()
         return _Worker(pool, engine, service, kv_pub, metrics_pub, slice_label)
 
     async def _retire(self, worker: _Worker) -> None:

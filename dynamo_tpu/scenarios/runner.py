@@ -34,6 +34,7 @@ from dynamo_tpu.llm.protocols.common import (
     PreprocessedRequest,
     StopConditions,
 )
+from dynamo_tpu.observability import flight as flight_obs
 from dynamo_tpu.observability.slo import SloConfig, SloObjective, SloTracker
 from dynamo_tpu.planner import (
     DefragConfig,
@@ -207,13 +208,21 @@ class ScenarioRunner:
                         history.extend(ann.data.token_ids)
                 stats.completed += 1
                 if spec.verify_outputs:
-                    # the mocker's greedy chain is fully determined by the
-                    # prompt's last token — so the reference an unmigrated
-                    # run would stream is computable without running it, and
-                    # any resume/migration replay or drop shows up here
-                    last = tokens[-1] if tokens else -1
-                    expected = [(last + 1 + i) % 1000 for i in range(osl)]
-                    if got == expected:
+                    if spec.fleet.engine == "mocker":
+                        # the mocker's greedy chain is fully determined by
+                        # the prompt's last token — so the reference an
+                        # unmigrated run would stream is computable without
+                        # running it, and any resume/migration replay or
+                        # drop shows up here
+                        last = tokens[-1] if tokens else -1
+                        expected = [(last + 1 + i) % 1000 for i in range(osl)]
+                    else:
+                        # real engines sample real logits: the strongest
+                        # engine-agnostic invariant is the token COUNT the
+                        # stop conditions demand (ignore_eos + max_tokens)
+                        expected = None
+                    if (got == expected if expected is not None
+                            else len(got) == osl):
                         stats.verified += 1
                     else:
                         stats.corrupt += 1
@@ -592,6 +601,7 @@ class ScenarioRunner:
     async def run(self) -> dict:
         spec = self.spec
         FAULTS.reset()
+        flight_dumps: list[str] = []
         wall_start = time.monotonic()
         self._t0_wall = wall_start
         self.fleet = SoakFleet(
@@ -658,6 +668,10 @@ class ScenarioRunner:
                 logger.info("phase %s starting at sim t=%.1fs",
                             phase.name, self.sim_now())
                 phases.append(await self._run_phase(phase))
+            # close the observability loop before teardown: every live
+            # engine's flight ring becomes a JSONL artifact the planner's
+            # replay_trace() can fit predictors from
+            flight_dumps = [str(p) for p in flight_obs.dump_all("soak_end")]
         finally:
             FAULTS.reset()
             if self.fleet is not None:
@@ -695,6 +709,10 @@ class ScenarioRunner:
                 ),
             },
             "slo": self.slo.status(self.sim_now()),
+            "flight": {
+                "enabled": flight_obs.flight_enabled(),
+                "dumps": flight_dumps,
+            },
             "ticks": self.ticks,
             "dyn_top_snapshots": self.top_snapshots,
             "passed": passed,

@@ -59,8 +59,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.seed is not None:
         spec.seed = args.seed
 
+    from dynamo_tpu.bench.perfgate import provenance_stamp
+
     artifact = asyncio.run(run_scenario(spec))
     artifact["generated_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # shared provenance header: lets scripts/perfgate.py refuse to diff
+    # artifacts from an incompatible schema generation
+    artifact["provenance"] = provenance_stamp()
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
         f.write("\n")

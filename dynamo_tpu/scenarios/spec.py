@@ -193,7 +193,7 @@ class SloSpec:
 
 @dataclass
 class FleetSpec:
-    """The mocker fleet under test: named pools served on one endpoint."""
+    """The fleet under test: named pools served on one endpoint."""
 
     pools: dict = field(default_factory=lambda: {"prefill": 1, "decode": 1})
     policy: str = "kv"               # "kv" (KV-affine) or "random"
@@ -202,6 +202,15 @@ class FleetSpec:
     max_batch_size: int = 8
     metrics_period_s: float = 0.25   # simulated seconds
     mocker: dict = field(default_factory=dict)   # MockerConfig overrides
+    # "mocker" (cost-model sim — how scenarios usually run) or "jax": REAL
+    # JaxLlmEngine workers stepping the actual model/scheduler/allocator
+    # hot path.  jax mode requires the scenario's speedup to be 1.0 — real
+    # engines serve in real time, so compressed arrivals would soak the
+    # queue, not the system (same rule as bench.routed_fleet.FleetConfig).
+    engine: str = "mocker"
+    # jax mode: engine context window; size it to the workload's longest
+    # prompt+generation (bucket ladder tops out here)
+    max_model_len: int = 512
     # emulated multi-slice placement: pool → list of slice labels assigned
     # round-robin to that pool's workers (published as TopologyCards, so the
     # fleet's KV router discovers the link classes).  Empty = single slice
@@ -215,6 +224,10 @@ class FleetSpec:
     def validate(self) -> None:
         if self.policy not in ("kv", "random"):
             raise ValueError(f"fleet policy must be kv|random, got {self.policy!r}")
+        if self.engine not in ("mocker", "jax"):
+            raise ValueError(
+                f"fleet engine must be mocker|jax, got {self.engine!r}"
+            )
         if not self.pools or any(n < 0 for n in self.pools.values()):
             raise ValueError("fleet pools must map name → replicas >= 0")
         if any(not labels for labels in self.slices.values()):
@@ -288,6 +301,12 @@ class ScenarioSpec:
         if self.speedup <= 0 or self.tick_s <= 0:
             raise ValueError("speedup and tick_s must be > 0")
         self.fleet.validate()
+        if self.fleet.engine == "jax" and self.speedup != 1.0:
+            raise ValueError(
+                "fleet.engine='jax' requires speedup=1.0: real engines serve "
+                "in real time, so compressed arrivals measure queue depth "
+                "instead of the system under test"
+            )
         names = [p.name for p in self.phases]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate phase names: {names}")

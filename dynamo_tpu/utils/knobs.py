@@ -199,6 +199,38 @@ K_SLO_SHED_BURN = register(
     doc="burn rate above which a saturated admission gate sheds (0 = off)",
     section=OBS)
 
+# -- perf flight recorder (docs/observability.md) ----------------------------
+K_FLIGHT = register(
+    "DYN_FLIGHT", type="bool", default=True,
+    doc="always-on perf flight recorder; `0` is bookkeeping-free (no ring, "
+        "no per-step allocations)", section=OBS)
+K_FLIGHT_BUFFER_BYTES = register(
+    "DYN_FLIGHT_BUFFER_BYTES", type="int", default=262144,
+    doc="byte budget of the flight-recorder ring (oldest records evicted "
+        "when a new record would exceed it)", section=OBS)
+K_FLIGHT_DIR = register(
+    "DYN_FLIGHT_DIR", type="str", default=None,
+    doc="directory flight dumps are written to (default "
+        "`$DYN_CACHE_DIR/flight` or `~/.cache/dynamo_tpu/flight`)", section=OBS)
+K_FLIGHT_BURN = register(
+    "DYN_FLIGHT_BURN", type="float", default=10.0,
+    doc="worst-window SLO burn rate above which the recorder auto-dumps "
+        "(0 = never dump on burn)", section=OBS)
+
+# -- perf regression gate (docs/observability.md) ----------------------------
+K_PERFGATE_BASELINE = register(
+    "DYN_PERFGATE_BASELINE", type="str", default=None,
+    doc="explicit PERF_BASELINE.json path for scripts/perfgate.py (default: "
+        "the repo-root artifact)", section=OBS)
+K_PERFGATE_GIT_DESCRIBE = register(
+    "DYN_PERFGATE_GIT_DESCRIBE", type="str", default=None,
+    doc="git describe string CI stamps into artifact provenance headers",
+    section=OBS)
+K_PERFGATE_HOST_CLASS = register(
+    "DYN_PERFGATE_HOST_CLASS", type="str", default=None,
+    doc="host-class label stamped into artifact provenance (default: the "
+        "JAX default backend, `unknown` without JAX)", section=OBS)
+
 # -- engine / kernels (docs/performance.md) ----------------------------------
 K_DECODE_OVERLAP = register(
     "DYN_DECODE_OVERLAP", type="bool", default=None,

@@ -16,12 +16,25 @@ from dynamo_tpu.utils.logging import get_logger
 logger = get_logger("utils.tasks")
 
 
+def _notify_flight(name: str, exc: BaseException) -> None:
+    """Dump the perf flight recorders on a task crash.  Lazy import (tasks
+    is near the bottom of the import graph) and best-effort: a crash report
+    must never mask the original failure."""
+    try:
+        from dynamo_tpu.observability import flight
+
+        flight.on_task_crash(name, exc)
+    except Exception:  # noqa: BLE001
+        logger.debug("flight crash dump failed", exc_info=True)
+
+
 def _log_if_failed(task: asyncio.Task) -> None:
     if task.cancelled():
         return
     exc = task.exception()
     if exc is not None:
         logger.error("background task %s crashed: %r", task.get_name(), exc)
+        _notify_flight(task.get_name(), exc)
 
 
 def spawn_logged(coro: Coroutine, *, name: str | None = None) -> asyncio.Task:
@@ -77,10 +90,12 @@ class CriticalTaskGroup:
         name = task.get_name()
         if getattr(task, "_dyn_critical", False):
             logger.error("critical task %s failed: %r", name, exc)
+            _notify_flight(name, exc)
             if self._on_failure is not None:
                 self._on_failure(exc)
         else:
             logger.warning("background task %s failed: %r", name, exc)
+            _notify_flight(name, exc)
 
     async def cancel_all(self) -> None:
         tasks = list(self._tasks)

@@ -67,6 +67,17 @@ SLO_FAMILIES = (
     "dyn_slo_threshold_seconds",
 )
 
+# perf flight recorder (dynamo_tpu/observability/flight.py): ring-buffer
+# accounting rendered on BOTH surfaces — aggregated text families on the
+# frontend (flight.render()) and per-worker gauges on the metrics service.
+# Always declared — zeros until a recorder goes live.
+FLIGHT_FAMILIES = (
+    "dyn_flight_records_total",
+    "dyn_flight_dropped_total",
+    "dyn_flight_dumps_total",
+    "dyn_flight_buffer_bytes",
+)
+
 # fleet topology plane (dynamo_tpu/topology/): map shape + link measurements,
 # rendered on BOTH surfaces (frontend text helper + metrics-service registry).
 # Always declared — zeros until topology cards are published.
@@ -87,7 +98,7 @@ FRONTEND_FAMILIES = (
     "dyn_llm_http_service_inter_token_latency_seconds",
     "dyn_llm_http_service_input_sequence_tokens",
     "dyn_llm_http_service_output_sequence_tokens",
-) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + SLO_FAMILIES + TOPOLOGY_FAMILIES
+) + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + SLO_FAMILIES + TOPOLOGY_FAMILIES + FLIGHT_FAMILIES
 
 # utilization accounting (dynamo_tpu/observability/perf.py → engine stats →
 # ForwardPassMetrics → metrics service)
@@ -162,9 +173,11 @@ WORKER_FAMILIES = (
     "dyn_worker_spec_accepted_tokens",
     "dyn_worker_kv_hit_blocks_total",
     "dyn_worker_kv_isl_blocks_total",
-) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES + TOPOLOGY_FAMILIES + (
-    # worker-surface-only: per-worker placement facts for dyn_top
+) + UNIFIED_FAMILIES + UTILIZATION_FAMILIES + RESILIENCE_FAMILIES + RESUME_DRAIN_FAMILIES + MIGRATION_FAMILIES + PREFETCH_FAMILIES + PLANNER_FAMILIES + DISAGG_FAMILIES + TOPOLOGY_FAMILIES + FLIGHT_FAMILIES + (
+    # worker-surface-only: per-worker placement facts for dyn_top, plus the
+    # latest flight-dump reason per worker (info-gauge, value 1)
     "dyn_topology_worker_info",
+    "dyn_flight_last_dump_info",
 )
 
 _HELP_RE = re.compile(r"^# (?:HELP|TYPE) (\S+)", re.MULTILINE)

@@ -17,16 +17,22 @@ Either base URL may be omitted to watch one surface.  ``--once`` renders a
 single frame and exits; ``--json`` emits the snapshot as JSON instead of a
 table (``--once --json`` is the machine mode used by tier-1 tests and
 benches).  stdlib only — usable on any node that can reach the endpoints.
+
+``--flight`` switches to the flight-recorder tail: print the newest
+``flight-*.jsonl`` dump (``--once``) or follow new dumps as they land
+(default).  ``--flight-dir`` overrides the dump directory.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.request
+from pathlib import Path
 
 _METRIC_LINE_HEAD = ("#",)
 
@@ -54,6 +60,10 @@ WORKER_FIELDS = {
     "dyn_disagg_remote_prefills_total": "disagg_remote_prefills",
     "dyn_disagg_kv_transfer_parts_total": "disagg_kv_transfer_parts",
     "dyn_disagg_transfer_hidden_ratio": "disagg_transfer_hidden_ratio",
+    "dyn_flight_records_total": "flight_records",
+    "dyn_flight_dropped_total": "flight_dropped",
+    "dyn_flight_dumps_total": "flight_dumps",
+    "dyn_flight_buffer_bytes": "flight_buffer_bytes",
 }
 
 # offload-tier occupancy gauges carry a second label (tier) and nest under
@@ -74,6 +84,10 @@ PLANNER_FIELDS = {
 # topology-plane placement info (value always 1; the facts ride as labels):
 # slice label + inbound hop class per worker → the SLICE/HOP column
 TOPOLOGY_INFO_FAMILY = "dyn_topology_worker_info"
+
+# flight-recorder last-dump info (value always 1; the reason rides as a
+# label) → the FLIGHT column's dump annotation
+FLIGHT_INFO_FAMILY = "dyn_flight_last_dump_info"
 
 
 def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
@@ -140,6 +154,10 @@ def collect_snapshot(
                 row = workers.setdefault(labels["worker"], {})
                 row["slice"] = labels.get("slice", "-")
                 row["hop"] = labels.get("hop", "-")
+                continue
+            if name == FLIGHT_INFO_FAMILY:
+                row = workers.setdefault(labels["worker"], {})
+                row["flight_last_dump_reason"] = labels.get("reason", "-")
                 continue
             tier_key = TIER_FIELDS.get(name)
             if tier_key is not None and "tier" in labels:
@@ -213,6 +231,91 @@ def collect_snapshot(
     return snap
 
 
+# -- flight-dump tailing -----------------------------------------------------
+def flight_dump_dir(override: str | None = None) -> Path:
+    """Where the flight recorder writes its JSONL dumps.  Mirrors
+    dynamo_tpu.observability.flight.flight_dir() — duplicated so dyn_top
+    stays stdlib-only and usable on nodes without the package installed."""
+    if override:
+        return Path(override)
+    env = os.environ.get("DYN_FLIGHT_DIR")  # dynlint: disable=knob-registry -- stdlib-only tool, no package import
+    if env:
+        return Path(env)
+    cache = os.environ.get("DYN_CACHE_DIR")  # dynlint: disable=knob-registry -- stdlib-only tool, no package import
+    if cache:
+        return Path(cache) / "flight"
+    return Path.home() / ".cache" / "dynamo_tpu" / "flight"
+
+
+def latest_flight_dump(directory: Path) -> Path | None:
+    dumps = sorted(
+        directory.glob("flight-*.jsonl"),
+        key=lambda p: p.stat().st_mtime,
+    )
+    return dumps[-1] if dumps else None
+
+
+def format_flight_record(rec: dict) -> str:
+    """One human line per flight record: monotonic timestamp, kind, and the
+    remaining fields as k=v in recorded order."""
+    t = rec.get("t")
+    head = f"{t:12.3f}" if isinstance(t, (int, float)) else f"{'-':>12}"
+    kind = str(rec.get("kind", "?"))
+    if kind == "event":
+        kind = f"event:{rec.get('event', '?')}"
+    body = " ".join(
+        f"{k}={v}" for k, v in rec.items()
+        if k not in ("t", "kind", "event", "schema_version")
+    )
+    return f"{head}  {kind:<22} {body}"
+
+
+def tail_flight(
+    directory: Path, follow: bool, interval: float, as_json: bool
+) -> int:
+    """Print the newest flight dump; with ``follow``, keep polling for a
+    newer dump file and print its records as they land (``tail -F`` across
+    dump generations)."""
+    current: Path | None = None
+    printed = 0
+    while True:
+        newest = latest_flight_dump(directory)
+        if newest is None:
+            if not follow:
+                print(f"no flight dumps under {directory}")
+                return 1
+        else:
+            if newest != current:
+                current, printed = newest, 0
+                if not as_json:
+                    print(f"== {current}")
+            lines = current.read_text().splitlines()
+            for line in lines[printed:]:
+                if not line.strip():
+                    continue
+                if as_json:
+                    print(line)
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    print(line)
+                    continue
+                if "records" in rec and "kind" not in rec:
+                    print(
+                        f"# dump source={rec.get('source')} "
+                        f"reason={rec.get('reason')} records={rec.get('records')} "
+                        f"at={rec.get('dumped_at')}"
+                    )
+                else:
+                    print(format_flight_record(rec))
+            printed = len(lines)
+        if not follow:
+            return 0
+        sys.stdout.flush()
+        time.sleep(interval)
+
+
 # -- rendering ---------------------------------------------------------------
 def _pct(value: float | None) -> str:
     return "-" if value is None else f"{100.0 * value:5.1f}%"
@@ -241,7 +344,7 @@ def render_table(snap: dict) -> str:
             f"{'GOODPUT/s':>10} "
             f"{'KV':>7} {'OCC':>7} {'RUN':>5} {'WAIT':>5} {'PREEMPT':>8} "
             f"{'WASTED':>8} {'PF-HIT':>7} {'UNI':>6} {'DRAIN':>6} "
-            f"{'XFER-HID':>8}"
+            f"{'XFER-HID':>8} {'FLIGHT':>8}"
         )
         for wid in sorted(workers):
             r = workers[wid]
@@ -260,8 +363,17 @@ def render_table(snap: dict) -> str:
                 f"{_pct(r.get('prefetch_hit_ratio')):>7} "
                 f"{_num(r.get('unified_windows'), 6)} "
                 f"{_num(r.get('admission_drains'), 6)} "
-                f"{_pct(r.get('disagg_transfer_hidden_ratio') if r.get('disagg_remote_prefills') else None):>8}"
+                f"{_pct(r.get('disagg_transfer_hidden_ratio') if r.get('disagg_remote_prefills') else None):>8} "
+                f"{_num(r.get('flight_records'), 8)}"
             )
+            if r.get("flight_dumps") or r.get("flight_dropped"):
+                lines.append(
+                    "  " + " " * 10 + " flight: "
+                    f"dumps={r.get('flight_dumps', 0):g} "
+                    f"last={r.get('flight_last_dump_reason', '-')} "
+                    f"buf={_num(r.get('flight_buffer_bytes'), 1).strip()}B "
+                    f"dropped={r.get('flight_dropped', 0):g}"
+                )
             tiers = r.get("offload_tiers") or {}
             if tiers:
                 cells = []
@@ -341,7 +453,20 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--once", action="store_true", help="one frame, then exit")
     parser.add_argument("--json", action="store_true", help="emit JSON snapshots")
     parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--flight", action="store_true",
+                        help="tail the newest flight-recorder dump instead "
+                             "of polling /metrics (local files, no URLs)")
+    parser.add_argument("--flight-dir", default=None,
+                        help="flight dump directory (default: DYN_FLIGHT_DIR "
+                             "/ DYN_CACHE_DIR/flight / ~/.cache/dynamo_tpu/flight)")
     args = parser.parse_args(argv)
+    if args.flight:
+        return tail_flight(
+            flight_dump_dir(args.flight_dir),
+            follow=not args.once,
+            interval=args.interval,
+            as_json=args.json,
+        )
     if not args.frontend and not args.worker:
         parser.error("give --frontend and/or --worker")
 

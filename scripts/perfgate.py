@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Perf regression gate CLI over the committed benchmark artifacts.
+
+The dynlint model, applied to performance: ``PERF_BASELINE.json`` commits
+the accepted value of every headline metric the artifact pile carries
+(schema: ``dynamo_tpu/bench/perfgate.py``); this gate fails on a NEW
+regression (metric degraded beyond its tolerance band) and on a STALE
+baseline entry (metric no longer extractable), so the baseline can only
+ever be moved deliberately.
+
+Usage::
+
+    python scripts/perfgate.py                 # check (tier-1 runs this too)
+    python scripts/perfgate.py --json          # machine-readable findings
+    python scripts/perfgate.py --write-baseline  # re-record after a
+                                                 # LEGITIMATE perf change
+
+``--write-baseline`` refuses to run while any artifact has uncommitted
+modifications — a baseline recorded over a dirty pile would launder
+unreviewed numbers into the ratchet.  Commit (or revert) the artifacts
+first; see docs/autopilot.md for the rebaseline process.
+
+Exit code 0 = gate passes; 1 = findings (printed one per line).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from dynamo_tpu.bench import perfgate  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--root", default=str(REPO_ROOT),
+                        help="directory holding the artifact pile "
+                             "(default: the repo root)")
+    parser.add_argument("--baseline", default=None,
+                        help="explicit PERF_BASELINE.json path (default: "
+                             "DYN_PERFGATE_BASELINE or <root>/PERF_BASELINE.json)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="re-record the baseline from the current pile "
+                             "(refuses over a dirty artifact set)")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root)
+    if args.write_baseline:
+        dirty = perfgate.dirty_artifacts(root)
+        if dirty:
+            print(
+                "refusing --write-baseline: uncommitted artifact changes in "
+                + ", ".join(dirty)
+            )
+            print("commit (or revert) the artifacts first, then re-record.")
+            return 1
+        try:
+            out = perfgate.write_baseline(root, args.baseline)
+        except ValueError as exc:
+            print(exc)
+            return 1
+        print(f"baseline written to {out}")
+        return 0
+
+    baseline_file = (
+        Path(args.baseline) if args.baseline else perfgate.baseline_path(root)
+    )
+    try:
+        baseline = perfgate.load_baseline(baseline_file)
+    except (OSError, ValueError) as exc:
+        print(f"cannot load baseline {baseline_file}: {exc}")
+        print("record one with: python scripts/perfgate.py --write-baseline")
+        return 1
+    findings = perfgate.check(root, baseline)
+    if args.json:
+        print(json.dumps(
+            [{"kind": f.kind, "metric": f.metric, "detail": f.detail}
+             for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f)
+        if not findings:
+            values, _ = perfgate.extract_metrics(root)
+            print(f"perf gate ok ({len(values)} metrics within band)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -502,6 +502,11 @@ def main() -> int:
                         help="also write the JSON result to this path")
     args = parser.parse_args()
     rc, result = asyncio.run(amain(args))
+    # shared provenance header (dynamo_tpu/bench/perfgate.py): lets the perf
+    # gate refuse to diff artifacts from an incompatible schema generation
+    from dynamo_tpu.bench.perfgate import provenance_stamp
+
+    result["provenance"] = provenance_stamp()
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2)

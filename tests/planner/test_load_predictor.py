@@ -145,3 +145,126 @@ def test_predictors_share_the_observe_predict_protocol():
         assert p.predict() == 0.0       # empty → no load
         p.observe(5.0)
         assert p.predict() == pytest.approx(5.0)
+
+
+# -- multi-step forecasts ----------------------------------------------------
+
+def test_every_predictor_answers_predict_ahead():
+    for kind in ("constant", "ewma", "linear", "ar", "seasonal"):
+        p = make_predictor(kind)
+        p.observe(5.0)
+        assert p.predict_ahead(1) == pytest.approx(p.predict())
+        assert p.predict_ahead(4) >= 0.0
+
+
+def test_linear_trend_extrapolates_multiple_steps():
+    p = LinearTrendPredictor(window=8)
+    for v in range(8):                    # a clean unit-slope ramp
+        p.observe(float(v))
+    assert p.predict() == pytest.approx(8.0)
+    assert p.predict_ahead(3) == pytest.approx(10.0)
+
+
+def test_ar_predict_ahead_is_side_effect_free():
+    p = ArPredictor(p=3, d=1)
+    series = _diurnal(48)
+    for v in series:
+        p.observe(v)
+    before = list(p._obs)
+    p.predict_ahead(6)
+    assert list(p._obs) == before
+
+
+def test_seasonal_predict_ahead_sees_one_period_out():
+    period = 12
+    p = SeasonalPredictor(period=period)
+    series = _diurnal(6 * period, period=period)
+    for v in series:
+        p.observe(v)
+    # a full period ahead lands on the same phase as one step ahead
+    assert p.predict_ahead(1 + period) == pytest.approx(
+        p.predict_ahead(1), abs=1.0
+    )
+    # after observing t=0..71 the next index is 72 (phase 0); the crest
+    # phase (t=75, sin=+1 → 35) and the trough phase (t=81, sin=-1 → 5)
+    # are both visible at their horizons
+    assert p.predict_ahead(4) == pytest.approx(35.0, abs=1.0)
+    assert p.predict_ahead(10) == pytest.approx(5.0, abs=1.0)
+
+
+# -- replay_trace: flight dump → fitted predictor ----------------------------
+
+def _record_diurnal_trace(tmp_path, *, period_s: float = 12.0,
+                          stop_t: int = 82):
+    """A flight recorder fed a synthetic diurnal load at 1 Hz on an
+    explicit clock, dumped to JSONL — the offline trace replay_trace eats."""
+    from dynamo_tpu.observability.flight import FlightRecorder
+
+    clock_t = [0.0]
+    rec = FlightRecorder(source="soak", capacity_bytes=1 << 20, enabled=True,
+                         clock=lambda: clock_t[0])
+    for t in range(stop_t):
+        clock_t[0] = float(t)
+        load = 20.0 + 15.0 * math.sin(2 * math.pi * t / period_s)
+        rec.record_step(iteration=t, num_running=load,
+                        decode_tokens=load * 4.0)
+    # discrete events interleave with steps and must not pollute the series
+    rec.record_event("preemption", victim="r-1")
+    return rec.dump("soak_end", path=tmp_path / "flight-soak-test.jsonl")
+
+
+def test_replay_trace_fits_seasonal_with_lead_time_over_reactive(tmp_path):
+    """The closed soak loop: a flight dump from a diurnal soak fits a
+    seasonal predictor that forecasts the NEXT crest steps before it
+    happens, while the reactive last-value baseline only ever reports the
+    current trough — zero lead time."""
+    from dynamo_tpu.planner.load_predictor import replay_trace
+
+    period = 12
+    # the trace stops at t=81, phase 9: a trough (sin=-1 at phase 9);
+    # the next crest (sin=+1, load 35) is 6 steps out at t=87
+    path = _record_diurnal_trace(tmp_path, period_s=float(period), stop_t=82)
+
+    fitted = replay_trace(path, kind="seasonal", period=period,
+                          field="num_running", bucket_s=1.0)
+    reactive = replay_trace(path, kind="constant", field="num_running",
+                            bucket_s=1.0)
+
+    crest_threshold = 30.0   # scale-up trigger: well above base load 20
+    steps_to_crest = 6
+
+    # the fitted predictor forecasts the crest value at the crest's phase
+    assert fitted.predict_ahead(steps_to_crest) == pytest.approx(35.0, abs=2.0)
+    # and crosses the scale-up threshold BEFORE the crest arrives: positive
+    # lead time for the planner to pre-position capacity
+    lead_horizons = [
+        h for h in range(1, steps_to_crest + 1)
+        if fitted.predict_ahead(h) >= crest_threshold
+    ]
+    assert lead_horizons, "seasonal fit never anticipated the crest"
+    # the reactive baseline sits at the trough at EVERY horizon — it cannot
+    # see the crest until it is already in it
+    for h in range(1, steps_to_crest + 1):
+        assert reactive.predict_ahead(h) < crest_threshold
+    assert reactive.predict_ahead(steps_to_crest) == pytest.approx(5.0, abs=2.0)
+
+
+def test_replay_trace_from_records_sum_agg_and_errors(tmp_path):
+    from dynamo_tpu.planner.load_predictor import replay_trace
+
+    # in-memory records (no file), rate signal summed per bucket
+    records = [
+        {"kind": "step", "t": 0.2, "decode_tokens": 3.0},
+        {"kind": "step", "t": 0.7, "decode_tokens": 4.0},
+        {"kind": "event", "t": 0.9, "event": "preemption"},
+        {"kind": "step", "t": 2.1, "decode_tokens": 5.0},  # bucket 1 is a gap
+    ]
+    p = replay_trace(records, kind="constant", field="decode_tokens",
+                     bucket_s=1.0, agg="sum")
+    assert p.predict() == pytest.approx(5.0)
+
+    with pytest.raises(ValueError, match="no step records"):
+        replay_trace([{"kind": "event", "t": 0.0, "event": "drain"}],
+                     field="num_running")
+    with pytest.raises(ValueError, match="agg"):
+        replay_trace(records, field="decode_tokens", agg="median")
